@@ -1,0 +1,113 @@
+"""Provider-agnostic accelerator abstraction.
+
+The reference hard-wires one provider into its context
+(`/root/reference/src/api/IntelGpuDataContext.tsx`); the BASELINE
+north-star lifts that into an AcceleratorDataContext where TPU and Intel
+GPU coexist and degrade independently. This module is the pure core of
+that abstraction: a Provider describes how to detect its nodes/pods and
+count devices; ``classify_fleet`` partitions one cluster snapshot into
+per-provider views in a single pass.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Mapping
+
+from . import intel, objects, tpu
+
+
+@dataclass(frozen=True)
+class Provider:
+    """One accelerator family. ``device_unit`` is the display word for a
+    schedulable device ('chip' for TPU, 'device' for Intel GPU)."""
+
+    name: str
+    display_name: str
+    device_unit: str
+    is_accel_node: Callable[[Any], bool]
+    is_accel_pod: Callable[[Any], bool]
+    is_plugin_pod: Callable[[Any], bool]
+    node_device_capacity: Callable[[Any], int]
+    node_device_allocatable: Callable[[Any], int]
+    pod_device_request: Callable[[Any], int]
+
+
+TPU_PROVIDER = Provider(
+    name="tpu",
+    display_name="Cloud TPU",
+    device_unit="chip",
+    is_accel_node=tpu.is_tpu_node,
+    is_accel_pod=tpu.is_tpu_requesting_pod,
+    is_plugin_pod=tpu.is_tpu_plugin_pod,
+    node_device_capacity=tpu.get_node_chip_capacity,
+    node_device_allocatable=tpu.get_node_chip_allocatable,
+    pod_device_request=tpu.get_pod_chip_request,
+)
+
+INTEL_PROVIDER = Provider(
+    name="intel",
+    display_name="Intel GPU",
+    device_unit="device",
+    is_accel_node=intel.is_intel_gpu_node,
+    is_accel_pod=intel.is_gpu_requesting_pod,
+    is_plugin_pod=intel.is_intel_plugin_pod,
+    node_device_capacity=intel.get_node_gpu_count,
+    node_device_allocatable=intel.get_node_gpu_allocatable,
+    pod_device_request=intel.get_pod_device_request,
+)
+
+#: Registration order = sidebar/priority order. TPU first by design.
+PROVIDERS: tuple[Provider, ...] = (TPU_PROVIDER, INTEL_PROVIDER)
+
+
+@dataclass
+class FleetView:
+    """One provider's slice of a cluster snapshot."""
+
+    provider: Provider
+    nodes: list[Any] = field(default_factory=list)
+    pods: list[Any] = field(default_factory=list)
+    plugin_pods: list[Any] = field(default_factory=list)
+
+    @property
+    def plugin_installed(self) -> bool:
+        """Plugin presence = daemon pods seen OR devices advertised. The
+        TPU side has no operator CRD, so — per the reference's own
+        CRD-absent fallback (ADR-003) — allocatable devices are accepted
+        as installation evidence."""
+        if self.plugin_pods:
+            return True
+        return any(self.provider.node_device_allocatable(n) > 0 for n in self.nodes)
+
+    def allocation_summary(self) -> Mapping[str, int]:
+        return objects.allocation_summary(
+            self.nodes,
+            self.pods,
+            self.provider.node_device_capacity,
+            self.provider.node_device_allocatable,
+            self.provider.pod_device_request,
+        )
+
+
+def classify_fleet(
+    nodes: Iterable[Any],
+    pods: Iterable[Any],
+    providers: tuple[Provider, ...] = PROVIDERS,
+) -> dict[str, FleetView]:
+    """Partition a cluster snapshot into per-provider views in one pass
+    over nodes and one over pods (a node or pod can belong to several
+    providers only in pathological fixtures; each provider applies its own
+    guard independently, so nothing is double-hidden)."""
+    views = {p.name: FleetView(provider=p) for p in providers}
+    for n in nodes:
+        for p in providers:
+            if p.is_accel_node(n):
+                views[p.name].nodes.append(n)
+    for pod in pods:
+        for p in providers:
+            if p.is_accel_pod(pod):
+                views[p.name].pods.append(pod)
+            if p.is_plugin_pod(pod):
+                views[p.name].plugin_pods.append(pod)
+    return views

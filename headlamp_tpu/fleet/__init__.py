@@ -1,0 +1,17 @@
+"""Deterministic fleet fixtures for the BASELINE configs."""
+
+from .fixtures import (  # noqa: F401
+    FIXTURE_NOW_EPOCH,
+    FIXTURE_NOW_ISO,
+    fleet_large,
+    fleet_mixed,
+    fleet_v5e4,
+    fleet_v5p32,
+    make_intel_node,
+    make_intel_pod,
+    make_plain_node,
+    make_plugin_daemonset,
+    make_plugin_pod,
+    make_tpu_node,
+    make_tpu_pod,
+)

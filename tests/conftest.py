@@ -1,0 +1,15 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU platform *before* jax is imported
+anywhere, so multi-chip sharding tests run without TPU hardware (the same
+mechanism the driver uses for dryrun_multichip).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,113 @@
+"""Provider abstraction + Intel provider tests (mixed-cluster config)."""
+
+from headlamp_tpu.domain import intel
+from headlamp_tpu.domain.accelerator import (
+    INTEL_PROVIDER,
+    PROVIDERS,
+    TPU_PROVIDER,
+    classify_fleet,
+)
+from headlamp_tpu.fleet import (
+    fleet_mixed,
+    make_intel_node,
+    make_intel_pod,
+    make_plain_node,
+    make_plugin_pod,
+    make_tpu_node,
+    make_tpu_pod,
+)
+
+
+class TestIntelProvider:
+    def test_node_detection_by_label(self):
+        node = {"metadata": {"labels": {"intel.feature.node.kubernetes.io/gpu": "true"}}}
+        assert intel.is_intel_gpu_node(node)
+
+    def test_node_detection_by_capacity(self):
+        node = {"status": {"capacity": {"gpu.intel.com/i915": "2"}}}
+        assert intel.is_intel_gpu_node(node)
+
+    def test_i915_plus_xe_sum(self):
+        node = {"status": {"capacity": {"gpu.intel.com/i915": "2", "gpu.intel.com/xe": "1"}}}
+        assert intel.get_node_gpu_count(node) == 3
+
+    def test_gpu_type(self):
+        assert intel.get_node_gpu_type(make_intel_node("a", discrete=True)) == "discrete"
+        assert intel.get_node_gpu_type(make_intel_node("a", discrete=False)) == "integrated"
+        generic = {"metadata": {"labels": {"intel.feature.node.kubernetes.io/gpu": "true"}}}
+        assert intel.get_node_gpu_type(generic) == "unknown"
+
+    def test_pod_requests(self):
+        pod = make_intel_pod("p", gpus=2)
+        assert intel.is_gpu_requesting_pod(pod)
+        assert intel.get_pod_gpu_requests(pod) == {"gpu.intel.com/i915": 2}
+        assert intel.get_pod_device_request(pod) == 2
+
+    def test_millicores_not_devices(self):
+        pod = {
+            "spec": {
+                "containers": [
+                    {"name": "c", "resources": {"requests": {"gpu.intel.com/millicores": "500"}}}
+                ]
+            }
+        }
+        assert intel.is_gpu_requesting_pod(pod)  # still a GPU pod...
+        assert intel.get_pod_device_request(pod) == 0  # ...but holds no devices
+
+    def test_null_safety(self):
+        assert not intel.is_intel_gpu_node(None)
+        assert not intel.is_gpu_requesting_pod(None)
+
+
+class TestClassifyFleet:
+    def test_mixed_cluster_partitions_both_ways(self):
+        fleet = fleet_mixed()
+        views = classify_fleet(fleet["nodes"], fleet["pods"])
+        assert len(views["tpu"].nodes) == 4
+        assert len(views["intel"].nodes) == 2
+        assert len(views["tpu"].pods) == 2
+        assert len(views["intel"].pods) == 2
+        assert len(views["tpu"].plugin_pods) == 1
+        assert len(views["intel"].plugin_pods) == 1
+
+    def test_plain_nodes_in_neither(self):
+        views = classify_fleet([make_plain_node("c1")], [])
+        assert not views["tpu"].nodes and not views["intel"].nodes
+
+    def test_plugin_installed_via_pods(self):
+        views = classify_fleet([], [make_plugin_pod("dp")])
+        assert views["tpu"].plugin_installed
+        assert not views["intel"].plugin_installed
+
+    def test_plugin_installed_via_allocatable(self):
+        # No daemon pods visible (RBAC may hide kube-system) but chips are
+        # advertised — ADR-003-style fallback still reports installed.
+        views = classify_fleet([make_tpu_node("t", chips=4)], [])
+        assert views["tpu"].plugin_installed
+
+    def test_allocation_summary_per_provider(self):
+        fleet = fleet_mixed()
+        views = classify_fleet(fleet["nodes"], fleet["pods"])
+        tpu_sum = views["tpu"].allocation_summary()
+        assert tpu_sum["capacity"] == 16
+        assert tpu_sum["in_use"] == 8
+        assert tpu_sum["utilization_pct"] == 50
+        intel_sum = views["intel"].allocation_summary()
+        assert intel_sum["capacity"] == 3
+        assert intel_sum["in_use"] == 1
+
+    def test_provider_registry_order(self):
+        # TPU is the first-class provider in this framework.
+        assert PROVIDERS[0] is TPU_PROVIDER
+        assert PROVIDERS[1] is INTEL_PROVIDER
+        assert TPU_PROVIDER.device_unit == "chip"
+
+    def test_independent_degradation(self):
+        # A TPU-only cluster must not report Intel as installed and
+        # vice versa — the BASELINE mixed config's core requirement.
+        tpu_only = classify_fleet([make_tpu_node("t")], [make_tpu_pod("p")])
+        assert tpu_only["tpu"].plugin_installed
+        assert not tpu_only["intel"].plugin_installed
+        intel_only = classify_fleet([make_intel_node("i")], [make_intel_pod("p")])
+        assert intel_only["intel"].plugin_installed
+        assert not intel_only["tpu"].plugin_installed

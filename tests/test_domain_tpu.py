@@ -1,0 +1,307 @@
+"""Tier-1 pure unit tests for the TPU domain model — the analogue of the
+reference's k8s.test.ts suite (/root/reference/src/api/k8s.test.ts), built
+on the same builder-fixture pattern."""
+
+from headlamp_tpu.domain import objects as obj
+from headlamp_tpu.domain import tpu
+from headlamp_tpu.domain.constants import (
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+)
+from headlamp_tpu.fleet import (
+    FIXTURE_NOW_EPOCH,
+    make_plain_node,
+    make_plugin_daemonset,
+    make_plugin_pod,
+    make_tpu_node,
+    make_tpu_pod,
+)
+
+# ---------------------------------------------------------------------------
+# is_tpu_node
+# ---------------------------------------------------------------------------
+
+class TestIsTpuNode:
+    def test_accelerator_label_alone(self):
+        node = {"metadata": {"name": "n", "labels": {GKE_TPU_ACCELERATOR_LABEL: "tpu-v5p-slice"}}}
+        assert tpu.is_tpu_node(node)
+
+    def test_capacity_alone(self):
+        node = {"metadata": {"name": "n"}, "status": {"capacity": {TPU_RESOURCE: "4"}}}
+        assert tpu.is_tpu_node(node)
+
+    def test_zero_capacity_no_label(self):
+        node = {"metadata": {"name": "n"}, "status": {"capacity": {TPU_RESOURCE: "0"}}}
+        assert not tpu.is_tpu_node(node)
+
+    def test_plain_node(self):
+        assert not tpu.is_tpu_node(make_plain_node("cpu-1"))
+
+    def test_null_safety(self):
+        assert not tpu.is_tpu_node(None)
+        assert not tpu.is_tpu_node({})
+        assert not tpu.is_tpu_node("not a node")
+        assert not tpu.is_tpu_node({"metadata": None, "status": None})
+
+    def test_filter(self):
+        nodes = [make_tpu_node("t1"), make_plain_node("c1"), make_tpu_node("t2")]
+        assert [obj.name(n) for n in tpu.filter_tpu_nodes(nodes)] == ["t1", "t2"]
+
+
+# ---------------------------------------------------------------------------
+# Chip accounting
+# ---------------------------------------------------------------------------
+
+class TestChipCounts:
+    def test_capacity_and_allocatable(self):
+        node = make_tpu_node("n", chips=8)
+        assert tpu.get_node_chip_capacity(node) == 8
+        assert tpu.get_node_chip_allocatable(node) == 8
+
+    def test_missing_status(self):
+        assert tpu.get_node_chip_capacity({"metadata": {"name": "n"}}) == 0
+
+    def test_non_numeric_capacity(self):
+        node = {"status": {"capacity": {TPU_RESOURCE: "garbage"}}}
+        assert tpu.get_node_chip_capacity(node) == 0
+
+
+# ---------------------------------------------------------------------------
+# Labels / generation
+# ---------------------------------------------------------------------------
+
+class TestGeneration:
+    def test_known_values(self):
+        assert tpu.get_tpu_generation("tpu-v4-podslice") == "v4"
+        assert tpu.get_tpu_generation("tpu-v5-lite-podslice") == "v5e"
+        assert tpu.get_tpu_generation("tpu-v5p-slice") == "v5p"
+        assert tpu.get_tpu_generation("tpu-v6e-slice") == "v6e"
+
+    def test_unknown_and_future(self):
+        assert tpu.get_tpu_generation(None) == "unknown"
+        assert tpu.get_tpu_generation("") == "unknown"
+        assert tpu.get_tpu_generation("nvidia-a100") == "unknown"
+        # Future generations degrade to the version fragment, not "unknown".
+        assert tpu.get_tpu_generation("tpu-v7x-slice") == "v7x"
+
+    def test_node_accessors(self):
+        node = make_tpu_node("n", accelerator="tpu-v5p-slice", topology="2x2x4", pool="p1")
+        assert tpu.get_node_accelerator(node) == "tpu-v5p-slice"
+        assert tpu.get_node_topology(node) == "2x2x4"
+        assert tpu.get_node_pool(node) == "p1"
+        assert tpu.get_node_generation(node) == "v5p"
+
+    def test_worker_id(self):
+        assert tpu.get_node_worker_id(make_tpu_node("n", worker_id=3)) == 3
+        assert tpu.get_node_worker_id(make_tpu_node("n", worker_id=0)) == 0
+        assert tpu.get_node_worker_id(make_tpu_node("n")) is None
+        bad = {"metadata": {"labels": {"cloud.google.com/gke-tpu-worker-id": "abc"}}}
+        assert tpu.get_node_worker_id(bad) is None
+
+    def test_multi_host_detection(self):
+        multi = make_tpu_node("m", topology="4x4", chips=4)
+        single = make_tpu_node("s", topology="2x2", chips=4)
+        assert tpu.is_multi_host_node(multi)
+        assert not tpu.is_multi_host_node(single)
+        assert not tpu.is_multi_host_node(make_plain_node("c"))
+
+
+# ---------------------------------------------------------------------------
+# Pods
+# ---------------------------------------------------------------------------
+
+class TestTpuPods:
+    def test_requesting_pod(self):
+        assert tpu.is_tpu_requesting_pod(make_tpu_pod("p", chips=4))
+
+    def test_limits_only(self):
+        pod = {
+            "spec": {"containers": [{"name": "c", "resources": {"limits": {TPU_RESOURCE: "8"}}}]}
+        }
+        assert tpu.is_tpu_requesting_pod(pod)
+        assert tpu.get_pod_chip_request(pod) == 8
+
+    def test_init_container_counts(self):
+        pod = {
+            "spec": {
+                "containers": [{"name": "main"}],
+                "initContainers": [
+                    {"name": "init", "resources": {"requests": {TPU_RESOURCE: "1"}}}
+                ],
+            }
+        }
+        assert tpu.is_tpu_requesting_pod(pod)
+        assert tpu.get_pod_chip_request(pod) == 1
+
+    def test_init_and_main_overlap_not_summed(self):
+        # K8s reserves max(max(init), sum(main)): a 4-chip init step
+        # followed by a 4-chip main container occupies 4 chips, not 8.
+        pod = {
+            "spec": {
+                "containers": [{"name": "m", "resources": {"requests": {TPU_RESOURCE: "4"}}}],
+                "initContainers": [
+                    {"name": "i", "resources": {"requests": {TPU_RESOURCE: "4"}}}
+                ],
+            }
+        }
+        assert tpu.get_pod_chip_request(pod) == 4
+
+    def test_init_max_dominates_small_main(self):
+        pod = {
+            "spec": {
+                "containers": [{"name": "m", "resources": {"requests": {TPU_RESOURCE: "1"}}}],
+                "initContainers": [
+                    {"name": "i1", "resources": {"requests": {TPU_RESOURCE: "8"}}},
+                    {"name": "i2", "resources": {"requests": {TPU_RESOURCE: "2"}}},
+                ],
+            }
+        }
+        assert tpu.get_pod_chip_request(pod) == 8
+
+    def test_multi_container_sum(self):
+        pod = {
+            "spec": {
+                "containers": [
+                    {"name": "a", "resources": {"requests": {TPU_RESOURCE: "4"}}},
+                    {"name": "b", "resources": {"requests": {TPU_RESOURCE: "2"}}},
+                ]
+            }
+        }
+        assert tpu.get_pod_chip_request(pod) == 6
+
+    def test_non_tpu_pod(self):
+        pod = {"spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}]}}
+        assert not tpu.is_tpu_requesting_pod(pod)
+        assert tpu.get_pod_chip_request(pod) == 0
+
+    def test_null_safety(self):
+        assert not tpu.is_tpu_requesting_pod(None)
+        assert not tpu.is_tpu_requesting_pod({})
+        assert tpu.get_pod_chip_request({}) == 0
+
+    def test_plugin_pod_label_variants(self):
+        assert tpu.is_tpu_plugin_pod(make_plugin_pod("dp-1"))
+        for key in ("app", "app.kubernetes.io/name"):
+            pod = {"metadata": {"labels": {key: "tpu-device-plugin"}}}
+            assert tpu.is_tpu_plugin_pod(pod)
+        assert not tpu.is_tpu_plugin_pod({"metadata": {"labels": {"app": "something"}}})
+        assert not tpu.is_tpu_plugin_pod({"metadata": {}})
+
+
+# ---------------------------------------------------------------------------
+# DaemonSet status state machine (k8s.ts:370-386 analogue)
+# ---------------------------------------------------------------------------
+
+class TestDaemonSetStatus:
+    def test_all_ready(self):
+        ds = make_plugin_daemonset(desired=4, ready=4)
+        assert tpu.daemonset_status_to_status(ds) == "success"
+        assert tpu.daemonset_status_text(ds) == "4/4 ready"
+
+    def test_none_scheduled(self):
+        ds = make_plugin_daemonset(desired=0, ready=0)
+        assert tpu.daemonset_status_to_status(ds) == "warning"
+        assert tpu.daemonset_status_text(ds) == "No nodes scheduled"
+
+    def test_unavailable(self):
+        ds = make_plugin_daemonset(desired=4, ready=3, unavailable=1)
+        assert tpu.daemonset_status_to_status(ds) == "warning"
+
+    def test_partial_without_unavailable(self):
+        ds = make_plugin_daemonset(desired=4, ready=2, unavailable=0)
+        assert tpu.daemonset_status_to_status(ds) == "error"
+
+
+# ---------------------------------------------------------------------------
+# Formatters / aggregation
+# ---------------------------------------------------------------------------
+
+class TestFormatting:
+    def test_format_accelerator(self):
+        assert tpu.format_accelerator("tpu-v5-lite-podslice") == "TPU v5e"
+        assert tpu.format_accelerator("tpu-v6e-slice") == "TPU v6e (Trillium)"
+        assert tpu.format_accelerator(None) == "TPU (unknown gen)"
+
+    def test_format_chip_count(self):
+        assert tpu.format_chip_count(1) == "1 chip"
+        assert tpu.format_chip_count(16) == "16 chips"
+
+    def test_format_resource_name(self):
+        assert tpu.format_tpu_resource_name(TPU_RESOURCE) == "TPU chips"
+        assert tpu.format_tpu_resource_name("other") == "other"
+
+    def test_format_age_buckets(self):
+        now = FIXTURE_NOW_EPOCH
+        assert obj.format_age("2026-07-28T23:59:30Z", now) == "30s"
+        assert obj.format_age("2026-07-28T23:30:00Z", now) == "30m"
+        assert obj.format_age("2026-07-28T19:00:00Z", now) == "5h"
+        assert obj.format_age("2026-07-25T00:00:00Z", now) == "4d"
+        assert obj.format_age(None, now) == "unknown"
+        assert obj.format_age("not-a-date", now) == "unknown"
+
+
+class TestAllocationSummary:
+    def test_summarize(self):
+        nodes = [make_tpu_node("a", chips=4), make_tpu_node("b", chips=4)]
+        pods = [
+            make_tpu_pod("p1", chips=4, phase="Running"),
+            make_tpu_pod("p2", chips=4, phase="Pending"),  # not counted
+            make_tpu_pod("p3", chips=2, phase="Running"),
+        ]
+        s = tpu.summarize_allocation(nodes, pods)
+        assert s["capacity"] == 8
+        assert s["allocatable"] == 8
+        assert s["in_use"] == 6
+        assert s["free"] == 2
+        assert s["utilization_pct"] == 75
+
+    def test_empty_fleet(self):
+        s = tpu.summarize_allocation([], [])
+        assert s["capacity"] == 0 and s["utilization_pct"] == 0
+
+    def test_phase_counts(self):
+        pods = [
+            make_tpu_pod("a", phase="Running"),
+            make_tpu_pod("b", phase="Pending"),
+            make_tpu_pod("c", phase="Weird"),
+        ]
+        counts = tpu.count_pod_phases(pods)
+        assert counts == {"Running": 1, "Pending": 1, "Succeeded": 0, "Failed": 0, "Other": 1}
+
+
+# ---------------------------------------------------------------------------
+# Generic object helpers
+# ---------------------------------------------------------------------------
+
+class TestObjectHelpers:
+    def test_pod_restarts(self):
+        pod = make_tpu_pod("p", restarts=3)
+        assert obj.pod_restarts(pod) == 3
+        assert obj.pod_restarts({}) == 0
+
+    def test_ready_checks(self):
+        assert obj.is_node_ready(make_tpu_node("n", ready=True))
+        assert not obj.is_node_ready(make_tpu_node("n", ready=False))
+        assert obj.is_pod_ready(make_tpu_pod("p"))
+        assert not obj.is_pod_ready(make_tpu_pod("p", phase="Pending"))
+
+    def test_kube_list(self):
+        assert obj.is_kube_list({"items": []})
+        assert not obj.is_kube_list({"items": "nope"})
+        assert not obj.is_kube_list(None)
+        assert obj.kube_list_items({"items": [1, 2]}) == [1, 2]
+
+    def test_dedup_by_uid(self):
+        a = make_tpu_pod("a")
+        dup = dict(a)
+        b = make_tpu_pod("b")
+        no_uid = {"metadata": {"name": "x"}}
+        assert obj.dedup_by_uid([a, dup, b, no_uid]) == [a, b]
+
+    def test_parse_int(self):
+        assert obj.parse_int("4") == 4
+        assert obj.parse_int("8Gi") == 8  # leading digits, parseInt-style
+        assert obj.parse_int(None) == 0
+        assert obj.parse_int("abc") == 0
+        assert obj.parse_int(2.9) == 2

@@ -1,0 +1,306 @@
+"""Topology engine tests: parsing, slice grouping, mesh geometry."""
+
+from headlamp_tpu.fleet import fleet_v5p32, make_tpu_node
+from headlamp_tpu.topology import (
+    build_mesh_layout,
+    expected_host_count,
+    group_slices,
+    host_block,
+    infer_chips_per_host,
+    parse_topology,
+    summarize_slices,
+    topology_chip_count,
+)
+
+# ---------------------------------------------------------------------------
+# parse_topology
+# ---------------------------------------------------------------------------
+
+class TestParseTopology:
+    def test_valid(self):
+        assert parse_topology("2x2") == (2, 2)
+        assert parse_topology("4x4x4") == (4, 4, 4)
+        assert parse_topology("1x1") == (1, 1)
+        assert parse_topology("16x16") == (16, 16)
+
+    def test_invalid(self):
+        for bad in (None, "", "x", "2x", "x2", "2x-1", "a x b", "2×2", "0x4"):
+            assert parse_topology(bad) == ()
+
+    def test_chip_count(self):
+        assert topology_chip_count((4, 4, 4)) == 64
+        assert topology_chip_count((2, 4)) == 8
+        assert topology_chip_count(()) == 0
+
+
+# ---------------------------------------------------------------------------
+# chips per host / expected hosts
+# ---------------------------------------------------------------------------
+
+class TestHostInference:
+    def test_observed_capacity_wins(self):
+        # v5e 2x4 is ambiguous (1x8-chip host vs 2x4-chip hosts); node
+        # capacity disambiguates.
+        assert infer_chips_per_host("v5e", (2, 4), observed=8) == 8
+        assert infer_chips_per_host("v5e", (2, 4), observed=4) == 4
+
+    def test_single_host_small_2d(self):
+        assert infer_chips_per_host("v5e", (2, 2)) == 4
+        assert infer_chips_per_host("v5e", (1, 1)) == 1
+        assert infer_chips_per_host("v5e", (2, 4)) == 8  # defaults to single host
+
+    def test_3d_default_four(self):
+        assert infer_chips_per_host("v5p", (2, 2, 4)) == 4
+        assert infer_chips_per_host("v4", (4, 4, 4)) == 4
+
+    def test_expected_hosts(self):
+        assert expected_host_count("v5p", (2, 2, 4)) == 4  # v5p-32: 16 chips
+        assert expected_host_count("v5e", (4, 4), observed_chips=4) == 4
+        assert expected_host_count("v5e", (2, 2)) == 1
+        assert expected_host_count("v5p", (4, 4, 4)) == 16
+        assert expected_host_count("v5e", ()) == 1
+
+
+# ---------------------------------------------------------------------------
+# Slice grouping
+# ---------------------------------------------------------------------------
+
+class TestGroupSlices:
+    def test_v5p32_fixture(self):
+        fleet = fleet_v5p32()
+        slices = group_slices(fleet["nodes"])
+        assert len(slices) == 1
+        s = slices[0]
+        assert s.node_pool == "v5p-pool"
+        assert s.generation == "v5p"
+        assert s.dims == (2, 2, 4)
+        assert s.total_chips == 16
+        assert s.expected_hosts == 4
+        assert s.actual_hosts == 4
+        assert s.is_multi_host
+        assert s.complete
+        # fixture marks worker 3 NotReady -> warning
+        assert s.ready_hosts == 3
+        assert s.health == "warning"
+
+    def test_explicit_worker_ids_order(self):
+        nodes = [
+            make_tpu_node("b-node", pool="p", worker_id=1, topology="4x4", chips=4),
+            make_tpu_node("a-node", pool="p", worker_id=0, topology="4x4", chips=4),
+        ]
+        s = group_slices(nodes)[0]
+        assert [w.node_name for w in s.workers] == ["a-node", "b-node"]
+        assert [w.worker_id for w in s.workers] == [0, 1]
+
+    def test_natural_name_fallback(self):
+        # No worker-id labels; names must sort numerically (w10 after w2).
+        nodes = [
+            make_tpu_node(f"pool-w{i}", pool="p", topology="16x16", chips=4)
+            for i in (10, 2, 0, 1)
+        ]
+        s = group_slices(nodes)[0]
+        assert [w.node_name for w in s.workers] == ["pool-w0", "pool-w1", "pool-w2", "pool-w10"]
+        assert [w.worker_id for w in s.workers] == [0, 1, 2, 3]
+
+    def test_incomplete_slice_is_error(self):
+        nodes = [
+            make_tpu_node(f"p-w{i}", pool="p", worker_id=i, topology="2x2x4", chips=4,
+                          accelerator="tpu-v5p-slice")
+            for i in range(3)  # expected 4
+        ]
+        s = group_slices(nodes)[0]
+        assert not s.complete
+        assert s.health == "error"
+        assert s.missing_worker_ids == [3]
+
+    def test_label_race_does_not_split_multi_host_pool(self):
+        # First node's topology label hasn't propagated (detected via
+        # capacity only); the pool must still group as one multi-host
+        # slice using a labeled sibling's topology.
+        bare = make_tpu_node("v5p-w0", pool="p", topology=None,
+                             accelerator="tpu-v5p-slice", chips=4, worker_id=0)
+        del bare["metadata"]["labels"]["cloud.google.com/gke-tpu-accelerator"]
+        labeled = [
+            make_tpu_node(f"v5p-w{i}", pool="p", topology="2x2x4",
+                          accelerator="tpu-v5p-slice", chips=4, worker_id=i)
+            for i in range(1, 4)
+        ]
+        slices = group_slices([bare] + labeled)
+        assert len(slices) == 1
+        s = slices[0]
+        assert s.dims == (2, 2, 4)
+        assert s.actual_hosts == 4 and s.complete
+
+    def test_out_of_range_worker_ids_incomplete(self):
+        # Workers {0,1,2,4} of an expected 4: worker 3 is missing, so the
+        # slice must not report healthy even though 4 nodes are present.
+        nodes = [
+            make_tpu_node(f"p-w{i}", pool="p", topology="2x2x4", chips=4,
+                          accelerator="tpu-v5p-slice", worker_id=i)
+            for i in (0, 1, 2, 4)
+        ]
+        s = group_slices(nodes)[0]
+        assert not s.complete
+        assert s.health == "error"
+        assert s.missing_worker_ids == [3]
+
+    def test_single_host_pool_splits_per_node(self):
+        # An autoscaled single-host pool (v5e-4, 2x2) with 3 nodes holds
+        # 3 independent slices — 12 chips total, not 4.
+        nodes = [
+            make_tpu_node(f"gke-v5e4-pool-n{i}", pool="v5e4-pool",
+                          topology="2x2", chips=4, worker_id=0)
+            for i in range(3)
+        ]
+        slices = group_slices(nodes)
+        assert len(slices) == 3
+        assert all(s.actual_hosts == 1 and s.expected_hosts == 1 for s in slices)
+        assert summarize_slices(slices)["total_chips"] == 12
+        # slice ids stay distinct while the pool name is shared
+        assert len({s.slice_id for s in slices}) == 3
+        assert {s.node_pool for s in slices} == {"v5e4-pool"}
+
+    def test_nodes_without_pool_are_singletons(self):
+        nodes = [make_tpu_node("lone-1"), make_tpu_node("lone-2")]
+        slices = group_slices(nodes)
+        assert len(slices) == 2
+        assert all(s.actual_hosts == 1 for s in slices)
+
+    def test_non_tpu_nodes_ignored(self):
+        from headlamp_tpu.fleet import make_plain_node
+
+        assert group_slices([make_plain_node("cpu")]) == []
+
+    def test_summary(self):
+        fleet = fleet_v5p32()
+        counters = summarize_slices(group_slices(fleet["nodes"]))
+        assert counters["total"] == 1
+        assert counters["multi_host"] == 1
+        assert counters["degraded"] == 1
+        assert counters["total_chips"] == 16
+
+
+# ---------------------------------------------------------------------------
+# Mesh geometry
+# ---------------------------------------------------------------------------
+
+class TestHostBlock:
+    def test_3d_block(self):
+        assert host_block((2, 2, 4), 4) == (2, 2, 1)
+        assert host_block((4, 4, 4), 4) == (2, 2, 1)
+
+    def test_2d_block(self):
+        assert host_block((4, 4), 4) == (2, 2)
+        assert host_block((2, 2), 4) == (2, 2)
+
+    def test_single_chip(self):
+        assert host_block((1, 1), 1) == (1, 1)
+
+    def test_whole_grid(self):
+        assert host_block((2, 4), 8) == (2, 4)
+
+
+class TestMeshLayout:
+    def _slice(self, **kwargs):
+        defaults = dict(pool="p", accelerator="tpu-v5p-slice", topology="2x2x4",
+                        chips=4)
+        defaults.update(kwargs)
+        topology = defaults.pop("topology")
+        accel = defaults.pop("accelerator")
+        chips = defaults.pop("chips")
+        pool = defaults.pop("pool")
+        n_workers = defaults.pop("n_workers", 4)
+        nodes = [
+            make_tpu_node(f"{pool}-w{i}", pool=pool, accelerator=accel,
+                          topology=topology, chips=chips, worker_id=i)
+            for i in range(n_workers)
+        ]
+        return group_slices(nodes)[0]
+
+    def test_v5p32_mesh(self):
+        layout = build_mesh_layout(self._slice())
+        assert layout.dims == (2, 2, 4)
+        assert len(layout.cells) == 16
+        assert layout.host_grid == (1, 1, 4)
+        # Every chip maps to a valid worker, 4 chips per worker.
+        per_worker = {}
+        for c in layout.cells:
+            per_worker[c.worker_id] = per_worker.get(c.worker_id, 0) + 1
+        assert per_worker == {0: 4, 1: 4, 2: 4, 3: 4}
+
+    def test_torus_wrap_links_on_v5p_long_axis(self):
+        layout = build_mesh_layout(self._slice())
+        wraps = [l for l in layout.links if l.wrap]
+        # Only the length-4 axis wraps; 2-axes don't.
+        assert wraps and all(l.axis == 2 for l in wraps)
+        assert len(wraps) == 4  # one wrap link per (x,y) column
+
+    def test_v5e_mesh_has_no_wrap(self):
+        sl = self._slice(accelerator="tpu-v5-lite-podslice", topology="4x4",
+                         chips=4, n_workers=4)
+        layout = build_mesh_layout(sl)
+        assert layout.dims == (4, 4)
+        assert len(layout.cells) == 16
+        assert all(not l.wrap for l in layout.links)
+        # 2D mesh link count: 2 * 4 * 3 = 24.
+        assert len(layout.links) == 24
+
+    def test_3d_projection_layers(self):
+        layout = build_mesh_layout(self._slice())
+        # 4 z-layers of a 2-wide grid + gaps: width = 2 + 3*(2+1) = 11.
+        assert layout.width == 11
+        assert layout.height == 2
+
+    def test_unknown_topology_fallback(self):
+        # Without a topology label a pool can't be proven multi-host, so
+        # each node becomes its own slice; the mesh degrades to a single
+        # unlinked cell per slice.
+        nodes = [make_tpu_node(f"p-w{i}", pool="p", topology=None, chips=4,
+                               worker_id=i) for i in range(2)]
+        slices = group_slices(nodes)
+        assert len(slices) == 2
+        layout = build_mesh_layout(slices[0])
+        assert layout.dims == ()
+        assert len(layout.cells) == 1
+        assert layout.links == []
+
+    def test_unknown_topology_multiworker_mesh(self):
+        # A hand-built slice with unknown dims but several workers still
+        # lays out one cell per worker in a row.
+        from headlamp_tpu.topology import SliceInfo, SliceWorker
+
+        sl = SliceInfo(
+            slice_id="s", node_pool="p", accelerator=None, generation="unknown",
+            topology=None, dims=(),
+            workers=[
+                SliceWorker(node={}, worker_id=i, ready=True, chip_capacity=4)
+                for i in range(3)
+            ],
+        )
+        layout = build_mesh_layout(sl)
+        assert len(layout.cells) == 3
+        assert layout.links == []
+        assert layout.width == 3 and layout.height == 1
+
+    def test_future_4d_topology_distinct_positions(self):
+        from headlamp_tpu.topology import SliceInfo, SliceWorker
+
+        sl = SliceInfo(
+            slice_id="s", node_pool="p", accelerator="tpu-v9-hyper", generation="v9",
+            topology="2x2x2x2", dims=(2, 2, 2, 2),
+            workers=[SliceWorker(node={}, worker_id=i, ready=True, chip_capacity=4)
+                     for i in range(4)],
+        )
+        layout = build_mesh_layout(sl)
+        assert len(layout.cells) == 16
+        positions = {(c.px, c.py) for c in layout.cells}
+        assert len(positions) == 16  # no overlapping cells
+
+    def test_cell_count_always_matches_topology(self):
+        for topo, accel in (("2x2", "tpu-v5-lite-podslice"),
+                            ("8x8", "tpu-v5-lite-podslice"),
+                            ("4x4x4", "tpu-v4-podslice")):
+            sl = self._slice(topology=topo, accelerator=accel,
+                             n_workers=max(1, topology_chip_count(parse_topology(topo)) // 4))
+            layout = build_mesh_layout(sl)
+            assert len(layout.cells) == topology_chip_count(parse_topology(topo))
