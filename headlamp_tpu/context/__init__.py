@@ -1,0 +1,33 @@
+"""State layer — the provider-agnostic AcceleratorDataContext.
+
+Role-equivalent to the reference's single-source-of-truth context
+(`/root/reference/src/api/IntelGpuDataContext.tsx`, ADR-001), lifted so
+multiple accelerator providers (TPU, Intel GPU) share one snapshot and
+degrade independently (the BASELINE north-star requirement).
+"""
+
+from .accelerator_context import (
+    AcceleratorDataContext,
+    ClusterSnapshot,
+    ProviderState,
+)
+from .sources import (
+    INTEL_SOURCE,
+    NODES_PATH,
+    PODS_PATH,
+    TPU_SOURCE,
+    ProviderSource,
+    default_sources,
+)
+
+__all__ = [
+    "AcceleratorDataContext",
+    "ClusterSnapshot",
+    "ProviderState",
+    "ProviderSource",
+    "INTEL_SOURCE",
+    "TPU_SOURCE",
+    "NODES_PATH",
+    "PODS_PATH",
+    "default_sources",
+]

@@ -1,0 +1,310 @@
+"""AcceleratorDataContext — single source of truth for cluster state.
+
+The multi-provider generalization of the reference's context provider
+(`/root/reference/src/api/IntelGpuDataContext.tsx:96-252`, ADR-001/002):
+
+- **Reactive track**: node + all-namespace pod lists (the ``useList``
+  analogue, `:98-99`). Fetched on every sync; a failure leaves the
+  previous list in place and records the error stream.
+- **Imperative track**: per-provider workload objects (CRDs/DaemonSets)
+  and plugin daemon pods via fallback chains with per-request timeouts,
+  silent per-path failure, and UID dedup (`:113-190`). Workload-source
+  absence degrades gracefully to ``workload_available=False`` instead of
+  erroring (ADR-003 `:133-137`).
+- ``refresh()`` re-runs the imperative track only, mirroring the
+  reference's ``refreshKey`` effect (`:109-111,190`); ``sync()`` runs
+  both tracks.
+
+Derived per-provider views (nodes/pods filters) are computed once per
+sync — the analogue of the reference's ``useMemo`` filters (`:200-208`)
+— not per page render, which is what keeps the 256-node dashboard p50
+inside the BASELINE budget.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping
+
+from ..domain import objects as obj
+from ..domain.accelerator import PROVIDERS, FleetView, Provider, classify_fleet
+from ..transport.api_proxy import DEFAULT_TIMEOUT_S, ApiError, Transport
+from .sources import ProviderSource, default_sources, workload_matches_provider
+from .sources import NODES_PATH, PODS_PATH
+
+
+@dataclass
+class ProviderState:
+    """One provider's slice of the snapshot — the per-provider
+    generalization of ``IntelGpuContextValue``
+    (`IntelGpuDataContext.tsx:28-52`)."""
+
+    provider: Provider
+    view: FleetView
+    #: Workload objects (Intel: GpuDevicePlugin CRs; TPU: DaemonSets).
+    workloads: list[Any] = field(default_factory=list)
+    #: False when every workload path failed — the ``crdAvailable``
+    #: analogue (`:133-137`); pages show a "not available" notice.
+    workload_available: bool = True
+    #: Set when every plugin-pod selector path failed for this provider.
+    #: Kept per-provider (not in the global error banner) so an absent
+    #: provider degrades independently; the provider's own pages may
+    #: surface it.
+    plugin_pods_error: str | None = None
+
+    @property
+    def nodes(self) -> list[Any]:
+        return self.view.nodes
+
+    @property
+    def pods(self) -> list[Any]:
+        return self.view.pods
+
+    @property
+    def plugin_pods(self) -> list[Any]:
+        return self.view.plugin_pods
+
+    @property
+    def plugin_installed(self) -> bool:
+        """Workloads seen OR daemon pods seen OR devices advertised
+        (`:222` generalized; the device-advertised arm covers TPU's
+        no-CRD world, SURVEY.md §7 hard part (d))."""
+        return bool(self.workloads) or self.view.plugin_installed
+
+    def allocation_summary(self) -> Mapping[str, int]:
+        return self.view.allocation_summary()
+
+
+@dataclass
+class ClusterSnapshot:
+    """Immutable view handed to pages; ``None`` lists mean the track has
+    never succeeded (the reference's ``loading`` definition `:214`)."""
+
+    all_nodes: list[Any] | None
+    all_pods: list[Any] | None
+    providers: dict[str, ProviderState]
+    errors: list[str]
+    fetched_at: float
+    refresh_count: int
+
+    @property
+    def loading(self) -> bool:
+        return self.all_nodes is None or self.all_pods is None
+
+    @property
+    def error(self) -> str | None:
+        """The page-facing aggregate: streams joined by '; '
+        (`IntelGpuDataContext.tsx:216-220`)."""
+        return "; ".join(self.errors) if self.errors else None
+
+    def provider(self, name: str) -> ProviderState:
+        return self.providers[name]
+
+
+class AcceleratorDataContext:
+    """Owns cluster state; pages read snapshots, never the transport.
+
+    ``transport`` and ``clock`` are injected for testability (the same
+    seam the vitest suite gets by mocking the Headlamp SDK module,
+    `IntelGpuDataContext.test.tsx:7-15`).
+    """
+
+    def __init__(
+        self,
+        transport: Transport,
+        *,
+        providers: tuple[Provider, ...] = PROVIDERS,
+        sources: Mapping[str, ProviderSource] | None = None,
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+        clock: Callable[[], float] = time.time,
+    ):
+        self._transport = transport
+        self._providers = providers
+        self._sources = dict(sources if sources is not None else default_sources())
+        self._timeout_s = timeout_s
+        self._clock = clock
+
+        self._all_nodes: list[Any] | None = None
+        self._all_pods: list[Any] | None = None
+        self._node_error: str | None = None
+        self._pod_error: str | None = None
+        self._workloads: dict[str, list[Any]] = {}
+        self._workload_available: dict[str, bool] = {}
+        self._fallback_plugin_pods: dict[str, list[Any]] = {}
+        self._plugin_pod_errors: dict[str, str | None] = {}
+        self._refresh_count = 0
+        self._cached_snapshot: ClusterSnapshot | None = None
+
+    # ------------------------------------------------------------------
+    # Track 1: reactive lists
+    # ------------------------------------------------------------------
+
+    def _sync_reactive(self) -> None:
+        try:
+            data = self._transport.request(NODES_PATH, self._timeout_s)
+            self._all_nodes = obj.kube_list_items(data)
+            self._node_error = None
+        except ApiError as e:
+            self._node_error = f"nodes: {e}"
+        try:
+            data = self._transport.request(PODS_PATH, self._timeout_s)
+            self._all_pods = obj.kube_list_items(data)
+            self._pod_error = None
+        except ApiError as e:
+            self._pod_error = f"pods: {e}"
+
+    # ------------------------------------------------------------------
+    # Track 2: imperative per-provider fetches
+    # ------------------------------------------------------------------
+
+    def _sync_imperative(self) -> None:
+        """Per-provider chains run concurrently: the chains are
+        independent, and a blackholed provider (e.g. firewalled Intel
+        namespaces on a TPU-only cluster) must cost the slowest single
+        chain, not the sum of every chain's timeouts."""
+        sourced = [
+            (p, self._sources[p.name])
+            for p in self._providers
+            if p.name in self._sources
+        ]
+        for p in self._providers:
+            if p.name not in self._sources:
+                self._workload_available[p.name] = False
+        if not sourced:
+            return
+
+        def fetch_one(provider: Provider, source: ProviderSource) -> None:
+            self._fetch_workloads(provider, source)
+            self._fetch_plugin_pods(provider, source)
+
+        if len(sourced) == 1:
+            fetch_one(*sourced[0])
+            return
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=len(sourced), thread_name_prefix="hl-tpu-provider"
+        ) as pool:
+            futures = [pool.submit(fetch_one, p, s) for p, s in sourced]
+            for f in futures:
+                f.result()
+
+    def _fetch_workloads(self, provider: Provider, source: ProviderSource) -> None:
+        """Fallback chain; total failure degrades silently to
+        ``workload_available=False`` (ADR-003 — a cluster without the
+        Intel operator or a visible DaemonSet is healthy, not broken).
+        A path that succeeds with zero matches does NOT stop the chain:
+        a plugin DaemonSet labeled differently from the primary selector
+        returns an empty 200 there, and only the namespace fallback with
+        client-side matching can find it. Any HTTP success keeps
+        ``workload_available`` True (the source exists; it may simply
+        hold nothing yet)."""
+        matched: list[Any] = []
+        any_success = False
+        for path in source.workload_paths:
+            try:
+                data = self._transport.request(path, self._timeout_s)
+            except ApiError:
+                continue
+            any_success = True
+            items = obj.kube_list_items(data) if obj.is_kube_list(data) else (
+                [data] if isinstance(data, Mapping) else []
+            )
+            matched = [w for w in items if workload_matches_provider(source, w)]
+            if matched:
+                break
+        self._workloads[provider.name] = obj.dedup_by_uid(matched) if matched else []
+        self._workload_available[provider.name] = any_success
+
+    def _fetch_plugin_pods(self, provider: Provider, source: ProviderSource) -> None:
+        """Sequential fallback paths, silent per-path catch, UID dedup
+        (`IntelGpuDataContext.tsx:155-174`). Collected pods supplement
+        the reactive pod list for clusters where the all-namespace list
+        is RBAC-restricted but namespaced reads are allowed."""
+        collected: list[Any] = []
+        any_success = False
+        for path in source.plugin_pod_paths:
+            if collected and "labelSelector=" not in path:
+                # Namespace-wide fallbacks exist only for installs whose
+                # labels no selector path matches; when a server-filtered
+                # path already found the daemon pods, an unfiltered list
+                # of the whole namespace (thousands of pods at fleet
+                # scale) buys nothing.
+                continue
+            try:
+                data = self._transport.request(path, self._timeout_s)
+            except ApiError:
+                continue
+            any_success = True
+            collected.extend(
+                p for p in obj.kube_list_items(data) if source.plugin_pod_filter(p)
+            )
+        # Total failure is recorded per-provider, NOT in the global error
+        # banner — on a TPU-only cluster the Intel paths all failing is
+        # expected, and polluting ClusterSnapshot.error with it would
+        # break independent degradation (the same reasoning as the
+        # reference's silent per-selector catch, `:162-164`).
+        self._plugin_pod_errors[provider.name] = (
+            None if any_success else "failed to query device-plugin pods"
+        )
+        self._fallback_plugin_pods[provider.name] = obj.dedup_by_uid(collected)
+
+    # ------------------------------------------------------------------
+    # Public API
+    # ------------------------------------------------------------------
+
+    def sync(self) -> ClusterSnapshot:
+        """Run both tracks and return a fresh snapshot."""
+        self._sync_reactive()
+        self._sync_imperative()
+        self._cached_snapshot = None
+        return self.snapshot()
+
+    def refresh(self) -> ClusterSnapshot:
+        """Imperative track only — the ``refreshKey`` semantics
+        (`:109-111`: hooks stay reactive, manual refresh re-fires the
+        CRD/daemon-pod effect)."""
+        self._refresh_count += 1
+        self._sync_imperative()
+        self._cached_snapshot = None
+        return self.snapshot()
+
+    def snapshot(self) -> ClusterSnapshot:
+        """The current snapshot. Built once per sync/refresh and cached —
+        the ``useMemo`` discipline (`:200-208,228-251`): N page reads
+        between syncs must not cost N fleet reclassifications."""
+        if self._cached_snapshot is not None:
+            return self._cached_snapshot
+        self._cached_snapshot = self._build_snapshot()
+        return self._cached_snapshot
+
+    def _build_snapshot(self) -> ClusterSnapshot:
+        views = classify_fleet(
+            self._all_nodes or [], self._all_pods or [], self._providers
+        )
+        providers: dict[str, ProviderState] = {}
+        for p in self._providers:
+            view = views[p.name]
+            # Merge imperative-track plugin pods not already present in
+            # the reactive list (UID dedup across tracks).
+            seen = {obj.uid(pod) for pod in view.plugin_pods}
+            for pod in self._fallback_plugin_pods.get(p.name, []):
+                if obj.uid(pod) not in seen:
+                    view.plugin_pods.append(pod)
+            providers[p.name] = ProviderState(
+                provider=p,
+                view=view,
+                workloads=list(self._workloads.get(p.name, [])),
+                workload_available=self._workload_available.get(p.name, True),
+                plugin_pods_error=self._plugin_pod_errors.get(p.name),
+            )
+
+        errors = [e for e in (self._node_error, self._pod_error) if e]
+        return ClusterSnapshot(
+            all_nodes=self._all_nodes,
+            all_pods=self._all_pods,
+            providers=providers,
+            errors=errors,
+            fetched_at=self._clock(),
+            refresh_count=self._refresh_count,
+        )

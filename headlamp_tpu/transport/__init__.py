@@ -1,0 +1,26 @@
+"""Transport layer — how the framework talks to a Kubernetes API server.
+
+Role-equivalent to the Headlamp SDK's ``ApiProxy.request`` used by the
+reference (`/root/reference/src/api/IntelGpuDataContext.tsx:9,125`;
+`/root/reference/src/api/metrics.ts:15,71`): a single JSON-over-HTTP
+request function behind which all cluster access happens. Everything above
+this layer is injectable/testable with :class:`MockTransport`.
+"""
+
+from .api_proxy import (
+    ApiError,
+    KubeTransport,
+    MockTransport,
+    RequestTimeout,
+    Transport,
+    with_timeout,
+)
+
+__all__ = [
+    "ApiError",
+    "KubeTransport",
+    "MockTransport",
+    "RequestTimeout",
+    "Transport",
+    "with_timeout",
+]

@@ -1,0 +1,189 @@
+"""Kubernetes API transport: the ``ApiProxy.request`` contract.
+
+The reference funnels every cluster call through one function —
+``ApiProxy.request(path) -> parsed JSON`` — and wraps each imperative call
+in a 2 s timeout (`/root/reference/src/api/IntelGpuDataContext.tsx:72-82`).
+This module provides the same contract for Python:
+
+- :class:`Transport` — the protocol (``request(path, timeout_s)``).
+- :func:`with_timeout` — hard wall-clock cap on any callable, the analogue
+  of the reference's ``withTimeout`` Promise.race.
+- :class:`KubeTransport` — real HTTP via stdlib ``urllib`` against an API
+  server base URL (``kubectl proxy``, or in-cluster with a service-account
+  bearer token).
+- :class:`MockTransport` — the test double: path -> canned response /
+  exception, with call recording (mirrors the vitest
+  ``ApiProxy.request`` mocks, `IntelGpuDataContext.test.tsx:7-15`).
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import threading
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Mapping, Protocol
+
+#: Default per-request timeout, matching the reference's 2 000 ms
+#: (`IntelGpuDataContext.tsx:72`).
+DEFAULT_TIMEOUT_S = 2.0
+
+
+class ApiError(Exception):
+    """A request failed (HTTP error, bad JSON, connection refused)."""
+
+    def __init__(self, path: str, message: str, status: int | None = None):
+        super().__init__(f"{path}: {message}")
+        self.path = path
+        self.status = status
+
+
+class RequestTimeout(ApiError):
+    """The request exceeded its wall-clock budget."""
+
+    def __init__(self, path: str, timeout_s: float):
+        super().__init__(path, f"timed out after {timeout_s:g}s")
+        self.timeout_s = timeout_s
+
+
+class Transport(Protocol):
+    """Single entry point for cluster JSON requests."""
+
+    def request(self, path: str, timeout_s: float = DEFAULT_TIMEOUT_S) -> Any:
+        """GET ``path`` and return parsed JSON. Raises :class:`ApiError`
+        (or :class:`RequestTimeout`) on failure; never returns partial
+        data."""
+        ...
+
+
+def with_timeout(fn: Callable[[], Any], timeout_s: float, path: str = "") -> Any:
+    """Run ``fn`` with a hard wall-clock cap — the reference's
+    ``withTimeout`` (`IntelGpuDataContext.tsx:72-82`). On expiry raises
+    :class:`RequestTimeout`; the abandoned call keeps running in its
+    daemon thread but its result is discarded. One fresh thread per call
+    (not a shared pool): urllib's socket timeout does not cover DNS
+    resolution, so a stalled resolver can park threads indefinitely — a
+    bounded pool would exhaust and then spuriously time out every later
+    request against a healthy server."""
+    outcome: dict[str, Any] = {}
+
+    def runner() -> None:
+        try:
+            outcome["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised in caller
+            outcome["error"] = e
+
+    thread = threading.Thread(target=runner, daemon=True, name="hl-tpu-timeout")
+    thread.start()
+    thread.join(timeout_s)
+    if thread.is_alive():
+        raise RequestTimeout(path, timeout_s)
+    if "error" in outcome:
+        raise outcome["error"]
+    return outcome.get("value")
+
+
+class KubeTransport:
+    """Real API-server transport over stdlib HTTP.
+
+    ``base_url`` examples:
+    - ``http://127.0.0.1:8001`` (kubectl proxy — no auth needed)
+    - ``https://10.0.0.1`` in-cluster, with ``bearer_token`` from the
+      mounted service account and ``ca_cert`` for verification.
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        bearer_token: str | None = None,
+        ca_cert: str | None = None,
+        insecure_skip_verify: bool = False,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self._headers: dict[str, str] = {"Accept": "application/json"}
+        if bearer_token:
+            self._headers["Authorization"] = f"Bearer {bearer_token}"
+        if ca_cert:
+            self._ssl_context: ssl.SSLContext | None = ssl.create_default_context(
+                cafile=ca_cert
+            )
+        elif insecure_skip_verify:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            self._ssl_context = ctx
+        else:
+            self._ssl_context = None
+
+    @classmethod
+    def in_cluster(cls) -> "KubeTransport":
+        """Build from the standard in-cluster service-account mount."""
+        sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+        with open(f"{sa}/token", encoding="utf-8") as f:
+            token = f.read().strip()
+        return cls(
+            "https://kubernetes.default.svc",
+            bearer_token=token,
+            ca_cert=f"{sa}/ca.crt",
+        )
+
+    def request(self, path: str, timeout_s: float = DEFAULT_TIMEOUT_S) -> Any:
+        url = self.base_url + (path if path.startswith("/") else "/" + path)
+
+        def do_request() -> Any:
+            req = urllib.request.Request(url, headers=self._headers)
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=timeout_s, context=self._ssl_context
+                ) as resp:
+                    body = resp.read()
+            except urllib.error.HTTPError as e:
+                raise ApiError(path, f"HTTP {e.code}", status=e.code) from e
+            except urllib.error.URLError as e:
+                raise ApiError(path, str(e.reason)) from e
+            try:
+                return json.loads(body)
+            except json.JSONDecodeError as e:
+                raise ApiError(path, f"invalid JSON: {e}") from e
+
+        return with_timeout(do_request, timeout_s, path)
+
+
+class MockTransport:
+    """Canned-response transport for tests and the demo server.
+
+    ``routes`` maps a path (exact string or a predicate-friendly prefix
+    via :meth:`add_prefix`) to either a JSON-shaped value, an Exception
+    instance (raised), or a zero-arg callable (invoked per request — use
+    for sequenced responses). Unrouted paths raise ``ApiError`` with
+    status 404, matching an apiserver's behaviour for absent CRDs.
+    """
+
+    def __init__(self, routes: Mapping[str, Any] | None = None):
+        self.routes: dict[str, Any] = dict(routes or {})
+        self._prefix_routes: list[tuple[str, Any]] = []
+        self.calls: list[str] = []
+
+    def add(self, path: str, response: Any) -> None:
+        self.routes[path] = response
+
+    def add_prefix(self, prefix: str, response: Any) -> None:
+        self._prefix_routes.append((prefix, response))
+
+    def request(self, path: str, timeout_s: float = DEFAULT_TIMEOUT_S) -> Any:
+        self.calls.append(path)
+        if path in self.routes:
+            return self._resolve(path, self.routes[path])
+        for prefix, response in self._prefix_routes:
+            if path.startswith(prefix):
+                return self._resolve(path, response)
+        raise ApiError(path, "HTTP 404", status=404)
+
+    def _resolve(self, path: str, response: Any) -> Any:
+        if isinstance(response, Exception):
+            raise response
+        if callable(response):
+            return self._resolve(path, response())
+        return response

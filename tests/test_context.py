@@ -1,0 +1,251 @@
+"""AcceleratorDataContext tests — the tier-2 provider suite.
+
+Re-creates the reference's context test matrix
+(`/root/reference/src/api/IntelGpuDataContext.test.tsx`) against the
+multi-provider Python context: loading while lists absent, workload
+(CRD/DaemonSet) success, workload failure degrading silently (NOT an
+error — ADR-003), refresh re-running only the imperative track, UID
+dedup across fallback selector paths, and independent per-provider
+degradation (the mixed-cluster BASELINE requirement).
+"""
+
+from headlamp_tpu.context import (
+    NODES_PATH,
+    PODS_PATH,
+    AcceleratorDataContext,
+    default_sources,
+)
+from headlamp_tpu.fleet import fixtures as fx
+from headlamp_tpu.transport import ApiError, MockTransport
+
+
+def kube_list(items):
+    return {"kind": "List", "items": items}
+
+
+def make_transport(fleet=None, *, daemonsets=True, plugin_pod_paths=True):
+    """Route a fixture fleet through the same URL surface the context
+    uses. ``daemonsets=False`` simulates a cluster where the TPU
+    DaemonSet is invisible; ``plugin_pod_paths=False`` breaks every pod
+    selector path."""
+    fleet = fleet or fx.fleet_v5e4()
+    t = MockTransport()
+    t.add(NODES_PATH, kube_list(fleet["nodes"]))
+    t.add(PODS_PATH, kube_list(fleet["pods"]))
+    if daemonsets:
+        t.add(
+            "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin",
+            kube_list(fleet.get("daemonsets", [])),
+        )
+    if plugin_pod_paths:
+        plugin_pods = [
+            p
+            for p in fleet["pods"]
+            if (p.get("metadata", {}).get("labels") or {}).get("k8s-app")
+            == "tpu-device-plugin"
+        ]
+        t.add(
+            "/api/v1/pods?labelSelector=k8s-app%3Dtpu-device-plugin",
+            kube_list(plugin_pods),
+        )
+    return t
+
+
+class TestLoadingAndErrors:
+    def test_loading_before_any_sync(self):
+        ctx = AcceleratorDataContext(MockTransport())
+        assert ctx.snapshot().loading is True
+
+    def test_loading_false_after_successful_sync(self):
+        ctx = AcceleratorDataContext(make_transport())
+        snap = ctx.sync()
+        assert snap.loading is False
+        assert snap.all_nodes is not None and len(snap.all_nodes) == 2
+
+    def test_node_list_failure_surfaces_in_error(self):
+        t = make_transport()
+        t.add(NODES_PATH, ApiError(NODES_PATH, "HTTP 500", status=500))
+        snap = AcceleratorDataContext(t).sync()
+        assert snap.loading is True  # nodes never arrived
+        assert "nodes" in (snap.error or "")
+
+    def test_error_streams_joined_with_semicolon(self):
+        t = MockTransport()  # everything 404s
+        snap = AcceleratorDataContext(t).sync()
+        assert snap.error is not None
+        assert "; " in snap.error
+
+    def test_previous_list_kept_when_refetch_fails(self):
+        fleet = fx.fleet_v5e4()
+        t = make_transport(fleet)
+        ctx = AcceleratorDataContext(t)
+        ctx.sync()
+        t.add(NODES_PATH, ApiError(NODES_PATH, "HTTP 503", status=503))
+        snap = ctx.sync()
+        # Stale-but-present beats blank: the reactive track keeps the
+        # last good list, as a list+watch would.
+        assert snap.all_nodes is not None and len(snap.all_nodes) == 2
+        assert "nodes" in (snap.error or "")
+
+
+class TestWorkloadTrack:
+    def test_daemonset_fetched_for_tpu(self):
+        snap = AcceleratorDataContext(make_transport()).sync()
+        tpu_state = snap.provider("tpu")
+        assert tpu_state.workload_available is True
+        assert len(tpu_state.workloads) == 1
+        assert tpu_state.workloads[0]["metadata"]["name"] == "tpu-device-plugin"
+
+    def test_workload_absence_degrades_without_error(self):
+        # ADR-003: a missing CRD/DaemonSet source is NOT an error.
+        snap = AcceleratorDataContext(make_transport(daemonsets=False)).sync()
+        tpu_state = snap.provider("tpu")
+        assert tpu_state.workload_available is False
+        assert tpu_state.workloads == []
+        assert "daemonset" not in (snap.error or "").lower()
+
+    def test_workload_fallback_path_used(self):
+        fleet = fx.fleet_v5e4()
+        t = make_transport(fleet, daemonsets=False)
+        # Primary label-selector path 404s; namespace fallback works.
+        t.add(
+            "/apis/apps/v1/namespaces/kube-system/daemonsets",
+            kube_list(fleet["daemonsets"]),
+        )
+        snap = AcceleratorDataContext(t).sync()
+        assert snap.provider("tpu").workload_available is True
+        assert len(snap.provider("tpu").workloads) == 1
+
+    def test_namespace_fallback_filters_foreign_daemonsets(self):
+        fleet = fx.fleet_v5e4()
+        t = make_transport(fleet, daemonsets=False)
+        foreign = {
+            "kind": "DaemonSet",
+            "metadata": {"name": "kube-proxy", "namespace": "kube-system"},
+        }
+        t.add(
+            "/apis/apps/v1/namespaces/kube-system/daemonsets",
+            kube_list(fleet["daemonsets"] + [foreign]),
+        )
+        snap = AcceleratorDataContext(t).sync()
+        names = [w["metadata"]["name"] for w in snap.provider("tpu").workloads]
+        assert names == ["tpu-device-plugin"]
+
+    def test_intel_crd_absence_independent_of_tpu(self):
+        # Mixed-cluster requirement: Intel CRD missing must not affect
+        # the TPU provider's availability.
+        snap = AcceleratorDataContext(make_transport(fx.fleet_mixed())).sync()
+        assert snap.provider("tpu").workload_available is True
+        assert snap.provider("intel").workload_available is False
+        assert snap.provider("intel").plugin_installed is True  # pods + devices
+
+
+class TestPluginPods:
+    def test_plugin_pods_classified_from_reactive_list(self):
+        snap = AcceleratorDataContext(make_transport()).sync()
+        assert len(snap.provider("tpu").plugin_pods) == 1
+
+    def test_fallback_pods_deduped_by_uid(self):
+        # The same daemon pod arriving via reactive list AND a selector
+        # path must appear once (`IntelGpuDataContext.tsx:168-174`).
+        snap = AcceleratorDataContext(make_transport()).sync()
+        pods = snap.provider("tpu").plugin_pods
+        uids = [p["metadata"]["uid"] for p in pods]
+        assert len(uids) == len(set(uids))
+
+    def test_all_selector_paths_failing_records_provider_error_only(self):
+        # Per-provider, NOT the global banner: an absent provider's pod
+        # paths all failing is expected on a cluster without it, and must
+        # not render as a cluster-wide error (independent degradation).
+        t = make_transport(plugin_pod_paths=False)
+        snap = AcceleratorDataContext(t).sync()
+        assert snap.provider("tpu").plugin_pods_error is not None
+        assert "device-plugin" not in (snap.error or "")
+
+    def test_differently_labeled_daemonset_found_via_namespace_fallback(self):
+        # Primary selector path returns an empty 200 (the DaemonSet is
+        # labeled app= instead of k8s-app=); the chain must continue to
+        # the namespace fallback and match client-side by name.
+        fleet = fx.fleet_v5e4()
+        t = make_transport(fleet, daemonsets=False)
+        t.add(
+            "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin",
+            kube_list([]),
+        )
+        t.add(
+            "/apis/apps/v1/namespaces/kube-system/daemonsets",
+            kube_list(fleet["daemonsets"]),
+        )
+        snap = AcceleratorDataContext(t).sync()
+        assert snap.provider("tpu").workload_available is True
+        assert len(snap.provider("tpu").workloads) == 1
+
+    def test_snapshot_cached_between_syncs(self):
+        ctx = AcceleratorDataContext(make_transport())
+        first = ctx.sync()
+        assert ctx.snapshot() is first  # no reclassification per read
+        assert ctx.sync() is not first
+
+    def test_selector_path_supplements_restricted_pod_list(self):
+        # RBAC-restricted cluster: all-namespace pod list forbidden, but
+        # the namespaced selector path works — plugin pods still found.
+        fleet = fx.fleet_v5e4()
+        t = MockTransport()
+        t.add(NODES_PATH, kube_list(fleet["nodes"]))
+        t.add(PODS_PATH, ApiError(PODS_PATH, "HTTP 403", status=403))
+        t.add(
+            "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin",
+            kube_list(fleet["daemonsets"]),
+        )
+        plugin_pods = [p for p in fleet["pods"] if "device-plugin" in p["metadata"]["name"]]
+        t.add(
+            "/api/v1/pods?labelSelector=k8s-app%3Dtpu-device-plugin",
+            kube_list(plugin_pods),
+        )
+        snap = AcceleratorDataContext(t).sync()
+        assert snap.loading is True  # pods list still missing
+        assert len(snap.provider("tpu").plugin_pods) == 1
+
+
+class TestRefreshSemantics:
+    def test_refresh_reruns_imperative_track_only(self):
+        t = make_transport()
+        ctx = AcceleratorDataContext(t)
+        ctx.sync()
+        reactive_calls = t.calls.count(NODES_PATH)
+        imperative_path = (
+            "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin"
+        )
+        imperative_calls = t.calls.count(imperative_path)
+        snap = ctx.refresh()
+        assert t.calls.count(NODES_PATH) == reactive_calls  # untouched
+        assert t.calls.count(imperative_path) == imperative_calls + 1
+        assert snap.refresh_count == 1
+
+    def test_refresh_count_increments(self):
+        ctx = AcceleratorDataContext(make_transport())
+        ctx.sync()
+        ctx.refresh()
+        snap = ctx.refresh()
+        assert snap.refresh_count == 2
+
+
+class TestProviderViews:
+    def test_v5e4_classification(self):
+        snap = AcceleratorDataContext(make_transport(fx.fleet_v5e4())).sync()
+        tpu_state = snap.provider("tpu")
+        assert len(tpu_state.nodes) == 1
+        assert len(tpu_state.pods) == 2  # running + pending trainers
+        alloc = tpu_state.allocation_summary()
+        assert alloc["capacity"] == 4
+        assert alloc["in_use"] == 4
+
+    def test_mixed_cluster_both_providers_populated(self):
+        snap = AcceleratorDataContext(make_transport(fx.fleet_mixed())).sync()
+        assert len(snap.provider("tpu").nodes) == 4
+        assert len(snap.provider("intel").nodes) == 2
+        assert snap.provider("intel").allocation_summary()["capacity"] == 3
+
+    def test_fetched_at_uses_injected_clock(self):
+        ctx = AcceleratorDataContext(make_transport(), clock=lambda: 1234.5)
+        assert ctx.sync().fetched_at == 1234.5

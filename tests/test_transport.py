@@ -1,0 +1,76 @@
+"""Transport-layer tests: timeout contract, mock routing, error taxonomy.
+
+Mirrors the reference's withTimeout + ApiProxy mock discipline
+(`/root/reference/src/api/IntelGpuDataContext.test.tsx:155-176` exercises
+the 2 s timeout with fake timers; here the cap is real wall-clock but
+shrunk to milliseconds).
+"""
+
+import time
+
+import pytest
+
+from headlamp_tpu.transport import (
+    ApiError,
+    MockTransport,
+    RequestTimeout,
+    with_timeout,
+)
+
+
+class TestWithTimeout:
+    def test_returns_result_within_budget(self):
+        assert with_timeout(lambda: 42, timeout_s=1.0) == 42
+
+    def test_raises_request_timeout_on_expiry(self):
+        with pytest.raises(RequestTimeout) as exc_info:
+            with_timeout(lambda: time.sleep(0.5), timeout_s=0.05, path="/slow")
+        assert exc_info.value.path == "/slow"
+        assert "timed out" in str(exc_info.value)
+
+    def test_timeout_is_an_api_error(self):
+        # Callers catch ApiError for all failures — timeout included.
+        assert issubclass(RequestTimeout, ApiError)
+
+    def test_propagates_exceptions(self):
+        def boom():
+            raise ValueError("inner")
+
+        with pytest.raises(ValueError):
+            with_timeout(boom, timeout_s=1.0)
+
+
+class TestMockTransport:
+    def test_exact_route(self):
+        t = MockTransport({"/api/v1/nodes": {"items": [1, 2]}})
+        assert t.request("/api/v1/nodes") == {"items": [1, 2]}
+
+    def test_unrouted_path_is_404(self):
+        t = MockTransport()
+        with pytest.raises(ApiError) as exc_info:
+            t.request("/apis/missing")
+        assert exc_info.value.status == 404
+
+    def test_exception_response_is_raised(self):
+        t = MockTransport({"/bad": ApiError("/bad", "HTTP 500", status=500)})
+        with pytest.raises(ApiError) as exc_info:
+            t.request("/bad")
+        assert exc_info.value.status == 500
+
+    def test_callable_response_sequences(self):
+        responses = iter([{"items": []}, {"items": [{"a": 1}]}])
+        t = MockTransport({"/seq": lambda: next(responses)})
+        assert t.request("/seq") == {"items": []}
+        assert t.request("/seq") == {"items": [{"a": 1}]}
+
+    def test_prefix_route(self):
+        t = MockTransport()
+        t.add_prefix("/api/v1/namespaces/", {"items": []})
+        assert t.request("/api/v1/namespaces/kube-system/pods") == {"items": []}
+
+    def test_records_calls(self):
+        t = MockTransport({"/a": {}, "/b": {}})
+        t.request("/a")
+        t.request("/b")
+        t.request("/a")
+        assert t.calls == ["/a", "/b", "/a"]
