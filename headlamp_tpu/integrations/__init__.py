@@ -1,0 +1,16 @@
+"""Native-view integrations.
+
+The reference injects accelerator context into Headlamp's own Node/Pod
+detail pages and Nodes table
+(`/root/reference/src/components/NodeDetailSection.tsx`,
+`PodDetailSection.tsx`, `integrations/NodeColumns.tsx`). These are the
+same injections for TPU: a section for a single Node, a section for a
+single Pod, and extra Nodes-table columns — each guarded to render
+nothing for non-TPU resources.
+"""
+
+from .node_detail import node_detail_section
+from .pod_detail import pod_detail_section
+from .node_columns import build_node_tpu_columns
+
+__all__ = ["node_detail_section", "pod_detail_section", "build_node_tpu_columns"]

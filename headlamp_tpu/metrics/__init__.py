@@ -1,0 +1,32 @@
+"""Metrics layer — Prometheus client for TPU telemetry.
+
+Role-equivalent to the reference's metrics client
+(`/root/reference/src/api/metrics.ts`): service discovery over a
+candidate chain, parallel PromQL queries through the kube-apiserver
+service proxy, sample joining, and honest availability reporting. The
+i915 hwmon power series are replaced by tpu-device-plugin / libtpu
+series (BASELINE north-star: tensorcore_utilization,
+memory_bandwidth_utilization, hbm_bytes_used).
+"""
+
+from .client import (
+    LOGICAL_METRICS,
+    PROMETHEUS_SERVICES,
+    TpuChipMetrics,
+    TpuMetricsSnapshot,
+    fetch_tpu_metrics,
+    find_prometheus_path,
+)
+from .format import format_bytes, format_percent, format_ratio_bar
+
+__all__ = [
+    "LOGICAL_METRICS",
+    "PROMETHEUS_SERVICES",
+    "TpuChipMetrics",
+    "TpuMetricsSnapshot",
+    "fetch_tpu_metrics",
+    "find_prometheus_path",
+    "format_bytes",
+    "format_percent",
+    "format_ratio_bar",
+]

@@ -1,0 +1,310 @@
+"""TPU Prometheus client: discovery, fan-out queries, chip-level join.
+
+Mirrors the reference client's four-stage shape
+(`/root/reference/src/api/metrics.ts:61-154`) with TPU content:
+
+1. **Service discovery** — probe a candidate chain of Prometheus
+   services through the apiserver service proxy with a trivial query
+   (``query=1``), first responder wins (`metrics.ts:61-90`). The chain
+   adds Google Managed Prometheus's in-cluster frontend to the three
+   community-standard services.
+2. **Fan-out** — the logical TPU metrics are queried in parallel
+   (`metrics.ts:101-116` does Promise.all; here a thread pool).
+3. **Schema tolerance** — each *logical* metric (tensorcore
+   utilization, HBM used/total, memory-bandwidth utilization, duty
+   cycle) is a fallback chain of candidate series names, because the
+   tpu-device-plugin and libtpu exporters disagree on naming and label
+   schema (SURVEY.md §7 hard part (c)). First non-empty result wins.
+4. **Join** — samples join into per-chip rows keyed on
+   (node, accelerator_id), with an instance→node fallback map built
+   from ``node_uname_info`` when samples carry only ``instance``
+   (`metrics.ts:119-124`).
+
+Returns ``None`` when no Prometheus is reachable (`metrics.ts:97-98`) —
+pages render the guided "install kube-prometheus/GMP" box, never crash.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import time
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping
+
+from ..transport.api_proxy import ApiError, Transport
+
+# ---------------------------------------------------------------------------
+# Service discovery
+# ---------------------------------------------------------------------------
+
+#: Candidate (namespace, service:port) pairs, probed in order. The first
+#: three mirror the reference's community-standard chain
+#: (`metrics.ts:61-65`); the fourth is Google Managed Prometheus's
+#: in-cluster query frontend.
+PROMETHEUS_SERVICES: tuple[tuple[str, str], ...] = (
+    ("monitoring", "prometheus-k8s:9090"),
+    ("monitoring", "prometheus-operated:9090"),
+    ("monitoring", "prometheus-server:80"),
+    ("gmp-system", "frontend:9090"),
+)
+
+
+def _proxy_query_path(namespace: str, service: str, promql: str) -> str:
+    """Apiserver service-proxy path for one instant query — the same
+    route the reference uses (`metrics.ts:71-79`), so no direct network
+    path to Prometheus is needed."""
+    q = urllib.parse.quote(promql, safe="")
+    return (
+        f"/api/v1/namespaces/{namespace}/services/{service}"
+        f"/proxy/api/v1/query?query={q}"
+    )
+
+
+def find_prometheus_path(
+    transport: Transport, timeout_s: float = 2.0
+) -> tuple[str, str] | None:
+    """Probe the chain with ``query=1``; return the first working
+    (namespace, service) or None."""
+    for namespace, service in PROMETHEUS_SERVICES:
+        try:
+            data = transport.request(
+                _proxy_query_path(namespace, service, "1"), timeout_s
+            )
+        except ApiError:
+            continue
+        if isinstance(data, Mapping) and data.get("status") == "success":
+            return namespace, service
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Logical metrics and their candidate series
+# ---------------------------------------------------------------------------
+
+#: logical name -> candidate PromQL expressions, tried until one returns
+#: a non-empty vector. Order: BASELINE.json's canonical names first, then
+#: the GKE tpu-device-plugin's kubelet-style names, then libtpu exporter
+#: variants.
+LOGICAL_METRICS: dict[str, tuple[str, ...]] = {
+    "tensorcore_utilization": (
+        "tensorcore_utilization",
+        "tpu_tensorcore_utilization",
+        "kubernetes_io_node_accelerator_tensorcore_utilization",
+    ),
+    "memory_bandwidth_utilization": (
+        "memory_bandwidth_utilization",
+        "tpu_memory_bandwidth_utilization",
+        "kubernetes_io_node_accelerator_memory_bandwidth_utilization",
+    ),
+    "hbm_bytes_used": (
+        "hbm_bytes_used",
+        "tpu_hbm_memory_usage_bytes",
+        "memory_used{accelerator=~\"tpu.*\"}",
+    ),
+    "hbm_bytes_total": (
+        "hbm_bytes_total",
+        "tpu_hbm_memory_total_bytes",
+        "memory_total{accelerator=~\"tpu.*\"}",
+    ),
+    "duty_cycle": (
+        "duty_cycle{accelerator=~\"tpu.*\"}",
+        "tpu_duty_cycle",
+    ),
+}
+
+#: Instance→node mapping series, used when TPU samples carry only
+#: ``instance`` (`metrics.ts:119-124` builds the same map from it).
+NODE_MAP_QUERY = "node_uname_info"
+
+
+# ---------------------------------------------------------------------------
+# Result model
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TpuChipMetrics:
+    """One TPU chip's (or one host aggregate's) telemetry row — the
+    analogue of ``GpuChipMetrics`` (`metrics.ts:21-32`). Fractions are
+    normalized to 0-1; None means that series had no sample for this
+    chip."""
+
+    node: str
+    accelerator_id: str
+    tensorcore_utilization: float | None = None
+    memory_bandwidth_utilization: float | None = None
+    hbm_bytes_used: float | None = None
+    hbm_bytes_total: float | None = None
+    duty_cycle: float | None = None
+
+
+@dataclass
+class TpuMetricsSnapshot:
+    """Everything the MetricsPage needs, including the honesty matrix:
+    ``availability`` says which logical metrics actually returned data —
+    rendered to the user exactly as the reference's Metric Availability
+    section does (`MetricsPage.tsx:125-185`)."""
+
+    namespace: str
+    service: str
+    chips: list[TpuChipMetrics] = field(default_factory=list)
+    availability: dict[str, bool] = field(default_factory=dict)
+    #: Which candidate expression satisfied each available metric —
+    #: surfaced in diagnostics so operators know which exporter they run.
+    resolved_series: dict[str, str] = field(default_factory=dict)
+    fetched_at: float = 0.0
+
+    @property
+    def by_node(self) -> dict[str, list[TpuChipMetrics]]:
+        out: dict[str, list[TpuChipMetrics]] = {}
+        for chip in self.chips:
+            out.setdefault(chip.node, []).append(chip)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Fetch + join
+# ---------------------------------------------------------------------------
+
+def _vector_result(data: Any) -> list[Mapping[str, Any]]:
+    """Extract a successful instant-query vector; anything else -> []."""
+    if not isinstance(data, Mapping) or data.get("status") != "success":
+        return []
+    inner = data.get("data")
+    if not isinstance(inner, Mapping) or inner.get("resultType") != "vector":
+        return []
+    result = inner.get("result")
+    return [s for s in result if isinstance(s, Mapping)] if isinstance(result, list) else []
+
+
+def _sample_value(sample: Mapping[str, Any]) -> float | None:
+    value = sample.get("value")
+    if not isinstance(value, (list, tuple)) or len(value) != 2:
+        return None
+    try:
+        return float(value[1])
+    except (TypeError, ValueError):
+        return None
+
+
+def _sample_labels(sample: Mapping[str, Any]) -> Mapping[str, str]:
+    metric = sample.get("metric")
+    return metric if isinstance(metric, Mapping) else {}
+
+
+#: Label keys that may carry the node name, by exporter variant.
+_NODE_LABELS = ("node", "node_name", "exported_node", "kubernetes_node")
+#: Label keys that may carry the chip/accelerator identity.
+_CHIP_LABELS = ("accelerator_id", "device", "chip", "tpu", "gpu")
+
+
+def _node_of(labels: Mapping[str, str], instance_map: Mapping[str, str]) -> str:
+    for key in _NODE_LABELS:
+        if labels.get(key):
+            return str(labels[key])
+    instance = str(labels.get("instance", ""))
+    if instance in instance_map:
+        return instance_map[instance]
+    # Strip the port: '10.0.0.7:9100' and '10.0.0.7:8431' are one host.
+    host = instance.rsplit(":", 1)[0]
+    return instance_map.get(host, host or "unknown")
+
+
+def _chip_of(labels: Mapping[str, str]) -> str:
+    for key in _CHIP_LABELS:
+        if labels.get(key):
+            return str(labels[key])
+    return "0"
+
+
+def _build_instance_map(samples: list[Mapping[str, Any]]) -> dict[str, str]:
+    """instance (with and without port) -> nodename, from node_uname_info
+    (`metrics.ts:119-124`)."""
+    out: dict[str, str] = {}
+    for s in samples:
+        labels = _sample_labels(s)
+        nodename = str(labels.get("nodename", ""))
+        instance = str(labels.get("instance", ""))
+        if nodename and instance:
+            out[instance] = nodename
+            out[instance.rsplit(":", 1)[0]] = nodename
+    return out
+
+
+_FRACTION_METRICS = (
+    "tensorcore_utilization",
+    "memory_bandwidth_utilization",
+    "duty_cycle",
+)
+
+
+def fetch_tpu_metrics(
+    transport: Transport,
+    *,
+    timeout_s: float = 2.0,
+    clock: Callable[[], float] = time.time,
+    prometheus: tuple[str, str] | None = None,
+) -> TpuMetricsSnapshot | None:
+    """Discover Prometheus (unless ``prometheus`` pins it), fan out all
+    logical-metric candidate queries plus the node map in parallel, and
+    join into per-chip rows. None when no Prometheus answers."""
+    found = prometheus or find_prometheus_path(transport, timeout_s)
+    if found is None:
+        return None
+    namespace, service = found
+
+    def run_query(promql: str) -> list[Mapping[str, Any]]:
+        try:
+            data = transport.request(
+                _proxy_query_path(namespace, service, promql), timeout_s
+            )
+        except ApiError:
+            return []
+        return _vector_result(data)
+
+    # Fan out: every candidate of every logical metric plus the node map
+    # in one parallel wave — one slow series costs max(latency), not
+    # sum(latency). Candidate order still decides which result is used.
+    queries: list[str] = [NODE_MAP_QUERY]
+    for candidates in LOGICAL_METRICS.values():
+        queries.extend(candidates)
+    with concurrent.futures.ThreadPoolExecutor(
+        max_workers=min(8, len(queries)), thread_name_prefix="hl-tpu-promql"
+    ) as pool:
+        results = dict(zip(queries, pool.map(run_query, queries)))
+
+    instance_map = _build_instance_map(results[NODE_MAP_QUERY])
+
+    chips: dict[tuple[str, str], TpuChipMetrics] = {}
+    availability: dict[str, bool] = {}
+    resolved: dict[str, str] = {}
+    for logical, candidates in LOGICAL_METRICS.items():
+        samples: list[Mapping[str, Any]] = []
+        for promql in candidates:
+            samples = results[promql]
+            if samples:
+                resolved[logical] = promql
+                break
+        availability[logical] = bool(samples)
+        for sample in samples:
+            labels = _sample_labels(sample)
+            value = _sample_value(sample)
+            if value is None:
+                continue
+            if logical in _FRACTION_METRICS and value > 1.5:
+                value /= 100  # exporter reported 0-100
+            key = (_node_of(labels, instance_map), _chip_of(labels))
+            row = chips.get(key)
+            if row is None:
+                row = chips[key] = TpuChipMetrics(node=key[0], accelerator_id=key[1])
+            setattr(row, logical, value)
+
+    ordered = sorted(chips.values(), key=lambda c: (c.node, c.accelerator_id))
+    return TpuMetricsSnapshot(
+        namespace=namespace,
+        service=service,
+        chips=ordered,
+        availability=availability,
+        resolved_series=resolved,
+        fetched_at=clock(),
+    )

@@ -1,0 +1,25 @@
+"""Pages — the view layer.
+
+One module per page, mirroring the reference's component inventory
+(`/root/reference/src/components/`): Overview, Nodes, Pods,
+DevicePlugins, Metrics — plus TopologyPage, the genuinely new TPU view
+(ICI pod-slice mesh). Every page is a pure function
+``(snapshot, …) -> Element``; rendering and data fetching live in other
+layers.
+"""
+
+from .overview import overview_page
+from .nodes import nodes_page
+from .pods import pods_page
+from .device_plugins import device_plugins_page
+from .metrics_page import metrics_page
+from .topology_page import topology_page
+
+__all__ = [
+    "overview_page",
+    "nodes_page",
+    "pods_page",
+    "device_plugins_page",
+    "metrics_page",
+    "topology_page",
+]

@@ -1,0 +1,114 @@
+"""Shared page helpers: status mappings, pod grouping, table cells.
+
+The bits every reference page re-derives locally (phase→status
+`PodsPage.tsx:30-43`, podsByNode `NodesPage.tsx:153-159`, pod chip
+cells) — hoisted here so six pages don't carry six copies.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping
+
+from ..context.accelerator_context import ClusterSnapshot, ProviderState
+from ..domain import objects as obj
+from ..domain import tpu
+from ..ui import ErrorBox, StatusLabel, h
+from ..ui.vdom import Element
+
+
+def phase_to_status(phase: str) -> str:
+    """Pod phase -> StatusLabel status (`PodsPage.tsx:30-43`)."""
+    return {
+        "Running": "success",
+        "Succeeded": "success",
+        "Pending": "warning",
+        "Failed": "error",
+    }.get(phase, "")
+
+
+def phase_label(pod: Any) -> Element:
+    phase = obj.pod_phase(pod)
+    return StatusLabel(phase_to_status(phase), phase)
+
+
+def ready_label(ready: bool) -> Element:
+    return StatusLabel("success" if ready else "error", "Ready" if ready else "Not Ready")
+
+
+def pods_by_node(pods: Iterable[Any]) -> dict[str, list[Any]]:
+    """nodeName -> pods map (`NodesPage.tsx:153-159`)."""
+    out: dict[str, list[Any]] = {}
+    for p in pods:
+        node = obj.pod_node_name(p)
+        if node:
+            out.setdefault(node, []).append(p)
+    return out
+
+
+def pod_namespaced_name(pod: Any) -> str:
+    ns = obj.namespace(pod)
+    return f"{ns}/{obj.name(pod)}" if ns else obj.name(pod)
+
+
+def age_cell(item: Any, now: float) -> str:
+    return obj.format_age(obj.creation_timestamp(item), now)
+
+
+def error_banner(snap: ClusterSnapshot) -> Element | None:
+    """The aggregated-error box every page places at the top
+    (`OverviewPage.tsx:162-168`)."""
+    return ErrorBox(snap.error) if snap.error else None
+
+
+def waiting_reason(pod: Any) -> str:
+    """First container's waiting.reason, for the Pending-pods attention
+    table (`PodsPage.tsx:252-260`)."""
+    statuses = obj.status(pod).get("containerStatuses")
+    if not isinstance(statuses, list):
+        return ""
+    for c in statuses:
+        if isinstance(c, Mapping):
+            state = c.get("state")
+            if isinstance(state, Mapping):
+                waiting = state.get("waiting")
+                if isinstance(waiting, Mapping) and waiting.get("reason"):
+                    return str(waiting["reason"])
+    return ""
+
+
+def plugin_not_detected_box(state: ProviderState) -> Element:
+    """Install guidance when no plugin evidence exists
+    (`OverviewPage.tsx:171-196` shows the Helm hint for Intel; the TPU
+    guidance points at GKE node-pool creation, which installs the
+    device plugin automatically)."""
+    if state.provider.name == "tpu":
+        hint = (
+            "TPU device plugin not detected. On GKE, create a TPU node pool "
+            "(gcloud container node-pools create --machine-type=ct5lp-hightpu-4t …); "
+            "the device plugin DaemonSet is installed automatically in kube-system."
+        )
+    else:
+        hint = (
+            "Intel GPU device plugin not detected. Install it with Helm: "
+            "helm install intel-device-plugins-operator "
+            "intel/intel-device-plugins-operator"
+        )
+    return h(
+        "div",
+        {"class_": "hl-notice hl-plugin-missing"},
+        h("h3", None, f"{state.provider.display_name} Plugin Not Detected"),
+        h("p", None, hint),
+    )
+
+
+def tpu_node_row_summary(node: Any) -> dict[str, Any]:
+    """The per-node facts several pages tabulate."""
+    return {
+        "name": obj.name(node),
+        "ready": obj.is_node_ready(node),
+        "generation": tpu.format_accelerator(tpu.get_node_accelerator(node)),
+        "topology": tpu.get_node_topology(node) or "—",
+        "pool": tpu.get_node_pool(node) or "—",
+        "chips": tpu.get_node_chip_capacity(node),
+        "allocatable": tpu.get_node_chip_allocatable(node),
+    }

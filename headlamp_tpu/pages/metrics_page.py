@@ -1,0 +1,198 @@
+"""MetricsPage — live TPU telemetry.
+
+Rebuild of `/root/reference/src/components/MetricsPage.tsx` with the
+i915 power series replaced by TPU series. Keeps the reference's three
+honesty patterns: an always-rendered Metric Availability matrix
+(`:125-185`), a guided Prometheus-unreachable box listing the probed
+services (`:270-286`), and a no-data diagnostic (`:288-316`). Per-chip
+cards use the shared 70/90 utilization thresholds (`:50-119`).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..metrics.client import (
+    LOGICAL_METRICS,
+    PROMETHEUS_SERVICES,
+    TpuMetricsSnapshot,
+)
+from ..metrics.format import format_bytes, format_percent, format_ratio_bar
+from ..ui import (
+    NameValueTable,
+    SectionBox,
+    SimpleTable,
+    StatusLabel,
+    UtilizationBar,
+    h,
+)
+from ..ui.vdom import Element
+
+#: Human description of each logical metric for the availability matrix.
+_METRIC_DESCRIPTIONS = {
+    "tensorcore_utilization": "TensorCore (MXU) utilization per chip",
+    "memory_bandwidth_utilization": "HBM bandwidth utilization per chip",
+    "hbm_bytes_used": "HBM memory in use",
+    "hbm_bytes_total": "HBM memory capacity",
+    "duty_cycle": "Accelerator duty cycle (device-plugin exporter)",
+}
+
+
+def availability_matrix(snap: TpuMetricsSnapshot | None) -> Element:
+    """Always rendered — tells the user which series their exporters
+    actually provide instead of silently showing blanks
+    (`MetricsPage.tsx:125-185`)."""
+    rows = []
+    for logical in LOGICAL_METRICS:
+        available = bool(snap and snap.availability.get(logical))
+        rows.append(
+            {
+                "metric": logical,
+                "description": _METRIC_DESCRIPTIONS.get(logical, logical),
+                "available": available,
+                "series": (snap.resolved_series.get(logical, "—") if snap else "—"),
+            }
+        )
+    return SectionBox(
+        "Metric Availability",
+        SimpleTable(
+            [
+                {"label": "Metric", "key": "metric"},
+                {"label": "Description", "key": "description"},
+                {
+                    "label": "Available",
+                    "getter": lambda r: StatusLabel(
+                        "success" if r["available"] else "warning",
+                        "Yes" if r["available"] else "No data",
+                    ),
+                },
+                {"label": "Series", "key": "series"},
+            ],
+            rows,
+        ),
+        h(
+            "p",
+            {"class_": "hl-hint"},
+            "TPU series come from the GKE tpu-device-plugin or a libtpu "
+            "exporter; names vary by exporter version, so each metric is "
+            "resolved through a fallback chain.",
+        ),
+    )
+
+
+def prometheus_unreachable_box() -> Element:
+    """Lists every probed service (`MetricsPage.tsx:270-286`)."""
+    return h(
+        "div",
+        {"class_": "hl-notice hl-prom-missing"},
+        h("h3", None, "Prometheus not reachable"),
+        h(
+            "p",
+            None,
+            "None of the candidate Prometheus services answered via the "
+            "apiserver service proxy:",
+        ),
+        h(
+            "ul",
+            None,
+            [h("li", None, f"{ns}/{svc}") for ns, svc in PROMETHEUS_SERVICES],
+        ),
+        h(
+            "p",
+            None,
+            "Install kube-prometheus, the Prometheus Helm chart, or enable "
+            "Google Managed Prometheus with the in-cluster frontend.",
+        ),
+    )
+
+
+def no_data_box(snap: TpuMetricsSnapshot) -> Element:
+    """Prometheus answered but no TPU series exist (`:288-316`)."""
+    return h(
+        "div",
+        {"class_": "hl-notice hl-no-tpu-metrics"},
+        h("h3", None, "No TPU metrics found"),
+        h(
+            "p",
+            None,
+            f"Prometheus at {snap.namespace}/{snap.service} is reachable but "
+            "returned no TPU series. Check that the tpu-device-plugin "
+            "metrics endpoint is being scraped (PodMonitoring/ServiceMonitor) "
+            "and that TPU workloads have run recently.",
+        ),
+    )
+
+
+def chip_card(chip: Any) -> Element:
+    rows: list[tuple[str, Any]] = []
+    if chip.tensorcore_utilization is not None:
+        rows.append(
+            (
+                "TensorCore utilization",
+                UtilizationBar(round(chip.tensorcore_utilization * 100, 1), 100, unit="%"),
+            )
+        )
+    if chip.memory_bandwidth_utilization is not None:
+        rows.append(
+            (
+                "HBM bandwidth",
+                UtilizationBar(
+                    round(chip.memory_bandwidth_utilization * 100, 1), 100, unit="%"
+                ),
+            )
+        )
+    if chip.hbm_bytes_used is not None:
+        rows.append(("HBM used", format_ratio_bar(chip.hbm_bytes_used, chip.hbm_bytes_total)))
+    if chip.duty_cycle is not None:
+        rows.append(("Duty cycle", format_percent(chip.duty_cycle)))
+    return SectionBox(
+        f"{chip.node} · chip {chip.accelerator_id}",
+        NameValueTable(rows) if rows else h("p", None, "No samples"),
+        class_="hl-chip-card",
+    )
+
+
+def metrics_page(metrics: TpuMetricsSnapshot | None) -> Element:
+    children: list[Any] = [availability_matrix(metrics)]
+
+    if metrics is None:
+        children.append(prometheus_unreachable_box())
+        return h("div", {"class_": "hl-page hl-metrics"}, children)
+
+    if not metrics.chips:
+        children.append(no_data_box(metrics))
+        return h("div", {"class_": "hl-page hl-metrics"}, children)
+
+    # Fleet summary (the reference's total-power section `:318-346`,
+    # recast as fleet-wide utilization + HBM totals).
+    utils = [
+        c.tensorcore_utilization
+        for c in metrics.chips
+        if c.tensorcore_utilization is not None
+    ]
+    hbm_used = [c.hbm_bytes_used for c in metrics.chips if c.hbm_bytes_used is not None]
+    hbm_total = [c.hbm_bytes_total for c in metrics.chips if c.hbm_bytes_total is not None]
+    summary_rows: list[tuple[str, Any]] = [("Chips reporting", len(metrics.chips))]
+    if utils:
+        summary_rows.append(
+            ("Mean TensorCore utilization", format_percent(sum(utils) / len(utils)))
+        )
+    if hbm_used:
+        summary_rows.append(("Total HBM used", format_bytes(sum(hbm_used))))
+    if hbm_total:
+        summary_rows.append(("Total HBM capacity", format_bytes(sum(hbm_total))))
+    children.append(
+        SectionBox(
+            "Fleet Telemetry",
+            NameValueTable(summary_rows),
+            h(
+                "p",
+                {"class_": "hl-hint"},
+                f"Source: {metrics.namespace}/{metrics.service} via apiserver "
+                "service proxy.",
+            ),
+        )
+    )
+
+    children.extend(chip_card(c) for c in metrics.chips)
+    return h("div", {"class_": "hl-page hl-metrics"}, children)

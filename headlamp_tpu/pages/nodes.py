@@ -1,0 +1,127 @@
+"""NodesPage — per-node summary table and detail cards.
+
+Rebuild of `/root/reference/src/components/NodesPage.tsx`: summary table
+(ready, type, devices, allocation bar, pods, age), per-node detail cards
+with OS/kernel/kubelet info, empty state — with TPU columns (generation,
+topology, slice pool, worker index) replacing the Intel type column.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..context.accelerator_context import ClusterSnapshot
+from ..domain import objects as obj
+from ..domain import tpu
+from ..ui import (
+    EmptyContent,
+    Loader,
+    NameValueTable,
+    SectionBox,
+    SimpleTable,
+    UtilizationBar,
+    h,
+)
+from ..ui.vdom import Element
+from .common import age_cell, error_banner, pods_by_node, ready_label
+
+
+def _node_allocation(node: Any, node_pods: list[Any]) -> tuple[int, int]:
+    """(chips in use by Running pods on this node, allocatable chips) —
+    the per-node bar inputs (`NodesPage.tsx:35-63`)."""
+    in_use = sum(
+        tpu.get_pod_chip_request(p)
+        for p in node_pods
+        if obj.pod_phase(p) == "Running"
+    )
+    return in_use, tpu.get_node_chip_allocatable(node)
+
+
+def nodes_page(
+    snap: ClusterSnapshot, *, now: float, provider_name: str = "tpu"
+) -> Element:
+    if snap.loading:
+        return h("div", {"class_": "hl-page hl-nodes"}, Loader())
+
+    state = snap.provider(provider_name)
+    by_node = pods_by_node(state.pods)
+
+    if not state.nodes:
+        # Empty state (`NodesPage.tsx:228-249`).
+        return h(
+            "div",
+            {"class_": "hl-page hl-nodes"},
+            error_banner(snap),
+            EmptyContent(
+                h("h3", None, "No TPU nodes found"),
+                h(
+                    "p",
+                    None,
+                    "No node carries the cloud.google.com/gke-tpu-accelerator "
+                    "label or advertises google.com/tpu capacity.",
+                ),
+            ),
+        )
+
+    def alloc_bar(node: Any) -> Element:
+        in_use, allocatable = _node_allocation(node, by_node.get(obj.name(node), []))
+        return UtilizationBar(in_use, allocatable, unit="chips")
+
+    summary = SectionBox(
+        "TPU Nodes",
+        SimpleTable(
+            [
+                {"label": "Name", "getter": obj.name},
+                {"label": "Ready", "getter": lambda n: ready_label(obj.is_node_ready(n))},
+                {
+                    "label": "Generation",
+                    "getter": lambda n: tpu.format_accelerator(tpu.get_node_accelerator(n)),
+                },
+                {"label": "Topology", "getter": lambda n: tpu.get_node_topology(n) or "—"},
+                {"label": "Chips", "getter": tpu.get_node_chip_capacity},
+                {"label": "Allocation", "getter": alloc_bar},
+                {
+                    "label": "TPU Pods",
+                    "getter": lambda n: len(by_node.get(obj.name(n), [])),
+                },
+                {"label": "Age", "getter": lambda n: age_cell(n, now)},
+            ],
+            state.nodes,
+        ),
+    )
+
+    # Per-node detail cards (`NodesPage.tsx:69-139,285-291`).
+    cards = []
+    for node in state.nodes:
+        info = obj.node_info(node)
+        worker = tpu.get_node_worker_id(node)
+        in_use, allocatable = _node_allocation(node, by_node.get(obj.name(node), []))
+        cards.append(
+            SectionBox(
+                obj.name(node),
+                NameValueTable(
+                    [
+                        ("Generation", tpu.format_accelerator(tpu.get_node_accelerator(node))),
+                        ("Accelerator label", tpu.get_node_accelerator(node) or "—"),
+                        ("Topology", tpu.get_node_topology(node) or "—"),
+                        ("Node pool", tpu.get_node_pool(node) or "—"),
+                        ("Worker index", worker if worker is not None else "—"),
+                        ("Chips (capacity)", tpu.get_node_chip_capacity(node)),
+                        ("Chips (allocatable)", allocatable),
+                        ("Chips in use", in_use),
+                        ("OS", info.get("osImage", "—")),
+                        ("Kernel", info.get("kernelVersion", "—")),
+                        ("Kubelet", info.get("kubeletVersion", "—")),
+                    ]
+                ),
+                class_="hl-node-card",
+            )
+        )
+
+    return h(
+        "div",
+        {"class_": "hl-page hl-nodes"},
+        error_banner(snap),
+        summary,
+        cards,
+    )

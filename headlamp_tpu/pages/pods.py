@@ -1,0 +1,133 @@
+"""PodsPage — TPU-requesting workloads.
+
+Rebuild of `/root/reference/src/components/PodsPage.tsx`: phase summary,
+all-pods table with per-container chip requests (req=/lim= display,
+`:49-88`), restarts, and the "Attention: Pending TPU Pods" table with
+the first container's waiting reason (`:239-268`).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..context.accelerator_context import ClusterSnapshot
+from ..domain import objects as obj
+from ..domain import tpu
+from ..domain.constants import TPU_RESOURCE
+from ..ui import (
+    EmptyContent,
+    Loader,
+    NameValueTable,
+    SectionBox,
+    SimpleTable,
+    h,
+)
+from ..ui.vdom import Element
+from .common import age_cell, error_banner, phase_label, pod_namespaced_name, waiting_reason
+
+
+def container_chip_list(pod: Any) -> Element:
+    """Per-container `name: req=N lim=M` lines (`PodsPage.tsx:49-88`
+    merges requests and limits per container)."""
+    lines = []
+    for c in obj.pod_containers(pod):
+        req = obj.parse_int(obj.container_requests(c).get(TPU_RESOURCE))
+        lim = obj.parse_int(obj.container_limits(c).get(TPU_RESOURCE))
+        if req or lim:
+            lines.append(
+                h(
+                    "div",
+                    {"class_": "hl-container-chips"},
+                    f"{c.get('name', '?')}: req={req} lim={lim}",
+                )
+            )
+    return h("div", None, lines)
+
+
+def pods_page(
+    snap: ClusterSnapshot, *, now: float, provider_name: str = "tpu"
+) -> Element:
+    if snap.loading:
+        return h("div", {"class_": "hl-page hl-pods"}, Loader())
+
+    state = snap.provider(provider_name)
+
+    if not state.pods:
+        return h(
+            "div",
+            {"class_": "hl-page hl-pods"},
+            error_banner(snap),
+            EmptyContent(
+                h("h3", None, "No TPU pods found"),
+                h("p", None, "No pod requests google.com/tpu in any namespace."),
+            ),
+        )
+
+    # Phase summary (`PodsPage.tsx:102-104,166-198`).
+    phases = tpu.count_pod_phases(state.pods)
+    total_chips = sum(
+        tpu.get_pod_chip_request(p)
+        for p in state.pods
+        if obj.pod_phase(p) == "Running"
+    )
+    summary = SectionBox(
+        "TPU Workload Summary",
+        NameValueTable(
+            [
+                ("Total pods", len(state.pods)),
+                *[(k, v) for k, v in phases.items() if v or k != "Other"],
+                ("Chips in use (Running)", tpu.format_chip_count(total_chips)),
+            ]
+        ),
+    )
+
+    all_pods = SectionBox(
+        "All TPU Pods",
+        SimpleTable(
+            [
+                {"label": "Pod", "getter": pod_namespaced_name},
+                {"label": "Phase", "getter": phase_label},
+                {"label": "Node", "getter": lambda p: obj.pod_node_name(p) or "—"},
+                {"label": "Containers", "getter": container_chip_list},
+                {
+                    "label": "Chips",
+                    "getter": lambda p: tpu.get_pod_chip_request(p),
+                },
+                {"label": "Restarts", "getter": obj.pod_restarts},
+                {"label": "Age", "getter": lambda p: age_cell(p, now)},
+            ],
+            state.pods,
+        ),
+    )
+
+    # Pending attention table (`PodsPage.tsx:239-268`).
+    pending = [p for p in state.pods if obj.pod_phase(p) == "Pending"]
+    attention = None
+    if pending:
+        attention = SectionBox(
+            "Attention: Pending TPU Pods",
+            SimpleTable(
+                [
+                    {"label": "Pod", "getter": pod_namespaced_name},
+                    {
+                        "label": "Chips requested",
+                        "getter": lambda p: tpu.format_chip_count(
+                            tpu.get_pod_chip_request(p)
+                        ),
+                    },
+                    {"label": "Reason", "getter": lambda p: waiting_reason(p) or "—"},
+                    {"label": "Age", "getter": lambda p: age_cell(p, now)},
+                ],
+                pending,
+            ),
+            class_="hl-attention",
+        )
+
+    return h(
+        "div",
+        {"class_": "hl-page hl-pods"},
+        error_banner(snap),
+        summary,
+        all_pods,
+        attention,
+    )

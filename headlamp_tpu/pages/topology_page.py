@@ -1,0 +1,192 @@
+"""TopologyPage — ICI pod-slice mesh view.
+
+The genuinely new page (SURVEY.md §7 step 5; no reference analogue —
+Intel GPUs have no inter-device fabric to draw). Per slice: identity,
+health, worker table, and a rendered chip mesh — cells positioned by the
+pure geometry in ``topology.mesh``, colored per worker (host), with ICI
+links summarized per axis (drawing thousands of individual link lines
+at 1024-node scale would swamp the DOM; counts + wrap flags carry the
+same information).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..context.accelerator_context import ClusterSnapshot
+from ..topology.mesh import MeshLayout, build_mesh_layout
+from ..topology.slices import SliceInfo, group_slices, summarize_slices
+from ..ui import (
+    EmptyContent,
+    Loader,
+    NameValueTable,
+    SectionBox,
+    SimpleTable,
+    StatusLabel,
+    h,
+)
+from ..ui.vdom import Element
+from .common import error_banner, ready_label
+
+#: Cell size in px for the HTML mesh rendering.
+_CELL = 28
+_GAP = 6
+
+_HEALTH_TEXT = {
+    "success": "Healthy",
+    "warning": "Degraded",
+    "error": "Incomplete",
+}
+
+
+def mesh_grid(layout: MeshLayout, sl: SliceInfo) -> Element:
+    """Absolute-positioned chip cells; one color class per worker
+    (worker_id % 8). Unready/missing workers render hatched."""
+    ready_by_worker = {w.worker_id: w.ready for w in sl.workers}
+    cells = []
+    for cell in layout.cells:
+        ready = ready_by_worker.get(cell.worker_id)
+        state = "ok" if ready else ("missing" if ready is None else "down")
+        cells.append(
+            h(
+                "div",
+                {
+                    "class_": (
+                        f"hl-mesh-cell hl-worker-{cell.worker_id % 8} "
+                        f"hl-mesh-{state}"
+                    ),
+                    "style": (
+                        f"left:{cell.px * (_CELL + _GAP)}px;"
+                        f"top:{cell.py * (_CELL + _GAP)}px;"
+                        f"width:{_CELL}px;height:{_CELL}px"
+                    ),
+                    "title": (
+                        f"chip {cell.chip_index} coord {cell.coord} "
+                        f"worker {cell.worker_id}"
+                    ),
+                    "data-worker": cell.worker_id,
+                },
+            )
+        )
+    width = layout.width * (_CELL + _GAP)
+    height = layout.height * (_CELL + _GAP)
+    axis_counts: dict[int, int] = {}
+    wrap_axes: set[int] = set()
+    for link in layout.links:
+        axis_counts[link.axis] = axis_counts.get(link.axis, 0) + 1
+        if link.wrap:
+            wrap_axes.add(link.axis)
+    link_summary = ", ".join(
+        f"axis {axis}: {count} links" + (" (torus)" if axis in wrap_axes else "")
+        for axis, count in sorted(axis_counts.items())
+    )
+    return h(
+        "div",
+        {"class_": "hl-mesh"},
+        h(
+            "div",
+            {
+                "class_": "hl-mesh-grid",
+                "style": f"position:relative;width:{width}px;height:{height}px",
+            },
+            cells,
+        ),
+        h("p", {"class_": "hl-mesh-links"}, f"ICI: {link_summary}" if link_summary else
+          "ICI topology unknown"),
+    )
+
+
+def slice_card(sl: SliceInfo) -> Element:
+    layout = build_mesh_layout(sl)
+    worker_table = SimpleTable(
+        [
+            {"label": "Worker", "getter": lambda w: w.worker_id},
+            {"label": "Node", "getter": lambda w: w.node_name},
+            {"label": "Ready", "getter": lambda w: ready_label(w.ready)},
+            {"label": "Chips", "getter": lambda w: w.chip_capacity},
+        ],
+        sl.workers,
+    )
+    missing = sl.missing_worker_ids
+    return SectionBox(
+        f"Slice: {sl.slice_id}",
+        NameValueTable(
+            [
+                ("Health", StatusLabel(sl.health, _HEALTH_TEXT[sl.health])),
+                ("Generation", sl.generation),
+                ("Topology", sl.topology or "unknown"),
+                ("Chips", sl.total_chips),
+                ("Hosts", f"{sl.actual_hosts}/{sl.expected_hosts}"),
+                ("Multi-host", "yes" if sl.is_multi_host else "no"),
+                *(
+                    [("Missing workers", ", ".join(map(str, missing)))]
+                    if missing
+                    else []
+                ),
+            ]
+        ),
+        mesh_grid(layout, sl),
+        worker_table,
+        class_="hl-slice-card",
+    )
+
+
+def topology_page(
+    snap: ClusterSnapshot, *, provider_name: str = "tpu", max_slices: int = 64
+) -> Element:
+    """Fleet slice summary + per-slice cards. ``max_slices`` caps the
+    card list the same way the overview caps its pod table — at the
+    1024-node fixture there are hundreds of slices; unhealthy ones sort
+    first so the cap never hides a problem."""
+    if snap.loading:
+        return h("div", {"class_": "hl-page hl-topology"}, Loader())
+
+    state = snap.provider(provider_name)
+    slices = group_slices(state.nodes)
+
+    if not slices:
+        return h(
+            "div",
+            {"class_": "hl-page hl-topology"},
+            error_banner(snap),
+            EmptyContent(
+                h("h3", None, "No TPU slices found"),
+                h("p", None, "No TPU nodes to derive slice topology from."),
+            ),
+        )
+
+    ssum = summarize_slices(slices)
+    summary = SectionBox(
+        "Slice Summary",
+        NameValueTable(
+            [
+                ("Slices", ssum["total"]),
+                ("Healthy", ssum["healthy"]),
+                ("Degraded", ssum["degraded"]),
+                ("Incomplete", ssum["incomplete"]),
+                ("Multi-host", ssum["multi_host"]),
+                ("Total chips", ssum["total_chips"]),
+            ]
+        ),
+    )
+
+    health_rank = {"error": 0, "warning": 1, "success": 2}
+    ordered = sorted(slices, key=lambda s: (health_rank[s.health], s.slice_id))
+    shown = ordered[:max_slices]
+    truncation = None
+    if len(ordered) > max_slices:
+        truncation = h(
+            "p",
+            {"class_": "hl-hint"},
+            f"Showing {max_slices} of {len(ordered)} slices "
+            "(unhealthy first).",
+        )
+
+    return h(
+        "div",
+        {"class_": "hl-page hl-topology"},
+        error_banner(snap),
+        summary,
+        truncation,
+        [slice_card(s) for s in shown],
+    )

@@ -1,0 +1,131 @@
+"""Plugin registration — the entry layer.
+
+Rebuild of `/root/reference/src/index.tsx`: the reference's module body
+registers 6 sidebar entries, 5 routes, 2 detail-view sections with kind
+guards, and 1 table-columns processor against the Headlamp host
+(`index.tsx:35-182`). Here the host is the framework's own server/CLI,
+so registration is explicit: :func:`register_plugin` populates a
+:class:`Registry` the host iterates. The registry is plain data —
+hosts decide how to render routes; kind guards stay callables exactly
+like the reference's (`index.tsx:153,168`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from .integrations import (
+    build_node_tpu_columns,
+    node_detail_section,
+    pod_detail_section,
+)
+from .pages import (
+    device_plugins_page,
+    metrics_page,
+    nodes_page,
+    overview_page,
+    pods_page,
+    topology_page,
+)
+
+
+@dataclass(frozen=True)
+class SidebarEntry:
+    name: str
+    label: str
+    url: str
+    parent: str | None = None
+
+
+@dataclass(frozen=True)
+class Route:
+    path: str
+    name: str
+    #: Page factory. Calling conventions vary per page (snapshot+now,
+    #: metrics snapshot, …); hosts dispatch via ``kind``.
+    component: Callable[..., Any]
+    #: 'snapshot' pages take (snap, now=…); 'metrics' takes the metrics
+    #: snapshot; 'topology' takes (snap).
+    kind: str = "snapshot"
+
+
+@dataclass(frozen=True)
+class DetailSection:
+    #: Kubernetes kind this section attaches to ('Node' | 'Pod') — the
+    #: reference guards on resource.kind (`index.tsx:153,168`).
+    resource_kind: str
+    component: Callable[..., Any]
+
+
+@dataclass(frozen=True)
+class ColumnsProcessor:
+    #: Table id to extend — the reference targets 'headlamp-nodes'
+    #: (`index.tsx:178`).
+    table_id: str
+    build_columns: Callable[[], list[dict[str, Any]]]
+
+
+@dataclass
+class Registry:
+    sidebar_entries: list[SidebarEntry] = field(default_factory=list)
+    routes: list[Route] = field(default_factory=list)
+    detail_sections: list[DetailSection] = field(default_factory=list)
+    columns_processors: list[ColumnsProcessor] = field(default_factory=list)
+
+    def route_for(self, path: str) -> Route | None:
+        for r in self.routes:
+            if r.path == path:
+                return r
+        return None
+
+    def sections_for(self, resource_kind: str) -> list[DetailSection]:
+        return [s for s in self.detail_sections if s.resource_kind == resource_kind]
+
+
+#: Sidebar root the entries hang under.
+SIDEBAR_ROOT = "tpu"
+
+
+def register_plugin(registry: Registry | None = None) -> Registry:
+    """Populate a registry with the full plugin surface — the analogue
+    of evaluating the reference's module body (`index.tsx:35-182`):
+    6 sidebar entries, 6 routes, 2 detail sections, 1 columns
+    processor."""
+    reg = registry if registry is not None else Registry()
+
+    entries = [
+        SidebarEntry(SIDEBAR_ROOT, "Cloud TPU", "/tpu", parent=None),
+        SidebarEntry("tpu-overview", "Overview", "/tpu", parent=SIDEBAR_ROOT),
+        SidebarEntry("tpu-nodes", "Nodes", "/tpu/nodes", parent=SIDEBAR_ROOT),
+        SidebarEntry("tpu-pods", "Workloads", "/tpu/pods", parent=SIDEBAR_ROOT),
+        SidebarEntry(
+            "tpu-deviceplugins", "Device Plugin", "/tpu/deviceplugins", parent=SIDEBAR_ROOT
+        ),
+        SidebarEntry("tpu-topology", "Topology", "/tpu/topology", parent=SIDEBAR_ROOT),
+        SidebarEntry("tpu-metrics", "Metrics", "/tpu/metrics", parent=SIDEBAR_ROOT),
+    ]
+    reg.sidebar_entries.extend(entries)
+
+    reg.routes.extend(
+        [
+            Route("/tpu", "tpu-overview", overview_page),
+            Route("/tpu/nodes", "tpu-nodes", nodes_page),
+            Route("/tpu/pods", "tpu-pods", pods_page),
+            Route("/tpu/deviceplugins", "tpu-deviceplugins", device_plugins_page),
+            Route("/tpu/topology", "tpu-topology", topology_page, kind="topology"),
+            Route("/tpu/metrics", "tpu-metrics", metrics_page, kind="metrics"),
+        ]
+    )
+
+    reg.detail_sections.extend(
+        [
+            DetailSection("Node", node_detail_section),
+            DetailSection("Pod", pod_detail_section),
+        ]
+    )
+
+    reg.columns_processors.append(
+        ColumnsProcessor("headlamp-nodes", build_node_tpu_columns)
+    )
+    return reg

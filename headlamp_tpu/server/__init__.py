@@ -1,0 +1,12 @@
+"""Server — the standalone dashboard host.
+
+The reference is hosted by the Headlamp web app; this framework ships
+its own host: a zero-dependency stdlib HTTP server that hydrates the
+AcceleratorDataContext, renders registered routes to HTML, and serves
+the sidebar navigation. Point it at a kube-apiserver (``kubectl proxy``)
+or run it in demo mode against the BASELINE fixture fleets.
+"""
+
+from .app import DashboardApp, make_demo_transport
+
+__all__ = ["DashboardApp", "make_demo_transport"]

@@ -1,0 +1,51 @@
+"""CLI entry: ``python -m headlamp_tpu.server``.
+
+Modes:
+- ``--demo [v5e4|v5p32|mixed|large]`` — fixture fleets, zero cluster.
+- ``--apiserver URL``                 — real cluster (e.g. http://127.0.0.1:8001
+  from ``kubectl proxy``).
+- ``--in-cluster``                    — service-account auth inside a pod.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ..transport.api_proxy import KubeTransport
+from .app import DashboardApp, make_demo_transport
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(prog="headlamp_tpu.server")
+    parser.add_argument("--demo", nargs="?", const="v5p32",
+                        choices=["v5e4", "v5p32", "mixed", "large"], default=None)
+    parser.add_argument("--apiserver", default=None,
+                        help="kube-apiserver base URL (e.g. kubectl proxy)")
+    parser.add_argument("--in-cluster", action="store_true")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8631)
+    args = parser.parse_args(argv)
+
+    if args.demo:
+        transport = make_demo_transport(args.demo)
+        mode = f"demo fleet '{args.demo}'"
+    elif args.in_cluster:
+        transport = KubeTransport.in_cluster()
+        mode = "in-cluster"
+    elif args.apiserver:
+        transport = KubeTransport(args.apiserver)
+        mode = args.apiserver
+    else:
+        parser.error("choose one of --demo, --apiserver URL, --in-cluster")
+
+    app = DashboardApp(transport)
+    server = app.serve(args.host, args.port)
+    print(f"TPU dashboard on http://{args.host}:{args.port}/tpu ({mode})")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,232 @@
+"""Dashboard HTTP host.
+
+Serves the registered plugin surface over stdlib ``http.server``:
+
+- ``GET <route.path>``        — render the route's page
+- ``GET /refresh?back=<url>`` — imperative-track refresh then redirect
+  (the manual refresh button, `OverviewPage.tsx:143-158`)
+- ``GET /healthz``            — liveness + snapshot freshness JSON
+
+Cluster state comes from one AcceleratorDataContext synced at most once
+per ``min_sync_interval_s`` (request-coalesced polling — the reactive
+track's list+watch analogue without a background thread); the metrics
+page triggers its own Prometheus fetch per view, matching the
+reference's independent MetricsPage fetch cycle
+(`MetricsPage.tsx:199-231`).
+
+Demo mode (``python -m headlamp_tpu.server --demo v5p32``) wires a
+MockTransport over the fixture fleets plus synthetic Prometheus data so
+the full UI runs with zero cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+from urllib.parse import parse_qs, urlparse
+
+from ..context.accelerator_context import AcceleratorDataContext
+from ..metrics.client import fetch_tpu_metrics
+from ..registration import Registry, register_plugin
+from ..transport.api_proxy import MockTransport, Transport
+from ..ui import render_html
+from .style import STYLESHEET
+
+
+class DashboardApp:
+    def __init__(
+        self,
+        transport: Transport,
+        *,
+        registry: Registry | None = None,
+        min_sync_interval_s: float = 5.0,
+        clock: Any = time.time,
+    ):
+        self._ctx = AcceleratorDataContext(transport)
+        self._transport = transport
+        self._registry = registry if registry is not None else register_plugin()
+        self._min_sync = min_sync_interval_s
+        self._clock = clock
+        self._last_sync = 0.0
+        # ThreadingHTTPServer serves requests concurrently; the context
+        # and the check-then-act on _last_sync are not thread-safe, so
+        # all state mutation funnels through one lock (renders of an
+        # already-built snapshot stay lock-free).
+        self._lock = threading.Lock()
+
+    @property
+    def registry(self) -> Registry:
+        return self._registry
+
+    def _synced_snapshot(self):
+        with self._lock:
+            now = self._clock()
+            if now - self._last_sync >= self._min_sync:
+                self._ctx.sync()
+                self._last_sync = now
+            return self._ctx.snapshot()
+
+    # ------------------------------------------------------------------
+    # Request handling (framework-level, server-agnostic)
+    # ------------------------------------------------------------------
+
+    def handle(self, path: str) -> tuple[int, str, str]:
+        """(status, content_type, body) for a GET. Pure enough to test
+        without sockets."""
+        parsed = urlparse(path)
+        route_path = parsed.path.rstrip("/") or "/tpu"
+
+        if route_path == "/healthz":
+            snap = self._ctx.snapshot()
+            body = json.dumps(
+                {
+                    "ok": True,
+                    "loading": snap.loading,
+                    "errors": snap.errors,
+                    "fetched_at": snap.fetched_at,
+                }
+            )
+            return 200, "application/json", body
+
+        if route_path == "/refresh":
+            with self._lock:
+                self._ctx.refresh()
+            back = parse_qs(parsed.query).get("back", ["/tpu"])[0]
+            # Only registered route paths may be redirect targets: kills
+            # open redirects ('//evil', absolute URLs) and header
+            # injection (CR/LF) in one allowlist check.
+            if self._registry.route_for(back) is None:
+                back = "/tpu"
+            return 302, back, ""
+
+        route = self._registry.route_for(route_path)
+        if route is None:
+            return 404, "text/html", self._page_html("Not Found", "<p>No such page.</p>")
+
+        snap = self._synced_snapshot()
+        now = self._clock()
+        if route.kind == "metrics":
+            metrics = fetch_tpu_metrics(self._transport, clock=self._clock)
+            el = route.component(metrics)
+        elif route.kind == "topology":
+            el = route.component(snap)
+        else:
+            el = route.component(snap, now=now)
+        return 200, "text/html", self._page_html(route.name, render_html(el), route_path)
+
+    def _page_html(self, title: str, body: str, active: str = "") -> str:
+        nav = "".join(
+            f'<a href="{e.url}"'
+            + (' class="active"' if e.url == active else "")
+            + f">{e.label}</a>"
+            for e in self._registry.sidebar_entries
+            if e.parent is not None
+        )
+        refresh = f'<a class="hl-refresh" href="/refresh?back={active or "/tpu"}">Refresh</a>'
+        return (
+            "<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>{title} · TPU Dashboard</title>"
+            f"<style>{STYLESHEET}</style></head>"
+            f"<body><nav class='hl-nav'>{nav}{refresh}</nav>"
+            f"<main>{body}</main></body></html>"
+        )
+
+    # ------------------------------------------------------------------
+    # Socket server
+    # ------------------------------------------------------------------
+
+    def serve(self, host: str = "127.0.0.1", port: int = 8631) -> ThreadingHTTPServer:
+        app = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                status, content_type, body = app.handle(self.path)
+                if status == 302:
+                    self.send_response(302)
+                    self.send_header("Location", content_type)
+                    self.end_headers()
+                    return
+                data = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", f"{content_type}; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args: Any) -> None:
+                pass
+
+        server = ThreadingHTTPServer((host, port), Handler)
+        return server
+
+
+# ---------------------------------------------------------------------------
+# Demo mode
+# ---------------------------------------------------------------------------
+
+def make_demo_transport(fleet_name: str = "v5p32") -> MockTransport:
+    """MockTransport serving a fixture fleet plus synthetic Prometheus
+    data — the zero-cluster path for demos, verification, and benches."""
+    from ..fleet import fixtures as fx
+
+    fleets = {
+        "v5e4": fx.fleet_v5e4,
+        "v5p32": fx.fleet_v5p32,
+        "mixed": fx.fleet_mixed,
+        "large": lambda: fx.fleet_large(1024),
+    }
+    fleet = fleets[fleet_name]()
+    t = MockTransport()
+    t.add("/api/v1/nodes", {"kind": "List", "items": fleet["nodes"]})
+    t.add("/api/v1/pods", {"kind": "List", "items": fleet["pods"]})
+    t.add(
+        "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin",
+        {"kind": "List", "items": fleet.get("daemonsets", [])},
+    )
+
+    # Synthetic Prometheus: deterministic per-chip utilization.
+    import urllib.parse
+
+    def q(promql: str) -> str:
+        return (
+            "/api/v1/namespaces/monitoring/services/prometheus-k8s:9090"
+            f"/proxy/api/v1/query?query={urllib.parse.quote(promql, safe='')}"
+        )
+
+    tpu_nodes = [
+        n["metadata"]["name"]
+        for n in fleet["nodes"]
+        if "cloud.google.com/gke-tpu-accelerator" in n["metadata"].get("labels", {})
+    ]
+
+    def vec(values: list[tuple[dict, float]]) -> dict:
+        return {
+            "status": "success",
+            "data": {
+                "resultType": "vector",
+                "result": [
+                    {"metric": labels, "value": [0, str(v)]} for labels, v in values
+                ],
+            },
+        }
+
+    GIB = 1024**3
+    util, used, total = [], [], []
+    for i, node in enumerate(tpu_nodes[:64]):
+        for chip in range(4):
+            labels = {"node": node, "accelerator_id": str(chip)}
+            util.append((labels, round(0.35 + 0.13 * ((i * 4 + chip) % 5), 2)))
+            used.append((labels, (8 + (i + chip) % 7) * GIB))
+            total.append((labels, 16 * GIB))
+    t.add(q("1"), {"status": "success", "data": {"resultType": "scalar", "result": [0, "1"]}})
+    t.add_prefix(
+        "/api/v1/namespaces/monitoring/services/prometheus-k8s:9090/proxy/api/v1/query",
+        vec([]),
+    )
+    t.add(q("tensorcore_utilization"), vec(util))
+    t.add(q("hbm_bytes_used"), vec(used))
+    t.add(q("hbm_bytes_total"), vec(total))
+    return t

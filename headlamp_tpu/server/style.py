@@ -1,0 +1,68 @@
+"""Stylesheet for the dashboard host — keyed off the ``hl-*`` classes
+the UI kit emits. Kept as a Python constant so the server stays a
+single zero-dependency package."""
+
+STYLESHEET = """
+:root { --ok:#2e7d32; --warn:#ed6c02; --err:#d32f2f; --ink:#1a1a24;
+        --muted:#667; --line:#e0e0e8; --bg:#f7f7fa; }
+* { box-sizing:border-box; }
+body { margin:0; font:14px/1.5 system-ui,sans-serif; color:var(--ink);
+       background:var(--bg); }
+.hl-nav { display:flex; gap:4px; padding:10px 16px; background:#fff;
+          border-bottom:1px solid var(--line); position:sticky; top:0; }
+.hl-nav a { padding:6px 12px; border-radius:6px; color:var(--ink);
+            text-decoration:none; }
+.hl-nav a.active { background:var(--bg); font-weight:600; }
+.hl-nav .hl-refresh { margin-left:auto; color:var(--muted); }
+main { max-width:1100px; margin:0 auto; padding:16px; }
+.hl-section { background:#fff; border:1px solid var(--line);
+              border-radius:8px; padding:14px 16px; margin:14px 0; }
+.hl-section-title { margin:0 0 10px; font-size:16px; }
+.hl-table { border-collapse:collapse; width:100%; }
+.hl-table th { text-align:left; color:var(--muted); font-weight:600;
+               border-bottom:1px solid var(--line); padding:6px 8px; }
+.hl-table td { border-bottom:1px solid var(--line); padding:6px 8px;
+               vertical-align:top; }
+.hl-namevalue { display:grid; grid-template-columns:220px 1fr; gap:4px 12px;
+                margin:0; }
+.hl-namevalue dt { color:var(--muted); }
+.hl-namevalue dd { margin:0; }
+.hl-status { padding:2px 8px; border-radius:10px; font-size:12px;
+             color:#fff; }
+.hl-status-ok { background:var(--ok); } .hl-status-warn { background:var(--warn); }
+.hl-status-err { background:var(--err); } .hl-status-neutral { background:var(--muted); }
+.hl-error { background:#fdecea; border:1px solid var(--err); color:var(--err);
+            border-radius:8px; padding:10px 14px; margin:14px 0; }
+.hl-notice { background:#fff8e1; border:1px solid var(--warn);
+             border-radius:8px; padding:10px 14px; margin:14px 0; }
+.hl-empty-content { background:#fff; border:1px dashed var(--line);
+                    border-radius:8px; padding:22px; text-align:center;
+                    color:var(--muted); margin:14px 0; }
+.hl-utilbar { position:relative; background:var(--bg); border:1px solid
+              var(--line); border-radius:6px; height:20px; min-width:160px; }
+.hl-utilbar-fill { height:100%; border-radius:5px; background:var(--ok); }
+.hl-utilbar-warn .hl-utilbar-fill { background:var(--warn); }
+.hl-utilbar-err .hl-utilbar-fill { background:var(--err); }
+.hl-utilbar-label { position:absolute; inset:0; display:flex; align-items:center;
+                    justify-content:center; font-size:11px; }
+.hl-pctbar-track { display:flex; height:14px; border-radius:6px;
+                   overflow:hidden; background:var(--bg); }
+.hl-pctbar-part { background:var(--ok); }
+.hl-pctbar-part:nth-child(2n) { background:#1565c0; }
+.hl-pctbar-part:nth-child(3n) { background:var(--warn); }
+.hl-pctbar-legend { color:var(--muted); font-size:12px; display:flex; gap:12px;
+                    margin-top:4px; }
+.hl-hint { color:var(--muted); font-size:12px; }
+.hl-loader { padding:30px; text-align:center; color:var(--muted); }
+.hl-mesh-grid { margin:10px 0; }
+.hl-mesh-cell { position:absolute; border-radius:4px; border:1px solid #fff; }
+.hl-worker-0 { background:#1565c0; } .hl-worker-1 { background:#2e7d32; }
+.hl-worker-2 { background:#ed6c02; } .hl-worker-3 { background:#6a1b9a; }
+.hl-worker-4 { background:#00838f; } .hl-worker-5 { background:#c62828; }
+.hl-worker-6 { background:#4e342e; } .hl-worker-7 { background:#37474f; }
+.hl-mesh-down { opacity:0.35; border-style:dashed; }
+.hl-mesh-missing { background:repeating-linear-gradient(45deg,#ccc,#ccc 4px,
+                   #eee 4px,#eee 8px) !important; }
+.hl-mesh-links { color:var(--muted); font-size:12px; }
+.hl-attention { border-color:var(--warn); }
+"""

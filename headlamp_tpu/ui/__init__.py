@@ -1,0 +1,48 @@
+"""UI kit — element tree and common components.
+
+The reference renders through Headlamp's CommonComponents
+(`SectionBox`, `SimpleTable`, `NameValueTable`, `StatusLabel`,
+`PercentageBar`, `Loader`, `SectionHeader` — e.g.
+`/root/reference/src/components/OverviewPage.tsx:8-16`). This package is
+the framework's own implementation of that kit over a minimal immutable
+element tree that renders to HTML (dashboard server) and plain text
+(CLI/tests). Pages build trees; renderers are separate — the same
+separation React gives the reference.
+"""
+
+from .vdom import Element, find_all, h, render_html, render_text, text_content
+from .components import (
+    BAR_CRIT_PCT,
+    BAR_WARN_PCT,
+    EmptyContent,
+    ErrorBox,
+    Loader,
+    NameValueTable,
+    PercentageBar,
+    SectionBox,
+    SectionHeader,
+    SimpleTable,
+    StatusLabel,
+    UtilizationBar,
+)
+
+__all__ = [
+    "Element",
+    "h",
+    "render_html",
+    "render_text",
+    "text_content",
+    "find_all",
+    "BAR_CRIT_PCT",
+    "BAR_WARN_PCT",
+    "EmptyContent",
+    "ErrorBox",
+    "Loader",
+    "NameValueTable",
+    "PercentageBar",
+    "SectionBox",
+    "SectionHeader",
+    "SimpleTable",
+    "StatusLabel",
+    "UtilizationBar",
+]

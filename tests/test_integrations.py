@@ -1,0 +1,131 @@
+"""Integration-component tests: the null-render contract for
+non-matching resources, with both raw and jsonData-wrapped inputs —
+mirroring `NodeDetailSection.test.tsx:84-95` and
+`PodDetailSection.test.tsx:81-90` — plus the '—' fallback of the
+Nodes-table columns (`NodeColumns.tsx:21-46`).
+"""
+
+from headlamp_tpu.context import AcceleratorDataContext, NODES_PATH, PODS_PATH
+from headlamp_tpu.fleet import fixtures as fx
+from headlamp_tpu.integrations import (
+    build_node_tpu_columns,
+    node_detail_section,
+    pod_detail_section,
+)
+from headlamp_tpu.registration import Registry, register_plugin
+from headlamp_tpu.transport import MockTransport
+from headlamp_tpu.ui import render_html, text_content
+
+
+def snapshot_for(fleet):
+    t = MockTransport()
+    t.add(NODES_PATH, {"items": fleet["nodes"]})
+    t.add(PODS_PATH, {"items": fleet["pods"]})
+    t.add(
+        "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin",
+        {"items": fleet.get("daemonsets", [])},
+    )
+    return AcceleratorDataContext(t).sync()
+
+
+class TestNodeDetailSection:
+    def test_null_for_non_tpu_node(self):
+        assert node_detail_section(fx.make_plain_node("n")) is None
+
+    def test_null_for_wrapped_non_tpu_node(self):
+        wrapped = {"jsonData": fx.make_plain_node("n")}
+        assert node_detail_section(wrapped) is None
+
+    def test_null_for_labeled_node_without_capacity(self):
+        node = fx.make_plain_node("n")
+        node["metadata"]["labels"][
+            "cloud.google.com/gke-tpu-accelerator"
+        ] = "tpu-v5-lite-podslice"
+        assert node_detail_section(node) is None
+
+    def test_renders_for_tpu_node_with_context(self):
+        fleet = fx.fleet_v5p32()
+        snap = snapshot_for(fleet)
+        el = node_detail_section(fleet["nodes"][0], snap)
+        text = text_content(el)
+        assert "TPU v5p" in text
+        assert "Slice v5p-pool" in text
+        assert "Worker index 0" in text
+        assert "ml/megatrain-0 (4 chips)" in text
+
+    def test_renders_with_wrapped_input(self):
+        fleet = fx.fleet_v5e4()
+        el = node_detail_section({"jsonData": fleet["nodes"][0]})
+        assert "TPU v5e" in text_content(el)
+        assert "Loading…" in text_content(el)  # no context provided
+
+    def test_pods_empty_message(self):
+        fleet = fx.fleet_v5e4()
+        fleet["pods"] = []
+        snap = snapshot_for(fleet)
+        el = node_detail_section(fleet["nodes"][0], snap)
+        assert "No TPU pods on this node" in text_content(el)
+
+
+class TestPodDetailSection:
+    def test_null_for_non_tpu_pod(self):
+        assert pod_detail_section(fx.make_intel_pod("p")) is None
+
+    def test_null_for_wrapped_non_tpu_pod(self):
+        assert pod_detail_section({"jsonData": fx.make_intel_pod("p")}) is None
+
+    def test_renders_container_rows(self):
+        pod = fx.make_tpu_pod("train", node="n1", chips=8)
+        el = pod_detail_section(pod)
+        text = text_content(el)
+        assert "worker → google.com/tpu" in text
+        assert "request 8 / limit 8" in text
+        assert "Effective chips 8 chips" in text
+        assert "Node n1" in text
+
+    def test_wrapped_input(self):
+        el = pod_detail_section({"jsonData": fx.make_tpu_pod("t", chips=1)})
+        assert "Effective chips 1 chip" in text_content(el)
+
+
+class TestNodeColumns:
+    def test_tpu_node_cells(self):
+        cols = build_node_tpu_columns()
+        node = fx.make_tpu_node("n", topology="2x2", chips=4)
+        values = [c["getter"](node) for c in cols]
+        assert values == ["TPU v5e", "4", "2x2"]
+
+    def test_non_tpu_node_dashes(self):
+        cols = build_node_tpu_columns()
+        node = fx.make_plain_node("n")
+        assert [c["getter"](node) for c in cols] == ["—", "—", "—"]
+
+    def test_wrapped_rows(self):
+        cols = build_node_tpu_columns()
+        wrapped = {"jsonData": fx.make_tpu_node("n", chips=8, topology="2x4")}
+        assert [c["getter"](wrapped) for c in cols] == ["TPU v5e", "8", "2x4"]
+
+
+class TestRegistration:
+    def test_full_surface_registered(self):
+        reg = register_plugin()
+        assert len(reg.sidebar_entries) == 7  # root + 6 children
+        assert len(reg.routes) == 6
+        assert {r.path for r in reg.routes} == {
+            "/tpu", "/tpu/nodes", "/tpu/pods", "/tpu/deviceplugins",
+            "/tpu/topology", "/tpu/metrics",
+        }
+        assert [s.resource_kind for s in reg.detail_sections] == ["Node", "Pod"]
+        assert reg.columns_processors[0].table_id == "headlamp-nodes"
+
+    def test_route_lookup_and_kind_guards(self):
+        reg = register_plugin()
+        assert reg.route_for("/tpu/topology").kind == "topology"
+        assert reg.route_for("/nope") is None
+        assert len(reg.sections_for("Node")) == 1
+        assert reg.sections_for("Deployment") == []
+
+    def test_registry_reuse(self):
+        reg = Registry()
+        out = register_plugin(reg)
+        assert out is reg

@@ -1,0 +1,179 @@
+"""Metrics-client tests: discovery chain, schema fallback, join, honesty.
+
+Mirrors the reference's metrics behaviors (probe fallback
+`metrics.ts:61-90`, parallel queries + join `:101-149`, null on no
+Prometheus `:97-98`) against mocked service-proxy routes.
+"""
+
+import urllib.parse
+
+from headlamp_tpu.metrics import (
+    LOGICAL_METRICS,
+    TpuMetricsSnapshot,
+    fetch_tpu_metrics,
+    find_prometheus_path,
+    format_bytes,
+    format_percent,
+    format_ratio_bar,
+)
+from headlamp_tpu.metrics.format import normalize_fraction
+from headlamp_tpu.transport import MockTransport
+
+GIB = 1024**3
+
+
+def proxy_path(promql, namespace="monitoring", service="prometheus-k8s:9090"):
+    q = urllib.parse.quote(promql, safe="")
+    return f"/api/v1/namespaces/{namespace}/services/{service}/proxy/api/v1/query?query={q}"
+
+
+def vector(samples):
+    return {
+        "status": "success",
+        "data": {
+            "resultType": "vector",
+            "result": [
+                {"metric": labels, "value": [1785283200.0, str(value)]}
+                for labels, value in samples
+            ],
+        },
+    }
+
+
+def make_prom_transport(series=None, *, namespace="monitoring", service="prometheus-k8s:9090"):
+    """Transport with one live Prometheus serving ``series``
+    (promql -> [(labels, value)]); every other query returns an empty
+    vector (success, no samples)."""
+    t = MockTransport()
+    prefix = f"/api/v1/namespaces/{namespace}/services/{service}/proxy/api/v1/query"
+    t.add_prefix(prefix, vector([]))
+    t.add(proxy_path("1", namespace, service), {"status": "success", "data": {"resultType": "scalar", "result": [0, "1"]}})
+    for promql, samples in (series or {}).items():
+        t.add(proxy_path(promql, namespace, service), vector(samples))
+    return t
+
+
+class TestDiscovery:
+    def test_first_service_wins(self):
+        t = make_prom_transport()
+        assert find_prometheus_path(t) == ("monitoring", "prometheus-k8s:9090")
+
+    def test_fallback_to_gmp_frontend(self):
+        t = make_prom_transport(namespace="gmp-system", service="frontend:9090")
+        assert find_prometheus_path(t) == ("gmp-system", "frontend:9090")
+
+    def test_no_prometheus_returns_none(self):
+        assert find_prometheus_path(MockTransport()) is None
+        assert fetch_tpu_metrics(MockTransport()) is None
+
+    def test_probe_rejects_non_success_payload(self):
+        t = MockTransport()
+        t.add(proxy_path("1"), {"status": "error"})
+        assert find_prometheus_path(t) is None
+
+
+class TestFetchAndJoin:
+    def test_canonical_series_joined_per_chip(self):
+        node = "gke-tpu-node-1"
+        t = make_prom_transport({
+            "tensorcore_utilization": [
+                ({"node": node, "accelerator_id": "0"}, 0.85),
+                ({"node": node, "accelerator_id": "1"}, 0.42),
+            ],
+            "hbm_bytes_used": [({"node": node, "accelerator_id": "0"}, 12 * GIB)],
+            "hbm_bytes_total": [({"node": node, "accelerator_id": "0"}, 16 * GIB)],
+        })
+        snap = fetch_tpu_metrics(t)
+        assert isinstance(snap, TpuMetricsSnapshot)
+        assert len(snap.chips) == 2
+        chip0 = snap.chips[0]
+        assert chip0.tensorcore_utilization == 0.85
+        assert chip0.hbm_bytes_used == 12 * GIB
+        assert chip0.hbm_bytes_total == 16 * GIB
+        assert snap.chips[1].tensorcore_utilization == 0.42
+        assert snap.chips[1].hbm_bytes_used is None
+
+    def test_fallback_series_names_used_when_canonical_empty(self):
+        # Exporter-variant schema: tpu_* names instead of BASELINE names.
+        t = make_prom_transport({
+            "tpu_tensorcore_utilization": [({"node_name": "n1", "device": "tpu-3"}, 0.5)],
+        })
+        snap = fetch_tpu_metrics(t)
+        assert snap.availability["tensorcore_utilization"] is True
+        assert snap.resolved_series["tensorcore_utilization"] == "tpu_tensorcore_utilization"
+        assert snap.chips[0].node == "n1"
+        assert snap.chips[0].accelerator_id == "tpu-3"
+
+    def test_percent_scaled_exporters_normalized(self):
+        t = make_prom_transport({
+            "tensorcore_utilization": [({"node": "n1"}, 87.5)],  # 0-100 scale
+        })
+        snap = fetch_tpu_metrics(t)
+        assert snap.chips[0].tensorcore_utilization == 0.875
+
+    def test_instance_mapped_to_nodename(self):
+        # Samples carrying only `instance` join through node_uname_info
+        # exactly like the reference's i915 power join.
+        t = make_prom_transport({
+            "node_uname_info": [({"instance": "10.0.0.7:9100", "nodename": "gke-w0"}, 1)],
+            "duty_cycle{accelerator=~\"tpu.*\"}": [({"instance": "10.0.0.7:8431"}, 0.93)],
+        })
+        snap = fetch_tpu_metrics(t)
+        assert snap.chips[0].node == "gke-w0"
+        assert snap.chips[0].duty_cycle == 0.93
+
+    def test_availability_matrix_is_honest(self):
+        t = make_prom_transport({
+            "tensorcore_utilization": [({"node": "n1"}, 0.1)],
+        })
+        snap = fetch_tpu_metrics(t)
+        assert snap.availability["tensorcore_utilization"] is True
+        assert snap.availability["memory_bandwidth_utilization"] is False
+        assert snap.availability["hbm_bytes_used"] is False
+        assert set(snap.availability) == set(LOGICAL_METRICS)
+
+    def test_pinned_prometheus_skips_probe(self):
+        t = make_prom_transport({"tensorcore_utilization": [({"node": "n1"}, 0.2)]})
+        snap = fetch_tpu_metrics(t, prometheus=("monitoring", "prometheus-k8s:9090"))
+        assert snap is not None
+        probe = proxy_path("1")
+        assert probe not in t.calls
+
+    def test_clock_injected(self):
+        t = make_prom_transport()
+        snap = fetch_tpu_metrics(t, clock=lambda: 99.0)
+        assert snap.fetched_at == 99.0
+
+    def test_by_node_grouping(self):
+        t = make_prom_transport({
+            "tensorcore_utilization": [
+                ({"node": "a", "accelerator_id": "0"}, 0.1),
+                ({"node": "b", "accelerator_id": "0"}, 0.2),
+                ({"node": "a", "accelerator_id": "1"}, 0.3),
+            ],
+        })
+        snap = fetch_tpu_metrics(t)
+        assert sorted(snap.by_node) == ["a", "b"]
+        assert len(snap.by_node["a"]) == 2
+
+
+class TestFormatters:
+    def test_format_percent(self):
+        assert format_percent(0.874) == "87.4%"
+        assert format_percent(None) == "—"
+        assert format_percent(87.4) == "87.4%"  # pre-scaled input
+
+    def test_normalize_fraction(self):
+        assert normalize_fraction(0.5) == 0.5
+        assert normalize_fraction(50) == 0.5
+        assert normalize_fraction(None) is None
+
+    def test_format_bytes(self):
+        assert format_bytes(None) == "—"
+        assert format_bytes(512) == "512 B"
+        assert format_bytes(15 * GIB) == "15.0 GiB"
+
+    def test_format_ratio_bar(self):
+        assert format_ratio_bar(12 * GIB, 16 * GIB) == "12.0 GiB / 16.0 GiB (75%)"
+        assert format_ratio_bar(None, 16 * GIB) == "—"
+        assert format_ratio_bar(1, 0) == "—"

@@ -1,0 +1,298 @@
+"""Page tests — tier 3 of the reference's test strategy (SURVEY.md §4):
+drive every page against fixture-built snapshots and assert on rendered
+structure/text, exactly as the reference's component tests assert
+section titles and empty/error/loaded branches via testing-library.
+"""
+
+import pytest
+
+from headlamp_tpu.context import AcceleratorDataContext, NODES_PATH, PODS_PATH
+from headlamp_tpu.fleet import fixtures as fx
+from headlamp_tpu.metrics.client import TpuChipMetrics, TpuMetricsSnapshot
+from headlamp_tpu.pages import (
+    device_plugins_page,
+    metrics_page,
+    nodes_page,
+    overview_page,
+    pods_page,
+    topology_page,
+)
+from headlamp_tpu.transport import ApiError, MockTransport
+from headlamp_tpu.ui import find_all, render_html, render_text, text_content
+
+NOW = fx.FIXTURE_NOW_EPOCH
+GIB = 1024**3
+
+
+def snapshot_for(fleet):
+    t = MockTransport()
+    t.add(NODES_PATH, {"items": fleet["nodes"]})
+    t.add(PODS_PATH, {"items": fleet["pods"]})
+    t.add(
+        "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin",
+        {"items": fleet.get("daemonsets", [])},
+    )
+    return AcceleratorDataContext(t).sync()
+
+
+def loading_snapshot():
+    return AcceleratorDataContext(MockTransport()).snapshot()
+
+
+@pytest.fixture(scope="module")
+def v5e4():
+    return snapshot_for(fx.fleet_v5e4())
+
+
+@pytest.fixture(scope="module")
+def v5p32():
+    return snapshot_for(fx.fleet_v5p32())
+
+
+@pytest.fixture(scope="module")
+def mixed():
+    return snapshot_for(fx.fleet_mixed())
+
+
+def titles(el):
+    return [text_content(e) for e in find_all(el, lambda e: e.tag == "h2")]
+
+
+class TestOverviewPage:
+    def test_loading_branch(self):
+        el = overview_page(loading_snapshot(), now=NOW)
+        assert "Loading" in text_content(el)
+
+    def test_v5e4_sections_and_counts(self, v5e4):
+        el = overview_page(v5e4, now=NOW)
+        t = titles(el)
+        assert "Device Plugin" in t
+        assert "TPU Nodes" in t
+        assert "Chip Allocation" in t
+        assert "Pod Slices" in t
+        text = text_content(el)
+        assert "Capacity 4 chips" in text
+        assert "In use 4 chips" in text
+        assert "1/1 ready" in text
+
+    def test_error_banner(self):
+        fleet = fx.fleet_v5e4()
+        t = MockTransport()
+        t.add(NODES_PATH, {"items": fleet["nodes"]})
+        t.add(PODS_PATH, ApiError(PODS_PATH, "HTTP 500", status=500))
+        snap = AcceleratorDataContext(t).sync()
+        el = overview_page(snap, now=NOW)
+        assert "Loading" in text_content(el)  # pods never arrived
+
+    def test_plugin_not_detected(self):
+        fleet = {"nodes": [fx.make_plain_node("n1")], "pods": []}
+        snap = snapshot_for(fleet)
+        el = overview_page(snap, now=NOW)
+        assert "Plugin Not Detected" in text_content(el)
+        assert "gcloud container node-pools create" in text_content(el)
+
+    def test_workload_missing_notice(self):
+        fleet = fx.fleet_v5e4()
+        t = MockTransport()
+        t.add(NODES_PATH, {"items": fleet["nodes"]})
+        t.add(PODS_PATH, {"items": fleet["pods"]})
+        snap = AcceleratorDataContext(t).sync()  # daemonset paths 404
+        el = overview_page(snap, now=NOW)
+        assert "workload status not available" in text_content(el)
+
+    def test_active_pods_capped_at_10(self):
+        nodes = [fx.make_tpu_node(f"n{i}", chips=8) for i in range(4)]
+        pods = [fx.make_tpu_pod(f"p{i}", node="n0", chips=1) for i in range(25)]
+        snap = snapshot_for({"nodes": nodes, "pods": pods})
+        el = overview_page(snap, now=NOW)
+        tables = find_all(
+            el, lambda e: e.tag == "section" and "hl-section" in e.props.get("class_", "")
+        )
+        active = [s for s in tables if "Active TPU Pods" in text_content(s)][0]
+        rows = find_all(active, lambda e: e.tag == "tr")
+        assert len(rows) == 11  # header + 10
+
+    def test_mixed_cluster_intel_view(self, mixed):
+        el = overview_page(mixed, now=NOW, provider_name="intel")
+        text = text_content(el)
+        assert "Capacity 3 device" in text or "Capacity" in text
+
+
+class TestNodesPage:
+    def test_loading(self):
+        assert "Loading" in text_content(nodes_page(loading_snapshot(), now=NOW))
+
+    def test_empty_state(self):
+        snap = snapshot_for({"nodes": [fx.make_plain_node("n")], "pods": []})
+        el = nodes_page(snap, now=NOW)
+        assert "No TPU nodes found" in text_content(el)
+
+    def test_v5p32_rows_and_cards(self, v5p32):
+        el = nodes_page(v5p32, now=NOW)
+        text = text_content(el)
+        assert "gke-v5p-pool-w0" in text
+        assert "TPU v5p" in text
+        assert "2x2x4" in text
+        # Per-node card facts.
+        assert "Container-Optimized OS from Google" in text
+        assert "Worker index" in text
+
+    def test_unready_node_marked(self, v5p32):
+        el = nodes_page(v5p32, now=NOW)
+        html = render_html(el)
+        assert "hl-status-err" in html  # w3 is not ready
+
+    def test_allocation_bar_present(self, v5e4):
+        el = nodes_page(v5e4, now=NOW)
+        assert "hl-utilbar" in render_html(el)
+
+
+class TestPodsPage:
+    def test_empty_state(self):
+        snap = snapshot_for({"nodes": [], "pods": []})
+        assert "No TPU pods found" in text_content(pods_page(snap, now=NOW))
+
+    def test_v5e4_summary_and_pending_attention(self, v5e4):
+        el = pods_page(v5e4, now=NOW)
+        text = text_content(el)
+        assert "Total pods 2" in text
+        assert "Attention: Pending TPU Pods" in text
+        assert "Unschedulable" in text
+
+    def test_container_req_lim_display(self, v5e4):
+        el = pods_page(v5e4, now=NOW)
+        assert "worker: req=4 lim=4" in text_content(el)
+
+    def test_restarts_column(self):
+        pods = [fx.make_tpu_pod("p", node="n", restarts=3)]
+        snap = snapshot_for({"nodes": [fx.make_tpu_node("n")], "pods": pods})
+        el = pods_page(snap, now=NOW)
+        rows = find_all(el, lambda e: e.tag == "tr")
+        assert any("\t3\t" in render_text(r) for r in rows)
+
+
+class TestDevicePluginsPage:
+    def test_daemonset_card(self, v5p32):
+        el = device_plugins_page(v5p32, now=NOW)
+        text = text_content(el)
+        assert "DaemonSet: kube-system/tpu-device-plugin" in text
+        assert "Desired 4" in text
+        assert "4/4 ready" in text
+
+    def test_degraded_rollout_status(self):
+        fleet = fx.fleet_v5e4()
+        fleet["daemonsets"] = [fx.make_plugin_daemonset(desired=3, ready=1, unavailable=2)]
+        el = device_plugins_page(snapshot_for(fleet), now=NOW)
+        assert "1/3 ready" in text_content(el)
+        assert "hl-status-warn" in render_html(el)
+
+    def test_source_unavailable_box(self):
+        fleet = fx.fleet_v5e4()
+        t = MockTransport()
+        t.add(NODES_PATH, {"items": fleet["nodes"]})
+        t.add(PODS_PATH, {"items": fleet["pods"]})
+        snap = AcceleratorDataContext(t).sync()
+        el = device_plugins_page(snap, now=NOW)
+        assert "Plugin workload status not available" in text_content(el)
+
+    def test_readable_but_empty(self):
+        fleet = fx.fleet_v5e4()
+        fleet["daemonsets"] = []
+        el = device_plugins_page(snapshot_for(fleet), now=NOW)
+        assert "No device-plugin workloads found" in text_content(el)
+
+
+class TestMetricsPage:
+    def test_prometheus_unreachable(self):
+        el = metrics_page(None)
+        text = text_content(el)
+        assert "Prometheus not reachable" in text
+        assert "monitoring/prometheus-k8s:9090" in text
+        assert "gmp-system/frontend:9090" in text
+        # Availability matrix still rendered, honestly all-No.
+        assert "Metric Availability" in text
+
+    def test_no_tpu_series_diagnostic(self):
+        snap = TpuMetricsSnapshot(namespace="monitoring", service="prometheus-k8s:9090")
+        el = metrics_page(snap)
+        assert "No TPU metrics found" in text_content(el)
+
+    def test_chips_rendered_with_bars(self):
+        snap = TpuMetricsSnapshot(
+            namespace="monitoring",
+            service="prometheus-k8s:9090",
+            chips=[
+                TpuChipMetrics(
+                    node="n1",
+                    accelerator_id="0",
+                    tensorcore_utilization=0.95,
+                    hbm_bytes_used=12 * GIB,
+                    hbm_bytes_total=16 * GIB,
+                ),
+                TpuChipMetrics(node="n1", accelerator_id="1", duty_cycle=0.5),
+            ],
+            availability={"tensorcore_utilization": True},
+        )
+        el = metrics_page(snap)
+        text = text_content(el)
+        assert "Chips reporting 2" in text
+        assert "Mean TensorCore utilization 95.0%" in text
+        assert "12.0 GiB / 16.0 GiB (75%)" in text
+        assert "hl-utilbar-err" in render_html(el)  # 95% ≥ crit
+
+    def test_availability_matrix_rows(self):
+        snap = TpuMetricsSnapshot(
+            namespace="m",
+            service="s",
+            chips=[TpuChipMetrics(node="n", accelerator_id="0", duty_cycle=0.1)],
+            availability={"duty_cycle": True, "tensorcore_utilization": False},
+            resolved_series={"duty_cycle": "tpu_duty_cycle"},
+        )
+        el = metrics_page(snap)
+        text = text_content(el)
+        assert "tpu_duty_cycle" in text
+        assert "No data" in text
+
+
+class TestTopologyPage:
+    def test_empty(self):
+        snap = snapshot_for({"nodes": [], "pods": []})
+        assert "No TPU slices found" in text_content(topology_page(snap))
+
+    def test_v5p32_slice_card(self, v5p32):
+        el = topology_page(v5p32)
+        text = text_content(el)
+        assert "Slice: v5p-pool" in text
+        assert "Topology 2x2x4" in text
+        assert "Hosts 4/4" in text
+        assert "Degraded" in text  # w3 not ready
+        assert "ICI: axis" in text
+        assert "torus" in text  # v5p wraps on the size-4 axis
+
+    def test_mesh_cells_rendered(self, v5p32):
+        el = topology_page(v5p32)
+        cells = find_all(el, lambda e: "hl-mesh-cell" in e.props.get("class_", ""))
+        assert len(cells) == 16  # 2x2x4 chips
+
+    def test_incomplete_slice_health(self):
+        nodes = [
+            fx.make_tpu_node(
+                f"gke-p-w{i}", pool="p", accelerator="tpu-v5p-slice",
+                topology="2x2x4", chips=4, worker_id=i,
+            )
+            for i in range(3)  # expected 4 hosts, one missing
+        ]
+        snap = snapshot_for({"nodes": nodes, "pods": []})
+        el = topology_page(snap)
+        text = text_content(el)
+        assert "Incomplete" in text
+        assert "Missing workers 3" in text
+
+    def test_slice_cap_unhealthy_first(self):
+        big = fx.fleet_large(256)
+        snap = snapshot_for(big)
+        el = topology_page(snap, max_slices=5)
+        text = text_content(el)
+        assert "Showing 5 of" in text
+        cards = find_all(el, lambda e: "hl-slice-card" in e.props.get("class_", ""))
+        assert len(cards) == 5

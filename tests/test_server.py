@@ -1,0 +1,107 @@
+"""Server-host tests: route dispatch, refresh redirect, health, demo
+transport, and a real socket round-trip."""
+
+import json
+import threading
+import urllib.request
+
+from headlamp_tpu.server import DashboardApp, make_demo_transport
+
+
+def make_app(fleet="v5p32", **kwargs):
+    return DashboardApp(make_demo_transport(fleet), min_sync_interval_s=0.0, **kwargs)
+
+
+class TestHandle:
+    def test_overview_route(self):
+        status, ctype, body = make_app().handle("/tpu")
+        assert status == 200 and ctype == "text/html"
+        assert "Chip Allocation" in body
+        assert "<style>" in body
+
+    def test_root_redirects_to_overview_content(self):
+        status, _, body = make_app().handle("/")
+        assert status == 200
+        assert "Chip Allocation" in body
+
+    def test_all_registered_routes_render(self):
+        app = make_app()
+        for route in app.registry.routes:
+            status, _, body = app.handle(route.path)
+            assert status == 200, route.path
+            assert "hl-" in body, route.path
+
+    def test_metrics_route_uses_demo_prometheus(self):
+        status, _, body = make_app().handle("/tpu/metrics")
+        assert status == 200
+        assert "Fleet Telemetry" in body
+        assert "tensorcore_utilization" in body
+
+    def test_topology_route_renders_mesh(self):
+        _, _, body = make_app().handle("/tpu/topology")
+        assert "hl-mesh-cell" in body
+        assert "Slice: v5p-pool" in body
+
+    def test_404(self):
+        status, _, _ = make_app().handle("/bogus")
+        assert status == 404
+
+    def test_refresh_redirects_back(self):
+        status, location, _ = make_app().handle("/refresh?back=/tpu/nodes")
+        assert status == 302 and location == "/tpu/nodes"
+
+    def test_refresh_rejects_external_redirect(self):
+        status, location, _ = make_app().handle("/refresh?back=http://evil.example")
+        assert status == 302 and location == "/tpu"
+
+    def test_healthz(self):
+        app = make_app()
+        app.handle("/tpu")  # hydrate
+        status, ctype, body = app.handle("/healthz")
+        assert status == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        assert payload["ok"] is True and payload["loading"] is False
+
+    def test_sync_coalescing(self):
+        clock_value = [100.0]
+        app = DashboardApp(
+            make_demo_transport("v5e4"),
+            min_sync_interval_s=5.0,
+            clock=lambda: clock_value[0],
+        )
+        t = app._transport
+        app.handle("/tpu")
+        first = t.calls.count("/api/v1/nodes")
+        app.handle("/tpu/nodes")  # within interval: no re-sync
+        assert t.calls.count("/api/v1/nodes") == first
+        clock_value[0] += 6
+        app.handle("/tpu/pods")
+        assert t.calls.count("/api/v1/nodes") == first + 1
+
+
+class TestSocketRoundTrip:
+    def test_serve_real_http(self):
+        app = make_app("mixed")
+        server = app.serve(port=0)
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/tpu", timeout=5) as r:
+                body = r.read().decode()
+            assert r.status == 200
+            assert "TPU Nodes" in body
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            ) as r:
+                assert json.loads(r.read())["ok"] is True
+        finally:
+            server.shutdown()
+
+
+class TestDemoTransport:
+    def test_large_fleet_served(self):
+        app = DashboardApp(make_demo_transport("large"), min_sync_interval_s=0.0)
+        status, _, body = app.handle("/tpu")
+        assert status == 200
+        assert "TPU Nodes" in body

@@ -1,0 +1,96 @@
+"""UI kit tests: element tree semantics, renderers, component contracts."""
+
+from headlamp_tpu.ui import (
+    EmptyContent,
+    ErrorBox,
+    Loader,
+    NameValueTable,
+    PercentageBar,
+    SectionBox,
+    SimpleTable,
+    StatusLabel,
+    UtilizationBar,
+    find_all,
+    h,
+    render_html,
+    render_text,
+    text_content,
+)
+
+
+class TestVdom:
+    def test_h_flattens_and_drops_none(self):
+        el = h("div", None, "a", None, ["b", None, ["c"]], False)
+        assert el.children == ("a", "b", "c")
+
+    def test_render_html_escapes(self):
+        el = h("p", {"title": 'x"y'}, "<script>")
+        out = render_html(el)
+        assert "&lt;script&gt;" in out
+        assert 'title="x&quot;y"' in out
+
+    def test_class_prop_renamed(self):
+        assert 'class="x"' in render_html(h("div", {"class_": "x"}))
+
+    def test_render_text_blocks_and_cells(self):
+        el = h("div", None,
+               h("h2", None, "Title"),
+               h("table", None, h("tr", None, h("td", None, "a"), h("td", None, "b"))))
+        text = render_text(el)
+        assert "Title" in text.splitlines()[0]
+        assert "a\tb" in text
+
+    def test_text_content_and_find_all(self):
+        el = h("div", None, h("span", {"id": "s"}, "hello"), " ", "world")
+        assert text_content(el) == "hello world"
+        assert len(find_all(el, lambda e: e.props.get("id") == "s")) == 1
+
+
+class TestComponents:
+    def test_section_box_title(self):
+        el = SectionBox("TPU Nodes", h("p", None, "body"))
+        assert "TPU Nodes" in text_content(el)
+        assert el.props["class_"] == "hl-section"
+
+    def test_simple_table_getter_and_key(self):
+        cols = [
+            {"label": "Name", "key": "name"},
+            {"label": "Twice", "getter": lambda r: r["n"] * 2},
+        ]
+        el = SimpleTable(cols, [{"name": "a", "n": 2}])
+        text = render_text(el)
+        assert "Name\tTwice" in text
+        assert "a\t4" in text
+
+    def test_simple_table_empty_message(self):
+        el = SimpleTable([{"label": "X", "key": "x"}], [], empty_message="No TPU pods")
+        assert text_content(el) == "No TPU pods"
+
+    def test_name_value_table(self):
+        el = NameValueTable([("Generation", "TPU v5e"), ("Chips", 4)])
+        assert "Generation TPU v5e Chips 4" == text_content(el)
+
+    def test_status_label_classes(self):
+        assert "hl-status-ok" in render_html(StatusLabel("success", "Ready"))
+        assert "hl-status-err" in render_html(StatusLabel("error", "Down"))
+        assert 'data-status="warning"' in render_html(StatusLabel("warning", "Hmm"))
+
+    def test_percentage_bar_widths_and_legend(self):
+        el = PercentageBar([("v5e", 3), ("v5p", 1)])
+        html = render_html(el)
+        assert "width:75.0%" in html
+        assert "v5e: 3" in text_content(el)
+
+    def test_utilization_bar_thresholds(self):
+        assert "hl-utilbar-ok" in render_html(UtilizationBar(1, 10))
+        assert "hl-utilbar-warn" in render_html(UtilizationBar(7, 10))
+        assert "hl-utilbar-err" in render_html(UtilizationBar(95, 100))
+        # Zero capacity never divides by zero.
+        assert 'data-pct="0"' in render_html(UtilizationBar(5, 0))
+
+    def test_loader_and_empty_and_error(self):
+        assert "Loading" in text_content(Loader())
+        assert "nothing" in text_content(EmptyContent("nothing"))
+        el = ErrorBox("nodes: HTTP 500")
+        assert "Error: nodes: HTTP 500" == text_content(el)
+        assert el.props.get("role") == "alert"
