@@ -1,0 +1,93 @@
+"""Benchmark: dashboard p50 render at 256 TPU nodes.
+
+The BASELINE metric ("dashboard p50 render ms @ 256 TPU nodes; metrics
+scrape→paint latency"). The reference publishes no numbers
+(BASELINE.json ``published: {}``); its only quantitative budget is the
+2 000 ms per-request timeout / <2 s scrape→paint target, so
+``vs_baseline`` is reported as the 2 000 ms budget divided by our p50 —
+how many times faster than the reference's latency budget one full
+dashboard paint is.
+
+What one iteration measures (the full user-facing path, zero cluster —
+fixture transport, exactly SURVEY.md §4's simulation discipline):
+  sync context → classify providers → render Overview + Nodes +
+  Topology + Workloads pages to final HTML.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_TPU_NODES = 256
+ITERATIONS = 30
+WARMUP = 3
+
+
+def build_app():
+    from headlamp_tpu.fleet import fixtures as fx
+    from headlamp_tpu.server import DashboardApp
+
+    # Exactly 256 TPU nodes (fleet_large mixes in plain nodes; keep
+    # generating until the TPU population reaches the target).
+    target, size = N_TPU_NODES, N_TPU_NODES
+    while True:
+        fleet = fx.fleet_large(size)
+        tpu_nodes = [
+            n
+            for n in fleet["nodes"]
+            if "cloud.google.com/gke-tpu-accelerator" in n["metadata"].get("labels", {})
+        ]
+        if len(tpu_nodes) >= target:
+            break
+        size += 64
+    plain = [
+        n
+        for n in fleet["nodes"]
+        if "cloud.google.com/gke-tpu-accelerator" not in n["metadata"].get("labels", {})
+    ]
+    fleet["nodes"] = tpu_nodes[:target] + plain
+    t = fx.fleet_transport(fleet)
+    return DashboardApp(t, min_sync_interval_s=0.0), len(tpu_nodes[:target])
+
+
+def one_paint(app) -> None:
+    for path in ("/tpu", "/tpu/nodes", "/tpu/topology", "/tpu/pods"):
+        status, _, body = app.handle(path)
+        assert status == 200 and body
+
+
+def main() -> None:
+    app, n_tpu = build_app()
+    assert n_tpu == N_TPU_NODES, n_tpu
+    for _ in range(WARMUP):
+        one_paint(app)
+    samples = []
+    for _ in range(ITERATIONS):
+        t0 = time.perf_counter()
+        one_paint(app)
+        samples.append((time.perf_counter() - t0) * 1000)
+    p50 = statistics.median(samples)
+    budget_ms = 2000.0  # the reference's request-timeout / scrape→paint budget
+    print(
+        json.dumps(
+            {
+                "metric": f"dashboard p50 full-paint (4 pages) @ {N_TPU_NODES} TPU nodes",
+                "value": round(p50, 2),
+                "unit": "ms",
+                "vs_baseline": round(budget_ms / p50, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

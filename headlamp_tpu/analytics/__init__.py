@@ -1,0 +1,23 @@
+"""Analytics — columnar fleet encoding and JAX rollup kernels.
+
+The reference re-derives fleet aggregates with per-render JS loops over
+pod/node objects (`/root/reference/src/components/OverviewPage.tsx:78-130`
+— fine at tens of nodes). This framework's fleet-scale path is
+TPU-native instead: snapshots encode once into fixed-shape columnar
+arrays (``encode``) and every aggregate the pages need — allocation,
+phase histograms, per-generation counts, per-node utilization — comes
+out of one fused, jitted XLA program (``fleet_jax``), optionally sharded
+over a device mesh for multi-host fleets (``parallel.mesh``).
+"""
+
+from .encode import FleetArrays, GENERATION_IDS, PHASE_IDS, encode_fleet
+from .fleet_jax import fleet_rollup, rollup_to_dict
+
+__all__ = [
+    "FleetArrays",
+    "GENERATION_IDS",
+    "PHASE_IDS",
+    "encode_fleet",
+    "fleet_rollup",
+    "rollup_to_dict",
+]

@@ -289,6 +289,23 @@ def make_plugin_daemonset(
     }
 
 
+def fleet_transport(fleet: dict[str, Any]):
+    """MockTransport serving a fixture fleet on the same URL surface the
+    context fetches (single definition — the server demo mode and
+    bench.py must wire identical routes, or a drifted daemonset path
+    would silently bench the degraded render path)."""
+    from ..transport.api_proxy import MockTransport
+
+    t = MockTransport()
+    t.add("/api/v1/nodes", {"kind": "List", "items": fleet["nodes"]})
+    t.add("/api/v1/pods", {"kind": "List", "items": fleet["pods"]})
+    t.add(
+        "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin",
+        {"kind": "List", "items": fleet.get("daemonsets", [])},
+    )
+    return t
+
+
 # ---------------------------------------------------------------------------
 # BASELINE config fleets
 # ---------------------------------------------------------------------------

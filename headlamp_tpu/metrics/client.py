@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping
 
 from ..transport.api_proxy import ApiError, Transport
+from .format import normalize_fraction
 
 # ---------------------------------------------------------------------------
 # Service discovery
@@ -291,8 +292,8 @@ def fetch_tpu_metrics(
             value = _sample_value(sample)
             if value is None:
                 continue
-            if logical in _FRACTION_METRICS and value > 1.5:
-                value /= 100  # exporter reported 0-100
+            if logical in _FRACTION_METRICS:
+                value = normalize_fraction(value)  # 0-100 exporters -> 0-1
             key = (_node_of(labels, instance_map), _chip_of(labels))
             row = chips.get(key)
             if row is None:

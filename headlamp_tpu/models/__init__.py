@@ -1,0 +1,34 @@
+"""Models — TPU-native telemetry forecasting.
+
+The framework's flagship numeric model: an MLP forecaster over per-chip
+utilization windows (predicting near-future TensorCore load so the
+dashboard can warn before saturation). Pure-functional params, optax
+training, bfloat16 matmuls sized for the MXU, and dp×tp mesh shardings
+in ``parallel.mesh``. No reference analogue (the Intel plugin computes
+nothing; SURVEY.md §2.2) — this is the TPU-first capability the
+BASELINE metrics page gains on top of parity.
+"""
+
+from .forecast import (
+    ForecastConfig,
+    forecast_next,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+    make_windows,
+    param_shardings,
+    synthetic_telemetry,
+)
+
+__all__ = [
+    "ForecastConfig",
+    "forecast_next",
+    "forward",
+    "init_params",
+    "loss_fn",
+    "make_train_step",
+    "make_windows",
+    "param_shardings",
+    "synthetic_telemetry",
+]

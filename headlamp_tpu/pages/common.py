@@ -101,14 +101,3 @@ def plugin_not_detected_box(state: ProviderState) -> Element:
     )
 
 
-def tpu_node_row_summary(node: Any) -> dict[str, Any]:
-    """The per-node facts several pages tabulate."""
-    return {
-        "name": obj.name(node),
-        "ready": obj.is_node_ready(node),
-        "generation": tpu.format_accelerator(tpu.get_node_accelerator(node)),
-        "topology": tpu.get_node_topology(node) or "—",
-        "pool": tpu.get_node_pool(node) or "—",
-        "chips": tpu.get_node_chip_capacity(node),
-        "allocatable": tpu.get_node_chip_allocatable(node),
-    }

@@ -1,0 +1,13 @@
+"""Parallelism — device meshes and sharded fleet analytics.
+
+The reference has no distributed compute (SURVEY.md §2.3); the TPU
+framework's distributed surface is SPMD analytics and model training
+over a ``jax.sharding.Mesh``: fleet rollups partitioned over hosts with
+XLA collectives doing the reduction, and the telemetry-forecast train
+step sharded data-parallel × model-parallel. Multi-chip is exercised on
+a virtual CPU mesh in tests and by the driver's dryrun.
+"""
+
+from .mesh import fleet_mesh, sharded_rollup, train_mesh
+
+__all__ = ["fleet_mesh", "sharded_rollup", "train_mesh"]

@@ -1,0 +1,161 @@
+"""Mesh construction and sharded fleet rollup.
+
+Design follows the scaling-book recipe: pick a mesh, annotate shardings,
+let XLA insert the collectives. The fleet rollup is embarrassingly
+row-parallel (nodes/pods partition over hosts; aggregates reduce), so
+it runs under ``shard_map`` with explicit ``psum`` over the ``hosts``
+axis — the ICI-friendly pattern (one all-reduce of a few scalars and
+two small histograms; per-node vectors all-gather only at the end).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map  # jax >= 0.7 stable API
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..analytics.encode import GENERATION_IDS, PHASE_IDS, FleetArrays
+from ..analytics.fleet_jax import _RUNNING
+
+
+def fleet_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D ``hosts`` mesh over the first ``n_devices`` devices — fleet
+    rows are the only sharded dimension in analytics."""
+    import numpy as np
+
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    return Mesh(np.array(devices[:n]).reshape(n), axis_names=("hosts",))
+
+
+def train_mesh(n_devices: int | None = None) -> Mesh:
+    """2-D ``(data, model)`` mesh for the forecaster train step: batch
+    shards over ``data``, hidden dimension over ``model`` (dp × tp)."""
+    import numpy as np
+
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    model = 2 if n % 2 == 0 and n >= 2 else 1
+    data = n // model
+    grid = np.array(devices[: data * model]).reshape(data, model)
+    return Mesh(grid, axis_names=("data", "model"))
+
+
+def _pad_to_multiple(a: jnp.ndarray, multiple: int, fill: int = 0) -> jnp.ndarray:
+    rem = a.shape[0] % multiple
+    if rem == 0:
+        return a
+    pad = multiple - rem
+    return jnp.concatenate([a, jnp.full((pad,), fill, a.dtype)])
+
+
+def sharded_rollup(fleet: FleetArrays, mesh: Mesh) -> dict[str, Any]:
+    """Fleet rollup partitioned over the ``hosts`` axis.
+
+    Each shard reduces its local node/pod rows; cross-host reduction is
+    a single ``psum`` per aggregate. The per-node in-use vector is
+    computed as a local segment-sum into the *global* node index space
+    then psum-reduced — pods and their nodes may land on different
+    shards, which plain concatenation would miscount.
+    """
+    n_hosts = mesh.shape["hosts"]
+
+    node_cols = [
+        jnp.asarray(fleet.node_capacity),
+        jnp.asarray(fleet.node_allocatable),
+        jnp.asarray(fleet.node_ready),
+        jnp.asarray(fleet.node_generation),
+        jnp.asarray(fleet.node_valid),
+    ]
+    pod_cols = [
+        jnp.asarray(fleet.pod_request),
+        jnp.asarray(fleet.pod_phase),
+        jnp.asarray(fleet.pod_node_idx),
+        jnp.asarray(fleet.pod_valid),
+    ]
+    node_cols = [_pad_to_multiple(c, n_hosts) for c in node_cols]
+    pod_cols = [_pad_to_multiple(c, n_hosts) for c in pod_cols]
+    n_nodes_pad = int(node_cols[0].shape[0])
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("hosts"),) * 5 + (P("hosts"),) * 4,
+        out_specs=P(),  # fully replicated aggregates (every out is a psum)
+    )
+    def rollup_shard(cap, alloc, ready, gen, nvalid, req, phase, nidx, pvalid):
+        local_cap = jnp.sum(cap * nvalid)
+        local_alloc = jnp.sum(alloc * nvalid)
+        local_nodes = jnp.sum(nvalid)
+        local_ready = jnp.sum(ready * nvalid)
+        running = ((phase == _RUNNING) & (pvalid == 1)).astype(jnp.int32)
+        req_running = req * running
+        local_in_use = jnp.sum(req_running)
+        local_phases = jax.ops.segment_sum(pvalid, phase, num_segments=len(PHASE_IDS))
+        local_gens = jax.ops.segment_sum(nvalid, gen, num_segments=len(GENERATION_IDS))
+        # Global node index space: unscheduled pods use the overflow
+        # segment past every real node row.
+        local_per_node = jax.ops.segment_sum(
+            req_running, nidx, num_segments=n_nodes_pad + 1
+        )[:n_nodes_pad]
+
+        return {
+            "capacity": jax.lax.psum(local_cap, "hosts"),
+            "allocatable": jax.lax.psum(local_alloc, "hosts"),
+            "in_use": jax.lax.psum(local_in_use, "hosts"),
+            "nodes_total": jax.lax.psum(local_nodes, "hosts"),
+            "nodes_ready": jax.lax.psum(local_ready, "hosts"),
+            "phase_counts": jax.lax.psum(local_phases, "hosts"),
+            "generation_counts": jax.lax.psum(local_gens, "hosts"),
+            "per_node_in_use": jax.lax.psum(local_per_node, "hosts"),
+        }
+
+    with mesh:
+        out = rollup_shard(*node_cols, *pod_cols)
+    result = {
+        "capacity": int(out["capacity"]),
+        "allocatable": int(out["allocatable"]),
+        "in_use": int(out["in_use"]),
+        "free": int(out["allocatable"]) - int(out["in_use"]),
+        "nodes_total": int(out["nodes_total"]),
+        "nodes_ready": int(out["nodes_ready"]),
+        "phase_counts": {
+            name: int(c) for name, c in zip(PHASE_IDS, out["phase_counts"])
+        },
+        "generation_counts": {
+            name: int(c)
+            for name, c in zip(GENERATION_IDS, out["generation_counts"])
+            if int(c) > 0
+        },
+        "per_node_in_use": [int(v) for v in out["per_node_in_use"][: fleet.n_nodes]],
+    }
+    return result
+
+
+def shard_fleet_arrays(fleet: FleetArrays, mesh: Mesh) -> dict[str, jax.Array]:
+    """Device-put the columnar fleet with row shardings over ``hosts`` —
+    for callers composing their own sharded computations."""
+    spec = NamedSharding(mesh, P("hosts"))
+    n_hosts = mesh.shape["hosts"]
+    cols = {
+        "node_capacity": fleet.node_capacity,
+        "node_allocatable": fleet.node_allocatable,
+        "node_ready": fleet.node_ready,
+        "node_generation": fleet.node_generation,
+        "node_valid": fleet.node_valid,
+        "pod_request": fleet.pod_request,
+        "pod_phase": fleet.pod_phase,
+        "pod_node_idx": fleet.pod_node_idx,
+        "pod_valid": fleet.pod_valid,
+    }
+    return {
+        k: jax.device_put(_pad_to_multiple(jnp.asarray(v), n_hosts), spec)
+        for k, v in cols.items()
+    }

@@ -168,8 +168,9 @@ class DashboardApp:
 # ---------------------------------------------------------------------------
 
 def make_demo_transport(fleet_name: str = "v5p32") -> MockTransport:
-    """MockTransport serving a fixture fleet plus synthetic Prometheus
-    data — the zero-cluster path for demos, verification, and benches."""
+    """MockTransport serving a fixture fleet (via
+    ``fixtures.fleet_transport``) plus synthetic Prometheus data — the
+    zero-cluster path for demos, verification, and benches."""
     from ..fleet import fixtures as fx
 
     fleets = {
@@ -179,13 +180,7 @@ def make_demo_transport(fleet_name: str = "v5p32") -> MockTransport:
         "large": lambda: fx.fleet_large(1024),
     }
     fleet = fleets[fleet_name]()
-    t = MockTransport()
-    t.add("/api/v1/nodes", {"kind": "List", "items": fleet["nodes"]})
-    t.add("/api/v1/pods", {"kind": "List", "items": fleet["pods"]})
-    t.add(
-        "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin",
-        {"kind": "List", "items": fleet.get("daemonsets", [])},
-    )
+    t = fx.fleet_transport(fleet)
 
     # Synthetic Prometheus: deterministic per-chip utilization.
     import urllib.parse

@@ -1,11 +1,20 @@
 """Test configuration.
 
-Force JAX onto a virtual 8-device CPU platform *before* jax is imported
-anywhere, so multi-chip sharding tests run without TPU hardware (the same
-mechanism the driver uses for dryrun_multichip).
+Force JAX onto a virtual 8-device CPU platform so multi-chip sharding
+tests run without TPU hardware (the same mechanism the driver uses for
+dryrun_multichip).
+
+Two paths are needed:
+- Plain environments: set JAX_PLATFORMS/XLA_FLAGS before jax imports.
+- Axon environments (real-TPU tunnel): a sitecustomize has already
+  imported jax with JAX_PLATFORMS=axon, so the env route is dead —
+  ``jax.config.update("jax_platforms", "cpu")`` after import re-selects
+  the backend, and XLA_FLAGS still applies because the CPU client
+  initializes lazily on first ``jax.devices()``.
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -13,3 +22,8 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

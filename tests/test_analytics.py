@@ -1,0 +1,167 @@
+"""Analytics tests: encoding invariants, XLA rollup vs pure-Python
+parity, sharded rollup on the virtual 8-device mesh, and the forecaster
+train step (loss decreases; sharded == replicated)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from headlamp_tpu.analytics import encode_fleet, rollup_to_dict
+from headlamp_tpu.analytics.fleet_jax import validate_rollup
+from headlamp_tpu.domain import tpu
+from headlamp_tpu.domain.accelerator import classify_fleet
+from headlamp_tpu.fleet import fixtures as fx
+from headlamp_tpu.models import (
+    ForecastConfig,
+    forward,
+    init_params,
+    make_train_step,
+    make_windows,
+    param_shardings,
+    synthetic_telemetry,
+)
+from headlamp_tpu.parallel import fleet_mesh, sharded_rollup, train_mesh
+
+
+def tpu_view(fleet):
+    return classify_fleet(fleet["nodes"], fleet["pods"])["tpu"]
+
+
+class TestEncoding:
+    def test_padding_buckets(self):
+        view = tpu_view(fx.fleet_v5p32())
+        arrays = encode_fleet(view.nodes, view.pods)
+        assert arrays.n_nodes == 4
+        assert arrays.n_nodes_padded == 8  # next pow2 bucket ≥ 8
+        assert arrays.node_valid.sum() == 4
+
+    def test_unscheduled_pod_points_at_overflow(self):
+        view = tpu_view(fx.fleet_v5e4())
+        arrays = encode_fleet(view.nodes, view.pods)
+        # eval-job is Pending with no node.
+        overflow = arrays.n_nodes_padded
+        assert overflow in arrays.pod_node_idx[: arrays.n_pods]
+
+    def test_empty_fleet_encodes(self):
+        arrays = encode_fleet([], [])
+        assert arrays.n_nodes == 0 and arrays.n_pods == 0
+        assert arrays.node_capacity.shape[0] >= 1
+
+
+class TestRollupParity:
+    @pytest.mark.parametrize("fleet_fn", [fx.fleet_v5e4, fx.fleet_v5p32, fx.fleet_mixed])
+    def test_matches_python_summary(self, fleet_fn):
+        view = tpu_view(fleet_fn())
+        arrays = encode_fleet(view.nodes, view.pods)
+        assert validate_rollup(arrays, view.allocation_summary())
+
+    def test_large_fleet_details(self):
+        view = tpu_view(fx.fleet_large(256))
+        arrays = encode_fleet(view.nodes, view.pods)
+        rolled = rollup_to_dict(arrays)
+        expected = view.allocation_summary()
+        assert rolled["capacity"] == expected["capacity"]
+        assert rolled["in_use"] == expected["in_use"]
+        assert rolled["phase_counts"] == tpu.count_pod_phases(view.pods)
+        assert rolled["nodes_total"] == len(view.nodes)
+        # Per-node vector sums to the running total minus unscheduled.
+        running_scheduled = sum(
+            tpu.get_pod_chip_request(p)
+            for p in view.pods
+            if p["status"]["phase"] == "Running" and p["spec"].get("nodeName")
+        )
+        assert sum(rolled["per_node_in_use"]) == running_scheduled
+
+    def test_hot_nodes_signal(self):
+        node = fx.make_tpu_node("n1", chips=4)
+        pods = [fx.make_tpu_pod("p1", node="n1", chips=4)]
+        arrays = encode_fleet([node], pods)
+        rolled = rollup_to_dict(arrays)
+        assert rolled["max_node_util_pct"] == 100.0
+        assert rolled["hot_nodes"] == 1
+
+
+class TestShardedRollup:
+    def test_eight_device_mesh_matches(self):
+        assert len(jax.devices()) >= 8  # conftest forces the virtual mesh
+        view = tpu_view(fx.fleet_large(128))
+        arrays = encode_fleet(view.nodes, view.pods)
+        mesh = fleet_mesh(8)
+        rolled = sharded_rollup(arrays, mesh)
+        expected = view.allocation_summary()
+        assert rolled["capacity"] == expected["capacity"]
+        assert rolled["allocatable"] == expected["allocatable"]
+        assert rolled["in_use"] == expected["in_use"]
+        assert rolled["phase_counts"] == tpu.count_pod_phases(view.pods)
+        # Cross-shard pod→node attribution survives the partition.
+        single = rollup_to_dict(arrays)
+        assert rolled["per_node_in_use"] == single["per_node_in_use"]
+
+    def test_odd_device_count(self):
+        # A host count that divides neither bucket size exercises the
+        # pad-to-multiple path. (One count only — each mesh shape is a
+        # fresh XLA compile, expensive on the CPU test platform.)
+        view = tpu_view(fx.fleet_v5p32())
+        arrays = encode_fleet(view.nodes, view.pods)
+        rolled = sharded_rollup(arrays, fleet_mesh(3))
+        assert rolled["capacity"] == 16
+
+
+class TestForecaster:
+    def test_forward_shapes_and_range(self):
+        cfg = ForecastConfig(window=16, hidden=32, horizon=4)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        x = jnp.zeros((5, cfg.window))
+        y = forward(params, x)
+        assert y.shape == (5, cfg.horizon)
+        assert bool(jnp.all((y >= 0) & (y <= 1)))
+
+    def test_windows(self):
+        series = synthetic_telemetry(3, 40)
+        x, y = make_windows(series, window=16, horizon=4)
+        assert x.shape == (3 * 21, 16)
+        assert y.shape == (3 * 21, 4)
+        # First window of first series is the series prefix.
+        np.testing.assert_allclose(np.asarray(x[0]), np.asarray(series[0, :16]))
+
+    def test_train_step_reduces_loss(self):
+        cfg = ForecastConfig(window=16, hidden=32, horizon=4, learning_rate=3e-3)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        series = synthetic_telemetry(8, 64)
+        x, y = make_windows(series, cfg.window, cfg.horizon)
+        train_step, optimizer = make_train_step(cfg)
+        opt_state = optimizer.init(params)
+        first_loss = None
+        loss = None
+        for _ in range(30):
+            params, opt_state, loss = train_step(params, opt_state, x, y)
+            first_loss = first_loss if first_loss is not None else float(loss)
+        assert float(loss) < first_loss * 0.7
+
+    def test_sharded_train_step_matches_replicated(self):
+        cfg = ForecastConfig(window=32, hidden=128, horizon=8)
+        params = init_params(jax.random.PRNGKey(1), cfg)
+        series = synthetic_telemetry(4, 72)
+        x, y = make_windows(series, cfg.window, cfg.horizon)
+        n = (x.shape[0] // 4) * 4
+        x, y = x[:n], y[:n]
+        train_step, optimizer = make_train_step(cfg)
+
+        # Replicated reference run.
+        opt_state = optimizer.init(params)
+        _, _, loss_ref = train_step(params, opt_state, x, y)
+
+        # dp×tp sharded run on the virtual mesh.
+        mesh = train_mesh(8)
+        shardings = param_shardings(mesh)
+        sharded_params = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+        from headlamp_tpu.models.forecast import batch_sharding
+
+        xs = jax.device_put(x, batch_sharding(mesh))
+        ys = jax.device_put(y, batch_sharding(mesh))
+        opt_state_s = optimizer.init(sharded_params)
+        _, _, loss_sharded = train_step(sharded_params, opt_state_s, xs, ys)
+
+        assert abs(float(loss_ref) - float(loss_sharded)) < 1e-4

@@ -1,0 +1,30 @@
+"""Driver-contract smoke tests: entry() jits; dryrun_multichip executes
+in-process on the virtual 8-device mesh (conftest provides it, so no
+subprocess fallback engages here)."""
+
+import sys
+from pathlib import Path
+
+import jax
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import __graft_entry__ as graft
+
+
+def test_entry_is_jittable():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (16, 8)
+    assert bool(jax.numpy.all((out >= 0) & (out <= 1)))
+
+
+def test_dryrun_multichip_8(capsys):
+    graft.dryrun_multichip(8)
+    assert "dryrun_multichip ok" in capsys.readouterr().out
+
+
+def test_dryrun_multichip_2(capsys):
+    graft.dryrun_multichip(2)
+    out = capsys.readouterr().out
+    assert "2 devices" in out
