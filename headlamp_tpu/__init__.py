@@ -3,20 +3,29 @@
 A ground-up rebuild of the capabilities of the Intel GPU Headlamp plugin
 (`/root/reference`, see SURVEY.md) around Google Cloud TPU primitives:
 
-- ``domain``    — pure domain model: GKE TPU node/pod detection, chip
-                  accounting, formatters; Intel GPU as a second provider
-                  behind a provider-agnostic accelerator abstraction.
-- ``topology``  — ICI pod-slice modeling: topology parsing, slice grouping,
-                  host/chip mesh coordinates and torus links (the data the
-                  TopologyPage renders).
-- ``fleet``     — fixture generators for the BASELINE configs (v5e-4,
-                  v5p-32 multi-host, mixed Intel+TPU, 1024-node stress).
-Landing later this round (see SURVEY.md §7 build order):
-``metrics`` (mini-PromQL evaluator + TPU metrics-client mirror),
-``analytics`` (JAX columnar fleet rollups measured by bench.py),
-``models``/``parallel`` (telemetry-forecasting model with a mesh-sharded
-train step), and the sibling ``plugin/`` Headlamp frontend (TS/React)
-whose pure logic this package mirrors 1:1 via shared JSON fixtures.
+- ``domain``       — pure domain model: GKE TPU node/pod detection, chip
+                     accounting, formatters; Intel GPU as a second
+                     provider behind a provider-agnostic abstraction.
+- ``topology``     — ICI pod-slice modeling: topology parsing, slice
+                     grouping, host/chip mesh coordinates, torus links.
+- ``fleet``        — fixture generators for the BASELINE configs.
+- ``transport``    — the ApiProxy contract: KubeTransport (urllib) and
+                     MockTransport, hard per-request timeouts.
+- ``context``      — AcceleratorDataContext: dual-track fetching,
+                     per-provider fallback chains and degradation.
+- ``metrics``      — Prometheus client: discovery chain, parallel
+                     PromQL fan-out, schema-tolerant series resolution,
+                     range-query utilization history.
+- ``ui``           — element tree + CommonComponents kit, HTML/text
+                     renderers.
+- ``pages``        — Overview/Nodes/Pods/DevicePlugins/Metrics plus the
+                     TopologyPage ICI mesh view.
+- ``integrations`` — Node/Pod detail sections, Nodes-table columns.
+- ``registration`` — the plugin surface (sidebar/routes/sections/columns).
+- ``server``       — standalone dashboard host (demo/apiserver/in-cluster).
+- ``analytics``    — columnar fleet encoding + jitted XLA rollups.
+- ``parallel``     — device meshes, shard_map rollup with psum.
+- ``models``       — utilization forecaster (bf16 MLP, fused online fit).
 """
 
 __version__ = "0.1.0"

@@ -24,6 +24,74 @@ from .encode import GENERATION_IDS, PHASE_IDS, FleetArrays
 _RUNNING = PHASE_IDS.index("Running")
 
 
+def local_aggregates(
+    node_capacity: jax.Array,
+    node_allocatable: jax.Array,
+    node_ready: jax.Array,
+    node_generation: jax.Array,
+    node_valid: jax.Array,
+    pod_request: jax.Array,
+    pod_phase: jax.Array,
+    pod_node_idx: jax.Array,
+    pod_valid: jax.Array,
+    *,
+    n_nodes_pad: int,
+    n_generations: int = len(GENERATION_IDS),
+    n_phases: int = len(PHASE_IDS),
+) -> dict[str, jax.Array]:
+    """The shared reduction body: sums/histograms over the rows it is
+    given. Single-device rollup calls it on the whole fleet; the
+    sharded rollup calls it per shard and psums the outputs — ONE
+    definition so the two paths cannot drift (``per_node_in_use``
+    segments into the *global* node index space either way;
+    ``n_nodes_pad`` is that global size, not the local row count)."""
+    cap = node_capacity * node_valid
+    alloc = node_allocatable * node_valid
+    running = ((pod_phase == _RUNNING) & (pod_valid == 1)).astype(jnp.int32)
+    req_running = pod_request * running
+    per_node_in_use = jax.ops.segment_sum(
+        req_running, pod_node_idx, num_segments=n_nodes_pad + 1
+    )[:n_nodes_pad]
+    return {
+        "capacity": jnp.sum(cap),
+        "allocatable": jnp.sum(alloc),
+        "in_use": jnp.sum(req_running),
+        "nodes_total": jnp.sum(node_valid),
+        "nodes_ready": jnp.sum(node_ready * node_valid),
+        "phase_counts": jax.ops.segment_sum(
+            pod_valid, pod_phase, num_segments=n_phases
+        ),
+        "generation_counts": jax.ops.segment_sum(
+            node_valid, node_generation, num_segments=n_generations
+        ),
+        "per_node_in_use": per_node_in_use,
+    }
+
+
+def aggregates_to_host_dict(out: Mapping[str, Any], n_nodes: int) -> dict[str, Any]:
+    """Shared host-side conversion (one device_get happens in the
+    caller): scalars to ints, vocabulary vectors to name→count maps."""
+    allocatable = int(out["allocatable"])
+    in_use = int(out["in_use"])
+    return {
+        "capacity": int(out["capacity"]),
+        "allocatable": allocatable,
+        "in_use": in_use,
+        "free": allocatable - in_use,
+        "nodes_total": int(out["nodes_total"]),
+        "nodes_ready": int(out["nodes_ready"]),
+        "phase_counts": {
+            name: int(c) for name, c in zip(PHASE_IDS, out["phase_counts"])
+        },
+        "generation_counts": {
+            name: int(c)
+            for name, c in zip(GENERATION_IDS, out["generation_counts"])
+            if int(c) > 0
+        },
+        "per_node_in_use": [int(v) for v in out["per_node_in_use"][:n_nodes]],
+    }
+
+
 @partial(jax.jit, static_argnames=("n_generations", "n_phases"))
 def fleet_rollup(
     node_capacity: jax.Array,
@@ -49,45 +117,30 @@ def fleet_rollup(
     - per_node_util_pct[N_pad]: 0-100 float32, 0 where allocatable=0
     - max_node_util_pct / hot_nodes (util >= 90): fleet pressure signals
     """
-    cap = node_capacity * node_valid
-    alloc = node_allocatable * node_valid
-    capacity = jnp.sum(cap)
-    allocatable = jnp.sum(alloc)
-    nodes_total = jnp.sum(node_valid)
-    nodes_ready = jnp.sum(node_ready * node_valid)
-
-    running = ((pod_phase == _RUNNING) & (pod_valid == 1)).astype(jnp.int32)
-    req_running = pod_request * running
-    in_use = jnp.sum(req_running)
-
     n_nodes_pad = node_capacity.shape[0]
-    # Unscheduled pods carry idx == n_nodes_pad (the overflow segment).
-    per_node_in_use = jax.ops.segment_sum(
-        req_running, pod_node_idx, num_segments=n_nodes_pad + 1
-    )[:n_nodes_pad]
-
-    alloc_f = alloc.astype(jnp.float32)
+    out = local_aggregates(
+        node_capacity,
+        node_allocatable,
+        node_ready,
+        node_generation,
+        node_valid,
+        pod_request,
+        pod_phase,
+        pod_node_idx,
+        pod_valid,
+        n_nodes_pad=n_nodes_pad,
+        n_generations=n_generations,
+        n_phases=n_phases,
+    )
+    alloc_f = (node_allocatable * node_valid).astype(jnp.float32)
     util = jnp.where(
-        alloc_f > 0, per_node_in_use.astype(jnp.float32) / alloc_f * 100.0, 0.0
+        alloc_f > 0,
+        out["per_node_in_use"].astype(jnp.float32) / alloc_f * 100.0,
+        0.0,
     )
-
-    phase_counts = jax.ops.segment_sum(
-        pod_valid, pod_phase, num_segments=n_phases
-    )
-    generation_counts = jax.ops.segment_sum(
-        node_valid, node_generation, num_segments=n_generations
-    )
-
     return {
-        "capacity": capacity,
-        "allocatable": allocatable,
-        "in_use": in_use,
-        "free": allocatable - in_use,
-        "nodes_total": nodes_total,
-        "nodes_ready": nodes_ready,
-        "phase_counts": phase_counts,
-        "generation_counts": generation_counts,
-        "per_node_in_use": per_node_in_use,
+        **out,
+        "free": out["allocatable"] - out["in_use"],
         "per_node_util_pct": util,
         "max_node_util_pct": jnp.max(util),
         "hot_nodes": jnp.sum((util >= 90.0).astype(jnp.int32)),
@@ -119,34 +172,19 @@ def rollup_to_dict(fleet: FleetArrays) -> dict[str, Any]:
     a tunneled/remote TPU turns a sub-millisecond rollup into tens of
     seconds."""
     out = jax.device_get(rollup_arrays(fleet))
-    phase_counts = {
-        name: int(c) for name, c in zip(PHASE_IDS, out["phase_counts"])
-    }
-    gen_counts = {
-        name: int(c)
-        for name, c in zip(GENERATION_IDS, out["generation_counts"])
-        if int(c) > 0
-    }
-    return {
-        "capacity": int(out["capacity"]),
-        "allocatable": int(out["allocatable"]),
-        "in_use": int(out["in_use"]),
-        "free": int(out["free"]),
-        "utilization_pct": (
-            round(int(out["in_use"]) / int(out["capacity"]) * 100)
-            if int(out["capacity"]) > 0
-            else 0
-        ),
-        "nodes_total": int(out["nodes_total"]),
-        "nodes_ready": int(out["nodes_ready"]),
-        "phase_counts": phase_counts,
-        "generation_counts": gen_counts,
-        "per_node_in_use": [
-            int(v) for v in out["per_node_in_use"][: fleet.n_nodes]
-        ],
-        "max_node_util_pct": float(out["max_node_util_pct"]),
-        "hot_nodes": int(out["hot_nodes"]),
-    }
+    result = aggregates_to_host_dict(out, fleet.n_nodes)
+    result.update(
+        {
+            "utilization_pct": (
+                round(result["in_use"] / result["capacity"] * 100)
+                if result["capacity"] > 0
+                else 0
+            ),
+            "max_node_util_pct": float(out["max_node_util_pct"]),
+            "hot_nodes": int(out["hot_nodes"]),
+        }
+    )
+    return result
 
 
 def validate_rollup(fleet: FleetArrays, summary: Mapping[str, int]) -> bool:

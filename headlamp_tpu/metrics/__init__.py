@@ -14,7 +14,9 @@ from .client import (
     PROMETHEUS_SERVICES,
     TpuChipMetrics,
     TpuMetricsSnapshot,
+    UtilizationHistory,
     fetch_tpu_metrics,
+    fetch_utilization_history,
     find_prometheus_path,
 )
 from .format import format_bytes, format_percent, format_ratio_bar
@@ -24,7 +26,9 @@ __all__ = [
     "PROMETHEUS_SERVICES",
     "TpuChipMetrics",
     "TpuMetricsSnapshot",
+    "UtilizationHistory",
     "fetch_tpu_metrics",
+    "fetch_utilization_history",
     "find_prometheus_path",
     "format_bytes",
     "format_percent",

@@ -62,6 +62,20 @@ def _proxy_query_path(namespace: str, service: str, promql: str) -> str:
     )
 
 
+def _proxy_range_path(
+    namespace: str, service: str, promql: str, start: float, end: float, step_s: int
+) -> str:
+    """Service-proxy path for a range query (utilization history — feeds
+    the forecaster; the reference has no range queries, its only
+    windowing is the 5m rate() in PromQL `metrics.ts:106`)."""
+    q = urllib.parse.quote(promql, safe="")
+    return (
+        f"/api/v1/namespaces/{namespace}/services/{service}"
+        f"/proxy/api/v1/query_range?query={q}"
+        f"&start={start:.0f}&end={end:.0f}&step={step_s}"
+    )
+
+
 def find_prometheus_path(
     transport: Transport, timeout_s: float = 2.0
 ) -> tuple[str, str] | None:
@@ -154,6 +168,10 @@ class TpuMetricsSnapshot:
     #: surfaced in diagnostics so operators know which exporter they run.
     resolved_series: dict[str, str] = field(default_factory=dict)
     fetched_at: float = 0.0
+    #: Wall-clock cost of discovery + fan-out + join — the scrape→paint
+    #: instrumentation the BASELINE <2s target is measured against
+    #: (SURVEY.md §5 tracing carry-over).
+    fetch_ms: float = 0.0
 
     @property
     def by_node(self) -> dict[str, list[TpuChipMetrics]]:
@@ -249,6 +267,7 @@ def fetch_tpu_metrics(
     """Discover Prometheus (unless ``prometheus`` pins it), fan out all
     logical-metric candidate queries plus the node map in parallel, and
     join into per-chip rows. None when no Prometheus answers."""
+    t_start = time.perf_counter()
     found = prometheus or find_prometheus_path(transport, timeout_s)
     if found is None:
         return None
@@ -308,4 +327,130 @@ def fetch_tpu_metrics(
         availability=availability,
         resolved_series=resolved,
         fetched_at=clock(),
+        fetch_ms=round((time.perf_counter() - t_start) * 1000, 1),
     )
+
+
+# ---------------------------------------------------------------------------
+# Utilization history (range queries) — forecaster input
+# ---------------------------------------------------------------------------
+
+@dataclass
+class UtilizationHistory:
+    """Aligned per-chip utilization traces: ``series[i]`` belongs to
+    ``keys[i] = (node, accelerator_id)``; every row has ``n_samples``
+    points ``step_s`` apart ending at ``end``. Gaps are forward-filled
+    (Prometheus staleness already interpolates short ones)."""
+
+    keys: list[tuple[str, str]]
+    series: list[list[float]]
+    step_s: int
+    end: float
+    resolved_query: str
+
+
+#: Minimum fraction of grid points a trace must actually have before it
+#: is used for forecasting — forward-filling a handful of fresh samples
+#: into a full window would fabricate history (the honesty analogue of
+#: the reference's '≥5m of scrape history' hint, `MetricsPage.tsx:105`).
+MIN_REAL_SAMPLE_FRACTION = 0.5
+
+
+def fetch_utilization_history(
+    transport: Transport,
+    *,
+    prometheus: tuple[str, str],
+    window_s: int = 3600,
+    step_s: int = 60,
+    timeout_s: float = 2.0,
+    clock: Callable[[], float] = time.time,
+    preferred_query: str | None = None,
+) -> UtilizationHistory | None:
+    """One range query per candidate series until one returns usable
+    data. ``preferred_query`` (e.g. the instant fetch's
+    ``resolved_series['tensorcore_utilization']``) is tried first so a
+    page view doesn't re-walk candidates the instant path already
+    eliminated. None when no candidate has enough real history."""
+    namespace, service = prometheus
+    end = clock()
+    start = end - window_s
+    n_samples = int(window_s // step_s) + 1
+    min_real = max(3, int(n_samples * MIN_REAL_SAMPLE_FRACTION))
+
+    # Node-name join map, same as the instant path (`metrics.ts:119-124`)
+    # — forecast rows must key identically to the chip cards beside them.
+    instance_map: dict[str, str] = {}
+    try:
+        data = transport.request(
+            _proxy_query_path(namespace, service, NODE_MAP_QUERY), timeout_s
+        )
+        instance_map = _build_instance_map(_vector_result(data))
+    except ApiError:
+        pass
+
+    candidates = list(
+        LOGICAL_METRICS["tensorcore_utilization"] + LOGICAL_METRICS["duty_cycle"]
+    )
+    if preferred_query and preferred_query in candidates:
+        candidates.remove(preferred_query)
+        candidates.insert(0, preferred_query)
+
+    for promql in candidates:
+        try:
+            data = transport.request(
+                _proxy_range_path(namespace, service, promql, start, end, step_s),
+                timeout_s,
+            )
+        except ApiError:
+            continue
+        if not isinstance(data, Mapping) or data.get("status") != "success":
+            continue
+        inner = data.get("data")
+        if not isinstance(inner, Mapping) or inner.get("resultType") != "matrix":
+            continue
+        result = inner.get("result")
+        if not isinstance(result, list) or not result:
+            continue
+
+        keys: list[tuple[str, str]] = []
+        series: list[list[float]] = []
+        for entry in result:
+            if not isinstance(entry, Mapping):
+                continue
+            labels = _sample_labels(entry)
+            key = (_node_of(labels, instance_map), _chip_of(labels))
+            values = entry.get("values")
+            if not isinstance(values, list):
+                continue
+            # Align onto the fixed grid, forward-filling short gaps.
+            by_ts = {}
+            for v in values:
+                if isinstance(v, (list, tuple)) and len(v) == 2:
+                    try:
+                        by_ts[round(float(v[0]))] = float(v[1])
+                    except (TypeError, ValueError):
+                        continue
+            if len(by_ts) < min_real:
+                continue  # mostly-fabricated trace: skip, stay honest
+            # Scale is decided ONCE per series: normalizing per sample
+            # would mix scales within one trace from a 0-100 exporter
+            # (an idle 0.9% sample passes the >1.5 test unscaled while
+            # busy samples get divided), fabricating saturation.
+            scale = 100.0 if max(by_ts.values()) > 1.5 else 1.0
+            grid: list[float] = []
+            last = next(iter(by_ts.values()))
+            for i in range(n_samples):
+                ts = round(start + i * step_s)
+                last = by_ts.get(ts, last)
+                grid.append(last / scale)
+            keys.append(key)
+            series.append(grid)
+        if series:
+            return UtilizationHistory(
+                keys=keys,
+                series=series,
+                step_s=step_s,
+                end=end,
+                resolved_query=promql,
+            )
+    return None

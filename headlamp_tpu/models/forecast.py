@@ -156,3 +156,59 @@ def forecast_next(
     horizon] predicted utilization."""
     del cfg
     return forward(params, recent)
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps"))
+def _fit_forecast_program(
+    x: jax.Array,
+    y: jax.Array,
+    recent: jax.Array,
+    key: jax.Array,
+    cfg: ForecastConfig,
+    steps: int,
+) -> jax.Array:
+    """init → ``steps`` optimizer steps (lax.scan) → predict, as ONE
+    XLA program. A Python training loop would issue one device dispatch
+    per step — tens of round-trips on a remote/tunneled TPU for a fit
+    that the fused program finishes in a single dispatch."""
+    params = init_params(key, cfg)
+    optimizer = optax.adam(cfg.learning_rate)
+    opt_state = optimizer.init(params)
+
+    def body(carry, _):
+        p, s = carry
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+        updates, s = optimizer.update(grads, s, p)
+        p = optax.apply_updates(p, updates)
+        return (p, s), loss
+
+    (params, _), _ = jax.lax.scan(body, (params, opt_state), None, length=steps)
+    return forward(params, recent)
+
+
+def fit_and_forecast(
+    series: jax.Array,
+    cfg: ForecastConfig | None = None,
+    *,
+    steps: int = 60,
+    seed: int = 0,
+) -> jax.Array:
+    """Online fit on the given traces, then predict the next horizon
+    from each trace's latest window: [n_chips, T] -> [n_chips, horizon].
+
+    There is no pre-trained checkpoint by design — utilization dynamics
+    are cluster-specific, the model is tiny, and fitting on exactly the
+    window the page displays keeps the prediction honest. Traces shorter
+    than window+horizon fall back to persistence (repeat last value)."""
+    cfg = cfg or ForecastConfig()
+    series = jnp.asarray(series, dtype=jnp.float32)
+    _, length = series.shape
+    if length < cfg.window + cfg.horizon:
+        last = series[:, -1:]
+        return jnp.repeat(last, cfg.horizon, axis=1)
+
+    x, y = make_windows(series, cfg.window, cfg.horizon)
+    recent = series[:, -cfg.window:]
+    return _fit_forecast_program(
+        x, y, recent, jax.random.PRNGKey(seed), cfg, steps
+    )

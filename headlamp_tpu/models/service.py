@@ -1,0 +1,84 @@
+"""Forecast service: UtilizationHistory → page-ready forecast view.
+
+The glue between the metrics client's range-query output and the
+MetricsPage: fits the forecaster on the fetched traces and summarizes
+per-chip risk. Pages stay pure — they render a ForecastView; this
+module owns the jax calls.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..metrics.client import UtilizationHistory
+from .forecast import ForecastConfig, fit_and_forecast
+
+
+@dataclass
+class ChipForecast:
+    node: str
+    accelerator_id: str
+    current: float
+    predicted_peak: float
+    predicted_mean: float
+    #: True when the chip is predicted to cross the 90% saturation line
+    #: within the horizon.
+    saturation_risk: bool
+
+
+@dataclass
+class ForecastView:
+    horizon_s: int
+    window_s: int
+    chips: list[ChipForecast] = field(default_factory=list)
+    fit_ms: float = 0.0
+
+    @property
+    def at_risk(self) -> list[ChipForecast]:
+        return [c for c in self.chips if c.saturation_risk]
+
+
+#: Saturation line shared with the UI kit's critical threshold.
+SATURATION_PCT = 90.0
+
+
+def forecast_from_history(
+    history: UtilizationHistory,
+    cfg: ForecastConfig | None = None,
+    *,
+    steps: int = 60,
+) -> ForecastView:
+    """Fit + predict + summarize. Deterministic (fixed seed)."""
+    import time
+
+    import numpy as np
+
+    cfg = cfg or ForecastConfig()
+    t0 = time.perf_counter()
+    preds = np.asarray(fit_and_forecast(np.asarray(history.series), cfg, steps=steps))
+    fit_ms = round((time.perf_counter() - t0) * 1000, 1)
+
+    chips = []
+    for key, trace, pred in zip(history.keys, history.series, preds):
+        peak = float(pred.max())
+        chips.append(
+            ChipForecast(
+                node=key[0],
+                accelerator_id=key[1],
+                current=float(trace[-1]),
+                predicted_peak=peak,
+                predicted_mean=float(pred.mean()),
+                saturation_risk=peak * 100 >= SATURATION_PCT,
+            )
+        )
+    chips.sort(key=lambda c: -c.predicted_peak)
+    n_samples = len(history.series[0]) if history.series else 0
+    return ForecastView(
+        horizon_s=cfg.horizon * history.step_s,
+        # The fit consumes the WHOLE fetched trace (sliding windows over
+        # all of it), so report that — not cfg.window — as the history
+        # span shown to operators.
+        window_s=max(0, (n_samples - 1)) * history.step_s,
+        chips=chips,
+        fit_ms=fit_ms,
+    )

@@ -152,7 +152,60 @@ def chip_card(chip: Any) -> Element:
     )
 
 
-def metrics_page(metrics: TpuMetricsSnapshot | None) -> Element:
+def forecast_section(view: Any) -> Element:
+    """Predicted-utilization section (no reference analogue — the TPU
+    framework's forward-looking addition). ``view`` is a
+    ``models.service.ForecastView``."""
+    mins = max(1, round(view.horizon_s / 60))
+    at_risk = view.at_risk
+    risk_banner = None
+    if at_risk:
+        names = ", ".join(f"{c.node}/chip {c.accelerator_id}" for c in at_risk[:5])
+        risk_banner = h(
+            "div",
+            {"class_": "hl-notice hl-forecast-risk"},
+            h("h3", None, f"{len(at_risk)} chip(s) predicted to saturate"),
+            h(
+                "p",
+                None,
+                f"≥90% TensorCore utilization expected within {mins} min: {names}",
+            ),
+        )
+    return SectionBox(
+        f"Utilization Forecast (next {mins} min)",
+        risk_banner,
+        SimpleTable(
+            [
+                {"label": "Node", "getter": lambda c: c.node},
+                {"label": "Chip", "getter": lambda c: c.accelerator_id},
+                {"label": "Now", "getter": lambda c: format_percent(c.current)},
+                {
+                    "label": "Predicted peak",
+                    "getter": lambda c: StatusLabel(
+                        "error" if c.saturation_risk else "success",
+                        format_percent(c.predicted_peak),
+                    ),
+                },
+                {
+                    "label": "Predicted mean",
+                    "getter": lambda c: format_percent(c.predicted_mean),
+                },
+            ],
+            view.chips[:16],
+            empty_message="No history to forecast from",
+        ),
+        h(
+            "p",
+            {"class_": "hl-hint"},
+            f"Model fit on the last {round(view.window_s / 60)} min of history "
+            f"in {view.fit_ms:g} ms (online MLP, deterministic seed).",
+        ),
+    )
+
+
+def metrics_page(
+    metrics: TpuMetricsSnapshot | None, forecast: Any | None = None
+) -> Element:
     children: list[Any] = [availability_matrix(metrics)]
 
     if metrics is None:
@@ -189,10 +242,14 @@ def metrics_page(metrics: TpuMetricsSnapshot | None) -> Element:
                 "p",
                 {"class_": "hl-hint"},
                 f"Source: {metrics.namespace}/{metrics.service} via apiserver "
-                "service proxy.",
+                f"service proxy; scrape→join took {metrics.fetch_ms:g} ms "
+                "(target <2000 ms).",
             ),
         )
     )
+
+    if forecast is not None:
+        children.append(forecast_section(forecast))
 
     children.extend(chip_card(c) for c in metrics.chips)
     return h("div", {"class_": "hl-page hl-metrics"}, children)

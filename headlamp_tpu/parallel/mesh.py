@@ -21,8 +21,8 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..analytics.encode import GENERATION_IDS, PHASE_IDS, FleetArrays
-from ..analytics.fleet_jax import _RUNNING
+from ..analytics.encode import FleetArrays
+from ..analytics.fleet_jax import aggregates_to_host_dict, local_aggregates
 
 
 def fleet_mesh(n_devices: int | None = None) -> Mesh:
@@ -91,51 +91,19 @@ def sharded_rollup(fleet: FleetArrays, mesh: Mesh) -> dict[str, Any]:
         out_specs=P(),  # fully replicated aggregates (every out is a psum)
     )
     def rollup_shard(cap, alloc, ready, gen, nvalid, req, phase, nidx, pvalid):
-        local_cap = jnp.sum(cap * nvalid)
-        local_alloc = jnp.sum(alloc * nvalid)
-        local_nodes = jnp.sum(nvalid)
-        local_ready = jnp.sum(ready * nvalid)
-        running = ((phase == _RUNNING) & (pvalid == 1)).astype(jnp.int32)
-        req_running = req * running
-        local_in_use = jnp.sum(req_running)
-        local_phases = jax.ops.segment_sum(pvalid, phase, num_segments=len(PHASE_IDS))
-        local_gens = jax.ops.segment_sum(nvalid, gen, num_segments=len(GENERATION_IDS))
-        # Global node index space: unscheduled pods use the overflow
-        # segment past every real node row.
-        local_per_node = jax.ops.segment_sum(
-            req_running, nidx, num_segments=n_nodes_pad + 1
-        )[:n_nodes_pad]
-
-        return {
-            "capacity": jax.lax.psum(local_cap, "hosts"),
-            "allocatable": jax.lax.psum(local_alloc, "hosts"),
-            "in_use": jax.lax.psum(local_in_use, "hosts"),
-            "nodes_total": jax.lax.psum(local_nodes, "hosts"),
-            "nodes_ready": jax.lax.psum(local_ready, "hosts"),
-            "phase_counts": jax.lax.psum(local_phases, "hosts"),
-            "generation_counts": jax.lax.psum(local_gens, "hosts"),
-            "per_node_in_use": jax.lax.psum(local_per_node, "hosts"),
-        }
+        # One shared reduction body with the single-device rollup
+        # (fleet_jax.local_aggregates) — pod_node_idx already indexes
+        # the GLOBAL node space, so each shard's segment-sum lands in
+        # the right global rows and a psum completes every aggregate.
+        local = local_aggregates(
+            cap, alloc, ready, gen, nvalid, req, phase, nidx, pvalid,
+            n_nodes_pad=n_nodes_pad,
+        )
+        return {k: jax.lax.psum(v, "hosts") for k, v in local.items()}
 
     with mesh:
-        out = rollup_shard(*node_cols, *pod_cols)
-    result = {
-        "capacity": int(out["capacity"]),
-        "allocatable": int(out["allocatable"]),
-        "in_use": int(out["in_use"]),
-        "free": int(out["allocatable"]) - int(out["in_use"]),
-        "nodes_total": int(out["nodes_total"]),
-        "nodes_ready": int(out["nodes_ready"]),
-        "phase_counts": {
-            name: int(c) for name, c in zip(PHASE_IDS, out["phase_counts"])
-        },
-        "generation_counts": {
-            name: int(c)
-            for name, c in zip(GENERATION_IDS, out["generation_counts"])
-            if int(c) > 0
-        },
-        "per_node_in_use": [int(v) for v in out["per_node_in_use"][: fleet.n_nodes]],
-    }
+        out = jax.device_get(rollup_shard(*node_cols, *pod_cols))
+    result = aggregates_to_host_dict(out, fleet.n_nodes)
     return result
 
 

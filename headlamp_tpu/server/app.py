@@ -21,6 +21,7 @@ the full UI runs with zero cluster.
 
 from __future__ import annotations
 
+import html
 import json
 import threading
 import time
@@ -29,7 +30,7 @@ from typing import Any
 from urllib.parse import parse_qs, urlparse
 
 from ..context.accelerator_context import AcceleratorDataContext
-from ..metrics.client import fetch_tpu_metrics
+from ..metrics.client import fetch_tpu_metrics, fetch_utilization_history
 from ..registration import Registry, register_plugin
 from ..transport.api_proxy import MockTransport, Transport
 from ..ui import render_html
@@ -56,6 +57,8 @@ class DashboardApp:
         # all state mutation funnels through one lock (renders of an
         # already-built snapshot stay lock-free).
         self._lock = threading.Lock()
+        self._forecast_lock = threading.Lock()
+        self._forecast_cache: tuple[float, Any] | None = None
 
     @property
     def registry(self) -> Registry:
@@ -69,13 +72,75 @@ class DashboardApp:
                 self._last_sync = now
             return self._ctx.snapshot()
 
+    #: Forecast results are cached this long — the history grid only
+    #: gains a point per step anyway, and the fit (jax compile + scan)
+    #: must not run on every page view.
+    FORECAST_TTL_S = 60.0
+
+    def _forecast_for(self, metrics: Any) -> Any:
+        """Forecast view for the metrics page, or None. None whenever
+        the analytics extras (jax/optax) are absent — the forecast is a
+        progressive enhancement, never a hard dependency of the page —
+        or history is too thin to be honest. TTL-cached."""
+        if metrics is None or not metrics.chips:
+            return None
+        # Dedicated lock (not self._lock — the fit can take seconds and
+        # must not block unrelated pages): exactly one thread refits per
+        # TTL window; concurrent requests wait and reuse its result.
+        with self._forecast_lock:
+            now = self._clock()
+            if self._forecast_cache is not None:
+                expiry, cached = self._forecast_cache
+                if now < expiry:
+                    return cached
+            forecast = self._compute_forecast(metrics)
+            self._forecast_cache = (now + self.FORECAST_TTL_S, forecast)
+            return forecast
+
+    def _compute_forecast(self, metrics: Any) -> Any:
+        forecast = None
+        try:
+            from ..models.service import forecast_from_history
+
+            history = fetch_utilization_history(
+                self._transport,
+                prometheus=(metrics.namespace, metrics.service),
+                clock=self._clock,
+                preferred_query=metrics.resolved_series.get(
+                    "tensorcore_utilization"
+                ),
+            )
+            if history is not None:
+                forecast = forecast_from_history(history)
+        except Exception:
+            # Broad by design: a missing extra (ImportError), an
+            # unusable jax backend (RuntimeError), or an exotic exporter
+            # payload must cost the forecast section only — never the
+            # metrics page. The negative result is cached too, so a
+            # broken jax install doesn't retry the fit on every view.
+            forecast = None
+        return forecast
+
     # ------------------------------------------------------------------
     # Request handling (framework-level, server-agnostic)
     # ------------------------------------------------------------------
 
     def handle(self, path: str) -> tuple[int, str, str]:
         """(status, content_type, body) for a GET. Pure enough to test
-        without sockets."""
+        without sockets. Never raises: route errors become a 500 page
+        (a traceback must not leak into a response, and one broken
+        route must not kill the handler thread)."""
+        try:
+            return self._handle(path)
+        except Exception as e:  # noqa: BLE001 — error boundary
+            body = self._page_html(
+                "Error",
+                f"<div class='hl-error' role='alert'>Internal error: "
+                f"{html.escape(type(e).__name__)}: {html.escape(str(e))}</div>",
+            )
+            return 500, "text/html", body
+
+    def _handle(self, path: str) -> tuple[int, str, str]:
         parsed = urlparse(path)
         route_path = parsed.path.rstrip("/") or "/tpu"
 
@@ -110,7 +175,8 @@ class DashboardApp:
         now = self._clock()
         if route.kind == "metrics":
             metrics = fetch_tpu_metrics(self._transport, clock=self._clock)
-            el = route.component(metrics)
+            forecast = self._forecast_for(metrics)
+            el = route.component(metrics, forecast)
         elif route.kind == "topology":
             el = route.component(snap)
         else:
@@ -217,11 +283,51 @@ def make_demo_transport(fleet_name: str = "v5p32") -> MockTransport:
             used.append((labels, (8 + (i + chip) % 7) * GIB))
             total.append((labels, 16 * GIB))
     t.add(q("1"), {"status": "success", "data": {"resultType": "scalar", "result": [0, "1"]}})
+    t.add(q("tensorcore_utilization"), vec(util))
+    t.add(q("hbm_bytes_used"), vec(used))
+    t.add(q("hbm_bytes_total"), vec(total))
+
+    # Range queries: synthesize utilization history on exactly the
+    # requested (start, end, step) grid so the forecaster has real
+    # traces to fit in demo mode. Registered BEFORE the generic /query
+    # prefix — prefix routes match in insertion order and '…/query' is
+    # a prefix of '…/query_range'.
+    import math
+    import urllib.parse as up
+
+    def range_response(path: str) -> dict:
+        query = up.parse_qs(up.urlparse(path).query)
+        if "tensorcore_utilization" not in up.unquote(query["query"][0]):
+            return {"status": "success", "data": {"resultType": "matrix", "result": []}}
+        start = float(query["start"][0])
+        end = float(query["end"][0])
+        step = int(query["step"][0])
+        result = []
+        for i, node in enumerate(tpu_nodes[:16]):
+            for chip in range(4):
+                base = 0.4 + 0.1 * ((i + chip) % 3)
+                values = []
+                ts = start
+                while ts <= end:
+                    v = base + 0.25 * math.sin(ts / 600 + i + chip) + 0.15 * math.sin(
+                        ts / 150 + chip
+                    )
+                    values.append([ts, f"{min(max(v, 0.0), 1.0):.4f}"])
+                    ts += step
+                result.append(
+                    {
+                        "metric": {"node": node, "accelerator_id": str(chip)},
+                        "values": values,
+                    }
+                )
+        return {"status": "success", "data": {"resultType": "matrix", "result": result}}
+
+    t.add_prefix(
+        "/api/v1/namespaces/monitoring/services/prometheus-k8s:9090/proxy/api/v1/query_range",
+        range_response,
+    )
     t.add_prefix(
         "/api/v1/namespaces/monitoring/services/prometheus-k8s:9090/proxy/api/v1/query",
         vec([]),
     )
-    t.add(q("tensorcore_utilization"), vec(util))
-    t.add(q("hbm_bytes_used"), vec(used))
-    t.add(q("hbm_bytes_total"), vec(total))
     return t

@@ -185,5 +185,18 @@ class MockTransport:
         if isinstance(response, Exception):
             raise response
         if callable(response):
-            return self._resolve(path, response())
+            # Callables may take the request path (dynamic routes like
+            # query_range, whose response must echo requested
+            # timestamps) or nothing (simple sequenced responses). The
+            # call form is chosen by signature, not try/except — a
+            # TypeError raised *inside* the callable must surface as
+            # the real bug, not as a dispatch retry.
+            import inspect
+
+            try:
+                takes_path = len(inspect.signature(response).parameters) >= 1
+            except (TypeError, ValueError):  # builtins without signatures
+                takes_path = False
+            produced = response(path) if takes_path else response()
+            return self._resolve(path, produced)
         return response

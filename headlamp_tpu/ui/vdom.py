@@ -17,7 +17,7 @@ from typing import Any, Callable, Iterator
 Child = Any  # Element | str | int | float | None (None children are dropped)
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class Element:
     tag: str
     props: dict[str, Any] = field(default_factory=dict)

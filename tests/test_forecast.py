@@ -1,0 +1,239 @@
+"""Forecast feature tests: range-query history fetch, online fit,
+page section, and the server wiring through demo mode."""
+
+import math
+
+from headlamp_tpu.metrics.client import (
+    TpuChipMetrics,
+    TpuMetricsSnapshot,
+    fetch_utilization_history,
+)
+from headlamp_tpu.models.service import forecast_from_history
+from headlamp_tpu.pages import metrics_page
+from headlamp_tpu.server import DashboardApp, make_demo_transport
+from headlamp_tpu.transport import MockTransport
+from headlamp_tpu.ui import text_content
+
+PROM = ("monitoring", "prometheus-k8s:9090")
+RANGE_PREFIX = (
+    "/api/v1/namespaces/monitoring/services/prometheus-k8s:9090"
+    "/proxy/api/v1/query_range"
+)
+
+
+def matrix_transport(series_fn, n_chips=2):
+    """Transport answering range queries with per-chip traces from
+    ``series_fn(chip_index, ts)``."""
+    t = MockTransport()
+
+    def respond(path):
+        import urllib.parse as up
+
+        q = up.parse_qs(up.urlparse(path).query)
+        start, end, step = float(q["start"][0]), float(q["end"][0]), int(q["step"][0])
+        result = []
+        for c in range(n_chips):
+            values = []
+            ts = start
+            while ts <= end:
+                values.append([ts, f"{series_fn(c, ts):.4f}"])
+                ts += step
+            result.append(
+                {"metric": {"node": "n1", "accelerator_id": str(c)}, "values": values}
+            )
+        return {"status": "success", "data": {"resultType": "matrix", "result": result}}
+
+    t.add_prefix(RANGE_PREFIX, respond)
+    return t
+
+
+class TestHistoryFetch:
+    def test_aligned_series(self):
+        t = matrix_transport(lambda c, ts: 0.5 + 0.1 * c)
+        hist = fetch_utilization_history(
+            t, prometheus=PROM, window_s=600, step_s=60, clock=lambda: 10_000.0
+        )
+        assert hist is not None
+        assert hist.keys == [("n1", "0"), ("n1", "1")]
+        assert len(hist.series[0]) == 11  # 600/60 + 1
+        assert abs(hist.series[1][0] - 0.6) < 1e-6
+
+    def test_percent_scale_normalized(self):
+        t = matrix_transport(lambda c, ts: 87.0)  # 0-100 exporter
+        hist = fetch_utilization_history(
+            t, prometheus=PROM, window_s=300, clock=lambda: 10_000.0
+        )
+        assert abs(hist.series[0][0] - 0.87) < 1e-6
+
+    def test_no_history_returns_none(self):
+        assert (
+            fetch_utilization_history(
+                MockTransport(), prometheus=PROM, clock=lambda: 0.0
+            )
+            is None
+        )
+
+    def test_sparse_history_rejected(self):
+        # Prometheus installed minutes ago: only 4 real points in a
+        # 60-point window. Forward-filling would fabricate history, so
+        # the fetch must return None instead of feeding the forecaster.
+        t = MockTransport()
+
+        def respond(path):
+            import urllib.parse as up
+
+            q = up.parse_qs(up.urlparse(path).query)
+            start, step = float(q["start"][0]), int(q["step"][0])
+            values = [[start + i * step, "0.95"] for i in range(4)]
+            return {
+                "status": "success",
+                "data": {
+                    "resultType": "matrix",
+                    "result": [
+                        {"metric": {"node": "n1", "accelerator_id": "0"}, "values": values}
+                    ],
+                },
+            }
+
+        t.add_prefix(RANGE_PREFIX, respond)
+        hist = fetch_utilization_history(
+            t, prometheus=PROM, window_s=3600, step_s=60, clock=lambda: 10_000.0
+        )
+        assert hist is None
+
+    def test_preferred_query_tried_first(self):
+        t = matrix_transport(lambda c, ts: 0.5)
+        fetch_utilization_history(
+            t,
+            prometheus=PROM,
+            window_s=300,
+            clock=lambda: 10_000.0,
+            preferred_query="tpu_tensorcore_utilization",
+        )
+        range_calls = [c for c in t.calls if "query_range" in c]
+        assert "tpu_tensorcore_utilization" in range_calls[0]
+
+    def test_instance_labels_joined_to_nodename(self):
+        # History samples carrying only `instance` must key rows by the
+        # node_uname_info-resolved node name, matching the chip cards.
+        t = MockTransport()
+        t.add(
+            "/api/v1/namespaces/monitoring/services/prometheus-k8s:9090"
+            "/proxy/api/v1/query?query=node_uname_info",
+            {
+                "status": "success",
+                "data": {
+                    "resultType": "vector",
+                    "result": [
+                        {
+                            "metric": {"instance": "10.0.0.7:9100", "nodename": "gke-w0"},
+                            "value": [0, "1"],
+                        }
+                    ],
+                },
+            },
+        )
+
+        def respond(path):
+            import urllib.parse as up
+
+            q = up.parse_qs(up.urlparse(path).query)
+            start, end, step = float(q["start"][0]), float(q["end"][0]), int(q["step"][0])
+            values = []
+            ts = start
+            while ts <= end:
+                values.append([ts, "0.5"])
+                ts += step
+            return {
+                "status": "success",
+                "data": {
+                    "resultType": "matrix",
+                    "result": [
+                        {"metric": {"instance": "10.0.0.7:8431"}, "values": values}
+                    ],
+                },
+            }
+
+        t.add_prefix(RANGE_PREFIX, respond)
+        hist = fetch_utilization_history(
+            t, prometheus=PROM, window_s=600, clock=lambda: 10_000.0
+        )
+        assert hist.keys[0][0] == "gke-w0"
+
+
+class TestForecastService:
+    def test_saturating_chip_flagged(self):
+        # Chip 0 ramps toward saturation; chip 1 stays flat and low.
+        def series(c, ts):
+            if c == 0:
+                return min(1.0, 0.5 + (ts - 4000) / 8000)
+            return 0.3
+
+        t = matrix_transport(series)
+        hist = fetch_utilization_history(
+            t, prometheus=PROM, window_s=3600, step_s=60, clock=lambda: 10_000.0
+        )
+        view = forecast_from_history(hist, steps=40)
+        by_chip = {c.accelerator_id: c for c in view.chips}
+        assert by_chip["0"].predicted_peak > by_chip["1"].predicted_peak
+        assert not by_chip["1"].saturation_risk
+        assert view.horizon_s == 8 * 60
+
+    def test_short_history_persistence_fallback(self):
+        t = matrix_transport(lambda c, ts: 0.42)
+        hist = fetch_utilization_history(
+            t, prometheus=PROM, window_s=300, step_s=60, clock=lambda: 10_000.0
+        )
+        view = forecast_from_history(hist)
+        assert abs(view.chips[0].predicted_peak - 0.42) < 1e-4
+
+
+class TestMetricsPageForecast:
+    def _metrics(self):
+        return TpuMetricsSnapshot(
+            namespace="monitoring",
+            service="prometheus-k8s:9090",
+            chips=[TpuChipMetrics(node="n1", accelerator_id="0", duty_cycle=0.4)],
+            availability={"duty_cycle": True},
+            fetch_ms=123.0,
+        )
+
+    def test_forecast_section_rendered(self):
+        t = matrix_transport(lambda c, ts: 0.97)
+        hist = fetch_utilization_history(
+            t, prometheus=PROM, window_s=3600, step_s=60, clock=lambda: 10_000.0
+        )
+        view = forecast_from_history(hist, steps=30)
+        el = metrics_page(self._metrics(), view)
+        text = text_content(el)
+        assert "Utilization Forecast" in text
+        assert "predicted to saturate" in text
+
+    def test_page_without_forecast(self):
+        el = metrics_page(self._metrics(), None)
+        assert "Utilization Forecast" not in text_content(el)
+
+    def test_scrape_paint_timing_shown(self):
+        el = metrics_page(self._metrics())
+        assert "123 ms" in text_content(el)
+
+
+class TestDemoWiring:
+    def test_demo_metrics_route_includes_forecast(self):
+        app = DashboardApp(make_demo_transport("v5p32"), min_sync_interval_s=0.0)
+        status, _, body = app.handle("/tpu/metrics")
+        assert status == 200
+        assert "Utilization Forecast" in body
+
+    def test_demo_range_route_not_shadowed(self):
+        t = make_demo_transport("v5e4")
+        hist = fetch_utilization_history(t, prometheus=PROM)
+        assert hist is not None and len(hist.series[0]) > 30
+
+    def test_forecast_cached_between_views(self):
+        t = make_demo_transport("v5e4")
+        app = DashboardApp(t, min_sync_interval_s=0.0)
+        app.handle("/tpu/metrics")
+        first_range_calls = sum(1 for c in t.calls if "query_range" in c)
+        app.handle("/tpu/metrics")  # within TTL: no refit, no refetch
+        assert sum(1 for c in t.calls if "query_range" in c) == first_range_calls
