@@ -84,6 +84,24 @@ def filter_gpu_requesting_pods(items: Iterable[Any]) -> list[Any]:
     return [p for p in items if is_gpu_requesting_pod(p)]
 
 
+def get_container_gpu_resources(container: Any) -> dict[str, tuple[int, int]]:
+    """Per-container ``{resource: (request, limit)}`` over the merged
+    requests∪limits key set, gpu.intel.com/* only — the single
+    definition behind the pods-page container list and the pod
+    detail-section rows (the reference merges the same way,
+    `PodsPage.tsx:49-88`, `PodDetailSection.tsx:57-83`)."""
+    requests = obj.container_requests(container)
+    limits = obj.container_limits(container)
+    return {
+        resource: (
+            obj.parse_int(requests.get(resource)),
+            obj.parse_int(limits.get(resource)),
+        )
+        for resource in sorted({*requests, *limits})
+        if resource.startswith(INTEL_GPU_RESOURCE_PREFIX)
+    }
+
+
 def get_pod_gpu_requests(pod: Any) -> dict[str, int]:
     """Per-resource effective requests: max(sum over main containers,
     max over init containers) — init containers run before the main ones
@@ -113,6 +131,57 @@ def is_intel_plugin_pod(pod: Any) -> bool:
     if not labels:
         return False
     return any(labels.get(k) == v for k, v in INTEL_PLUGIN_POD_LABELS)
+
+
+# ---------------------------------------------------------------------------
+# GpuDevicePlugin CRD status (reference: k8s.ts:56-80,370-386)
+# ---------------------------------------------------------------------------
+
+def plugin_status_to_status(plugin: Any) -> str:
+    """'success' | 'warning' | 'error' from the CRD's rollout counters —
+    the reference's state machine (k8s.ts:370-379): no desired nodes ⇒
+    warning; all ready ⇒ success; else error."""
+    s = obj.status(plugin)
+    desired = obj.parse_int(s.get("desiredNumberScheduled"))
+    ready = obj.parse_int(s.get("numberReady"))
+    if desired == 0:
+        return "warning"
+    if ready == desired:
+        return "success"
+    return "error"
+
+
+def plugin_status_text(plugin: Any) -> str:
+    """Human rollout text (k8s.ts:381-386)."""
+    s = obj.status(plugin)
+    desired = obj.parse_int(s.get("desiredNumberScheduled"))
+    ready = obj.parse_int(s.get("numberReady"))
+    if desired == 0:
+        return "No nodes scheduled"
+    return f"{ready}/{desired} ready"
+
+
+def format_gpu_resource_name(resource_key: str) -> str:
+    """'gpu.intel.com/i915' -> 'GPU (i915)' (k8s.ts:354-364)."""
+    if not resource_key.startswith(INTEL_GPU_RESOURCE_PREFIX):
+        return resource_key
+    suffix = resource_key[len(INTEL_GPU_RESOURCE_PREFIX):]
+    pretty = {
+        "i915": "GPU (i915)",
+        "xe": "GPU (xe)",
+        "millicores": "GPU millicores",
+        "memory.max": "GPU memory",
+        "tiles": "GPU tiles",
+    }
+    return pretty.get(suffix, f"GPU ({suffix})")
+
+
+def format_gpu_type(gpu_type: str) -> str:
+    """(k8s.ts:194-199)."""
+    return {
+        "discrete": "Discrete GPU",
+        "integrated": "Integrated GPU",
+    }.get(gpu_type, "Intel GPU")
 
 
 def filter_intel_plugin_pods(items: Iterable[Any]) -> list[Any]:

@@ -225,6 +225,17 @@ def allocation_summary(
     }
 
 
+def count_pod_phases(pods: Iterable[Any]) -> dict[str, int]:
+    """Phase histogram with an Other bucket (`OverviewPage.tsx:122-130`).
+    Provider-neutral: both the TPU and Intel overview/pods pages consume
+    it."""
+    counts = {"Running": 0, "Pending": 0, "Succeeded": 0, "Failed": 0, "Other": 0}
+    for p in pods:
+        phase = pod_phase(p)
+        counts[phase if phase in counts else "Other"] += 1
+    return counts
+
+
 def format_age(timestamp: str | None, now_epoch_s: float) -> str:
     """Human age from an RFC3339 timestamp: s/m/h/d buckets
     (reference: k8s.ts:337-348). ``now_epoch_s`` is explicit so callers and

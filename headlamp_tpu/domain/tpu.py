@@ -245,10 +245,6 @@ def summarize_allocation(nodes: Iterable[Any], pods: Iterable[Any]) -> Mapping[s
     )
 
 
-def count_pod_phases(pods: Iterable[Any]) -> dict[str, int]:
-    """Phase histogram with an Other bucket (OverviewPage.tsx:122-130)."""
-    counts = {"Running": 0, "Pending": 0, "Succeeded": 0, "Failed": 0, "Other": 0}
-    for p in pods:
-        phase = obj.pod_phase(p)
-        counts[phase if phase in counts else "Other"] += 1
-    return counts
+#: Provider-neutral phase histogram — lives in objects; re-exported here
+#: for the established TPU-page call sites.
+count_pod_phases = obj.count_pod_phases

@@ -289,6 +289,40 @@ def make_plugin_daemonset(
     }
 
 
+def make_intel_crd(
+    name: str = "gpudeviceplugin-sample",
+    *,
+    desired: int = 1,
+    ready: int | None = None,
+    shared_dev_num: int = 1,
+    age_seconds: int = 3600 * 72,
+) -> dict[str, Any]:
+    """A GpuDevicePlugin CR shaped like the reference's domain model
+    (`/root/reference/src/api/k8s.ts:56-80`)."""
+    if ready is None:
+        ready = desired
+    return {
+        "apiVersion": "deviceplugin.intel.com/v1",
+        "kind": "GpuDevicePlugin",
+        "metadata": {
+            "name": name,
+            "uid": f"uid-crd-{name}",
+            "creationTimestamp": _ts(age_seconds),
+        },
+        "spec": {
+            "image": "intel/intel-gpu-plugin:0.30.0",
+            "sharedDevNum": shared_dev_num,
+            "preferredAllocationPolicy": "balanced",
+            "enableMonitoring": True,
+            "nodeSelector": {"intel.feature.node.kubernetes.io/gpu": "true"},
+        },
+        "status": {
+            "desiredNumberScheduled": desired,
+            "numberReady": ready,
+        },
+    }
+
+
 def fleet_transport(fleet: dict[str, Any]):
     """MockTransport serving a fixture fleet on the same URL surface the
     context fetches (single definition — the server demo mode and
@@ -303,6 +337,11 @@ def fleet_transport(fleet: dict[str, Any]):
         "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin",
         {"kind": "List", "items": fleet.get("daemonsets", [])},
     )
+    if "gpudeviceplugins" in fleet:
+        t.add(
+            "/apis/deviceplugin.intel.com/v1/gpudeviceplugins",
+            {"kind": "List", "items": fleet["gpudeviceplugins"]},
+        )
     return t
 
 
@@ -382,6 +421,7 @@ def fleet_mixed() -> dict[str, Any]:
         "nodes": tpu_nodes + intel_nodes + [make_plain_node("gke-default-pool-m1")],
         "pods": pods + plugins,
         "daemonsets": [make_plugin_daemonset(desired=4)],
+        "gpudeviceplugins": [make_intel_crd(desired=2)],
     }
 
 

@@ -12,5 +12,17 @@ nothing for non-TPU resources.
 from .node_detail import node_detail_section
 from .pod_detail import pod_detail_section
 from .node_columns import build_node_tpu_columns
+from .intel_views import (
+    build_node_intel_columns,
+    intel_node_detail_section,
+    intel_pod_detail_section,
+)
 
-__all__ = ["node_detail_section", "pod_detail_section", "build_node_tpu_columns"]
+__all__ = [
+    "node_detail_section",
+    "pod_detail_section",
+    "build_node_tpu_columns",
+    "build_node_intel_columns",
+    "intel_node_detail_section",
+    "intel_pod_detail_section",
+]

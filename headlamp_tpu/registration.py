@@ -16,7 +16,10 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from .integrations import (
+    build_node_intel_columns,
     build_node_tpu_columns,
+    intel_node_detail_section,
+    intel_pod_detail_section,
     node_detail_section,
     pod_detail_section,
 )
@@ -27,6 +30,13 @@ from .pages import (
     overview_page,
     pods_page,
     topology_page,
+)
+from .pages.intel import (
+    intel_device_plugins_page,
+    intel_metrics_page,
+    intel_nodes_page,
+    intel_overview_page,
+    intel_pods_page,
 )
 
 
@@ -83,15 +93,20 @@ class Registry:
         return [s for s in self.detail_sections if s.resource_kind == resource_kind]
 
 
-#: Sidebar root the entries hang under.
+#: Sidebar roots the entries hang under. TPU first by design
+#: (accelerator.PROVIDERS order); Intel is the compatibility provider
+#: carrying the reference plugin's full surface.
 SIDEBAR_ROOT = "tpu"
+INTEL_SIDEBAR_ROOT = "intel"
 
 
 def register_plugin(registry: Registry | None = None) -> Registry:
     """Populate a registry with the full plugin surface — the analogue
-    of evaluating the reference's module body (`index.tsx:35-182`):
-    6 sidebar entries, 6 routes, 2 detail sections, 1 columns
-    processor."""
+    of evaluating the reference's module body (`index.tsx:35-182`),
+    doubled across the two providers: TPU sidebar/routes plus the
+    reference's own Intel sidebar/routes, detail sections for both
+    (each null-guards itself), and both column sets on the native
+    Nodes table."""
     reg = registry if registry is not None else Registry()
 
     entries = [
@@ -107,6 +122,23 @@ def register_plugin(registry: Registry | None = None) -> Registry:
     ]
     reg.sidebar_entries.extend(entries)
 
+    intel_entries = [
+        SidebarEntry(INTEL_SIDEBAR_ROOT, "Intel GPU", "/intel", parent=None),
+        SidebarEntry("intel-overview", "Overview", "/intel", parent=INTEL_SIDEBAR_ROOT),
+        SidebarEntry("intel-nodes", "Nodes", "/intel/nodes", parent=INTEL_SIDEBAR_ROOT),
+        SidebarEntry("intel-pods", "Workloads", "/intel/pods", parent=INTEL_SIDEBAR_ROOT),
+        SidebarEntry(
+            "intel-deviceplugins",
+            "Device Plugins",
+            "/intel/deviceplugins",
+            parent=INTEL_SIDEBAR_ROOT,
+        ),
+        SidebarEntry(
+            "intel-metrics", "Metrics", "/intel/metrics", parent=INTEL_SIDEBAR_ROOT
+        ),
+    ]
+    reg.sidebar_entries.extend(intel_entries)
+
     reg.routes.extend(
         [
             Route("/tpu", "tpu-overview", overview_page),
@@ -115,6 +147,20 @@ def register_plugin(registry: Registry | None = None) -> Registry:
             Route("/tpu/deviceplugins", "tpu-deviceplugins", device_plugins_page),
             Route("/tpu/topology", "tpu-topology", topology_page, kind="topology"),
             Route("/tpu/metrics", "tpu-metrics", metrics_page, kind="metrics"),
+            Route("/intel", "intel-overview", intel_overview_page),
+            Route("/intel/nodes", "intel-nodes", intel_nodes_page),
+            Route("/intel/pods", "intel-pods", intel_pods_page),
+            Route(
+                "/intel/deviceplugins",
+                "intel-deviceplugins",
+                intel_device_plugins_page,
+            ),
+            Route(
+                "/intel/metrics",
+                "intel-metrics",
+                intel_metrics_page,
+                kind="intel-metrics",
+            ),
         ]
     )
 
@@ -122,10 +168,15 @@ def register_plugin(registry: Registry | None = None) -> Registry:
         [
             DetailSection("Node", node_detail_section),
             DetailSection("Pod", pod_detail_section),
+            DetailSection("Node", intel_node_detail_section),
+            DetailSection("Pod", intel_pod_detail_section),
         ]
     )
 
     reg.columns_processors.append(
         ColumnsProcessor("headlamp-nodes", build_node_tpu_columns)
+    )
+    reg.columns_processors.append(
+        ColumnsProcessor("headlamp-nodes", build_node_intel_columns)
     )
     return reg

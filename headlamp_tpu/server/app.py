@@ -177,6 +177,12 @@ class DashboardApp:
             metrics = fetch_tpu_metrics(self._transport, clock=self._clock)
             forecast = self._forecast_for(metrics)
             el = route.component(metrics, forecast)
+        elif route.kind == "intel-metrics":
+            from ..metrics.intel_client import fetch_intel_gpu_metrics
+
+            el = route.component(
+                fetch_intel_gpu_metrics(self._transport, clock=self._clock)
+            )
         elif route.kind == "topology":
             el = route.component(snap)
         else:
@@ -283,6 +289,28 @@ def make_demo_transport(fleet_name: str = "v5p32") -> MockTransport:
             used.append((labels, (8 + (i + chip) % 7) * GIB))
             total.append((labels, 16 * GIB))
     t.add(q("1"), {"status": "success", "data": {"resultType": "scalar", "result": [0, "1"]}})
+
+    # Intel i915 hwmon series for any Intel nodes in the fleet (the
+    # reference's own metric surface, metrics.ts:101-116).
+    from ..domain.intel import is_intel_gpu_node
+    from ..metrics.intel_client import INTEL_QUERIES
+
+    intel_nodes = [
+        n["metadata"]["name"] for n in fleet["nodes"] if is_intel_gpu_node(n)
+    ]
+    if intel_nodes:
+        uname, chips_s, power_s, tdp_s = [], [], [], []
+        for i, node in enumerate(intel_nodes):
+            instance = f"10.1.0.{i + 1}:9100"
+            uname.append(({"instance": instance, "nodename": node}, 1))
+            labels = {"instance": instance, "chip": "card0", "chip_name": "i915"}
+            chips_s.append((labels, 1))
+            power_s.append((labels, 18.5 + 3 * i))
+            tdp_s.append((labels, 120.0))
+        t.add(q(INTEL_QUERIES["node_map"]), vec(uname))
+        t.add(q(INTEL_QUERIES["chips"]), vec(chips_s))
+        t.add(q(INTEL_QUERIES["power"]), vec(power_s))
+        t.add(q(INTEL_QUERIES["tdp"]), vec(tdp_s))
     t.add(q("tensorcore_utilization"), vec(util))
     t.add(q("hbm_bytes_used"), vec(used))
     t.add(q("hbm_bytes_total"), vec(total))

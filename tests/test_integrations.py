@@ -109,20 +109,31 @@ class TestNodeColumns:
 class TestRegistration:
     def test_full_surface_registered(self):
         reg = register_plugin()
-        assert len(reg.sidebar_entries) == 7  # root + 6 children
-        assert len(reg.routes) == 6
-        assert {r.path for r in reg.routes} == {
+        # TPU: root + 6 children; Intel: root + 5 children.
+        assert len(reg.sidebar_entries) == 13
+        tpu_paths = {
             "/tpu", "/tpu/nodes", "/tpu/pods", "/tpu/deviceplugins",
             "/tpu/topology", "/tpu/metrics",
         }
-        assert [s.resource_kind for s in reg.detail_sections] == ["Node", "Pod"]
-        assert reg.columns_processors[0].table_id == "headlamp-nodes"
+        intel_paths = {
+            "/intel", "/intel/nodes", "/intel/pods", "/intel/deviceplugins",
+            "/intel/metrics",
+        }
+        assert {r.path for r in reg.routes} == tpu_paths | intel_paths
+        # Both providers inject into Node and Pod detail views.
+        assert sorted(s.resource_kind for s in reg.detail_sections) == [
+            "Node", "Node", "Pod", "Pod",
+        ]
+        assert [c.table_id for c in reg.columns_processors] == [
+            "headlamp-nodes", "headlamp-nodes",
+        ]
 
     def test_route_lookup_and_kind_guards(self):
         reg = register_plugin()
         assert reg.route_for("/tpu/topology").kind == "topology"
+        assert reg.route_for("/intel/metrics").kind == "intel-metrics"
         assert reg.route_for("/nope") is None
-        assert len(reg.sections_for("Node")) == 1
+        assert len(reg.sections_for("Node")) == 2
         assert reg.sections_for("Deployment") == []
 
     def test_registry_reuse(self):

@@ -1,0 +1,239 @@
+"""Intel-provider parity tests: the reference plugin's own surface
+(CRD status machine, i915 power metrics, all five pages, native-view
+injections) hosted in this framework."""
+
+from headlamp_tpu.context import AcceleratorDataContext, NODES_PATH, PODS_PATH
+from headlamp_tpu.domain import intel
+from headlamp_tpu.fleet import fixtures as fx
+from headlamp_tpu.integrations import (
+    build_node_intel_columns,
+    intel_node_detail_section,
+    intel_pod_detail_section,
+)
+from headlamp_tpu.metrics.client import PROMETHEUS_SERVICES
+from headlamp_tpu.metrics.intel_client import (
+    INTEL_QUERIES,
+    IntelMetricsSnapshot,
+    GpuChipMetrics,
+    fetch_intel_gpu_metrics,
+    format_watts,
+)
+from headlamp_tpu.pages.intel import (
+    intel_device_plugins_page,
+    intel_metrics_page,
+    intel_nodes_page,
+    intel_overview_page,
+    intel_pods_page,
+)
+from headlamp_tpu.server import DashboardApp, make_demo_transport
+from headlamp_tpu.transport import MockTransport
+from headlamp_tpu.ui import render_html, text_content
+
+NOW = fx.FIXTURE_NOW_EPOCH
+
+
+def mixed_snapshot():
+    return AcceleratorDataContext(fx.fleet_transport(fx.fleet_mixed())).sync()
+
+
+class TestCrdStatus:
+    def test_state_machine(self):
+        # k8s.ts:370-379: desired 0 -> warning; ready==desired ->
+        # success; else error.
+        assert intel.plugin_status_to_status(fx.make_intel_crd(desired=0)) == "warning"
+        assert intel.plugin_status_to_status(fx.make_intel_crd(desired=2)) == "success"
+        assert (
+            intel.plugin_status_to_status(fx.make_intel_crd(desired=3, ready=1))
+            == "error"
+        )
+
+    def test_status_text(self):
+        assert intel.plugin_status_text(fx.make_intel_crd(desired=0)) == "No nodes scheduled"
+        assert intel.plugin_status_text(fx.make_intel_crd(desired=3, ready=1)) == "1/3 ready"
+
+    def test_resource_name_formatting(self):
+        assert intel.format_gpu_resource_name("gpu.intel.com/i915") == "GPU (i915)"
+        assert intel.format_gpu_resource_name("gpu.intel.com/memory.max") == "GPU memory"
+        assert intel.format_gpu_resource_name("cpu") == "cpu"
+
+
+class TestIntelMetricsClient:
+    def _prom(self, series):
+        import urllib.parse
+
+        t = MockTransport()
+        prefix = "/api/v1/namespaces/monitoring/services/prometheus-k8s:9090/proxy/api/v1/query"
+        t.add_prefix(prefix, {"status": "success", "data": {"resultType": "vector", "result": []}})
+        t.add(
+            prefix + "?query=1",
+            {"status": "success", "data": {"resultType": "scalar", "result": [0, "1"]}},
+        )
+        for promql, samples in series.items():
+            t.add(
+                prefix + "?query=" + urllib.parse.quote(promql, safe=""),
+                {
+                    "status": "success",
+                    "data": {
+                        "resultType": "vector",
+                        "result": [
+                            {"metric": labels, "value": [0, str(v)]}
+                            for labels, v in samples
+                        ],
+                    },
+                },
+            )
+        return t
+
+    def test_power_join(self):
+        labels = {"instance": "10.0.0.5:9100", "chip": "card0", "chip_name": "i915"}
+        t = self._prom(
+            {
+                INTEL_QUERIES["node_map"]: [
+                    ({"instance": "10.0.0.5:9100", "nodename": "arc-node-1"}, 1)
+                ],
+                INTEL_QUERIES["chips"]: [(labels, 1)],
+                INTEL_QUERIES["power"]: [(labels, 21.5)],
+                INTEL_QUERIES["tdp"]: [(labels, 120)],
+            }
+        )
+        snap = fetch_intel_gpu_metrics(t)
+        assert snap is not None and len(snap.chips) == 1
+        chip = snap.chips[0]
+        assert chip.node == "arc-node-1" and chip.chip == "card0"
+        assert chip.power_watts == 21.5 and chip.tdp_watts == 120
+        assert abs(chip.power_fraction - 21.5 / 120) < 1e-9
+
+    def test_chip_without_power_rate_yet(self):
+        # <5m of scrape history: chip discovered, no power sample.
+        labels = {"instance": "10.0.0.5:9100", "chip": "card0", "chip_name": "i915"}
+        t = self._prom({INTEL_QUERIES["chips"]: [(labels, 1)]})
+        snap = fetch_intel_gpu_metrics(t)
+        assert len(snap.chips) == 1
+        assert snap.chips[0].power_watts is None
+
+    def test_no_prometheus(self):
+        assert fetch_intel_gpu_metrics(MockTransport()) is None
+
+    def test_format_watts(self):
+        assert format_watts(21.46) == "21.5 W"
+        assert format_watts(None) == "—"
+
+
+class TestIntelPages:
+    def test_overview_sections(self):
+        el = intel_overview_page(mixed_snapshot(), now=NOW)
+        text = text_content(el)
+        assert "Device Plugins" in text
+        assert "2/2 ready" in text
+        assert "GPU Nodes" in text
+        assert "Total 2" in text
+        assert "Discrete GPU: 2" in text
+        assert "Capacity 3 devices" in text
+
+    def test_overview_not_detected(self):
+        fleet = {"nodes": [fx.make_plain_node("n")], "pods": []}
+        snap = AcceleratorDataContext(fx.fleet_transport(fleet)).sync()
+        el = intel_overview_page(snap, now=NOW)
+        text = text_content(el)
+        assert "Intel GPU Plugin Not Detected" in text
+        assert "helm" in text.lower()
+        assert "CRD not available" in text
+
+    def test_device_plugins_crd_card(self):
+        el = intel_device_plugins_page(mixed_snapshot(), now=NOW)
+        text = text_content(el)
+        assert "GpuDevicePlugin: gpudeviceplugin-sample" in text
+        assert "intel/intel-gpu-plugin:0.30.0" in text
+        assert "Shared devices 1" in text
+        assert "Allocation policy balanced" in text
+
+    def test_nodes_page(self):
+        el = intel_nodes_page(mixed_snapshot(), now=NOW)
+        text = text_content(el)
+        assert "arc-node-1" in text
+        assert "Discrete GPU" in text
+        assert "GPU (i915) 2" in text  # per-resource card row
+
+    def test_nodes_empty(self):
+        fleet = {"nodes": [fx.make_plain_node("n")], "pods": []}
+        snap = AcceleratorDataContext(fx.fleet_transport(fleet)).sync()
+        assert "No Intel GPU nodes found" in text_content(
+            intel_nodes_page(snap, now=NOW)
+        )
+
+    def test_pods_page_pending_attention(self):
+        el = intel_pods_page(mixed_snapshot(), now=NOW)
+        text = text_content(el)
+        assert "All GPU Pods" in text
+        assert "GPU (i915) req=1 lim=1" in text
+        assert "Attention: Pending GPU Pods" in text
+
+    def test_metrics_page_availability_and_power(self):
+        snap = IntelMetricsSnapshot(
+            namespace="monitoring",
+            service="prometheus-k8s:9090",
+            chips=[
+                GpuChipMetrics(node="arc-node-1", chip="card0", power_watts=20.0, tdp_watts=100.0),
+                GpuChipMetrics(node="arc-node-2", chip="card0"),
+            ],
+            fetch_ms=321.0,
+        )
+        el = intel_metrics_page(snap)
+        text = text_content(el)
+        assert "GPU frequency" in text  # honesty matrix
+        assert "AMD-only" in text
+        assert "Total power 20.0 W" in text
+        assert "needs ≥5m of scrape history" in text
+        assert "hl-utilbar" in render_html(el)
+
+    def test_metrics_page_unreachable_lists_services(self):
+        text = text_content(intel_metrics_page(None))
+        assert "Prometheus not reachable" in text
+        assert f"{PROMETHEUS_SERVICES[0][0]}/{PROMETHEUS_SERVICES[0][1]}" in text
+
+    def test_metrics_page_no_i915(self):
+        snap = IntelMetricsSnapshot(namespace="m", service="s")
+        assert "No i915 Metrics" in text_content(intel_metrics_page(snap))
+
+
+class TestIntelIntegrations:
+    def test_node_section_null_contract(self):
+        assert intel_node_detail_section(fx.make_plain_node("n")) is None
+        assert intel_node_detail_section({"jsonData": fx.make_tpu_node("t")}) is None
+
+    def test_node_section_renders(self):
+        snap = mixed_snapshot()
+        node = [n for n in snap.all_nodes if n["metadata"]["name"] == "arc-node-1"][0]
+        el = intel_node_detail_section(node, snap)
+        text = text_content(el)
+        assert "Discrete GPU" in text
+        assert "default/transcode-1 (1 GPUs)" in text
+
+    def test_pod_section(self):
+        assert intel_pod_detail_section(fx.make_tpu_pod("t")) is None
+        el = intel_pod_detail_section(fx.make_intel_pod("p", node="arc-node-1"))
+        text = text_content(el)
+        assert "GPU (i915)" in text
+        assert "request 1 / limit 1" in text
+
+    def test_columns(self):
+        cols = build_node_intel_columns()
+        intel_node = fx.make_intel_node("a", gpus=2)
+        assert [c["getter"](intel_node) for c in cols] == ["Discrete GPU", "2"]
+        assert [c["getter"](fx.make_tpu_node("t")) for c in cols] == ["—", "—"]
+
+
+class TestServerIntelRoutes:
+    def test_all_intel_routes_render_in_demo(self):
+        app = DashboardApp(make_demo_transport("mixed"), min_sync_interval_s=0.0)
+        for path in ("/intel", "/intel/nodes", "/intel/pods", "/intel/deviceplugins"):
+            status, _, body = app.handle(path)
+            assert status == 200, path
+            assert "hl-" in body
+
+    def test_intel_metrics_route_with_demo_power(self):
+        app = DashboardApp(make_demo_transport("mixed"), min_sync_interval_s=0.0)
+        status, _, body = app.handle("/intel/metrics")
+        assert status == 200
+        assert "Power Summary" in body
+        assert "W" in body
