@@ -1,0 +1,93 @@
+"""Text-mode CLI — the dashboard for terminals and headless hosts.
+
+``python -m headlamp_tpu.cli <page>`` renders the same element trees
+the HTTP host serves, through ``ui.vdom.render_text``. One framework,
+three consumers (HTTP, CLI, tests) — the payoff of pages being pure
+functions of snapshots (ADR-001/007).
+
+Pages: overview | nodes | pods | deviceplugins | topology | metrics |
+intel | intel-nodes | intel-pods | intel-deviceplugins | intel-metrics
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from .context.accelerator_context import AcceleratorDataContext
+from .registration import register_plugin
+from .transport.api_proxy import KubeTransport
+from .ui import render_text
+
+#: CLI page name -> route path.
+PAGES = {
+    "overview": "/tpu",
+    "nodes": "/tpu/nodes",
+    "pods": "/tpu/pods",
+    "deviceplugins": "/tpu/deviceplugins",
+    "topology": "/tpu/topology",
+    "metrics": "/tpu/metrics",
+    "intel": "/intel",
+    "intel-nodes": "/intel/nodes",
+    "intel-pods": "/intel/pods",
+    "intel-deviceplugins": "/intel/deviceplugins",
+    "intel-metrics": "/intel/metrics",
+}
+
+
+def render_page(page: str, transport, *, clock=time.time) -> str:
+    """Render one page to text against a transport (exposed for tests)."""
+    registry = register_plugin()
+    route = registry.route_for(PAGES[page])
+    assert route is not None
+    if route.kind == "metrics":
+        from .metrics.client import fetch_tpu_metrics
+
+        metrics = fetch_tpu_metrics(transport, clock=clock)
+        try:
+            from .models.service import compute_forecast
+
+            forecast = compute_forecast(transport, metrics, clock=clock)
+        except ImportError:
+            forecast = None
+        return render_text(route.component(metrics, forecast))
+    if route.kind == "intel-metrics":
+        from .metrics.intel_client import fetch_intel_gpu_metrics
+
+        return render_text(
+            route.component(fetch_intel_gpu_metrics(transport, clock=clock))
+        )
+    ctx = AcceleratorDataContext(transport, clock=clock)
+    snap = ctx.sync()
+    if route.kind == "topology":
+        return render_text(route.component(snap))
+    return render_text(route.component(snap, now=clock()))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="headlamp_tpu.cli")
+    parser.add_argument("page", choices=sorted(PAGES), nargs="?", default="overview")
+    parser.add_argument("--demo", nargs="?", const="v5p32",
+                        choices=["v5e4", "v5p32", "mixed", "large"], default=None)
+    parser.add_argument("--apiserver", default=None)
+    parser.add_argument("--in-cluster", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.demo:
+        from .server.app import make_demo_transport
+
+        transport = make_demo_transport(args.demo)
+    elif args.in_cluster:
+        transport = KubeTransport.in_cluster()
+    elif args.apiserver:
+        transport = KubeTransport(args.apiserver)
+    else:
+        parser.error("choose one of --demo, --apiserver URL, --in-cluster")
+
+    print(render_page(args.page, transport))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

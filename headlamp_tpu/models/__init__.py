@@ -11,6 +11,7 @@ BASELINE metrics page gains on top of parity.
 
 from .forecast import (
     ForecastConfig,
+    fit_and_forecast,
     forecast_next,
     forward,
     init_params,
@@ -23,6 +24,7 @@ from .forecast import (
 
 __all__ = [
     "ForecastConfig",
+    "fit_and_forecast",
     "forecast_next",
     "forward",
     "init_params",

@@ -1,0 +1,135 @@
+"""Pallas TPU kernel: fused forecaster inference.
+
+The forecaster's forward pass is three dense layers
+(`forecast.forward`). Under plain XLA each layer's activation can round
+-trip through HBM between fused regions; at serving scale (thousands of
+chips × frequent refresh) the op is HBM-bandwidth-bound, which makes it
+this framework's honest Pallas target (per
+`/opt/skills/guides/pallas_guide.md`): all three weights fit comfortably
+in VMEM (~90 KB), so one kernel keeps every intermediate on-chip and
+touches HBM exactly twice per row (read x, write y).
+
+Layout notes (guide §Tiling):
+- Batch is tiled in blocks of 128 rows (grid dim 0); window (32) and
+  horizon (8) are zero-padded to the 128-lane width — padded columns
+  multiply zero-padded weight rows, contributing nothing.
+- Matmuls run through the MXU in bf16 with f32 accumulation
+  (``preferred_element_type``), matching the XLA reference path's
+  precision recipe exactly so parity tests can use tight tolerances.
+
+The kernel is inference-only (no custom VJP) — training goes through
+the XLA path, which autodiff already handles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .forecast import ForecastConfig, Params
+
+#: Batch rows per grid step (f32 min sublane tile is 8; 128 keeps the
+#: MXU fed).
+_BLOCK_B = 128
+#: Lane width everything pads to.
+_LANES = 128
+
+
+def _forward_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref, b3_ref, out_ref):
+    """One batch tile: y = sigmoid(gelu(gelu(x@w1+b1)@w2+b2)@w3+b3),
+    entirely in VMEM."""
+
+    def dense(h, w_ref, b_ref):
+        y = jax.lax.dot_general(
+            h.astype(jnp.bfloat16),
+            w_ref[:].astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # Biases arrive padded to a full (8, 128) f32 tile; row 0 is
+        # the real bias, broadcast over the batch tile.
+        return y + b_ref[0:1, :]
+
+    h = jax.nn.gelu(dense(x_ref[:], w1_ref, b1_ref))
+    h = jax.nn.gelu(dense(h, w2_ref, b2_ref))
+    out_ref[:] = jax.nn.sigmoid(dense(h, w3_ref, b3_ref))
+
+
+def _pad2(a: jax.Array, rows: int, cols: int) -> jax.Array:
+    return jnp.pad(a, ((0, rows - a.shape[0]), (0, cols - a.shape[1])))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _padded_forward(x_p, w1_p, b1_p, w2_p, b2_p, w3_p, b3_p, *, interpret: bool):
+    n_blocks = x_p.shape[0] // _BLOCK_B
+    weight_spec = pl.BlockSpec(
+        (_LANES, _LANES), lambda i: (0, 0), memory_space=pltpu.VMEM
+    )
+    bias_spec = pl.BlockSpec((8, _LANES), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    grid_spec = pl.GridSpec(
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec(
+                (_BLOCK_B, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            weight_spec,
+            bias_spec,
+            weight_spec,
+            bias_spec,
+            weight_spec,
+            bias_spec,
+        ],
+        out_specs=pl.BlockSpec(
+            (_BLOCK_B, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+    )
+    return pl.pallas_call(
+        _forward_kernel,
+        out_shape=jax.ShapeDtypeStruct((x_p.shape[0], _LANES), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(x_p, w1_p, b1_p, w2_p, b2_p, w3_p, b3_p)
+
+
+def forecast_forward_pallas(
+    params: Params,
+    x: jax.Array,
+    cfg: ForecastConfig | None = None,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Drop-in replacement for ``forecast.forward`` on the inference
+    path: [batch, window] -> [batch, horizon]. ``interpret`` defaults to
+    True off-TPU (the guide's debugging mode) and False on TPU."""
+    cfg = cfg or ForecastConfig()
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    batch = x.shape[0]
+    window = x.shape[1]
+    hidden = params["w1"].shape[1]
+    horizon = params["w3"].shape[1]
+    if hidden > _LANES or window > _LANES or horizon > _LANES:
+        raise ValueError(
+            f"window={window}, hidden={hidden}, horizon={horizon}: every "
+            f"dimension must fit the single-tile kernel width {_LANES}"
+        )
+
+    batch_p = max(_BLOCK_B, -(-batch // _BLOCK_B) * _BLOCK_B)
+    x_p = _pad2(x.astype(jnp.float32), batch_p, _LANES)
+    w1_p = _pad2(params["w1"].astype(jnp.float32), _LANES, _LANES)
+    w2_p = _pad2(params["w2"].astype(jnp.float32), _LANES, _LANES)
+    w3_p = _pad2(params["w3"].astype(jnp.float32), _LANES, _LANES)
+    b1_p = _pad2(params["b1"].reshape(1, -1).astype(jnp.float32), 8, _LANES)
+    b2_p = _pad2(params["b2"].reshape(1, -1).astype(jnp.float32), 8, _LANES)
+    b3_p = _pad2(params["b3"].reshape(1, -1).astype(jnp.float32), 8, _LANES)
+    del window  # zero-padding makes the contraction width-invariant
+
+    out = _padded_forward(
+        x_p, w1_p, b1_p, w2_p, b2_p, w3_p, b3_p, interpret=bool(interpret)
+    )
+    return out[:batch, :horizon]

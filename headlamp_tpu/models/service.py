@@ -42,6 +42,34 @@ class ForecastView:
 SATURATION_PCT = 90.0
 
 
+def compute_forecast(transport, metrics, *, clock=None) -> ForecastView | None:
+    """Shared metrics-route glue for every host (HTTP server, CLI):
+    fetch history for the snapshot's Prometheus and fit, degrading to
+    None on missing extras, unusable jax backends, or thin history —
+    one definition so consumers cannot drift on what the metrics page
+    shows."""
+    import time as _time
+
+    from ..metrics.client import fetch_utilization_history
+
+    if metrics is None or not metrics.chips:
+        return None
+    try:
+        history = fetch_utilization_history(
+            transport,
+            prometheus=(metrics.namespace, metrics.service),
+            clock=clock or _time.time,
+            preferred_query=metrics.resolved_series.get("tensorcore_utilization"),
+        )
+        if history is None:
+            return None
+        return forecast_from_history(history)
+    except Exception:
+        # Forecast is a progressive enhancement — any failure costs the
+        # section, never the page.
+        return None
+
+
 def forecast_from_history(
     history: UtilizationHistory,
     cfg: ForecastConfig | None = None,

@@ -30,7 +30,7 @@ from typing import Any
 from urllib.parse import parse_qs, urlparse
 
 from ..context.accelerator_context import AcceleratorDataContext
-from ..metrics.client import fetch_tpu_metrics, fetch_utilization_history
+from ..metrics.client import fetch_tpu_metrics
 from ..registration import Registry, register_plugin
 from ..transport.api_proxy import MockTransport, Transport
 from ..ui import render_html
@@ -98,28 +98,16 @@ class DashboardApp:
             return forecast
 
     def _compute_forecast(self, metrics: Any) -> Any:
-        forecast = None
+        # Delegates to the shared host glue (models.service) so the CLI
+        # and HTTP consumers render identical metrics pages. Import is
+        # lazy and guarded: models.service itself imports jax-dependent
+        # modules at call time, but the import alone must not break a
+        # host without the analytics extras.
         try:
-            from ..models.service import forecast_from_history
-
-            history = fetch_utilization_history(
-                self._transport,
-                prometheus=(metrics.namespace, metrics.service),
-                clock=self._clock,
-                preferred_query=metrics.resolved_series.get(
-                    "tensorcore_utilization"
-                ),
-            )
-            if history is not None:
-                forecast = forecast_from_history(history)
-        except Exception:
-            # Broad by design: a missing extra (ImportError), an
-            # unusable jax backend (RuntimeError), or an exotic exporter
-            # payload must cost the forecast section only — never the
-            # metrics page. The negative result is cached too, so a
-            # broken jax install doesn't retry the fit on every view.
-            forecast = None
-        return forecast
+            from ..models.service import compute_forecast
+        except ImportError:
+            return None
+        return compute_forecast(self._transport, metrics, clock=self._clock)
 
     # ------------------------------------------------------------------
     # Request handling (framework-level, server-agnostic)

@@ -95,7 +95,13 @@ def render_text(node: Child) -> str:
             out.append("\t")
         for c in n.children:
             walk(c)
-        if n.tag in _BLOCK_TAGS:
+        if n.tag == "dt":
+            # Name/value pairs: name<TAB>value, one pair per line (the
+            # dd below closes the line via _BLOCK_TAGS).
+            out.append("\t")
+        elif n.tag == "dd":
+            out.append("\n")
+        elif n.tag in _BLOCK_TAGS:
             out.append("\n")
 
     walk(node)

@@ -1,0 +1,40 @@
+"""CLI tests: every page renders to text in demo mode; output carries
+the page's load-bearing facts."""
+
+import pytest
+
+from headlamp_tpu.cli import PAGES, render_page
+from headlamp_tpu.server.app import make_demo_transport
+
+
+class TestCli:
+    @pytest.mark.parametrize("page", sorted(PAGES))
+    def test_every_page_renders_text(self, page):
+        out = render_page(page, make_demo_transport("mixed"))
+        assert isinstance(out, str) and len(out) > 40
+
+    def test_overview_facts(self):
+        out = render_page("overview", make_demo_transport("v5p32"))
+        assert "Chip Allocation" in out
+        assert "Capacity\t16 chips" in out
+
+    def test_metrics_page_includes_forecast(self):
+        # The CLI must render the same metrics page as the HTTP host —
+        # forecast section included.
+        out = render_page("metrics", make_demo_transport("v5p32"))
+        assert "Utilization Forecast" in out
+
+    def test_topology_facts(self):
+        out = render_page("topology", make_demo_transport("v5p32"))
+        assert "Slice: v5p-pool" in out
+        assert "ICI: axis" in out
+
+    def test_intel_metrics_power(self):
+        out = render_page("intel-metrics", make_demo_transport("mixed"))
+        assert "Power Summary" in out
+        assert "W" in out
+
+    def test_table_layout_tab_separated(self):
+        out = render_page("nodes", make_demo_transport("v5e4"))
+        header_lines = [l for l in out.splitlines() if "Name\tReady" in l]
+        assert header_lines, out[:400]

@@ -1,0 +1,49 @@
+"""Pallas kernel tests (interpret mode on the CPU test platform; the
+same kernel compiles bit-exact on a real TPU chip — verified on
+hardware, tunnel dispatch dominates timing there)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from headlamp_tpu.models import ForecastConfig, forward, init_params
+from headlamp_tpu.models.pallas_forward import forecast_forward_pallas
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ForecastConfig()
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    return cfg, params
+
+
+class TestPallasForward:
+    def test_parity_with_xla_forward(self, setup):
+        cfg, params = setup
+        x = jax.random.uniform(jax.random.PRNGKey(4), (200, cfg.window))
+        ref = forward(params, x)
+        pal = forecast_forward_pallas(params, x, cfg, interpret=True)
+        assert pal.shape == (200, cfg.horizon)
+        assert float(jnp.max(jnp.abs(ref - pal))) < 2e-2
+
+    def test_small_batch_padding(self, setup):
+        cfg, params = setup
+        x = jnp.ones((3, cfg.window)) * 0.5
+        pal = forecast_forward_pallas(params, x, cfg, interpret=True)
+        ref = forward(params, x)
+        assert pal.shape == (3, cfg.horizon)
+        assert float(jnp.max(jnp.abs(ref - pal))) < 2e-2
+
+    def test_exact_block_multiple(self, setup):
+        cfg, params = setup
+        x = jax.random.uniform(jax.random.PRNGKey(5), (256, cfg.window))
+        pal = forecast_forward_pallas(params, x, cfg, interpret=True)
+        assert pal.shape == (256, cfg.horizon)
+        assert bool(jnp.all((pal >= 0) & (pal <= 1)))
+
+    def test_oversized_hidden_rejected(self, setup):
+        cfg, _ = setup
+        big = init_params(jax.random.PRNGKey(0), ForecastConfig(hidden=128))
+        big["w1"] = jnp.zeros((cfg.window, 256))
+        with pytest.raises(ValueError):
+            forecast_forward_pallas(big, jnp.ones((4, cfg.window)), interpret=True)

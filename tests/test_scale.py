@@ -1,0 +1,51 @@
+"""Fleet-scale guards: the 1024-node stress fixture (BASELINE config #5)
+must stay correct and inside the performance budget — the regression
+tripwire for the p50-paint metric bench.py reports."""
+
+import time
+
+from headlamp_tpu.context import AcceleratorDataContext
+from headlamp_tpu.fleet import fixtures as fx
+from headlamp_tpu.server import DashboardApp
+from headlamp_tpu.topology.slices import group_slices, summarize_slices
+
+
+class TestThousandNodeFleet:
+    def test_full_paint_under_budget(self):
+        fleet = fx.fleet_large(1024)
+        app = DashboardApp(fx.fleet_transport(fleet), min_sync_interval_s=0.0)
+        app.handle("/tpu")  # warm (first sync + classify)
+        t0 = time.perf_counter()
+        for path in ("/tpu", "/tpu/nodes", "/tpu/topology", "/tpu/pods"):
+            status, _, body = app.handle(path)
+            assert status == 200 and len(body) > 1000
+        elapsed = time.perf_counter() - t0
+        # The BASELINE budget is 2 s for a single scrape→paint; a full
+        # 4-page paint at 4x the headline node count gets the same
+        # envelope with margin (CI machines vary — this is a tripwire
+        # for order-of-magnitude regressions, not a microbenchmark).
+        assert elapsed < 2.0, f"4-page paint took {elapsed:.2f}s at 1024 nodes"
+
+    def test_classification_consistency_at_scale(self):
+        fleet = fx.fleet_large(1024)
+        snap = AcceleratorDataContext(fx.fleet_transport(fleet)).sync()
+        tpu_state = snap.provider("tpu")
+        slices = summarize_slices(group_slices(tpu_state.nodes))
+        # Every TPU node belongs to exactly one slice.
+        assert slices["total"] > 0
+        per_slice_nodes = sum(
+            s.actual_hosts for s in group_slices(tpu_state.nodes)
+        )
+        assert per_slice_nodes == len(tpu_state.nodes)
+        # Allocation math stays self-consistent.
+        alloc = tpu_state.allocation_summary()
+        assert alloc["capacity"] >= alloc["in_use"] >= 0
+        assert alloc["free"] == alloc["allocatable"] - alloc["in_use"]
+
+    def test_topology_page_caps_cards(self):
+        fleet = fx.fleet_large(1024)
+        app = DashboardApp(fx.fleet_transport(fleet), min_sync_interval_s=0.0)
+        _, _, body = app.handle("/tpu/topology")
+        # The cap keeps the DOM bounded (unhealthy-first ordering).
+        assert body.count("hl-slice-card") <= 70
+        assert "Showing 64 of" in body
