@@ -225,11 +225,18 @@ class AcceleratorDataContext:
         any_success = False
         for path in source.plugin_pod_paths:
             if collected and "labelSelector=" not in path:
-                # Namespace-wide fallbacks exist only for installs whose
-                # labels no selector path matches; when a server-filtered
-                # path already found the daemon pods, an unfiltered list
-                # of the whole namespace (thousands of pods at fleet
-                # scale) buys nothing.
+                # Deliberate deviation from the reference, which always
+                # merges all three paths (`IntelGpuDataContext.tsx:
+                # 155-174`): namespace-wide fallbacks exist only for
+                # installs whose labels no selector path matches. The
+                # skip is gated on `collected`, which only holds pods
+                # that passed `plugin_pod_filter` — so a selector path
+                # must have found *confirmed* daemon pods before the
+                # unfiltered whole-namespace list (thousands of pods at
+                # fleet scale) is skipped. Daemon pods in the install
+                # namespace matching neither selector are only missed in
+                # the rare split-label install where other daemon pods
+                # DID match a selector.
                 continue
             try:
                 data = self._transport.request(path, self._timeout_s)

@@ -33,19 +33,24 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping
 
 from ..transport.api_proxy import ApiError, Transport
-from .format import normalize_fraction
 
 # ---------------------------------------------------------------------------
 # Service discovery
 # ---------------------------------------------------------------------------
 
-#: Candidate (namespace, service:port) pairs, probed in order. The first
-#: three mirror the reference's community-standard chain
-#: (`metrics.ts:61-65`); the fourth is Google Managed Prometheus's
-#: in-cluster query frontend.
+#: Candidate (namespace, service:port) pairs, probed in order. The chain
+#: is a superset of the reference's (`metrics.ts:61-65` probes
+#: kube-prometheus-stack-prometheus:9090, prometheus-operated:9090, and
+#: prometheus:9090): it carries all three of those, adds the
+#: prometheus-operator (prometheus-k8s) and Helm-chart
+#: (prometheus-server) service names, and finishes with Google Managed
+#: Prometheus's in-cluster query frontend — GMP is the default metrics
+#: stack on the GKE clusters TPU fleets run on.
 PROMETHEUS_SERVICES: tuple[tuple[str, str], ...] = (
     ("monitoring", "prometheus-k8s:9090"),
+    ("monitoring", "kube-prometheus-stack-prometheus:9090"),
     ("monitoring", "prometheus-operated:9090"),
+    ("monitoring", "prometheus:9090"),
     ("monitoring", "prometheus-server:80"),
     ("gmp-system", "frontend:9090"),
 )
@@ -306,13 +311,22 @@ def fetch_tpu_metrics(
                 resolved[logical] = promql
                 break
         availability[logical] = bool(samples)
+        # Scale is decided ONCE per resolved series, mirroring the
+        # range-query path (see fetch_utilization_history): per-sample
+        # normalization would leave an idle chip's 1.2 (meaning 1.2% on
+        # a 0-100 exporter) unscaled and render it as 120% utilization.
+        scale = 1.0
+        if logical in _FRACTION_METRICS and samples:
+            values = [v for v in map(_sample_value, samples) if v is not None]
+            if values and max(values) > 1.5:
+                scale = 100.0
         for sample in samples:
             labels = _sample_labels(sample)
             value = _sample_value(sample)
             if value is None:
                 continue
             if logical in _FRACTION_METRICS:
-                value = normalize_fraction(value)  # 0-100 exporters -> 0-1
+                value = value / scale
             key = (_node_of(labels, instance_map), _chip_of(labels))
             row = chips.get(key)
             if row is None:

@@ -76,6 +76,32 @@ def waiting_reason(pod: Any) -> str:
     return ""
 
 
+#: Per-node detail-card cap shared by the nodes pages — the same
+#: fleet-scale discipline as the topology page's slice-card cap: at the
+#: 1024-node fixture an uncapped loop renders 1024 cards in one response.
+NODES_DETAIL_CAP = 64
+#: Summary-table row cap. Larger than the card cap (a row is ~10× lighter
+#: than a card) but still bounds the DOM at the 1024-node fixture.
+NODES_TABLE_CAP = 512
+
+
+def cap_nodes_for_cards(
+    nodes: list[Any], cap: int = NODES_DETAIL_CAP, what: str = "node detail cards"
+) -> tuple[list[Any], Element | None]:
+    """Order nodes not-ready-first (the ones an operator opens the page
+    for), then by name, and cap. Returns (shown, truncation-hint) where
+    the hint is None when nothing was dropped."""
+    ordered = sorted(nodes, key=lambda n: (obj.is_node_ready(n), obj.name(n)))
+    if len(ordered) <= cap:
+        return ordered, None
+    hint = h(
+        "p",
+        {"class_": "hl-hint"},
+        f"Showing {cap} of {len(ordered)} {what} (not-ready first).",
+    )
+    return ordered[:cap], hint
+
+
 def plugin_not_detected_box(state: ProviderState) -> Element:
     """Install guidance when no plugin evidence exists
     (`OverviewPage.tsx:171-196` shows the Helm hint for Intel; the TPU

@@ -35,7 +35,9 @@ from ..ui import (
 )
 from ..ui.vdom import Element
 from .common import (
+    NODES_TABLE_CAP,
     age_cell,
+    cap_nodes_for_cards,
     error_banner,
     phase_label,
     pod_namespaced_name,
@@ -310,6 +312,9 @@ def intel_nodes_page(snap: ClusterSnapshot, *, now: float) -> Element:
         )
         return UtilizationBar(in_use, intel.get_node_gpu_allocatable(node), unit="GPUs")
 
+    table_nodes, table_hint = cap_nodes_for_cards(
+        state.nodes, NODES_TABLE_CAP, "node rows"
+    )
     summary = SectionBox(
         "Intel GPU Nodes",
         SimpleTable(
@@ -328,12 +333,14 @@ def intel_nodes_page(snap: ClusterSnapshot, *, now: float) -> Element:
                 },
                 {"label": "Age", "getter": lambda n: age_cell(n, now)},
             ],
-            state.nodes,
+            table_nodes,
         ),
+        table_hint,
     )
 
+    shown, truncation = cap_nodes_for_cards(state.nodes)
     cards = []
-    for node in state.nodes:
+    for node in shown:
         info = obj.node_info(node)
         resources = {
             k: v
@@ -359,7 +366,12 @@ def intel_nodes_page(snap: ClusterSnapshot, *, now: float) -> Element:
             )
         )
     return h(
-        "div", {"class_": "hl-page hl-intel-nodes"}, error_banner(snap), summary, cards
+        "div",
+        {"class_": "hl-page hl-intel-nodes"},
+        error_banner(snap),
+        summary,
+        truncation,
+        cards,
     )
 
 

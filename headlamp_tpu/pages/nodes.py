@@ -23,7 +23,14 @@ from ..ui import (
     h,
 )
 from ..ui.vdom import Element
-from .common import age_cell, error_banner, pods_by_node, ready_label
+from .common import (
+    NODES_TABLE_CAP,
+    age_cell,
+    cap_nodes_for_cards,
+    error_banner,
+    pods_by_node,
+    ready_label,
+)
 
 
 def _node_allocation(node: Any, node_pods: list[Any]) -> tuple[int, int]:
@@ -67,6 +74,11 @@ def nodes_page(
         in_use, allocatable = _node_allocation(node, by_node.get(obj.name(node), []))
         return UtilizationBar(in_use, allocatable, unit="chips")
 
+    # The summary table is capped too (rows are lighter than cards but
+    # 1024 of them still unbounds the response).
+    table_nodes, table_hint = cap_nodes_for_cards(
+        state.nodes, NODES_TABLE_CAP, "node rows"
+    )
     summary = SectionBox(
         "TPU Nodes",
         SimpleTable(
@@ -86,13 +98,16 @@ def nodes_page(
                 },
                 {"label": "Age", "getter": lambda n: age_cell(n, now)},
             ],
-            state.nodes,
+            table_nodes,
         ),
+        table_hint,
     )
 
-    # Per-node detail cards (`NodesPage.tsx:69-139,285-291`).
+    # Per-node detail cards (`NodesPage.tsx:69-139,285-291`), capped
+    # not-ready-first at fleet scale.
+    shown, truncation = cap_nodes_for_cards(state.nodes)
     cards = []
-    for node in state.nodes:
+    for node in shown:
         info = obj.node_info(node)
         worker = tpu.get_node_worker_id(node)
         in_use, allocatable = _node_allocation(node, by_node.get(obj.name(node), []))
@@ -123,5 +138,6 @@ def nodes_page(
         {"class_": "hl-page hl-nodes"},
         error_banner(snap),
         summary,
+        truncation,
         cards,
     )

@@ -58,7 +58,24 @@ class DashboardApp:
         # already-built snapshot stay lock-free).
         self._lock = threading.Lock()
         self._forecast_lock = threading.Lock()
-        self._forecast_cache: tuple[float, Any] | None = None
+        #: (epoch, content key, expiry, value) — keyed on the Prometheus
+        #: target and the chip set so a forecast fitted for fleet A is
+        #: never served for fleet B within the TTL.
+        self._forecast_cache: tuple[int, Any, float, Any] | None = None
+        self._metrics_lock = threading.Lock()
+        self._metrics_cache: tuple[int, float, Any] | None = None
+        #: Bumped by /refresh. Cache entries record the epoch current
+        #: when their fetch *started*; a mismatched epoch invalidates
+        #: them. This lets refresh invalidate without touching
+        #: _metrics_lock/_forecast_lock — both are held across
+        #: multi-second network fetches / jax fits, and the refresh
+        #: redirect must never stall behind those.
+        self._cache_epoch = 0
+        #: Last fully-built snapshot, published atomically (single
+        #: reference assignment) after each sync — /healthz reads this
+        #: without locking, so liveness probes can never stall behind a
+        #: slow cluster sync holding self._lock.
+        self._last_snapshot: Any = None
 
     @property
     def registry(self) -> Registry:
@@ -70,31 +87,70 @@ class DashboardApp:
             if now - self._last_sync >= self._min_sync:
                 self._ctx.sync()
                 self._last_sync = now
-            return self._ctx.snapshot()
+            snap = self._ctx.snapshot()
+            self._last_snapshot = snap
+            return snap
 
     #: Forecast results are cached this long — the history grid only
     #: gains a point per step anyway, and the fit (jax compile + scan)
     #: must not run on every page view.
     FORECAST_TTL_S = 60.0
+    #: Instant metrics fetches are briefly cached too: the Prometheus
+    #: round-trip is cheap but not free, and without a TTL every page
+    #: view pays it while the forecast beside it is served from cache.
+    METRICS_TTL_S = 5.0
+
+    @staticmethod
+    def _metrics_key(metrics: Any) -> Any:
+        """Content key for the forecast cache: the Prometheus target plus
+        the chip set. Chip *identity* (not sample values) is the right
+        granularity — values change every scrape, but a forecast is only
+        wrong-for-the-fleet when the chips themselves change."""
+        return (
+            metrics.namespace,
+            metrics.service,
+            frozenset((c.node, c.accelerator_id) for c in metrics.chips),
+        )
+
+    def _cached_metrics(self) -> Any:
+        """TTL-cached `fetch_tpu_metrics`. A failed fetch (None) is also
+        cached — a down Prometheus must not re-pay the full probe chain
+        on every view within the TTL."""
+        with self._metrics_lock:
+            epoch = self._cache_epoch
+            now = self._clock()
+            if self._metrics_cache is not None:
+                cached_epoch, expiry, cached = self._metrics_cache
+                if cached_epoch == epoch and now < expiry:
+                    return cached
+            metrics = fetch_tpu_metrics(self._transport, clock=self._clock)
+            # Stored under the epoch read BEFORE the fetch: a refresh
+            # arriving mid-fetch bumps the epoch and this entry is born
+            # stale, so the next view refetches.
+            self._metrics_cache = (epoch, now + self.METRICS_TTL_S, metrics)
+            return metrics
 
     def _forecast_for(self, metrics: Any) -> Any:
         """Forecast view for the metrics page, or None. None whenever
         the analytics extras (jax/optax) are absent — the forecast is a
         progressive enhancement, never a hard dependency of the page —
-        or history is too thin to be honest. TTL-cached."""
+        or history is too thin to be honest. TTL-cached, keyed on the
+        metrics content (see `_metrics_key`)."""
         if metrics is None or not metrics.chips:
             return None
+        key = self._metrics_key(metrics)
         # Dedicated lock (not self._lock — the fit can take seconds and
         # must not block unrelated pages): exactly one thread refits per
         # TTL window; concurrent requests wait and reuse its result.
         with self._forecast_lock:
+            epoch = self._cache_epoch
             now = self._clock()
             if self._forecast_cache is not None:
-                expiry, cached = self._forecast_cache
-                if now < expiry:
+                cached_epoch, cached_key, expiry, cached = self._forecast_cache
+                if cached_epoch == epoch and now < expiry and cached_key == key:
                     return cached
             forecast = self._compute_forecast(metrics)
-            self._forecast_cache = (now + self.FORECAST_TTL_S, forecast)
+            self._forecast_cache = (epoch, key, now + self.FORECAST_TTL_S, forecast)
             return forecast
 
     def _compute_forecast(self, metrics: Any) -> Any:
@@ -133,7 +189,17 @@ class DashboardApp:
         route_path = parsed.path.rstrip("/") or "/tpu"
 
         if route_path == "/healthz":
-            snap = self._ctx.snapshot()
+            # Liveness must never block: reads the atomically-published
+            # last snapshot instead of taking self._lock (held across
+            # full cluster syncs — seconds at fleet scale, exactly when
+            # a kubelet probe timing out would restart a healthy pod).
+            # It also must not build a snapshot itself: a concurrent
+            # sync may be mid-mutation (nodes updated, workloads not
+            # yet), and a half-synced snapshot must not get cached.
+            snap = self._last_snapshot
+            if snap is None:
+                body = json.dumps({"ok": True, "loading": True, "errors": []})
+                return 200, "application/json", body
             body = json.dumps(
                 {
                     "ok": True,
@@ -147,6 +213,14 @@ class DashboardApp:
         if route_path == "/refresh":
             with self._lock:
                 self._ctx.refresh()
+            # Manual refresh also invalidates the metrics + forecast
+            # caches — the user is explicitly asking for fresh data, and
+            # serving a cached Prometheus view from before the click
+            # would make the button look broken. Done by bumping the
+            # epoch, NOT by taking the cache locks: those are held
+            # across multi-second fetches/fits, and the redirect must
+            # return immediately.
+            self._cache_epoch += 1
             back = parse_qs(parsed.query).get("back", ["/tpu"])[0]
             # Only registered route paths may be redirect targets: kills
             # open redirects ('//evil', absolute URLs) and header
@@ -162,7 +236,7 @@ class DashboardApp:
         snap = self._synced_snapshot()
         now = self._clock()
         if route.kind == "metrics":
-            metrics = fetch_tpu_metrics(self._transport, clock=self._clock)
+            metrics = self._cached_metrics()
             forecast = self._forecast_for(metrics)
             el = route.component(metrics, forecast)
         elif route.kind == "intel-metrics":

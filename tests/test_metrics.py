@@ -111,6 +111,33 @@ class TestFetchAndJoin:
         snap = fetch_tpu_metrics(t)
         assert snap.chips[0].tensorcore_utilization == 0.875
 
+    def test_scale_decided_once_per_series(self):
+        # Mixed busy/idle samples from a 0-100 exporter: the idle chip's
+        # 1.2 means 1.2%, and must NOT be rendered as 120% utilization.
+        # Scale is decided per resolved series, as in the range path.
+        t = make_prom_transport({
+            "tensorcore_utilization": [
+                ({"node": "n1", "accelerator_id": "0"}, 87.5),
+                ({"node": "n1", "accelerator_id": "1"}, 1.2),
+            ],
+        })
+        snap = fetch_tpu_metrics(t)
+        by_id = {c.accelerator_id: c for c in snap.chips}
+        assert by_id["0"].tensorcore_utilization == 0.875
+        assert by_id["1"].tensorcore_utilization == 0.012
+
+    def test_fraction_scale_untouched_for_0_1_exporters(self):
+        t = make_prom_transport({
+            "tensorcore_utilization": [
+                ({"node": "n1", "accelerator_id": "0"}, 0.95),
+                ({"node": "n1", "accelerator_id": "1"}, 0.01),
+            ],
+        })
+        snap = fetch_tpu_metrics(t)
+        by_id = {c.accelerator_id: c for c in snap.chips}
+        assert by_id["0"].tensorcore_utilization == 0.95
+        assert by_id["1"].tensorcore_utilization == 0.01
+
     def test_instance_mapped_to_nodename(self):
         # Samples carrying only `instance` join through node_uname_info
         # exactly like the reference's i915 power join.

@@ -49,3 +49,30 @@ class TestThousandNodeFleet:
         # The cap keeps the DOM bounded (unhealthy-first ordering).
         assert body.count("hl-slice-card") <= 70
         assert "Showing 64 of" in body
+
+    def test_nodes_page_caps_detail_cards(self):
+        fleet = fx.fleet_large(1024)
+        app = DashboardApp(fx.fleet_transport(fleet), min_sync_interval_s=0.0)
+        _, _, body = app.handle("/tpu/nodes")
+        # Same fleet-scale discipline as the topology page: detail cards
+        # are capped not-ready-first with an honest truncation hint.
+        assert body.count("hl-node-card") <= 64
+        assert "Showing 64 of" in body
+        # The summary table is bounded too — the card cap alone would
+        # leave the response O(fleet).
+        assert "Showing 512 of" in body
+
+    def test_nodes_page_cap_prioritizes_not_ready(self):
+        fleet = fx.fleet_large(1024)
+        app = DashboardApp(fx.fleet_transport(fleet), min_sync_interval_s=0.0)
+        snap = app._synced_snapshot()
+        from headlamp_tpu.domain import objects as obj
+
+        not_ready = [
+            obj.name(n)
+            for n in snap.provider("tpu").nodes
+            if not obj.is_node_ready(n)
+        ]
+        if not_ready:
+            _, _, body = app.handle("/tpu/nodes")
+            assert not_ready[0] in body

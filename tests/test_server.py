@@ -79,6 +79,63 @@ class TestHandle:
         assert t.calls.count("/api/v1/nodes") == first + 1
 
 
+class TestCaching:
+    def _probe_count(self, transport):
+        return sum(1 for c in transport.calls if "query?query=1" in c)
+
+    def test_metrics_ttl_cache(self):
+        clock = [100.0]
+        app = DashboardApp(
+            make_demo_transport("v5e4"),
+            min_sync_interval_s=0.0,
+            clock=lambda: clock[0],
+        )
+        app.handle("/tpu/metrics")
+        probes = self._probe_count(app._transport)
+        app.handle("/tpu/metrics")  # within TTL: served from cache
+        assert self._probe_count(app._transport) == probes
+        clock[0] += app.METRICS_TTL_S + 1
+        app.handle("/tpu/metrics")
+        assert self._probe_count(app._transport) == probes + 1
+
+    def test_refresh_invalidates_metrics_cache(self):
+        clock = [100.0]
+        app = DashboardApp(
+            make_demo_transport("v5e4"),
+            min_sync_interval_s=0.0,
+            clock=lambda: clock[0],
+        )
+        app.handle("/tpu/metrics")
+        probes = self._probe_count(app._transport)
+        app.handle("/refresh?back=/tpu/metrics")
+        app.handle("/tpu/metrics")  # same clock, but refresh invalidated
+        assert self._probe_count(app._transport) == probes + 1
+
+    def test_forecast_cache_keyed_on_fleet_content(self):
+        from types import SimpleNamespace
+
+        app = make_app("v5e4")
+        fits = []
+        app._compute_forecast = lambda m: (fits.append(1), "forecast")[1]
+
+        def metrics(chips):
+            return SimpleNamespace(
+                namespace="monitoring",
+                service="prometheus-k8s:9090",
+                chips=[
+                    SimpleNamespace(node=n, accelerator_id=a) for n, a in chips
+                ],
+            )
+
+        m1 = metrics([("n1", "0"), ("n1", "1")])
+        assert app._forecast_for(m1) == "forecast" and len(fits) == 1
+        # Same fleet within TTL: cache hit.
+        assert app._forecast_for(m1) == "forecast" and len(fits) == 1
+        # Different chip set: stale forecast must NOT be served.
+        m2 = metrics([("n2", "0")])
+        assert app._forecast_for(m2) == "forecast" and len(fits) == 2
+
+
 class TestSocketRoundTrip:
     def test_serve_real_http(self):
         app = make_app("mixed")
