@@ -6,7 +6,8 @@ three consumers (HTTP, CLI, tests) — the payoff of pages being pure
 functions of snapshots (ADR-001/007).
 
 Pages: overview | nodes | pods | deviceplugins | topology | metrics |
-intel | intel-nodes | intel-pods | intel-deviceplugins | intel-metrics
+intel | intel-nodes | intel-pods | intel-deviceplugins | intel-metrics |
+cluster-nodes
 """
 
 from __future__ import annotations
@@ -33,6 +34,7 @@ PAGES = {
     "intel-pods": "/intel/pods",
     "intel-deviceplugins": "/intel/deviceplugins",
     "intel-metrics": "/intel/metrics",
+    "cluster-nodes": "/nodes",
 }
 
 
@@ -62,6 +64,8 @@ def render_page(page: str, transport, *, clock=time.time) -> str:
     snap = ctx.sync()
     if route.kind == "topology":
         return render_text(route.component(snap))
+    if route.kind == "native-nodes":
+        return render_text(route.component(snap, now=clock(), registry=registry))
     return render_text(route.component(snap, now=clock()))
 
 

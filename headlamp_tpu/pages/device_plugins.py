@@ -25,7 +25,8 @@ from ..ui import (
     h,
 )
 from ..ui.vdom import Element
-from .common import age_cell, error_banner, phase_label, pod_namespaced_name
+from .common import age_cell, error_banner, phase_label
+from .native import pod_link
 
 
 def _ds_node_selector(ds: Any) -> str:
@@ -123,7 +124,7 @@ def device_plugins_page(
             "Plugin Pods",
             SimpleTable(
                 [
-                    {"label": "Pod", "getter": pod_namespaced_name},
+                    {"label": "Pod", "getter": pod_link},
                     {"label": "Node", "getter": lambda p: obj.pod_node_name(p) or "—"},
                     {"label": "Phase", "getter": phase_label},
                     {"label": "Restarts", "getter": obj.pod_restarts},

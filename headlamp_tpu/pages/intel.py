@@ -34,13 +34,13 @@ from ..ui import (
     h,
 )
 from ..ui.vdom import Element
+from .native import node_link, pod_link
 from .common import (
     NODES_TABLE_CAP,
     age_cell,
     cap_nodes_for_cards,
     error_banner,
     phase_label,
-    pod_namespaced_name,
     pods_by_node,
     ready_label,
     waiting_reason,
@@ -120,7 +120,7 @@ def intel_overview_page(snap: ClusterSnapshot, *, now: float) -> Element:
                 "Plugin Pods",
                 SimpleTable(
                     [
-                        {"label": "Pod", "getter": pod_namespaced_name},
+                        {"label": "Pod", "getter": pod_link},
                         {"label": "Node", "getter": lambda p: obj.pod_node_name(p) or "—"},
                         {"label": "Phase", "getter": phase_label},
                         {"label": "Restarts", "getter": obj.pod_restarts},
@@ -184,7 +184,7 @@ def intel_overview_page(snap: ClusterSnapshot, *, now: float) -> Element:
             f"Active GPU Pods (top {_ACTIVE_CAP})",
             SimpleTable(
                 [
-                    {"label": "Pod", "getter": pod_namespaced_name},
+                    {"label": "Pod", "getter": pod_link},
                     {"label": "Node", "getter": lambda p: obj.pod_node_name(p) or "—"},
                     {
                         "label": "GPUs",
@@ -265,7 +265,7 @@ def intel_device_plugins_page(snap: ClusterSnapshot, *, now: float) -> Element:
             "Plugin Pods",
             SimpleTable(
                 [
-                    {"label": "Pod", "getter": pod_namespaced_name},
+                    {"label": "Pod", "getter": pod_link},
                     {"label": "Node", "getter": lambda p: obj.pod_node_name(p) or "—"},
                     {"label": "Phase", "getter": phase_label},
                     {"label": "Restarts", "getter": obj.pod_restarts},
@@ -319,7 +319,7 @@ def intel_nodes_page(snap: ClusterSnapshot, *, now: float) -> Element:
         "Intel GPU Nodes",
         SimpleTable(
             [
-                {"label": "Name", "getter": obj.name},
+                {"label": "Name", "getter": node_link},
                 {"label": "Ready", "getter": lambda n: ready_label(obj.is_node_ready(n))},
                 {
                     "label": "Type",
@@ -421,7 +421,7 @@ def intel_pods_page(snap: ClusterSnapshot, *, now: float) -> Element:
         "All GPU Pods",
         SimpleTable(
             [
-                {"label": "Pod", "getter": pod_namespaced_name},
+                {"label": "Pod", "getter": pod_link},
                 {"label": "Phase", "getter": phase_label},
                 {"label": "Node", "getter": lambda p: obj.pod_node_name(p) or "—"},
                 {"label": "Containers", "getter": container_list},
@@ -438,7 +438,7 @@ def intel_pods_page(snap: ClusterSnapshot, *, now: float) -> Element:
             "Attention: Pending GPU Pods",
             SimpleTable(
                 [
-                    {"label": "Pod", "getter": pod_namespaced_name},
+                    {"label": "Pod", "getter": pod_link},
                     {
                         "label": "GPUs requested",
                         "getter": intel.get_pod_device_request,

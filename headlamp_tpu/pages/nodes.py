@@ -23,6 +23,7 @@ from ..ui import (
     h,
 )
 from ..ui.vdom import Element
+from .native import node_link
 from .common import (
     NODES_TABLE_CAP,
     age_cell,
@@ -83,7 +84,7 @@ def nodes_page(
         "TPU Nodes",
         SimpleTable(
             [
-                {"label": "Name", "getter": obj.name},
+                {"label": "Name", "getter": node_link},
                 {"label": "Ready", "getter": lambda n: ready_label(obj.is_node_ready(n))},
                 {
                     "label": "Generation",

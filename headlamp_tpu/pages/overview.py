@@ -33,8 +33,8 @@ from .common import (
     error_banner,
     phase_label,
     plugin_not_detected_box,
-    pod_namespaced_name,
 )
+from .native import pod_link
 
 #: Running-pods table cap (`OverviewPage.tsx:414` caps at 10).
 ACTIVE_PODS_CAP = 10
@@ -98,7 +98,7 @@ def overview_page(
                 "Plugin Pods",
                 SimpleTable(
                     [
-                        {"label": "Pod", "getter": pod_namespaced_name},
+                        {"label": "Pod", "getter": pod_link},
                         {"label": "Node", "getter": lambda p: obj.pod_node_name(p) or "—"},
                         {"label": "Phase", "getter": phase_label},
                         {"label": "Restarts", "getter": obj.pod_restarts},
@@ -184,7 +184,7 @@ def overview_page(
             f"Active TPU Pods (top {ACTIVE_PODS_CAP})",
             SimpleTable(
                 [
-                    {"label": "Pod", "getter": pod_namespaced_name},
+                    {"label": "Pod", "getter": pod_link},
                     {"label": "Node", "getter": lambda p: obj.pod_node_name(p) or "—"},
                     {
                         "label": "Chips",

@@ -23,7 +23,8 @@ from ..ui import (
     h,
 )
 from ..ui.vdom import Element
-from .common import age_cell, error_banner, phase_label, pod_namespaced_name, waiting_reason
+from .common import age_cell, error_banner, phase_label, waiting_reason
+from .native import pod_link
 
 
 def container_chip_list(pod: Any) -> Element:
@@ -85,7 +86,7 @@ def pods_page(
         "All TPU Pods",
         SimpleTable(
             [
-                {"label": "Pod", "getter": pod_namespaced_name},
+                {"label": "Pod", "getter": pod_link},
                 {"label": "Phase", "getter": phase_label},
                 {"label": "Node", "getter": lambda p: obj.pod_node_name(p) or "—"},
                 {"label": "Containers", "getter": container_chip_list},
@@ -108,7 +109,7 @@ def pods_page(
             "Attention: Pending TPU Pods",
             SimpleTable(
                 [
-                    {"label": "Pod", "getter": pod_namespaced_name},
+                    {"label": "Pod", "getter": pod_link},
                     {
                         "label": "Chips requested",
                         "getter": lambda p: tpu.format_chip_count(

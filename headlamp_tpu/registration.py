@@ -31,6 +31,7 @@ from .pages import (
     pods_page,
     topology_page,
 )
+from .pages.native import native_nodes_page
 from .pages.intel import (
     intel_device_plugins_page,
     intel_metrics_page,
@@ -139,6 +140,16 @@ def register_plugin(registry: Registry | None = None) -> Registry:
     ]
     reg.sidebar_entries.extend(intel_entries)
 
+    # The host's own native surface — the nodes table the column
+    # processors extend (`index.tsx:177-182` targets Headlamp's
+    # 'headlamp-nodes'; here the framework hosts that table itself).
+    reg.sidebar_entries.extend(
+        [
+            SidebarEntry("cluster", "Cluster", "/nodes", parent=None),
+            SidebarEntry("cluster-nodes", "Nodes", "/nodes", parent="cluster"),
+        ]
+    )
+
     reg.routes.extend(
         [
             Route("/tpu", "tpu-overview", overview_page),
@@ -161,6 +172,7 @@ def register_plugin(registry: Registry | None = None) -> Registry:
                 intel_metrics_page,
                 kind="intel-metrics",
             ),
+            Route("/nodes", "cluster-nodes", native_nodes_page, kind="native-nodes"),
         ]
     )
 

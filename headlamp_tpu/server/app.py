@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import html
 import json
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -31,10 +32,18 @@ from urllib.parse import parse_qs, urlparse
 
 from ..context.accelerator_context import AcceleratorDataContext
 from ..metrics.client import fetch_tpu_metrics
+from ..pages.native import native_node_page, native_nodes_page, native_pod_page
 from ..registration import Registry, register_plugin
 from ..transport.api_proxy import MockTransport, Transport
 from ..ui import render_html
 from .style import STYLESHEET
+
+#: Dynamic native-detail paths: /node/<name> and /pod/<ns>/<name>.
+#: Kubernetes object names are DNS-1123 (lowercase alphanumerics, '-',
+#: '.'), so the patterns are strict — anything else 404s rather than
+#: reaching a renderer with attacker-shaped input.
+_NODE_DETAIL_RE = re.compile(r"^/node/([a-z0-9.-]{1,253})$")
+_POD_DETAIL_RE = re.compile(r"^/pod/([a-z0-9.-]{1,253})/([a-z0-9.-]{1,253})$")
 
 
 class DashboardApp:
@@ -222,12 +231,42 @@ class DashboardApp:
             # return immediately.
             self._cache_epoch += 1
             back = parse_qs(parsed.query).get("back", ["/tpu"])[0]
-            # Only registered route paths may be redirect targets: kills
-            # open redirects ('//evil', absolute URLs) and header
-            # injection (CR/LF) in one allowlist check.
-            if self._registry.route_for(back) is None:
+            # Only registered route paths and strictly-shaped native
+            # detail paths may be redirect targets: kills open redirects
+            # ('//evil', absolute URLs) and header injection (CR/LF) in
+            # one allowlist check.
+            if self._registry.route_for(back) is None and not (
+                _NODE_DETAIL_RE.match(back) or _POD_DETAIL_RE.match(back)
+            ):
                 back = "/tpu"
             return 302, back, ""
+
+        # Native host surface: the views the detail sections and column
+        # processors inject into (`index.tsx:152-182`).
+        node_match = _NODE_DETAIL_RE.match(route_path)
+        if node_match:
+            snap = self._synced_snapshot()
+            el = native_node_page(
+                snap, node_match.group(1), now=self._clock(), registry=self._registry
+            )
+            status = 404 if el.props.get("data-notfound") else 200
+            return status, "text/html", self._page_html(
+                f"Node {node_match.group(1)}", render_html(el)
+            )
+        pod_match = _POD_DETAIL_RE.match(route_path)
+        if pod_match:
+            snap = self._synced_snapshot()
+            el = native_pod_page(
+                snap,
+                pod_match.group(1),
+                pod_match.group(2),
+                now=self._clock(),
+                registry=self._registry,
+            )
+            status = 404 if el.props.get("data-notfound") else 200
+            return status, "text/html", self._page_html(
+                f"Pod {pod_match.group(2)}", render_html(el)
+            )
 
         route = self._registry.route_for(route_path)
         if route is None:
@@ -247,6 +286,8 @@ class DashboardApp:
             )
         elif route.kind == "topology":
             el = route.component(snap)
+        elif route.kind == "native-nodes":
+            el = route.component(snap, now=now, registry=self._registry)
         else:
             el = route.component(snap, now=now)
         return 200, "text/html", self._page_html(route.name, render_html(el), route_path)

@@ -109,8 +109,9 @@ class TestNodeColumns:
 class TestRegistration:
     def test_full_surface_registered(self):
         reg = register_plugin()
-        # TPU: root + 6 children; Intel: root + 5 children.
-        assert len(reg.sidebar_entries) == 13
+        # TPU: root + 6 children; Intel: root + 5 children; native
+        # Cluster surface: root + 1 child.
+        assert len(reg.sidebar_entries) == 15
         tpu_paths = {
             "/tpu", "/tpu/nodes", "/tpu/pods", "/tpu/deviceplugins",
             "/tpu/topology", "/tpu/metrics",
@@ -119,7 +120,8 @@ class TestRegistration:
             "/intel", "/intel/nodes", "/intel/pods", "/intel/deviceplugins",
             "/intel/metrics",
         }
-        assert {r.path for r in reg.routes} == tpu_paths | intel_paths
+        native_paths = {"/nodes"}
+        assert {r.path for r in reg.routes} == tpu_paths | intel_paths | native_paths
         # Both providers inject into Node and Pod detail views.
         assert sorted(s.resource_kind for s in reg.detail_sections) == [
             "Node", "Node", "Pod", "Pod",

@@ -79,6 +79,83 @@ class TestHandle:
         assert t.calls.count("/api/v1/nodes") == first + 1
 
 
+class TestNativeViews:
+    """The host surface for the integrations (`index.tsx:152-182`):
+    detail routes render registered sections, the native nodes table
+    applies both providers' column processors."""
+
+    def test_native_nodes_table_applies_column_processors(self):
+        _, _, body = make_app("mixed").handle("/nodes")
+        # Base columns + TPU processor + Intel processor.
+        for label in ("TPU Type", "TPU Chips", "TPU Topology", "GPU Type", "GPU Devices"):
+            assert label in body, label
+        # Non-matching rows show the em-dash fallback.
+        assert "—" in body
+
+    def test_native_nodes_table_links_to_detail(self):
+        _, _, body = make_app("mixed").handle("/nodes")
+        assert 'href="/node/gke-v5e16-pool-w0"' in body
+        assert 'href="/node/arc-node-1"' in body
+
+    def test_node_detail_injects_tpu_section(self):
+        status, _, body = make_app("v5p32").handle("/node/gke-v5p-pool-w0")
+        assert status == 200
+        # Native facts plus the injected TPU section with slice context.
+        assert "Kubelet" in body
+        assert "hl-node-detail" in body
+        assert "Worker index" in body
+
+    def test_node_detail_injects_intel_section_on_gpu_node(self):
+        status, _, body = make_app("mixed").handle("/node/arc-node-1")
+        assert status == 200
+        assert "Intel GPU" in body
+        assert "hl-node-detail" in body
+
+    def test_node_detail_null_renders_sections_for_plain_node(self):
+        status, _, body = make_app("v5p32").handle("/node/gke-default-pool-e5f6")
+        assert status == 200
+        assert "Kubelet" in body  # native facts render
+        assert "hl-node-detail" not in body  # no section injected
+
+    def test_node_detail_404(self):
+        status, _, body = make_app("v5p32").handle("/node/nope")
+        assert status == 404
+        assert "Node not found" in body
+
+    def test_pod_detail_injects_tpu_section(self):
+        status, _, body = make_app("v5p32").handle("/pod/ml/megatrain-0")
+        assert status == 200
+        assert "hl-pod-detail" in body
+        assert "google.com/tpu" in body
+
+    def test_pod_detail_null_renders_for_non_accelerator_pod(self):
+        app = make_app("mixed")
+        status, _, body = app.handle("/pod/kube-system/tpu-device-plugin-a")
+        assert status == 200
+        # The daemon pod requests no TPU/GPU: native facts only.
+        assert "hl-pod-detail" not in body
+
+    def test_pod_detail_404(self):
+        status, _, _ = make_app("v5p32").handle("/pod/ml/nope")
+        assert status == 404
+
+    def test_malformed_detail_paths_rejected(self):
+        app = make_app("v5p32")
+        for path in ("/node/../etc", "/node/UPPER", "/pod/onlyns", "/node/"):
+            status, _, _ = app.handle(path)
+            assert status == 404, path
+
+    def test_refresh_back_allows_native_detail(self):
+        status, location, _ = make_app("v5p32").handle(
+            "/refresh?back=/node/gke-v5p-pool-w0"
+        )
+        assert status == 302 and location == "/node/gke-v5p-pool-w0"
+
+    def test_tpu_nodes_page_links_to_native_detail(self):
+        _, _, body = make_app("v5p32").handle("/tpu/nodes")
+        assert 'href="/node/gke-v5p-pool-w0"' in body
+
+
 class TestCaching:
     def _probe_count(self, transport):
         return sum(1 for c in transport.calls if "query?query=1" in c)
