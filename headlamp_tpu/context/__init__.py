@@ -13,6 +13,7 @@ from .accelerator_context import (
 )
 from .sources import (
     INTEL_SOURCE,
+    ACTIVE_PODS_FIELD_SELECTOR,
     NODES_PATH,
     PODS_PATH,
     TPU_SOURCE,
@@ -27,6 +28,7 @@ __all__ = [
     "ProviderSource",
     "INTEL_SOURCE",
     "TPU_SOURCE",
+    "ACTIVE_PODS_FIELD_SELECTOR",
     "NODES_PATH",
     "PODS_PATH",
     "default_sources",

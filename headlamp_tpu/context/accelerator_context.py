@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import time
+import urllib.parse
 from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping
 
@@ -111,6 +112,15 @@ class AcceleratorDataContext:
     `IntelGpuDataContext.test.tsx:7-15`).
     """
 
+    #: Reactive-track page size. 500 keeps each page's JSON well under
+    #: what a 2 s per-request timeout can move even on a slow apiserver;
+    #: a 10k-pod fleet costs 20 requests, each individually timed out.
+    PAGE_LIMIT = 500
+    #: Runaway-loop backstop for a server that keeps returning continue
+    #: tokens (200 pages × 500 = 100k objects — far beyond any fleet
+    #: this dashboard targets).
+    MAX_PAGES = 200
+
     def __init__(
         self,
         transport: Transport,
@@ -119,12 +129,19 @@ class AcceleratorDataContext:
         sources: Mapping[str, ProviderSource] | None = None,
         timeout_s: float = DEFAULT_TIMEOUT_S,
         clock: Callable[[], float] = time.time,
+        page_limit: int | None = None,
+        pod_field_selector: str | None = None,
     ):
         self._transport = transport
         self._providers = providers
         self._sources = dict(sources if sources is not None else default_sources())
         self._timeout_s = timeout_s
         self._clock = clock
+        self._page_limit = page_limit if page_limit is not None else self.PAGE_LIMIT
+        #: Optional server-side pod filter (e.g. ACTIVE_PODS_FIELD_SELECTOR
+        #: drops Succeeded/Failed pods) — a fleet-scale option the
+        #: reference's all-namespace useList has no analogue for.
+        self._pod_field_selector = pod_field_selector
 
         self._all_nodes: list[Any] | None = None
         self._all_pods: list[Any] | None = None
@@ -141,16 +158,50 @@ class AcceleratorDataContext:
     # Track 1: reactive lists
     # ------------------------------------------------------------------
 
+    def _list_paginated(self, path: str) -> list[Any]:
+        """Full list via ``limit=N&continue=<token>`` chunks — the
+        fleet-scale replacement for the reference's single unpaginated
+        ``useList`` GET (`IntelGpuDataContext.tsx:98-99`): on a 1 000+
+        node cluster one monolithic list is tens of MB and cannot finish
+        inside the per-request timeout, while every 500-object page can.
+        Each page request gets the full ``timeout_s``. An expired
+        continue token (apiserver answers 410 Gone) or any mid-chain
+        failure raises; the caller keeps the previous good list."""
+        items: list[Any] = []
+        continue_token = ""
+        sep = "&" if "?" in path else "?"
+        for _ in range(self.MAX_PAGES):
+            url = f"{path}{sep}limit={self._page_limit}"
+            if continue_token:
+                url += "&continue=" + urllib.parse.quote(continue_token, safe="")
+            data = self._transport.request(url, self._timeout_s)
+            items.extend(obj.kube_list_items(data))
+            continue_token = ""
+            if isinstance(data, Mapping):
+                metadata = data.get("metadata")
+                if isinstance(metadata, Mapping):
+                    continue_token = str(metadata.get("continue") or "")
+            if not continue_token:
+                return items
+        raise ApiError(path, f"list did not terminate within {self.MAX_PAGES} pages")
+
+    def _pods_path(self) -> str:
+        if self._pod_field_selector:
+            return (
+                PODS_PATH
+                + "?fieldSelector="
+                + urllib.parse.quote(self._pod_field_selector, safe="")
+            )
+        return PODS_PATH
+
     def _sync_reactive(self) -> None:
         try:
-            data = self._transport.request(NODES_PATH, self._timeout_s)
-            self._all_nodes = obj.kube_list_items(data)
+            self._all_nodes = self._list_paginated(NODES_PATH)
             self._node_error = None
         except ApiError as e:
             self._node_error = f"nodes: {e}"
         try:
-            data = self._transport.request(PODS_PATH, self._timeout_s)
-            self._all_pods = obj.kube_list_items(data)
+            self._all_pods = self._list_paginated(self._pods_path())
             self._pod_error = None
         except ApiError as e:
             self._pod_error = f"pods: {e}"

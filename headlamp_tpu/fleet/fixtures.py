@@ -331,8 +331,11 @@ def fleet_transport(fleet: dict[str, Any]):
     from ..transport.api_proxy import MockTransport
 
     t = MockTransport()
-    t.add("/api/v1/nodes", {"kind": "List", "items": fleet["nodes"]})
-    t.add("/api/v1/pods", {"kind": "List", "items": fleet["pods"]})
+    # add_list serves limit/continue pagination like the apiserver — the
+    # context always pages its reactive lists, so the fixture transport
+    # must speak the same protocol.
+    t.add_list("/api/v1/nodes", fleet["nodes"])
+    t.add_list("/api/v1/pods", fleet["pods"])
     t.add(
         "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin",
         {"kind": "List", "items": fleet.get("daemonsets", [])},

@@ -24,6 +24,16 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--in-cluster", action="store_true")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8631)
+    parser.add_argument(
+        "--background-sync", type=float, metavar="SECONDS", default=None,
+        help="sync the cluster state every N seconds off the request "
+        "path (page views stop paying for syncs)",
+    )
+    parser.add_argument(
+        "--active-pods-only", action="store_true",
+        help="server-side fieldSelector dropping Succeeded/Failed pods "
+        "from the reactive list (batch-heavy fleets)",
+    )
     args = parser.parse_args(argv)
 
     if args.demo:
@@ -38,7 +48,16 @@ def main(argv: list[str] | None = None) -> None:
     else:
         parser.error("choose one of --demo, --apiserver URL, --in-cluster")
 
-    app = DashboardApp(transport)
+    from ..context.sources import ACTIVE_PODS_FIELD_SELECTOR
+
+    app = DashboardApp(
+        transport,
+        pod_field_selector=(
+            ACTIVE_PODS_FIELD_SELECTOR if args.active_pods_only else None
+        ),
+    )
+    if args.background_sync:
+        app.start_background_sync(args.background_sync)
     server = app.serve(args.host, args.port)
     print(f"TPU dashboard on http://{args.host}:{args.port}/tpu ({mode})")
     try:

@@ -54,8 +54,11 @@ class DashboardApp:
         registry: Registry | None = None,
         min_sync_interval_s: float = 5.0,
         clock: Any = time.time,
+        pod_field_selector: str | None = None,
     ):
-        self._ctx = AcceleratorDataContext(transport)
+        self._ctx = AcceleratorDataContext(
+            transport, pod_field_selector=pod_field_selector
+        )
         self._transport = transport
         self._registry = registry if registry is not None else register_plugin()
         self._min_sync = min_sync_interval_s
@@ -89,6 +92,30 @@ class DashboardApp:
     @property
     def registry(self) -> Registry:
         return self._registry
+
+    def start_background_sync(self, interval_s: float | None = None) -> threading.Event:
+        """Periodic cluster sync off the request path — the closest
+        server-side analogue of the reference's live list+watch
+        (`IntelGpuDataContext.tsx:98-99`): page views read the freshest
+        completed sync instead of paying for one inline. Returns a stop
+        Event (the thread is a daemon either way). Sync failures are
+        absorbed — the next tick retries, and the request path's own
+        coalesced sync still works."""
+        stop = threading.Event()
+        interval = interval_s if interval_s is not None else max(self._min_sync, 1.0)
+
+        def loop() -> None:
+            while not stop.wait(interval):
+                try:
+                    with self._lock:
+                        self._ctx.sync()
+                        self._last_sync = self._clock()
+                        self._last_snapshot = self._ctx.snapshot()
+                except Exception:  # noqa: BLE001 — keep the heartbeat alive
+                    pass
+
+        threading.Thread(target=loop, daemon=True, name="hl-tpu-sync").start()
+        return stop
 
     def _synced_snapshot(self):
         with self._lock:

@@ -161,9 +161,16 @@ class MockTransport:
     status 404, matching an apiserver's behaviour for absent CRDs.
     """
 
+    #: Query parameters a paginated list request may carry and still be
+    #: served by an :meth:`add_list` route (anything else — e.g. a
+    #: labelSelector — must be routed explicitly).
+    _LIST_PARAMS = frozenset({"limit", "continue", "fieldSelector", "resourceVersion"})
+
     def __init__(self, routes: Mapping[str, Any] | None = None):
         self.routes: dict[str, Any] = dict(routes or {})
         self._prefix_routes: list[tuple[str, Any]] = []
+        self._list_routes: dict[str, Any] = {}
+        self._overrides: list[tuple[str, Any]] = []
         self.calls: list[str] = []
 
     def add(self, path: str, response: Any) -> None:
@@ -172,10 +179,74 @@ class MockTransport:
     def add_prefix(self, prefix: str, response: Any) -> None:
         self._prefix_routes.append((prefix, response))
 
+    def add_override(self, prefix: str, response: Any) -> None:
+        """Route checked before everything else (last registered wins) —
+        the test hook for 'break this endpoint regardless of pagination'.
+        A query-less prefix matches the endpoint itself and its
+        limit/continue/fieldSelector forms, but NOT selector sub-queries
+        (``?labelSelector=``) — those are distinct fallback paths with
+        their own routes; break them with an explicit ``?labelSelector``
+        prefix."""
+        self._overrides.append((prefix, response))
+
+    def _override_matches(self, path: str, prefix: str) -> bool:
+        import urllib.parse
+
+        if "?" in prefix:
+            return path.startswith(prefix)
+        parsed = urllib.parse.urlparse(path)
+        if not parsed.path.startswith(prefix):
+            return False
+        params = set(urllib.parse.parse_qs(parsed.query))
+        return not (params - self._LIST_PARAMS)
+
+    def add_list(self, path: str, items: list[Any]) -> None:
+        """Serve a Kubernetes list at ``path`` honoring ``limit=`` /
+        ``continue=`` pagination the way the apiserver does (continue
+        tokens are opaque to clients; here they are plain offsets).
+        Requests with no ``limit`` get the whole list. fieldSelector /
+        resourceVersion params are accepted and ignored (the mock does
+        not filter); a labelSelector query does NOT match — selector
+        routes stay explicit."""
+        import urllib.parse
+
+        def respond(req_path: str) -> Any:
+            query = urllib.parse.parse_qs(urllib.parse.urlparse(req_path).query)
+            limit = int(query.get("limit", ["0"])[0] or 0)
+            if not limit:
+                return {"kind": "List", "items": list(items)}
+            offset = int(query.get("continue", ["0"])[0] or 0)
+            page = items[offset : offset + limit]
+            next_offset = offset + limit
+            metadata = (
+                {"continue": str(next_offset)} if next_offset < len(items) else {}
+            )
+            return {"kind": "List", "metadata": metadata, "items": page}
+
+        self._list_routes[path] = respond
+
+    def _match_list_route(self, path: str) -> Any | None:
+        import urllib.parse
+
+        parsed = urllib.parse.urlparse(path)
+        respond = self._list_routes.get(parsed.path)
+        if respond is None:
+            return None
+        params = set(urllib.parse.parse_qs(parsed.query))
+        if params - self._LIST_PARAMS:
+            return None
+        return respond
+
     def request(self, path: str, timeout_s: float = DEFAULT_TIMEOUT_S) -> Any:
         self.calls.append(path)
+        for prefix, response in reversed(self._overrides):
+            if self._override_matches(path, prefix):
+                return self._resolve(path, response)
         if path in self.routes:
             return self._resolve(path, self.routes[path])
+        list_route = self._match_list_route(path)
+        if list_route is not None:
+            return self._resolve(path, list_route)
         for prefix, response in self._prefix_routes:
             if path.startswith(prefix):
                 return self._resolve(path, response)

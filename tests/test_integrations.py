@@ -19,8 +19,8 @@ from headlamp_tpu.ui import render_html, text_content
 
 def snapshot_for(fleet):
     t = MockTransport()
-    t.add(NODES_PATH, {"items": fleet["nodes"]})
-    t.add(PODS_PATH, {"items": fleet["pods"]})
+    t.add_list(NODES_PATH, fleet["nodes"])
+    t.add_list(PODS_PATH, fleet["pods"])
     t.add(
         "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin",
         {"items": fleet.get("daemonsets", [])},

@@ -26,8 +26,8 @@ GIB = 1024**3
 
 def snapshot_for(fleet):
     t = MockTransport()
-    t.add(NODES_PATH, {"items": fleet["nodes"]})
-    t.add(PODS_PATH, {"items": fleet["pods"]})
+    t.add_list(NODES_PATH, fleet["nodes"])
+    t.add_list(PODS_PATH, fleet["pods"])
     t.add(
         "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin",
         {"items": fleet.get("daemonsets", [])},
@@ -78,8 +78,8 @@ class TestOverviewPage:
     def test_error_banner(self):
         fleet = fx.fleet_v5e4()
         t = MockTransport()
-        t.add(NODES_PATH, {"items": fleet["nodes"]})
-        t.add(PODS_PATH, ApiError(PODS_PATH, "HTTP 500", status=500))
+        t.add_list(NODES_PATH, fleet["nodes"])
+        t.add_override(PODS_PATH, ApiError(PODS_PATH, "HTTP 500", status=500))
         snap = AcceleratorDataContext(t).sync()
         el = overview_page(snap, now=NOW)
         assert "Loading" in text_content(el)  # pods never arrived
@@ -94,8 +94,8 @@ class TestOverviewPage:
     def test_workload_missing_notice(self):
         fleet = fx.fleet_v5e4()
         t = MockTransport()
-        t.add(NODES_PATH, {"items": fleet["nodes"]})
-        t.add(PODS_PATH, {"items": fleet["pods"]})
+        t.add_list(NODES_PATH, fleet["nodes"])
+        t.add_list(PODS_PATH, fleet["pods"])
         snap = AcceleratorDataContext(t).sync()  # daemonset paths 404
         el = overview_page(snap, now=NOW)
         assert "workload status not available" in text_content(el)
@@ -189,8 +189,8 @@ class TestDevicePluginsPage:
     def test_source_unavailable_box(self):
         fleet = fx.fleet_v5e4()
         t = MockTransport()
-        t.add(NODES_PATH, {"items": fleet["nodes"]})
-        t.add(PODS_PATH, {"items": fleet["pods"]})
+        t.add_list(NODES_PATH, fleet["nodes"])
+        t.add_list(PODS_PATH, fleet["pods"])
         snap = AcceleratorDataContext(t).sync()
         el = device_plugins_page(snap, now=NOW)
         assert "Plugin workload status not available" in text_content(el)

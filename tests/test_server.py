@@ -70,13 +70,18 @@ class TestHandle:
             clock=lambda: clock_value[0],
         )
         t = app._transport
+
+        def node_lists() -> int:
+            return sum(1 for c in t.calls if c.startswith("/api/v1/nodes"))
+
         app.handle("/tpu")
-        first = t.calls.count("/api/v1/nodes")
+        first = node_lists()
+        assert first > 0
         app.handle("/tpu/nodes")  # within interval: no re-sync
-        assert t.calls.count("/api/v1/nodes") == first
+        assert node_lists() == first
         clock_value[0] += 6
         app.handle("/tpu/pods")
-        assert t.calls.count("/api/v1/nodes") == first + 1
+        assert node_lists() == first + 1
 
 
 class TestNativeViews:
@@ -211,6 +216,28 @@ class TestCaching:
         # Different chip set: stale forecast must NOT be served.
         m2 = metrics([("n2", "0")])
         assert app._forecast_for(m2) == "forecast" and len(fits) == 2
+
+
+class TestBackgroundSync:
+    def test_background_sync_keeps_snapshot_fresh(self):
+        import time as _time
+
+        app = DashboardApp(make_demo_transport("v5e4"), min_sync_interval_s=3600.0)
+        stop = app.start_background_sync(0.05)
+        try:
+            deadline = _time.time() + 5
+            while app._last_snapshot is None and _time.time() < deadline:
+                _time.sleep(0.02)
+            assert app._last_snapshot is not None
+            assert app._last_snapshot.loading is False
+            # Page view does NOT pay a sync (min interval is huge, the
+            # background thread already hydrated).
+            calls_before = len(app._transport.calls)
+            status, _, _ = app.handle("/healthz")
+            assert status == 200
+            assert len(app._transport.calls) == calls_before
+        finally:
+            stop.set()
 
 
 class TestSocketRoundTrip:
