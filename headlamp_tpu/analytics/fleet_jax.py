@@ -6,8 +6,11 @@ control flow — `lax`/`segment_sum` only, per the XLA-semantics rules).
 Segment counts are static (padding-row trick from ``encode``), so the
 program caches per (node-bucket, pod-bucket) shape pair.
 
-The kernels are pure array→array; pages consume :func:`rollup_to_dict`,
-which converts to host ints exactly once.
+The kernels are pure array→array; the serving path reaches them through
+``analytics.stats.fleet_stats`` (called by ``ProviderState.fleet_stats``
+and rendered by the overview page), which wraps :func:`rollup_to_dict`
+and converts to host ints exactly once, with a pure-Python fallback on
+jax-less hosts.
 """
 
 from __future__ import annotations

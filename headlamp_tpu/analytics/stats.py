@@ -1,0 +1,113 @@
+"""Fleet stats — the serving-path entry to the XLA rollup.
+
+One function, :func:`fleet_stats`, computes every dashboard aggregate
+for a provider view. On hosts with jax, the TPU provider's stats come
+from the fused XLA rollup (``fleet_jax.rollup_to_dict`` — one compiled
+program per fleet-shape bucket, ADR-006); everywhere else — no jax, a
+broken backend, or a provider whose device accessors the columnar
+encoding doesn't carry (Intel) — the pure-Python fallback produces the
+IDENTICAL key set, pinned together by the parity test at the 1024-node
+fixture (``tests/test_analytics.py``).
+
+Keys: capacity, allocatable, in_use, free, utilization_pct,
+nodes_total, nodes_ready, phase_counts, generation_counts,
+per_node_in_use, max_node_util_pct, hot_nodes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from ..domain import objects, tpu
+from ..domain.accelerator import FleetView
+
+#: Node-utilization percentage at or above which a node counts as hot —
+#: the UI kit's critical threshold (`NodesPage.tsx:38`).
+HOT_NODE_PCT = 90.0
+
+
+def python_fleet_stats(view: FleetView) -> dict[str, Any]:
+    """Pure-Python reference implementation: same aggregates, same key
+    set, no jax. Also the numeric oracle the XLA rollup is tested
+    against."""
+    provider = view.provider
+    summary = dict(
+        objects.allocation_summary(
+            view.nodes,
+            view.pods,
+            provider.node_device_capacity,
+            provider.node_device_allocatable,
+            provider.pod_device_request,
+        )
+    )
+
+    nodes_ready = sum(1 for n in view.nodes if objects.is_node_ready(n))
+
+    # Per-node in-use from Running pods, in view.nodes order.
+    in_use_by_node: dict[str, int] = {}
+    for pod in view.pods:
+        if objects.pod_phase(pod) != "Running":
+            continue
+        node_name = objects.pod_node_name(pod)
+        if node_name:
+            in_use_by_node[node_name] = in_use_by_node.get(
+                node_name, 0
+            ) + provider.pod_device_request(pod)
+    per_node_in_use = [in_use_by_node.get(objects.name(n), 0) for n in view.nodes]
+
+    max_util = 0.0
+    hot_nodes = 0
+    for node, in_use in zip(view.nodes, per_node_in_use):
+        allocatable = provider.node_device_allocatable(node)
+        if allocatable <= 0:
+            continue
+        util = in_use / allocatable * 100.0
+        max_util = max(max_util, util)
+        if util >= HOT_NODE_PCT:
+            hot_nodes += 1
+
+    if provider.name == "tpu":
+        # Same stable vocabulary as the columnar encoding, so both
+        # implementations bucket unknown generations identically.
+        from .encode import GENERATION_IDS
+
+        generation_counts: dict[str, int] = {}
+        for n in view.nodes:
+            generation = tpu.get_node_generation(n)
+            if generation not in GENERATION_IDS:
+                generation = "other"
+            generation_counts[generation] = generation_counts.get(generation, 0) + 1
+    else:
+        # Intel has no TPU generation vocabulary; its pages group by GPU
+        # type separately.
+        generation_counts = {}
+
+    return {
+        **summary,
+        "nodes_total": len(view.nodes),
+        "nodes_ready": nodes_ready,
+        "phase_counts": objects.count_pod_phases(view.pods),
+        "generation_counts": generation_counts,
+        "per_node_in_use": per_node_in_use,
+        "max_node_util_pct": float(max_util),
+        "hot_nodes": hot_nodes,
+    }
+
+
+def fleet_stats(view: FleetView) -> dict[str, Any]:
+    """Serving-path aggregates for one provider view.
+
+    TPU provider + importable jax → the fused XLA rollup; anything else
+    → :func:`python_fleet_stats`. Any jax-side failure falls back too:
+    analytics acceleration must never cost a page."""
+    if view.provider.name != "tpu":
+        return python_fleet_stats(view)
+    try:
+        from .encode import encode_fleet
+        from .fleet_jax import rollup_to_dict
+    except ImportError:
+        return python_fleet_stats(view)
+    try:
+        return rollup_to_dict(encode_fleet(view.nodes, view.pods))
+    except Exception:  # noqa: BLE001 — degraded, never broken
+        return python_fleet_stats(view)

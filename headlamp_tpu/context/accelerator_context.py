@@ -77,6 +77,20 @@ class ProviderState:
     def allocation_summary(self) -> Mapping[str, int]:
         return self.view.allocation_summary()
 
+    #: Lazily-computed dashboard aggregates (see analytics.stats).
+    _stats: Mapping[str, Any] | None = None
+
+    def fleet_stats(self) -> Mapping[str, Any]:
+        """Every dashboard aggregate for this provider, computed once
+        per snapshot: the XLA fused rollup on jax-capable hosts (TPU
+        provider), pure-Python fallback otherwise — identical keys
+        either way (``analytics/stats.py``)."""
+        if self._stats is None:
+            from ..analytics.stats import fleet_stats
+
+            self._stats = fleet_stats(self.view)
+        return self._stats
+
 
 @dataclass
 class ClusterSnapshot:

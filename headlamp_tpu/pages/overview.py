@@ -108,42 +108,48 @@ def overview_page(
             )
         )
 
+    # Every aggregate below comes from one fleet_stats() call — the XLA
+    # fused rollup on jax hosts, pure-Python fallback elsewhere
+    # (analytics/stats.py; ADR-006).
+    stats = state.fleet_stats()
+
     # Node summary + generation distribution (`OverviewPage.tsx:275-312`).
-    gen_counts: dict[str, int] = {}
-    ready_nodes = 0
-    for n in state.nodes:
-        key = tpu.format_accelerator(tpu.get_node_accelerator(n))
-        gen_counts[key] = gen_counts.get(key, 0) + 1
-        if obj.is_node_ready(n):
-            ready_nodes += 1
+    gen_counts = {
+        tpu.format_generation(g): c for g, c in stats["generation_counts"].items()
+    }
     children.append(
         SectionBox(
             "TPU Nodes",
             NameValueTable(
                 [
-                    ("Total", len(state.nodes)),
-                    ("Ready", ready_nodes),
-                    ("Not Ready", len(state.nodes) - ready_nodes),
+                    ("Total", stats["nodes_total"]),
+                    ("Ready", stats["nodes_ready"]),
+                    ("Not Ready", stats["nodes_total"] - stats["nodes_ready"]),
                 ]
             ),
             PercentageBar(sorted(gen_counts.items())) if gen_counts else None,
         )
     )
 
-    # Allocation summary (`OverviewPage.tsx:316-357`).
-    alloc = state.allocation_summary()
+    # Allocation summary (`OverviewPage.tsx:316-357`) plus the fleet
+    # pressure signals the rollup computes (hot = node util ≥ 90%).
     children.append(
         SectionBox(
             "Chip Allocation",
             NameValueTable(
                 [
-                    ("Capacity", tpu.format_chip_count(alloc["capacity"])),
-                    ("Allocatable", tpu.format_chip_count(alloc["allocatable"])),
-                    ("In use", tpu.format_chip_count(alloc["in_use"])),
-                    ("Free", tpu.format_chip_count(alloc["free"])),
+                    ("Capacity", tpu.format_chip_count(stats["capacity"])),
+                    ("Allocatable", tpu.format_chip_count(stats["allocatable"])),
+                    ("In use", tpu.format_chip_count(stats["in_use"])),
+                    ("Free", tpu.format_chip_count(stats["free"])),
+                    ("Hot nodes (≥90%)", stats["hot_nodes"]),
+                    (
+                        "Max node utilization",
+                        f"{stats['max_node_util_pct']:.0f}%",
+                    ),
                 ]
             ),
-            UtilizationBar(alloc["in_use"], alloc["capacity"], unit="chips"),
+            UtilizationBar(stats["in_use"], stats["capacity"], unit="chips"),
         )
     )
 
@@ -168,7 +174,7 @@ def overview_page(
         )
 
     # Workload phases (`OverviewPage.tsx:360-390`).
-    phases = tpu.count_pod_phases(state.pods)
+    phases = stats["phase_counts"]
     children.append(
         SectionBox(
             "TPU Workloads",

@@ -74,6 +74,10 @@ class TestOverviewPage:
         assert "Capacity 4 chips" in text
         assert "In use 4 chips" in text
         assert "1/1 ready" in text
+        # Fleet pressure signals from the serving-path rollup
+        # (analytics/stats.py): v5e4's one node runs at 4/4 chips.
+        assert "Hot nodes (≥90%) 1" in text
+        assert "Max node utilization 100%" in text
 
     def test_error_banner(self):
         fleet = fx.fleet_v5e4()
