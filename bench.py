@@ -1,20 +1,26 @@
-"""Benchmark: dashboard p50 render at 256 TPU nodes.
+"""Benchmark: the BASELINE's headline metrics, on the real device.
 
-The BASELINE metric ("dashboard p50 render ms @ 256 TPU nodes; metrics
-scrape→paint latency"). The reference publishes no numbers
-(BASELINE.json ``published: {}``); its only quantitative budget is the
-2 000 ms per-request timeout / <2 s scrape→paint target, so
-``vs_baseline`` is reported as the 2 000 ms budget divided by our p50 —
-how many times faster than the reference's latency budget one full
-dashboard paint is.
+Primary metric — **metrics scrape→paint p50 @ 256 TPU nodes**: the full
+user-facing path of the metrics page (Prometheus service discovery +
+instant-query fan-out + join + utilization-history range query +
+forecaster fit on the jax device + HTML render), against the
+reference's 2 000 ms budget (`BASELINE.md`: "<2 s Prometheus
+round-trip"; the reference's own per-request timeout,
+`/root/reference/src/api/IntelGpuDataContext.tsx:72`). A fresh
+DashboardApp per iteration defeats the metrics/forecast TTL caches, so
+every sample pays the real fetch+fit; jit caches persist in-process, so
+this is steady-state, not compile time.
 
-What one iteration measures (the full user-facing path, zero cluster —
-fixture transport, exactly SURVEY.md §4's simulation discipline):
-  sync context → classify providers → render Overview + Nodes +
-  Topology + Workloads pages to final HTML.
+Extras reported alongside (same JSON line, `extra` object):
+- ``dashboard_p50_ms_4pages`` — sync + classify + render Overview,
+  Nodes, Topology, Workloads (the round-1 metric, for continuity).
+- ``forecast_fit_infer_ms_256chips`` — fit_and_forecast on 256
+  synthetic chip traces: the jax fit (fused 60-step scan) + inference
+  (Pallas kernel when the device is a TPU, via forecast_next).
+- ``jax_platform`` — the device the forecaster actually ran on.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": ...}
+  {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": ..., "extra": {...}}
 """
 
 from __future__ import annotations
@@ -28,16 +34,17 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 N_TPU_NODES = 256
-ITERATIONS = 30
-WARMUP = 3
+PAINT_ITERATIONS = 30
+METRICS_ITERATIONS = 10
+WARMUP = 2
+BUDGET_MS = 2000.0  # the reference's request-timeout / scrape→paint budget
 
 
-def build_app():
+def build_fleet():
+    """Exactly 256 TPU nodes (fleet_large mixes in plain nodes; keep
+    generating until the TPU population reaches the target)."""
     from headlamp_tpu.fleet import fixtures as fx
-    from headlamp_tpu.server import DashboardApp
 
-    # Exactly 256 TPU nodes (fleet_large mixes in plain nodes; keep
-    # generating until the TPU population reaches the target).
     target, size = N_TPU_NODES, N_TPU_NODES
     while True:
         fleet = fx.fleet_large(size)
@@ -55,36 +62,98 @@ def build_app():
         if "cloud.google.com/gke-tpu-accelerator" not in n["metadata"].get("labels", {})
     ]
     fleet["nodes"] = tpu_nodes[:target] + plain
+    return fleet
+
+
+def make_app(fleet):
+    from headlamp_tpu.fleet import fixtures as fx
+    from headlamp_tpu.server import DashboardApp
+    from headlamp_tpu.server.app import add_demo_prometheus
+
     t = fx.fleet_transport(fleet)
-    return DashboardApp(t, min_sync_interval_s=0.0), len(tpu_nodes[:target])
+    add_demo_prometheus(t, fleet)
+    return DashboardApp(t, min_sync_interval_s=0.0)
 
 
-def one_paint(app) -> None:
-    for path in ("/tpu", "/tpu/nodes", "/tpu/topology", "/tpu/pods"):
-        status, _, body = app.handle(path)
+def bench_dashboard_paint(fleet) -> float:
+    app = make_app(fleet)
+
+    def one_paint() -> None:
+        for path in ("/tpu", "/tpu/nodes", "/tpu/topology", "/tpu/pods"):
+            status, _, body = app.handle(path)
+            assert status == 200 and body
+
+    for _ in range(WARMUP):
+        one_paint()
+    samples = []
+    for _ in range(PAINT_ITERATIONS):
+        t0 = time.perf_counter()
+        one_paint()
+        samples.append((time.perf_counter() - t0) * 1000)
+    return statistics.median(samples)
+
+
+def bench_metrics_scrape_paint(fleet) -> float:
+    """Fresh app per iteration: the TTL caches must not turn the
+    scrape→paint measurement into a cache-read measurement."""
+    for _ in range(WARMUP):
+        status, _, body = make_app(fleet).handle("/tpu/metrics")
+        assert status == 200 and "Fleet Telemetry" in body
+    samples = []
+    for _ in range(METRICS_ITERATIONS):
+        app = make_app(fleet)
+        t0 = time.perf_counter()
+        status, _, body = app.handle("/tpu/metrics")
+        samples.append((time.perf_counter() - t0) * 1000)
         assert status == 200 and body
+    return statistics.median(samples)
+
+
+def bench_forecaster() -> tuple[float, str]:
+    import jax
+
+    from headlamp_tpu.models import fit_and_forecast, synthetic_telemetry
+
+    platform = jax.devices()[0].platform
+    series = synthetic_telemetry(256, 96)
+    # Compile once, then measure steady-state dispatch+execute.
+    jax.block_until_ready(fit_and_forecast(series))
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fit_and_forecast(series))
+        samples.append((time.perf_counter() - t0) * 1000)
+    return statistics.median(samples), platform
 
 
 def main() -> None:
-    app, n_tpu = build_app()
-    assert n_tpu == N_TPU_NODES, n_tpu
-    for _ in range(WARMUP):
-        one_paint(app)
-    samples = []
-    for _ in range(ITERATIONS):
-        t0 = time.perf_counter()
-        one_paint(app)
-        samples.append((time.perf_counter() - t0) * 1000)
-    p50 = statistics.median(samples)
-    budget_ms = 2000.0  # the reference's request-timeout / scrape→paint budget
+    fleet = build_fleet()
+    metrics_p50 = bench_metrics_scrape_paint(fleet)
+    paint_p50 = bench_dashboard_paint(fleet)
+    try:
+        forecast_ms, platform = bench_forecaster()
+    except Exception:  # jax-less host: report the page path only
+        forecast_ms, platform = None, "unavailable"
     print(
         json.dumps(
             {
-                "metric": f"dashboard p50 full-paint (4 pages) @ {N_TPU_NODES} TPU nodes",
-                "value": round(p50, 2),
+                "metric": (
+                    "metrics scrape→paint p50 (Prometheus fetch + forecast "
+                    f"fit + render) @ {N_TPU_NODES} TPU nodes"
+                ),
+                "value": round(metrics_p50, 2),
                 "unit": "ms",
-                "vs_baseline": round(budget_ms / p50, 2),
-            }
+                "vs_baseline": round(BUDGET_MS / metrics_p50, 2),
+                "extra": {
+                    "baseline_budget_ms": BUDGET_MS,
+                    "dashboard_p50_ms_4pages": round(paint_p50, 2),
+                    "forecast_fit_infer_ms_256chips": (
+                        round(forecast_ms, 2) if forecast_ms is not None else None
+                    ),
+                    "jax_platform": platform,
+                },
+            },
+            ensure_ascii=False,
         )
     )
 

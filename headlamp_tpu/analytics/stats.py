@@ -94,13 +94,30 @@ def python_fleet_stats(view: FleetView) -> dict[str, Any]:
     }
 
 
-def fleet_stats(view: FleetView) -> dict[str, Any]:
+#: Fleet size at which the XLA rollup takes over from the Python loops.
+#: The crossover is dominated by device *dispatch* latency, not compute:
+#: one rollup dispatch over a tunneled/remote TPU costs ~100-200 ms
+#: while the Python loops finish a 256-node fleet in ~1 ms — but the
+#: loops grow linearly with pods×nodes while the fused program's cost is
+#: flat, so past this size the rollup wins everywhere and below it only
+#: on hosts with local-device dispatch. ADR-006 ("callers choose by
+#: scale") encodes the policy here, in one place.
+XLA_ROLLUP_MIN_NODES = 512
+
+
+def fleet_stats(view: FleetView, *, backend: str | None = None) -> dict[str, Any]:
     """Serving-path aggregates for one provider view.
 
-    TPU provider + importable jax → the fused XLA rollup; anything else
-    → :func:`python_fleet_stats`. Any jax-side failure falls back too:
+    Dispatch policy: the fused XLA rollup for TPU-provider fleets of
+    ``XLA_ROLLUP_MIN_NODES``+ nodes on jax-capable hosts; the
+    pure-Python implementation otherwise. ``backend`` ("xla"/"python")
+    pins a path for tests and benches. Any jax-side failure falls back:
     analytics acceleration must never cost a page."""
+    if backend == "python":
+        return python_fleet_stats(view)
     if view.provider.name != "tpu":
+        return python_fleet_stats(view)
+    if backend != "xla" and len(view.nodes) < XLA_ROLLUP_MIN_NODES:
         return python_fleet_stats(view)
     try:
         from .encode import encode_fleet

@@ -150,27 +150,38 @@ def make_windows(
 
 
 def forecast_next(
-    params: Params, recent: jax.Array, cfg: ForecastConfig
+    params: Params, recent: jax.Array, cfg: ForecastConfig | None = None
 ) -> jax.Array:
-    """Pages' entry: [n_chips, window] recent samples -> [n_chips,
-    horizon] predicted utilization."""
-    del cfg
+    """Pages' inference entry: [n_chips, window] recent samples ->
+    [n_chips, horizon] predicted utilization.
+
+    Dispatch: on a TPU backend the fused Pallas kernel serves inference
+    (``pallas_forward.forecast_forward_pallas`` — every intermediate
+    stays in VMEM); elsewhere the plain XLA ``forward``. Any Pallas
+    failure falls back to XLA — the kernel is an optimization, never a
+    dependency."""
+    if jax.devices()[0].platform == "tpu":
+        try:
+            from .pallas_forward import forecast_forward_pallas
+
+            return forecast_forward_pallas(params, recent, cfg, interpret=False)
+        except Exception:  # noqa: BLE001 — optimization, not a dependency
+            pass
     return forward(params, recent)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps"))
-def _fit_forecast_program(
+def _fit_program(
     x: jax.Array,
     y: jax.Array,
-    recent: jax.Array,
     key: jax.Array,
     cfg: ForecastConfig,
     steps: int,
-) -> jax.Array:
-    """init → ``steps`` optimizer steps (lax.scan) → predict, as ONE
-    XLA program. A Python training loop would issue one device dispatch
-    per step — tens of round-trips on a remote/tunneled TPU for a fit
-    that the fused program finishes in a single dispatch."""
+) -> Params:
+    """init → ``steps`` optimizer steps (lax.scan) → fitted params, as
+    ONE XLA program. A Python training loop would issue one device
+    dispatch per step — tens of round-trips on a remote/tunneled TPU for
+    a fit that the fused program finishes in a single dispatch."""
     params = init_params(key, cfg)
     optimizer = optax.adam(cfg.learning_rate)
     opt_state = optimizer.init(params)
@@ -183,7 +194,7 @@ def _fit_forecast_program(
         return (p, s), loss
 
     (params, _), _ = jax.lax.scan(body, (params, opt_state), None, length=steps)
-    return forward(params, recent)
+    return params
 
 
 def fit_and_forecast(
@@ -195,6 +206,8 @@ def fit_and_forecast(
 ) -> jax.Array:
     """Online fit on the given traces, then predict the next horizon
     from each trace's latest window: [n_chips, T] -> [n_chips, horizon].
+    The fit is one fused XLA program; the predict goes through
+    :func:`forecast_next` (Pallas kernel on TPU, XLA elsewhere).
 
     There is no pre-trained checkpoint by design — utilization dynamics
     are cluster-specific, the model is tiny, and fitting on exactly the
@@ -209,6 +222,5 @@ def fit_and_forecast(
 
     x, y = make_windows(series, cfg.window, cfg.horizon)
     recent = series[:, -cfg.window:]
-    return _fit_forecast_program(
-        x, y, recent, jax.random.PRNGKey(seed), cfg, steps
-    )
+    params = _fit_program(x, y, jax.random.PRNGKey(seed), cfg, steps)
+    return forecast_next(params, recent, cfg)

@@ -383,7 +383,15 @@ def make_demo_transport(fleet_name: str = "v5p32") -> MockTransport:
     }
     fleet = fleets[fleet_name]()
     t = fx.fleet_transport(fleet)
+    add_demo_prometheus(t, fleet)
+    return t
 
+
+def add_demo_prometheus(t: MockTransport, fleet: dict) -> MockTransport:
+    """Wire synthetic Prometheus (instant + range queries) for a fixture
+    fleet onto an existing transport — shared by demo mode and bench.py
+    so the benched scrape→paint path exercises the same series the demo
+    serves."""
     # Synthetic Prometheus: deterministic per-chip utilization.
     import urllib.parse
 

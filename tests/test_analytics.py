@@ -176,7 +176,7 @@ class TestServingPathStats:
         from headlamp_tpu.analytics.stats import fleet_stats, python_fleet_stats
 
         view = tpu_view(fx.fleet_large(1024))
-        xla = fleet_stats(view)
+        xla = fleet_stats(view, backend="xla")
         py = python_fleet_stats(view)
         assert set(xla) == set(py)
         for key in ("capacity", "allocatable", "in_use", "free",
@@ -187,6 +187,29 @@ class TestServingPathStats:
         assert xla["generation_counts"] == py["generation_counts"]
         assert xla["per_node_in_use"] == py["per_node_in_use"]
         assert abs(xla["max_node_util_pct"] - py["max_node_util_pct"]) < 1e-3
+
+    def test_scale_dispatch_policy(self):
+        from headlamp_tpu.analytics import stats as st
+
+        small = tpu_view(fx.fleet_v5p32())  # 4 nodes → python path
+        large = tpu_view(fx.fleet_large(1024))  # ≥512 → XLA path
+        assert len(large.nodes) >= st.XLA_ROLLUP_MIN_NODES
+
+        called = []
+        original = st.python_fleet_stats
+
+        def spying(view):
+            called.append(len(view.nodes))
+            return original(view)
+
+        st.python_fleet_stats = spying
+        try:
+            st.fleet_stats(small)
+            assert called == [4]
+            st.fleet_stats(large)  # must NOT go through python
+            assert called == [4]
+        finally:
+            st.python_fleet_stats = original
 
     def test_intel_provider_uses_python_path(self):
         from headlamp_tpu.analytics.stats import fleet_stats
