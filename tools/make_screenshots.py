@@ -1,0 +1,76 @@
+"""Generate docs/screenshots/*.svg from the demo fleet.
+
+The reference ships SVG page captures (`docs/screenshots/01-overview.svg`
+etc., SURVEY.md §2.4). Here the captures are generated, not drawn: each
+SVG embeds the REAL rendered page (the same element tree + stylesheet
+the server serves, demo fleet ``v5p32``) via ``foreignObject``, so the
+images can never drift from the implementation. Regenerate after UI
+changes:
+
+    python tools/make_screenshots.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from headlamp_tpu.server import DashboardApp, make_demo_transport  # noqa: E402
+
+OUT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs",
+    "screenshots",
+)
+
+#: (filename, route, viewport height)
+CAPTURES = [
+    ("01-overview.svg", "/tpu", 1180),
+    ("02-topology.svg", "/tpu/topology", 1280),
+    ("03-metrics.svg", "/tpu/metrics", 1380),
+    ("04-node-detail.svg", "/node/gke-v5p-pool-w0", 900),
+]
+
+WIDTH = 1060
+
+
+def extract_capture(page_html: str) -> str:
+    """Stylesheet + <main> content from the served page. Both are
+    XML-well-formed (the element renderer closes every tag and the
+    stylesheet contains no '<'), which foreignObject requires; the
+    full document shell (doctype, meta) is not, so it is dropped."""
+    import re
+
+    match = re.search(r"<style>(.*?)</style>.*?<main>(.*)</main>", page_html, re.S)
+    assert match, "page shell changed; update extract_capture"
+    style, main = match.groups()
+    return f"<style>{style}</style><main>{main}</main>"
+
+
+def svg_wrap(body_html: str, height: int) -> str:
+    return (
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{WIDTH}" '
+        f'height="{height}" viewBox="0 0 {WIDTH} {height}">\n'
+        f'<rect width="100%" height="100%" fill="#f4f6f8"/>\n'
+        f'<foreignObject x="0" y="0" width="{WIDTH}" height="{height}">\n'
+        f'<body xmlns="http://www.w3.org/1999/xhtml">\n{body_html}\n</body>\n'
+        f"</foreignObject>\n</svg>\n"
+    )
+
+
+def main() -> None:
+    app = DashboardApp(make_demo_transport("v5p32"), min_sync_interval_s=0.0)
+    os.makedirs(OUT_DIR, exist_ok=True)
+    for filename, route, height in CAPTURES:
+        status, _, html = app.handle(route)
+        assert status == 200, (route, status)
+        path = os.path.join(OUT_DIR, filename)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(svg_wrap(extract_capture(html), height))
+        print(f"wrote {path} ({len(html)} bytes of page HTML)")
+
+
+if __name__ == "__main__":
+    main()
