@@ -1,0 +1,116 @@
+"""Cross-language parity contract — the Python half.
+
+The shared fixtures (``fixtures/*.json``) pin the Python topology engine
+and its TS mirror (``plugin/src/api/topology.ts``) to each other:
+
+- This suite asserts the stored fixtures exactly match what the CURRENT
+  Python engine produces (stale fixtures fail here; regenerate with
+  ``python tools/export_fixtures.py``).
+- The TS side replays the same fixtures in vitest
+  (``plugin/src/api/topology.test.ts``), run by CI's node job — this
+  image ships no JS runtime, so here the mirror is checked structurally:
+  every required export exists and the mirrored constants match the
+  Python domain constants character-for-character.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+from headlamp_tpu.domain import constants as C
+from tools.export_fixtures import FLEETS, expected_for
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES_DIR = os.path.join(REPO, "fixtures")
+TS_MIRROR = os.path.join(REPO, "plugin", "src", "api", "topology.ts")
+TS_TEST = os.path.join(REPO, "plugin", "src", "api", "topology.test.ts")
+
+
+def load_fixture(name):
+    with open(os.path.join(FIXTURES_DIR, f"{name}.json"), encoding="utf-8") as f:
+        return json.load(f)
+
+
+class TestSharedFixturesFresh:
+    @pytest.mark.parametrize("name", sorted(FLEETS))
+    def test_fixture_matches_current_engine(self, name):
+        stored = load_fixture(name)
+        fleet = FLEETS[name]()
+        # Round-trip through JSON so tuples/lists compare equal.
+        current = json.loads(json.dumps(expected_for(fleet), sort_keys=True))
+        assert stored["expected"] == current, (
+            f"fixtures/{name}.json is stale — regenerate with "
+            "`python tools/export_fixtures.py`"
+        )
+
+    @pytest.mark.parametrize("name", sorted(FLEETS))
+    def test_fixture_fleet_embedded(self, name):
+        stored = load_fixture(name)
+        assert stored["fleet"]["nodes"], name
+        assert "pods" in stored["fleet"]
+
+    def test_degraded_fixture_exercises_health_paths(self):
+        expected = load_fixture("v5p32-degraded")["expected"]
+        sl = expected["slices"][0]
+        assert sl["health"] == "error"  # worker 3 missing
+        assert sl["missing_worker_ids"] == [3]
+        assert sl["ready_hosts"] < sl["actual_hosts"]  # w2 NotReady
+
+
+#: Exports the TS mirror must provide (checked textually — no JS runtime
+#: in the test image; CI's node job executes them for real).
+REQUIRED_TS_EXPORTS = (
+    "parseTopology",
+    "topologyChipCount",
+    "inferChipsPerHost",
+    "expectedHostCount",
+    "naturalCompare",
+    "groupSlices",
+    "summarizeSlices",
+    "sliceHealth",
+    "sliceMissingWorkerIds",
+    "hostBlock",
+    "chipWorker",
+    "buildMeshLayout",
+    "computeExpected",
+    "isTpuNode",
+    "getNodeWorkerId",
+    "parseIntLenient",
+)
+
+
+class TestTsMirrorStructure:
+    @pytest.fixture(scope="class")
+    def ts_source(self):
+        with open(TS_MIRROR, encoding="utf-8") as f:
+            return f.read()
+
+    def test_mirror_and_test_exist(self):
+        assert os.path.exists(TS_MIRROR)
+        assert os.path.exists(TS_TEST)
+
+    @pytest.mark.parametrize("symbol", REQUIRED_TS_EXPORTS)
+    def test_required_export_present(self, ts_source, symbol):
+        assert re.search(
+            rf"export (function|const|interface) {symbol}\b", ts_source
+        ), f"topology.ts must export {symbol}"
+
+    def test_constants_mirror_python(self, ts_source):
+        for value in (
+            C.TPU_RESOURCE,
+            C.GKE_TPU_ACCELERATOR_LABEL,
+            C.GKE_TPU_TOPOLOGY_LABEL,
+            C.GKE_NODEPOOL_LABEL,
+            C.GKE_TPU_WORKER_ID_LABEL,
+        ):
+            assert f"'{value}'" in ts_source, value
+        for accelerator, generation in C.TPU_ACCELERATOR_GENERATIONS.items():
+            assert f"'{accelerator}': '{generation}'" in ts_source, accelerator
+
+    def test_ts_test_replays_every_fixture(self):
+        with open(TS_TEST, encoding="utf-8") as f:
+            src = f.read()
+        assert "computeExpected(payload.fleet.nodes)" in src
+        assert "toEqual(payload.expected)" in src

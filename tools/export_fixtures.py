@@ -1,0 +1,129 @@
+"""Export shared JSON fixtures + expected topology outputs.
+
+``fixtures/*.json`` is the cross-language contract: each file holds a
+deterministic fixture fleet (``fleet/fixtures.py``) plus the Python
+topology engine's outputs for it (slices, summary, mesh geometry). The
+TS mirror's vitest suite (``plugin/src/api/topology.test.ts``) replays
+the same fleets and must reproduce ``expected`` byte-for-byte;
+``tests/test_ts_parity.py`` asserts the stored files stay in sync with
+the Python engine. Regenerate after topology changes:
+
+    python tools/export_fixtures.py
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from headlamp_tpu.fleet import fixtures as fx  # noqa: E402
+from headlamp_tpu.topology.mesh import build_mesh_layout  # noqa: E402
+from headlamp_tpu.topology.slices import group_slices, summarize_slices  # noqa: E402
+
+OUT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "fixtures"
+)
+
+
+def degraded_v5p32() -> dict:
+    """v5p-32 slice with worker 3 missing and worker 2 NotReady —
+    exercises the incomplete/degraded health paths both engines must
+    agree on."""
+    fleet = copy.deepcopy(fx.fleet_v5p32())
+    fleet["nodes"] = [
+        n for n in fleet["nodes"] if n["metadata"]["name"] != "gke-v5p-pool-w3"
+    ]
+    for n in fleet["nodes"]:
+        if n["metadata"]["name"] == "gke-v5p-pool-w2":
+            for c in n.get("status", {}).get("conditions", []):
+                if c.get("type") == "Ready":
+                    c["status"] = "False"
+    return fleet
+
+
+FLEETS = {
+    "v5e4": fx.fleet_v5e4,
+    "v5p32": fx.fleet_v5p32,
+    "mixed": fx.fleet_mixed,
+    "v5p32-degraded": degraded_v5p32,
+}
+
+
+def expected_for(fleet: dict) -> dict:
+    slices = group_slices(fleet["nodes"])
+    out_slices = []
+    for s in slices:
+        mesh = build_mesh_layout(s)
+        out_slices.append(
+            {
+                "slice_id": s.slice_id,
+                "node_pool": s.node_pool,
+                "accelerator": s.accelerator,
+                "generation": s.generation,
+                "topology": s.topology,
+                "dims": list(s.dims),
+                "total_chips": s.total_chips,
+                "chips_per_host": s.chips_per_host,
+                "expected_hosts": s.expected_hosts,
+                "actual_hosts": s.actual_hosts,
+                "is_multi_host": s.is_multi_host,
+                "ready_hosts": s.ready_hosts,
+                "missing_worker_ids": s.missing_worker_ids,
+                "health": s.health,
+                "workers": [
+                    {
+                        "node_name": w.node_name,
+                        "worker_id": w.worker_id,
+                        "ready": w.ready,
+                        "chip_capacity": w.chip_capacity,
+                    }
+                    for w in s.workers
+                ],
+                "mesh": {
+                    "dims": list(mesh.dims),
+                    "host_grid": list(mesh.host_grid),
+                    "block": list(mesh.block),
+                    "width": mesh.width,
+                    "height": mesh.height,
+                    "cells": [
+                        [c.chip_index, list(c.coord), c.worker_id, c.px, c.py]
+                        for c in mesh.cells
+                    ],
+                    "links": [
+                        [k.a, k.b, k.axis, 1 if k.wrap else 0] for k in mesh.links
+                    ],
+                },
+            }
+        )
+    return {"slices": out_slices, "summary": dict(summarize_slices(slices))}
+
+
+def main() -> None:
+    os.makedirs(OUT_DIR, exist_ok=True)
+    for name, maker in FLEETS.items():
+        fleet = maker()
+        payload = {
+            "name": name,
+            "fleet": {
+                "nodes": fleet["nodes"],
+                "pods": fleet.get("pods", []),
+                "daemonsets": fleet.get("daemonsets", []),
+            },
+            "expected": expected_for(fleet),
+        }
+        path = os.path.join(OUT_DIR, f"{name}.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(
+            f"wrote {path}: {len(fleet['nodes'])} nodes, "
+            f"{len(payload['expected']['slices'])} slices"
+        )
+
+
+if __name__ == "__main__":
+    main()
