@@ -26,6 +26,22 @@ from ..domain.accelerator import FleetView
 HOT_NODE_PCT = 90.0
 
 
+def _generation_counts(nodes: list[Any]) -> dict[str, int]:
+    """Generation histogram preserving the ACTUAL inferred generation —
+    a future 'tpu-v7x-slice' counts as 'v7x' and displays as 'TPU v7x'
+    (format_generation's documented degradation), never as 'other'. The
+    XLA rollup's histogram is vocabulary-bucketed (static shapes demand
+    a fixed vocab), so :func:`fleet_stats` overrides its bucketed counts
+    with this exact host-side pass — one O(nodes) loop against a fused
+    program that already crossed the device boundary is noise, and it
+    keeps the two backends byte-identical."""
+    counts: dict[str, int] = {}
+    for n in nodes:
+        generation = tpu.get_node_generation(n)
+        counts[generation] = counts.get(generation, 0) + 1
+    return counts
+
+
 def python_fleet_stats(view: FleetView) -> dict[str, Any]:
     """Pure-Python reference implementation: same aggregates, same key
     set, no jax. Also the numeric oracle the XLA rollup is tested
@@ -67,16 +83,7 @@ def python_fleet_stats(view: FleetView) -> dict[str, Any]:
             hot_nodes += 1
 
     if provider.name == "tpu":
-        # Same stable vocabulary as the columnar encoding, so both
-        # implementations bucket unknown generations identically.
-        from .encode import GENERATION_IDS
-
-        generation_counts: dict[str, int] = {}
-        for n in view.nodes:
-            generation = tpu.get_node_generation(n)
-            if generation not in GENERATION_IDS:
-                generation = "other"
-            generation_counts[generation] = generation_counts.get(generation, 0) + 1
+        generation_counts = _generation_counts(view.nodes)
     else:
         # Intel has no TPU generation vocabulary; its pages group by GPU
         # type separately.
@@ -125,6 +132,10 @@ def fleet_stats(view: FleetView, *, backend: str | None = None) -> dict[str, Any
     except ImportError:
         return python_fleet_stats(view)
     try:
-        return rollup_to_dict(encode_fleet(view.nodes, view.pods))
+        stats = rollup_to_dict(encode_fleet(view.nodes, view.pods))
     except Exception:  # noqa: BLE001 — degraded, never broken
         return python_fleet_stats(view)
+    # Exact generation names (see _generation_counts): the device-side
+    # histogram is fixed-vocabulary; the display histogram is not.
+    stats["generation_counts"] = _generation_counts(view.nodes)
+    return stats

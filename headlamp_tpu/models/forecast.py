@@ -172,16 +172,19 @@ def forecast_next(
 
 @partial(jax.jit, static_argnames=("cfg", "steps"))
 def _fit_program(
-    x: jax.Array,
-    y: jax.Array,
+    series: jax.Array,
     key: jax.Array,
     cfg: ForecastConfig,
     steps: int,
 ) -> Params:
-    """init → ``steps`` optimizer steps (lax.scan) → fitted params, as
-    ONE XLA program. A Python training loop would issue one device
-    dispatch per step — tens of round-trips on a remote/tunneled TPU for
-    a fit that the fused program finishes in a single dispatch."""
+    """windowing → init → ``steps`` optimizer steps (lax.scan) → fitted
+    params, as ONE XLA program. A Python training loop would issue one
+    device dispatch per step — tens of round-trips on a remote/tunneled
+    TPU for a fit the fused program finishes in a single dispatch; the
+    windowing (``make_windows``'s gathers) is fused in too, because each
+    un-jitted jnp op is its own dispatch and over a tunneled chip those
+    round-trips dominate the whole fit."""
+    x, y = make_windows(series, cfg.window, cfg.horizon)
     params = init_params(key, cfg)
     optimizer = optax.adam(cfg.learning_rate)
     opt_state = optimizer.init(params)
@@ -220,7 +223,6 @@ def fit_and_forecast(
         last = series[:, -1:]
         return jnp.repeat(last, cfg.horizon, axis=1)
 
-    x, y = make_windows(series, cfg.window, cfg.horizon)
     recent = series[:, -cfg.window:]
-    params = _fit_program(x, y, jax.random.PRNGKey(seed), cfg, steps)
+    params = _fit_program(series, jax.random.PRNGKey(seed), cfg, steps)
     return forecast_next(params, recent, cfg)

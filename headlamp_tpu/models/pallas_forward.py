@@ -63,7 +63,26 @@ def _pad2(a: jax.Array, rows: int, cols: int) -> jax.Array:
     return jnp.pad(a, ((0, rows - a.shape[0]), (0, cols - a.shape[1])))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("batch_p", "horizon", "interpret"))
+def _pallas_program(
+    params: Params, x: jax.Array, *, batch_p: int, horizon: int, interpret: bool
+):
+    """Padding → kernel → un-pad as ONE jitted program: each un-jitted
+    jnp.pad is its own device dispatch, and over a tunneled/remote TPU
+    those seven round-trips cost more than the kernel itself."""
+    x_p = _pad2(x.astype(jnp.float32), batch_p, _LANES)
+    w1_p = _pad2(params["w1"].astype(jnp.float32), _LANES, _LANES)
+    w2_p = _pad2(params["w2"].astype(jnp.float32), _LANES, _LANES)
+    w3_p = _pad2(params["w3"].astype(jnp.float32), _LANES, _LANES)
+    b1_p = _pad2(params["b1"].reshape(1, -1).astype(jnp.float32), 8, _LANES)
+    b2_p = _pad2(params["b2"].reshape(1, -1).astype(jnp.float32), 8, _LANES)
+    b3_p = _pad2(params["b3"].reshape(1, -1).astype(jnp.float32), 8, _LANES)
+    out = _padded_forward(
+        x_p, w1_p, b1_p, w2_p, b2_p, w3_p, b3_p, interpret=interpret
+    )
+    return out[: x.shape[0], :horizon]
+
+
 def _padded_forward(x_p, w1_p, b1_p, w2_p, b2_p, w3_p, b3_p, *, interpret: bool):
     n_blocks = x_p.shape[0] // _BLOCK_B
     weight_spec = pl.BlockSpec(
@@ -118,18 +137,9 @@ def forecast_forward_pallas(
             f"window={window}, hidden={hidden}, horizon={horizon}: every "
             f"dimension must fit the single-tile kernel width {_LANES}"
         )
-
-    batch_p = max(_BLOCK_B, -(-batch // _BLOCK_B) * _BLOCK_B)
-    x_p = _pad2(x.astype(jnp.float32), batch_p, _LANES)
-    w1_p = _pad2(params["w1"].astype(jnp.float32), _LANES, _LANES)
-    w2_p = _pad2(params["w2"].astype(jnp.float32), _LANES, _LANES)
-    w3_p = _pad2(params["w3"].astype(jnp.float32), _LANES, _LANES)
-    b1_p = _pad2(params["b1"].reshape(1, -1).astype(jnp.float32), 8, _LANES)
-    b2_p = _pad2(params["b2"].reshape(1, -1).astype(jnp.float32), 8, _LANES)
-    b3_p = _pad2(params["b3"].reshape(1, -1).astype(jnp.float32), 8, _LANES)
     del window  # zero-padding makes the contraction width-invariant
 
-    out = _padded_forward(
-        x_p, w1_p, b1_p, w2_p, b2_p, w3_p, b3_p, interpret=bool(interpret)
+    batch_p = max(_BLOCK_B, -(-batch // _BLOCK_B) * _BLOCK_B)
+    return _pallas_program(
+        params, x, batch_p=batch_p, horizon=horizon, interpret=bool(interpret)
     )
-    return out[:batch, :horizon]

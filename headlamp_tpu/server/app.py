@@ -88,6 +88,10 @@ class DashboardApp:
         #: without locking, so liveness probes can never stall behind a
         #: slow cluster sync holding self._lock.
         self._last_snapshot: Any = None
+        #: Stop event of the background sync thread, when one is running
+        #: (see start_background_sync) — its liveness suppresses inline
+        #: syncs on the request path.
+        self._background_stop: threading.Event | None = None
 
     @property
     def registry(self) -> Registry:
@@ -104,23 +108,35 @@ class DashboardApp:
         stop = threading.Event()
         interval = interval_s if interval_s is not None else max(self._min_sync, 1.0)
 
-        def loop() -> None:
-            while not stop.wait(interval):
-                try:
-                    with self._lock:
-                        self._ctx.sync()
-                        self._last_sync = self._clock()
-                        self._last_snapshot = self._ctx.snapshot()
-                except Exception:  # noqa: BLE001 — keep the heartbeat alive
-                    pass
+        def sync_once() -> None:
+            try:
+                with self._lock:
+                    self._ctx.sync()
+                    self._last_sync = self._clock()
+                    self._last_snapshot = self._ctx.snapshot()
+            except Exception:  # noqa: BLE001 — keep the heartbeat alive
+                pass
 
+        def loop() -> None:
+            sync_once()  # hydrate immediately; first page view must not block
+            while not stop.wait(interval):
+                sync_once()
+
+        # While the thread runs, page views never sync inline — that is
+        # the flag's whole promise. The stop event re-enables inline
+        # syncing (checked per request, so a stopped thread does not
+        # strand the app with a permanently stale snapshot).
+        self._background_stop = stop
         threading.Thread(target=loop, daemon=True, name="hl-tpu-sync").start()
         return stop
 
     def _synced_snapshot(self):
+        background_live = (
+            self._background_stop is not None and not self._background_stop.is_set()
+        )
         with self._lock:
             now = self._clock()
-            if now - self._last_sync >= self._min_sync:
+            if not background_live and now - self._last_sync >= self._min_sync:
                 self._ctx.sync()
                 self._last_sync = now
             snap = self._ctx.snapshot()
@@ -278,7 +294,7 @@ class DashboardApp:
             )
             status = 404 if el.props.get("data-notfound") else 200
             return status, "text/html", self._page_html(
-                f"Node {node_match.group(1)}", render_html(el)
+                f"Node {node_match.group(1)}", render_html(el), route_path
             )
         pod_match = _POD_DETAIL_RE.match(route_path)
         if pod_match:
@@ -292,7 +308,7 @@ class DashboardApp:
             )
             status = 404 if el.props.get("data-notfound") else 200
             return status, "text/html", self._page_html(
-                f"Pod {pod_match.group(2)}", render_html(el)
+                f"Pod {pod_match.group(2)}", render_html(el), route_path
             )
 
         route = self._registry.route_for(route_path)

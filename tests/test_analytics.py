@@ -211,6 +211,21 @@ class TestServingPathStats:
         finally:
             st.python_fleet_stats = original
 
+    def test_future_generation_preserved_not_bucketed(self):
+        # A future accelerator label must surface as its inferred
+        # generation ("v7x" → "TPU v7x" in the UI), not collapse to
+        # "other" — on BOTH backends.
+        from headlamp_tpu.analytics.stats import fleet_stats, python_fleet_stats
+
+        fleet = fx.fleet_v5p32()
+        for n in fleet["nodes"]:
+            labels = n["metadata"].get("labels", {})
+            if labels.get("cloud.google.com/gke-tpu-accelerator"):
+                labels["cloud.google.com/gke-tpu-accelerator"] = "tpu-v7x-slice"
+        view = tpu_view(fleet)
+        assert python_fleet_stats(view)["generation_counts"] == {"v7x": 4}
+        assert fleet_stats(view, backend="xla")["generation_counts"] == {"v7x": 4}
+
     def test_intel_provider_uses_python_path(self):
         from headlamp_tpu.analytics.stats import fleet_stats
 

@@ -160,6 +160,14 @@ class TestNativeViews:
         _, _, body = make_app("v5p32").handle("/tpu/nodes")
         assert 'href="/node/gke-v5p-pool-w0"' in body
 
+    def test_detail_page_refresh_returns_to_detail(self):
+        # The Refresh link on a native detail page must come back to
+        # that page, not dump the user on /tpu.
+        _, _, body = make_app("v5p32").handle("/node/gke-v5p-pool-w0")
+        assert 'href="/refresh?back=/node/gke-v5p-pool-w0"' in body
+        _, _, body = make_app("v5p32").handle("/pod/ml/megatrain-0")
+        assert 'href="/refresh?back=/pod/ml/megatrain-0"' in body
+
 
 class TestCaching:
     def _probe_count(self, transport):
@@ -236,6 +244,28 @@ class TestBackgroundSync:
             status, _, _ = app.handle("/healthz")
             assert status == 200
             assert len(app._transport.calls) == calls_before
+        finally:
+            stop.set()
+
+    def test_page_views_never_sync_inline_while_background_live(self):
+        import time as _time
+
+        # Interval far longer than min_sync: without suppression every
+        # page view >5s after the tick would still sync inline.
+        app = DashboardApp(make_demo_transport("v5e4"), min_sync_interval_s=0.0)
+        stop = app.start_background_sync(3600.0)
+        try:
+            deadline = _time.time() + 5
+            while app._last_snapshot is None and _time.time() < deadline:
+                _time.sleep(0.02)
+            calls_before = len(app._transport.calls)
+            status, _, _ = app.handle("/tpu")  # min_sync=0 → would re-sync inline
+            assert status == 200
+            assert len(app._transport.calls) == calls_before
+            # Stopping the thread re-enables inline syncing.
+            stop.set()
+            app.handle("/tpu")
+            assert len(app._transport.calls) > calls_before
         finally:
             stop.set()
 
