@@ -118,23 +118,37 @@ def fleet_stats(view: FleetView, *, backend: str | None = None) -> dict[str, Any
     Dispatch policy: the fused XLA rollup for TPU-provider fleets of
     ``XLA_ROLLUP_MIN_NODES``+ nodes on jax-capable hosts; the
     pure-Python implementation otherwise. ``backend`` ("xla"/"python")
-    pins a path for tests and benches. Any jax-side failure falls back:
-    analytics acceleration must never cost a page."""
+    pins a path for tests and benches; an explicit "xla" pin propagates
+    every failure — missing jax, broken rollup, non-TPU provider —
+    instead of silently degrading, so a parity test on a jax-less host
+    must skip, not vacuously compare Python to itself. On the default
+    path any jax-side failure falls back: analytics acceleration must
+    never cost a page."""
     if backend == "python":
         return python_fleet_stats(view)
+    if backend == "xla":
+        if view.provider.name != "tpu":
+            raise ValueError(
+                f"backend='xla' unsupported for provider "
+                f"{view.provider.name!r}: the columnar encoding carries "
+                f"TPU device accessors only"
+            )
+        return _xla_stats(view)
     if view.provider.name != "tpu":
         return python_fleet_stats(view)
-    if backend != "xla" and len(view.nodes) < XLA_ROLLUP_MIN_NODES:
+    if len(view.nodes) < XLA_ROLLUP_MIN_NODES:
         return python_fleet_stats(view)
     try:
-        from .encode import encode_fleet
-        from .fleet_jax import rollup_to_dict
-    except ImportError:
-        return python_fleet_stats(view)
-    try:
-        stats = rollup_to_dict(encode_fleet(view.nodes, view.pods))
+        return _xla_stats(view)
     except Exception:  # noqa: BLE001 — degraded, never broken
         return python_fleet_stats(view)
+
+
+def _xla_stats(view: FleetView) -> dict[str, Any]:
+    from .encode import encode_fleet
+    from .fleet_jax import rollup_to_dict
+
+    stats = rollup_to_dict(encode_fleet(view.nodes, view.pods))
     # Exact generation names (see _generation_counts): the device-side
     # histogram is fixed-vocabulary; the display histogram is not.
     stats["generation_counts"] = _generation_counts(view.nodes)

@@ -261,6 +261,18 @@ _FRACTION_METRICS = (
     "duty_cycle",
 )
 
+#: Per-series scale detection threshold. A genuine utilization fraction
+#: is bounded by 1.0, so a sample clearly above it can only come from a
+#: 0-100 exporter and the whole series is divided by 100 — including a
+#: near-idle 0-100 series (max 1.3 ⇒ 1.3%) that the old >1.5 cutoff
+#: left rendering as 130%. The margin above 1.0 is deliberately wide:
+#: Prometheus ``rate()`` extrapolation can overshoot a saturated 0-1
+#: chip past 1.0, and misreading that as percent would divide a
+#: saturated fleet by 100 (hiding saturation) — a far worse error than
+#: an idle percent-exporter in the residual (1.0, 1.2] band rendering
+#: as the clamped 100% (see format_percent).
+FRACTION_MAX = 1.2
+
 
 def fetch_tpu_metrics(
     transport: Transport,
@@ -312,13 +324,16 @@ def fetch_tpu_metrics(
                 break
         availability[logical] = bool(samples)
         # Scale is decided ONCE per resolved series, mirroring the
-        # range-query path (see fetch_utilization_history): per-sample
-        # normalization would leave an idle chip's 1.2 (meaning 1.2% on
-        # a 0-100 exporter) unscaled and render it as 120% utilization.
+        # range-query path (see fetch_utilization_history). A genuine
+        # utilization *fraction* cannot exceed 1.0, so any sample above
+        # FRACTION_MAX (1.0 plus rate-jitter allowance) proves a 0-100
+        # exporter — including a near-idle one reporting 1.2 meaning
+        # 1.2%. Only the (1.0, FRACTION_MAX] sliver stays ambiguous;
+        # the render-time clamp in format_percent bounds that residue.
         scale = 1.0
         if logical in _FRACTION_METRICS and samples:
             values = [v for v in map(_sample_value, samples) if v is not None]
-            if values and max(values) > 1.5:
+            if values and max(values) > FRACTION_MAX:
                 scale = 100.0
         for sample in samples:
             labels = _sample_labels(sample)
@@ -448,9 +463,11 @@ def fetch_utilization_history(
                 continue  # mostly-fabricated trace: skip, stay honest
             # Scale is decided ONCE per series: normalizing per sample
             # would mix scales within one trace from a 0-100 exporter
-            # (an idle 0.9% sample passes the >1.5 test unscaled while
-            # busy samples get divided), fabricating saturation.
-            scale = 100.0 if max(by_ts.values()) > 1.5 else 1.0
+            # (an idle 0.9% sample passing the threshold unscaled while
+            # busy samples get divided), fabricating saturation. Same
+            # FRACTION_MAX rule as the instant path: fractions cannot
+            # exceed 1.0, so anything above it proves a 0-100 exporter.
+            scale = 100.0 if max(by_ts.values()) > FRACTION_MAX else 1.0
             grid: list[float] = []
             last = next(iter(by_ts.values()))
             for i in range(n_samples):

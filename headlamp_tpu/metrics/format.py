@@ -12,10 +12,16 @@ def format_percent(fraction: float | None, digits: int = 1) -> str:
     """0.874 -> '87.4%'. None (metric unavailable) -> '—'. Values already
     in percent (>1.5) are assumed pre-scaled — the tpu-device-plugin and
     libtpu exporters disagree on 0-1 vs 0-100 scaling, so the formatter
-    normalizes rather than trusting either."""
+    normalizes rather than trusting either. The result is clamped to
+    [0, 100]: every caller formats a utilization/duty-cycle fraction,
+    which cannot legitimately exceed 100%. The clamp only bounds the
+    residue the client's per-series scale detection (FRACTION_MAX in
+    metrics.client) cannot resolve — rate jitter fractionally above 1.0
+    — so nothing real is hidden by it."""
     if fraction is None:
         return "—"
     pct = fraction * 100 if fraction <= 1.5 else fraction
+    pct = min(max(pct, 0.0), 100.0)
     return f"{pct:.{digits}f}%"
 
 

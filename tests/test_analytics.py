@@ -188,6 +188,38 @@ class TestServingPathStats:
         assert xla["per_node_in_use"] == py["per_node_in_use"]
         assert abs(xla["max_node_util_pct"] - py["max_node_util_pct"]) < 1e-3
 
+    def test_explicit_xla_pin_propagates_failures(self):
+        # ADVICE r2: backend="xla" must not silently degrade to the
+        # Python path — a parity test on a broken/jax-less host would
+        # then vacuously compare Python to itself and still pass.
+        from headlamp_tpu.analytics import fleet_jax
+        from headlamp_tpu.analytics.stats import fleet_stats
+
+        view = tpu_view(fx.fleet_v5p32())
+        original = fleet_jax.rollup_to_dict
+
+        def broken(encoded):
+            raise RuntimeError("rollup broken")
+
+        fleet_jax.rollup_to_dict = broken
+        try:
+            with pytest.raises(RuntimeError, match="rollup broken"):
+                fleet_stats(view, backend="xla")
+            # The default path still degrades gracefully.
+            assert fleet_stats(view)["nodes_total"] == 4
+        finally:
+            fleet_jax.rollup_to_dict = original
+
+    def test_explicit_xla_pin_rejects_non_tpu_provider(self):
+        # The pin must not silently serve the Python path for a provider
+        # the columnar encoding cannot represent.
+        from headlamp_tpu.analytics.stats import fleet_stats
+
+        fleet = fx.fleet_mixed()
+        intel_view = classify_fleet(fleet["nodes"], fleet["pods"])["intel"]
+        with pytest.raises(ValueError, match="unsupported for provider"):
+            fleet_stats(intel_view, backend="xla")
+
     def test_scale_dispatch_policy(self):
         from headlamp_tpu.analytics import stats as st
 

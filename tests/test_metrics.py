@@ -7,6 +7,8 @@ Prometheus `:97-98`) against mocked service-proxy routes.
 
 import urllib.parse
 
+import pytest
+
 from headlamp_tpu.metrics import (
     LOGICAL_METRICS,
     TpuMetricsSnapshot,
@@ -126,6 +128,37 @@ class TestFetchAndJoin:
         assert by_id["0"].tensorcore_utilization == 0.875
         assert by_id["1"].tensorcore_utilization == 0.012
 
+    def test_fully_idle_percent_exporter_still_rescaled(self):
+        # ADVICE r2: with every chip ≤1.5 the old >1.5 cutoff never
+        # fired and an idle 0-100 exporter's 1.3 (meaning 1.3%) rendered
+        # as 130%. Fractions cannot exceed 1.0 (+ jitter margin), so a
+        # 1.3 sample alone proves the series is percent-scaled.
+        t = make_prom_transport({
+            "tensorcore_utilization": [
+                ({"node": "n1", "accelerator_id": "0"}, 1.3),
+                ({"node": "n1", "accelerator_id": "1"}, 0.4),
+            ],
+        })
+        snap = fetch_tpu_metrics(t)
+        by_id = {c.accelerator_id: c for c in snap.chips}
+        assert by_id["0"].tensorcore_utilization == pytest.approx(0.013)
+        assert by_id["1"].tensorcore_utilization == pytest.approx(0.004)
+
+    def test_rate_jitter_above_one_does_not_rescale_fractions(self):
+        # A saturated 0-1 exporter overshooting 1.0 via rate()
+        # extrapolation must NOT be misread as percent-scaled — that
+        # would divide a saturated fleet by 100 and hide the saturation.
+        t = make_prom_transport({
+            "tensorcore_utilization": [
+                ({"node": "n1", "accelerator_id": "0"}, 1.06),
+                ({"node": "n1", "accelerator_id": "1"}, 0.98),
+            ],
+        })
+        snap = fetch_tpu_metrics(t)
+        by_id = {c.accelerator_id: c for c in snap.chips}
+        assert by_id["0"].tensorcore_utilization == 1.06  # clamped at render
+        assert by_id["1"].tensorcore_utilization == 0.98
+
     def test_fraction_scale_untouched_for_0_1_exporters(self):
         t = make_prom_transport({
             "tensorcore_utilization": [
@@ -189,6 +222,14 @@ class TestFormatters:
         assert format_percent(0.874) == "87.4%"
         assert format_percent(None) == "—"
         assert format_percent(87.4) == "87.4%"  # pre-scaled input
+
+    def test_format_percent_clamps_to_0_100(self):
+        # ADVICE r2: a fully idle 0-100 exporter defeats the per-series
+        # scale heuristic (all samples ≤1.5), so 1.2-meaning-1.2% would
+        # render as 120% — the render-time clamp bounds the damage.
+        assert format_percent(1.2) == "100.0%"
+        assert format_percent(120.0) == "100.0%"
+        assert format_percent(-0.1) == "0.0%"
 
     def test_normalize_fraction(self):
         assert normalize_fraction(0.5) == 0.5

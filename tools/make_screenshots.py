@@ -15,8 +15,19 @@ from __future__ import annotations
 import os
 import sys
 
+# Deterministic output is a contract: CI runs `git diff --exit-code
+# docs/screenshots/` after regenerating, so the same commit must produce
+# byte-identical SVGs everywhere. Three sources of nondeterminism are
+# pinned: the clock (fixed to the fixtures' epoch so Age cells never
+# change), the forecast fit (pinned fixture values — see pin_forecast),
+# and the wall-clock scrape timing (scrubbed in extract_capture). CPU
+# jax is forced so that even incidental jax imports cannot touch a
+# host's TPU during generation.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from headlamp_tpu.fleet.fixtures import FIXTURE_NOW_EPOCH  # noqa: E402
 from headlamp_tpu.server import DashboardApp, make_demo_transport  # noqa: E402
 
 OUT_DIR = os.path.join(
@@ -46,7 +57,49 @@ def extract_capture(page_html: str) -> str:
     match = re.search(r"<style>(.*?)</style>.*?<main>(.*)</main>", page_html, re.S)
     assert match, "page shell changed; update extract_capture"
     style, main = match.groups()
+    # Scrub the measured scrape→join wall-clock timing — the one part of
+    # a rendered page that legitimately differs between two identical
+    # runs. The fixed stand-in keeps the diagnostics line present in the
+    # capture without breaking byte-for-byte determinism.
+    main = re.sub(r"took [0-9.]+ ms", "took 12 ms", main)
     return f"<style>{style}</style><main>{main}</main>"
+
+
+def pin_forecast() -> None:
+    """Replace the live MLP forecast with pinned representative values.
+
+    The forecast section's numbers (and its peak-sorted row order) come
+    from a jax CPU fit; XLA numerics are not contractually stable across
+    jax releases, and CI regenerates these SVGs under `git diff
+    --exit-code`. So the screenshots render the REAL page/renderer with
+    *fixture* forecast outputs — the same philosophy as the reference's
+    page tests, which mock the data context and assert the real render
+    (`OverviewPage.test.tsx:67-80`)."""
+    from headlamp_tpu.models import service
+
+    def pinned_forecast(transport, metrics, *, clock=None):
+        if metrics is None or not metrics.chips:
+            return None
+        chips = []
+        for i, chip in enumerate(metrics.chips[:16]):
+            current = 0.35 + 0.05 * (i % 7)
+            peak = min(current + 0.18 + 0.03 * (i % 3), 0.97)
+            chips.append(
+                service.ChipForecast(
+                    node=chip.node,
+                    accelerator_id=chip.accelerator_id,
+                    current=round(current, 3),
+                    predicted_peak=round(peak, 3),
+                    predicted_mean=round((current + peak) / 2, 3),
+                    saturation_risk=peak * 100 >= service.SATURATION_PCT,
+                )
+            )
+        chips.sort(key=lambda c: -c.predicted_peak)
+        return service.ForecastView(
+            horizon_s=480, window_s=3600, chips=chips, fit_ms=120.0
+        )
+
+    service.compute_forecast = pinned_forecast
 
 
 def svg_wrap(body_html: str, height: int) -> str:
@@ -61,7 +114,12 @@ def svg_wrap(body_html: str, height: int) -> str:
 
 
 def main() -> None:
-    app = DashboardApp(make_demo_transport("v5p32"), min_sync_interval_s=0.0)
+    pin_forecast()
+    app = DashboardApp(
+        make_demo_transport("v5p32"),
+        min_sync_interval_s=0.0,
+        clock=lambda: FIXTURE_NOW_EPOCH,
+    )
     os.makedirs(OUT_DIR, exist_ok=True)
     for filename, route, height in CAPTURES:
         status, _, html = app.handle(route)
