@@ -4,8 +4,14 @@ The multi-provider generalization of the reference's context provider
 (`/root/reference/src/api/IntelGpuDataContext.tsx:96-252`, ADR-001/002):
 
 - **Reactive track**: node + all-namespace pod lists (the ``useList``
-  analogue, `:98-99`). Fetched on every sync; a failure leaves the
-  previous list in place and records the error stream.
+  analogue, `:98-99`). Fetched paginated on the first sync; with watch
+  enabled (``enable_watch`` — wired by the server's background sync),
+  later syncs poll a bounded ``watch=true&resourceVersion=`` delta
+  stream and apply ADDED/MODIFIED/DELETED events to the object stores,
+  re-listing only on 410 Gone or watch failure — the full list+watch
+  protocol behind the reference's ``useList``, so steady state moves
+  deltas, not the fleet. A failure leaves the previous list in place
+  and records the error stream.
 - **Imperative track**: per-provider workload objects (CRDs/DaemonSets)
   and plugin daemon pods via fallback chains with per-request timeouts,
   silent per-path failure, and UID dedup (`:113-190`). Workload-source
@@ -34,6 +40,12 @@ from ..domain.accelerator import PROVIDERS, FleetView, Provider, classify_fleet
 from ..transport.api_proxy import DEFAULT_TIMEOUT_S, ApiError, Transport
 from .sources import ProviderSource, default_sources, workload_matches_provider
 from .sources import NODES_PATH, PODS_PATH
+
+
+class _WatchExpired(Exception):
+    """The watch cursor predates the apiserver's retained window (410
+    Gone, delivered either as an HTTP status or an ERROR event) — the
+    protocol's signal to resync via full re-list."""
 
 
 @dataclass
@@ -134,6 +146,11 @@ class AcceleratorDataContext:
     #: tokens (200 pages × 500 = 100k objects — far beyond any fleet
     #: this dashboard targets).
     MAX_PAGES = 200
+    #: Server-side watch window (``timeoutSeconds=``): the apiserver
+    #: holds the bounded watch open this long collecting events before
+    #: closing the stream. Short, because each sync is a delta *poll* —
+    #: the background loop's interval provides the cadence.
+    WATCH_WINDOW_S = 1.0
 
     def __init__(
         self,
@@ -145,6 +162,7 @@ class AcceleratorDataContext:
         clock: Callable[[], float] = time.time,
         page_limit: int | None = None,
         pod_field_selector: str | None = None,
+        watch: bool = False,
     ):
         self._transport = transport
         self._providers = providers
@@ -156,11 +174,28 @@ class AcceleratorDataContext:
         #: drops Succeeded/Failed pods) — a fleet-scale option the
         #: reference's all-namespace useList has no analogue for.
         self._pod_field_selector = pod_field_selector
+        #: Incremental reactive syncs (list+watch). Off by default: a
+        #: one-shot CLI render or an infrequent inline sync gains nothing
+        #: from a delta protocol; the server's background loop turns it
+        #: on (`DashboardApp.start_background_sync`).
+        self._watch_enabled = watch
 
         self._all_nodes: list[Any] | None = None
         self._all_pods: list[Any] | None = None
         self._node_error: str | None = None
         self._pod_error: str | None = None
+        #: Per-track incremental state: object store (key → object,
+        #: insertion-ordered) and the watch cursor. An empty cursor means
+        #: no successful LIST yet — watch stays disarmed until one lands.
+        self._track_store: dict[str, dict[str, Any]] = {"nodes": {}, "pods": {}}
+        self._track_rv: dict[str, str] = {"nodes": "", "pods": ""}
+        #: Observability: how many full re-lists vs watch polls vs
+        #: applied events each track has seen (surfaced by /healthz
+        #: consumers and asserted by the watch tests).
+        self.watch_stats: dict[str, dict[str, int]] = {
+            "nodes": {"relists": 0, "watches": 0, "events": 0},
+            "pods": {"relists": 0, "watches": 0, "events": 0},
+        }
         self._workloads: dict[str, list[Any]] = {}
         self._workload_available: dict[str, bool] = {}
         self._fallback_plugin_pods: dict[str, list[Any]] = {}
@@ -172,7 +207,7 @@ class AcceleratorDataContext:
     # Track 1: reactive lists
     # ------------------------------------------------------------------
 
-    def _list_paginated(self, path: str) -> list[Any]:
+    def _list_paginated(self, path: str) -> tuple[list[Any], str]:
         """Full list via ``limit=N&continue=<token>`` chunks — the
         fleet-scale replacement for the reference's single unpaginated
         ``useList`` GET (`IntelGpuDataContext.tsx:98-99`): on a 1 000+
@@ -180,9 +215,13 @@ class AcceleratorDataContext:
         inside the per-request timeout, while every 500-object page can.
         Each page request gets the full ``timeout_s``. An expired
         continue token (apiserver answers 410 Gone) or any mid-chain
-        failure raises; the caller keeps the previous good list."""
+        failure raises; the caller keeps the previous good list. Returns
+        ``(items, resourceVersion)`` — the first page's list RV, which
+        pins the snapshot the continue chain reads and is the cursor a
+        subsequent watch resumes from."""
         items: list[Any] = []
         continue_token = ""
+        resource_version = ""
         sep = "&" if "?" in path else "?"
         for _ in range(self.MAX_PAGES):
             url = f"{path}{sep}limit={self._page_limit}"
@@ -195,8 +234,10 @@ class AcceleratorDataContext:
                 metadata = data.get("metadata")
                 if isinstance(metadata, Mapping):
                     continue_token = str(metadata.get("continue") or "")
+                    if not resource_version:
+                        resource_version = str(metadata.get("resourceVersion") or "")
             if not continue_token:
-                return items
+                return items, resource_version
         raise ApiError(path, f"list did not terminate within {self.MAX_PAGES} pages")
 
     def _pods_path(self) -> str:
@@ -208,17 +249,98 @@ class AcceleratorDataContext:
             )
         return PODS_PATH
 
+    def enable_watch(self, enabled: bool = True) -> None:
+        """Switch the reactive track to incremental list+watch syncs.
+        Takes effect on the next ``sync()``; the first one after a cold
+        start still pays a full LIST (there is no cursor yet)."""
+        self._watch_enabled = enabled
+
+    @staticmethod
+    def _obj_key(o: Any) -> str:
+        """Store key: UID when present (the identity Kubernetes dedups
+        by), name as the fixture-friendly fallback."""
+        return obj.uid(o) or obj.name(o)
+
+    def _watch_path(self, path: str, resource_version: str) -> str:
+        sep = "&" if "?" in path else "?"
+        return (
+            f"{path}{sep}watch=true"
+            f"&resourceVersion={urllib.parse.quote(resource_version, safe='')}"
+            f"&allowWatchBookmarks=true"
+            f"&timeoutSeconds={max(int(self.WATCH_WINDOW_S), 1)}"
+        )
+
+    def _apply_watch_events(self, track: str, events: list[Any]) -> int:
+        """Apply a watch response to the track's store. Returns the
+        number of object events applied. Raises :class:`_WatchExpired`
+        on a 410 ERROR event and :class:`ApiError` on any other ERROR —
+        both make the caller fall back to a full re-list."""
+        store = self._track_store[track]
+        applied = 0
+        for event in events:
+            if not isinstance(event, Mapping):
+                continue
+            etype = str(event.get("type", ""))
+            payload = event.get("object")
+            if etype == "ERROR":
+                code = payload.get("code") if isinstance(payload, Mapping) else None
+                if code == 410:
+                    raise _WatchExpired()
+                raise ApiError(track, f"watch ERROR event: {payload}")
+            if not isinstance(payload, Mapping):
+                continue
+            if etype in ("ADDED", "MODIFIED"):
+                store[self._obj_key(payload)] = payload
+                applied += 1
+            elif etype == "DELETED":
+                store.pop(self._obj_key(payload), None)
+                applied += 1
+            # Advance the cursor from every event (bookmarks included —
+            # that is their entire purpose: moving the cursor past quiet
+            # stretches so it cannot expire).
+            rv = obj.metadata(payload).get("resourceVersion")
+            if rv:
+                self._track_rv[track] = str(rv)
+        return applied
+
+    def _sync_track(self, track: str, path: str) -> str | None:
+        """Sync one reactive list; returns the error string for the
+        stream (or None). Incremental watch when enabled, armed (a prior
+        LIST recorded a cursor), and the transport supports it; full
+        paginated re-list otherwise — and as the fallback for ANY watch
+        failure, 410 Gone included, so a watch-incapable or degraded
+        server costs exactly the pre-watch behavior."""
+        stats = self.watch_stats[track]
+        watcher = getattr(self._transport, "watch", None)
+        if self._watch_enabled and watcher is not None and self._track_rv[track]:
+            try:
+                events = watcher(
+                    self._watch_path(path, self._track_rv[track]),
+                    self.WATCH_WINDOW_S + self._timeout_s,
+                )
+                applied = self._apply_watch_events(track, events)
+            except (_WatchExpired, ApiError):
+                pass  # fall through to the re-list below
+            else:
+                stats["watches"] += 1
+                stats["events"] += applied
+                return None
+        try:
+            items, resource_version = self._list_paginated(path)
+        except ApiError as e:
+            return f"{track}: {e}"
+        self._track_store[track] = {self._obj_key(o): o for o in items}
+        self._track_rv[track] = resource_version
+        stats["relists"] += 1
+        return None
+
     def _sync_reactive(self) -> None:
-        try:
-            self._all_nodes = self._list_paginated(NODES_PATH)
-            self._node_error = None
-        except ApiError as e:
-            self._node_error = f"nodes: {e}"
-        try:
-            self._all_pods = self._list_paginated(self._pods_path())
-            self._pod_error = None
-        except ApiError as e:
-            self._pod_error = f"pods: {e}"
+        self._node_error = self._sync_track("nodes", NODES_PATH)
+        if self._node_error is None:
+            self._all_nodes = list(self._track_store["nodes"].values())
+        self._pod_error = self._sync_track("pods", self._pods_path())
+        if self._pod_error is None:
+            self._all_pods = list(self._track_store["pods"].values())
 
     # ------------------------------------------------------------------
     # Track 2: imperative per-provider fetches

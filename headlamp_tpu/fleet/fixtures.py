@@ -331,11 +331,14 @@ def fleet_transport(fleet: dict[str, Any]):
     from ..transport.api_proxy import MockTransport
 
     t = MockTransport()
-    # add_list serves limit/continue pagination like the apiserver — the
-    # context always pages its reactive lists, so the fixture transport
-    # must speak the same protocol.
-    t.add_list("/api/v1/nodes", fleet["nodes"])
-    t.add_list("/api/v1/pods", fleet["pods"])
+    # Watchable lists serve limit/continue pagination like the apiserver
+    # plus the watch-delta protocol — the context always pages its
+    # reactive lists and, with watch enabled, polls deltas, so the
+    # fixture transport must speak both. The feeds are exposed on the
+    # transport (``t.node_feed`` / ``t.pod_feed``) for scenario tests
+    # that mutate the fleet mid-run.
+    t.node_feed = t.add_watchable_list("/api/v1/nodes", fleet["nodes"])
+    t.pod_feed = t.add_watchable_list("/api/v1/pods", fleet["pods"])
     t.add(
         "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin",
         {"kind": "List", "items": fleet.get("daemonsets", [])},

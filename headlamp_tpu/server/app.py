@@ -57,7 +57,7 @@ class DashboardApp:
         pod_field_selector: str | None = None,
     ):
         self._ctx = AcceleratorDataContext(
-            transport, pod_field_selector=pod_field_selector
+            transport, pod_field_selector=pod_field_selector, clock=clock
         )
         self._transport = transport
         self._registry = registry if registry is not None else register_plugin()
@@ -92,6 +92,16 @@ class DashboardApp:
         #: (see start_background_sync) — its liveness suppresses inline
         #: syncs on the request path.
         self._background_stop: threading.Event | None = None
+        #: Wakes the background loop early — set by /refresh so a manual
+        #: refresh shortens the reactive track's staleness to one sync,
+        #: not one full interval.
+        self._background_wake = threading.Event()
+        self._background_interval: float | None = None
+        #: Consecutive syncs that raised or produced an errors-bearing
+        #: snapshot. Written by whichever path syncs (background loop or
+        #: inline); read racily by /healthz — int updates are atomic
+        #: enough for a health probe.
+        self._sync_failures = 0
 
     @property
     def registry(self) -> Registry:
@@ -105,21 +115,47 @@ class DashboardApp:
         Event (the thread is a daemon either way). Sync failures are
         absorbed — the next tick retries, and the request path's own
         coalesced sync still works."""
-        stop = threading.Event()
+        wake = self._background_wake
+        ctx = self._ctx
+
+        class _StopEvent(threading.Event):
+            """Setting stop also wakes the loop so it exits promptly
+            instead of sleeping out the rest of the interval, and turns
+            watch mode back off — the re-enabled inline request-path
+            sync must cost fast LISTs, not two full server-side watch
+            windows per page view."""
+
+            def set(self) -> None:  # noqa: A003 (threading.Event API)
+                super().set()
+                ctx.enable_watch(False)
+                wake.set()
+
+        stop = _StopEvent()
         interval = interval_s if interval_s is not None else max(self._min_sync, 1.0)
+        self._background_interval = interval
+        # Steady-state background syncing transfers watch deltas, not
+        # the whole fleet — see AcceleratorDataContext.enable_watch.
+        self._ctx.enable_watch()
 
         def sync_once() -> None:
             try:
                 with self._lock:
                     self._ctx.sync()
                     self._last_sync = self._clock()
-                    self._last_snapshot = self._ctx.snapshot()
+                    snap = self._ctx.snapshot()
+                    self._last_snapshot = snap
             except Exception:  # noqa: BLE001 — keep the heartbeat alive
-                pass
+                self._record_sync(None)
+            else:
+                self._record_sync(snap)
 
         def loop() -> None:
             sync_once()  # hydrate immediately; first page view must not block
-            while not stop.wait(interval):
+            while True:
+                wake.wait(interval)
+                wake.clear()
+                if stop.is_set():
+                    return
                 sync_once()
 
         # While the thread runs, page views never sync inline — that is
@@ -130,18 +166,53 @@ class DashboardApp:
         threading.Thread(target=loop, daemon=True, name="hl-tpu-sync").start()
         return stop
 
+    def _record_sync(self, snap: Any) -> None:
+        """Track consecutive failing syncs for /healthz. A sync counts as
+        failed when it raised (snap is None) or when its snapshot carries
+        reactive-track errors — transport failures never raise out of
+        ``ctx.sync()`` (they degrade into ``snapshot.errors``), so the
+        error streams ARE the failure signal."""
+        if snap is not None and not snap.errors:
+            self._sync_failures = 0
+        else:
+            self._sync_failures += 1
+
+    def _background_live(self) -> bool:
+        return self._background_stop is not None and not self._background_stop.is_set()
+
     def _synced_snapshot(self):
-        background_live = (
-            self._background_stop is not None and not self._background_stop.is_set()
-        )
+        # With background sync live, page views read the atomically
+        # published snapshot WITHOUT taking the sync lock: the loop
+        # holds self._lock across each tick, and with watch enabled a
+        # tick spans the bounded watch windows (seconds against a real
+        # apiserver) — a page view must never stall behind that.
+        if self._background_live():
+            snap = self._last_snapshot
+            if snap is not None:
+                return snap
+            # Not yet hydrated: fall through and build one under the
+            # lock (races the loop's first tick harmlessly — ctx.sync
+            # and snapshot builds are serialized by the lock).
         with self._lock:
             now = self._clock()
-            if not background_live and now - self._last_sync >= self._min_sync:
+            if not self._background_live() and now - self._last_sync >= self._min_sync:
                 self._ctx.sync()
                 self._last_sync = now
-            snap = self._ctx.snapshot()
+                snap = self._ctx.snapshot()
+                self._record_sync(snap)
+            else:
+                snap = self._ctx.snapshot()
             self._last_snapshot = snap
             return snap
+
+    #: Consecutive failing syncs at which /healthz flips ``ok`` to false
+    #: — one blip must not restart a pod, a persistent failure must not
+    #: hide behind a hard-coded ``"ok": true``.
+    HEALTH_FAILURE_THRESHOLD = 3
+    #: With background sync live, a snapshot older than this many
+    #: intervals means the loop is wedged (thread died, sync hanging) —
+    #: also flips ``ok`` even when no individual sync reported failure.
+    HEALTH_MAX_STALE_INTERVALS = 3.0
 
     #: Forecast results are cached this long — the history grid only
     #: gains a point per step anyway, and the fit (jax compile + scan)
@@ -249,22 +320,52 @@ class DashboardApp:
             # sync may be mid-mutation (nodes updated, workloads not
             # yet), and a half-synced snapshot must not get cached.
             snap = self._last_snapshot
+            failures = self._sync_failures
+            failing = failures >= self.HEALTH_FAILURE_THRESHOLD
+            background = self._background_live()
             if snap is None:
-                body = json.dumps({"ok": True, "loading": True, "errors": []})
+                body = json.dumps(
+                    {
+                        "ok": not failing,
+                        "loading": True,
+                        "errors": [],
+                        "consecutive_sync_failures": failures,
+                        "background_sync": background,
+                    }
+                )
                 return 200, "application/json", body
+            age = max(self._clock() - snap.fetched_at, 0.0)
+            interval = self._background_interval
+            wedged = (
+                background
+                and interval is not None
+                and age > self.HEALTH_MAX_STALE_INTERVALS * interval
+            )
             body = json.dumps(
                 {
-                    "ok": True,
+                    "ok": not failing and not wedged,
                     "loading": snap.loading,
                     "errors": snap.errors,
                     "fetched_at": snap.fetched_at,
+                    "last_sync_age_s": round(age, 3),
+                    "consecutive_sync_failures": failures,
+                    "background_sync": background,
                 }
             )
             return 200, "application/json", body
 
         if route_path == "/refresh":
-            with self._lock:
-                self._ctx.refresh()
+            # With background sync live, waking the loop covers BOTH
+            # tracks (its sync() runs reactive + imperative) and the
+            # redirect never waits on the sync lock — which the loop
+            # holds across whole ticks, watch windows included. Without
+            # it, run the imperative refresh inline as the reference's
+            # refreshKey effect does (`IntelGpuDataContext.tsx:109-111`).
+            if self._background_live():
+                self._background_wake.set()
+            else:
+                with self._lock:
+                    self._ctx.refresh()
             # Manual refresh also invalidates the metrics + forecast
             # caches — the user is explicitly asking for fresh data, and
             # serving a cached Prometheus view from before the click

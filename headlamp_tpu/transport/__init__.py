@@ -13,6 +13,8 @@ from .api_proxy import (
     MockTransport,
     RequestTimeout,
     Transport,
+    WatchFeed,
+    WatchTransport,
     with_timeout,
 )
 
@@ -22,5 +24,7 @@ __all__ = [
     "MockTransport",
     "RequestTimeout",
     "Transport",
+    "WatchFeed",
+    "WatchTransport",
     "with_timeout",
 ]

@@ -57,6 +57,26 @@ class Transport(Protocol):
         ...
 
 
+class WatchTransport(Protocol):
+    """Optional transport extension: bounded Kubernetes watch.
+
+    A watch request (``?watch=true&resourceVersion=N&timeoutSeconds=S``)
+    is a normal GET whose body is newline-delimited JSON events the
+    apiserver streams until ``timeoutSeconds`` elapses — so a
+    request/response transport can serve it as a *batch delta poll*:
+    collect the whole bounded stream, return the parsed events. The
+    context degrades to full re-lists when a transport lacks this method
+    (checked with ``hasattr``, mirroring how the reference only gets
+    live updates where the SDK provides ``useList``'s watch)."""
+
+    def watch(self, path: str, timeout_s: float = DEFAULT_TIMEOUT_S) -> list[Any]:
+        """GET a bounded watch stream; return its parsed event objects
+        (``{"type": "ADDED"|"MODIFIED"|"DELETED"|"BOOKMARK"|"ERROR",
+        "object": {...}}``) in arrival order. Raises :class:`ApiError`
+        on transport failure (HTTP 410 ⇒ the caller must re-list)."""
+        ...
+
+
 def with_timeout(fn: Callable[[], Any], timeout_s: float, path: str = "") -> Any:
     """Run ``fn`` with a hard wall-clock cap — the reference's
     ``withTimeout`` (`IntelGpuDataContext.tsx:72-82`). On expiry raises
@@ -133,6 +153,8 @@ class KubeTransport:
         url = self.base_url + (path if path.startswith("/") else "/" + path)
 
         def do_request() -> Any:
+            import http.client
+
             req = urllib.request.Request(url, headers=self._headers)
             try:
                 with urllib.request.urlopen(
@@ -143,12 +165,149 @@ class KubeTransport:
                 raise ApiError(path, f"HTTP {e.code}", status=e.code) from e
             except urllib.error.URLError as e:
                 raise ApiError(path, str(e.reason)) from e
+            except (OSError, http.client.HTTPException) as e:
+                # A response cut mid-read (reset, truncated chunk) is a
+                # transport failure like any other — callers must see
+                # ApiError, never a raw socket exception.
+                raise ApiError(path, f"read failed: {e}") from e
             try:
                 return json.loads(body)
             except json.JSONDecodeError as e:
                 raise ApiError(path, f"invalid JSON: {e}") from e
 
         return with_timeout(do_request, timeout_s, path)
+
+    def watch(self, path: str, timeout_s: float = DEFAULT_TIMEOUT_S) -> list[Any]:
+        """Bounded watch: read the NDJSON event stream until the server
+        closes it (it will, after the ``timeoutSeconds`` the caller put
+        in ``path``). ``timeout_s`` is the *client* budget and must
+        exceed the server-side window — the caller owns that margin."""
+        url = self.base_url + (path if path.startswith("/") else "/" + path)
+
+        def do_request() -> list[Any]:
+            import http.client
+
+            req = urllib.request.Request(url, headers=self._headers)
+            events: list[Any] = []
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=timeout_s, context=self._ssl_context
+                ) as resp:
+                    for raw in resp:
+                        line = raw.strip()
+                        if not line:
+                            continue
+                        events.append(json.loads(line))
+            except urllib.error.HTTPError as e:
+                raise ApiError(path, f"HTTP {e.code}", status=e.code) from e
+            except urllib.error.URLError as e:
+                raise ApiError(path, str(e.reason)) from e
+            except (OSError, http.client.HTTPException) as e:
+                # Long-lived watch streams get cut mid-body far more
+                # often than short GETs complete abnormally: a reset or
+                # truncated chunk raises ConnectionResetError /
+                # IncompleteRead here, and it must surface as ApiError
+                # so the context's fall-back-to-relist path engages.
+                raise ApiError(path, f"watch stream failed: {e}") from e
+            except json.JSONDecodeError as e:
+                raise ApiError(path, f"invalid watch JSON: {e}") from e
+            return events
+
+        return with_timeout(do_request, timeout_s, path)
+
+
+class WatchFeed:
+    """Mock apiserver state for one watchable list: current objects plus
+    a bounded event log keyed by resourceVersion. Tests and the demo
+    server mutate it with :meth:`push`; the paginated LIST response and
+    the watch-delta response both derive from it, so a context driven
+    against it sees exactly the list+watch protocol contract (including
+    410 Gone after :meth:`compact`)."""
+
+    def __init__(self, items: list[Any], resource_version: int = 1000):
+        self._items: dict[str, Any] = {}
+        for item in items:
+            self._items[self._uid(item)] = item
+        self.resource_version = int(resource_version)
+        #: (resource_version, event) pairs, oldest first.
+        self.events: list[tuple[int, dict]] = []
+        #: Oldest resourceVersion still replayable; watches asking for
+        #: anything older get the apiserver's 410 Gone ERROR event.
+        self.oldest_retained = int(resource_version)
+
+    @staticmethod
+    def _uid(item: Any) -> str:
+        metadata = item.get("metadata", {}) if isinstance(item, Mapping) else {}
+        return str(metadata.get("uid") or metadata.get("name") or id(item))
+
+    def push(self, event_type: str, obj: Any) -> None:
+        """Record an ADDED/MODIFIED/DELETED/BOOKMARK event; object
+        events also apply to the current state (BOOKMARK only advances
+        the resourceVersion, exactly like the apiserver's)."""
+        self.resource_version += 1
+        if event_type == "DELETED":
+            self._items.pop(self._uid(obj), None)
+        elif event_type != "BOOKMARK":
+            self._items[self._uid(obj)] = obj
+        self.events.append((self.resource_version, {"type": event_type, "object": obj}))
+
+    def compact(self) -> None:
+        """Forget the event log — subsequent watches from any older
+        resourceVersion get 410 Gone, forcing the client's re-list path
+        (the apiserver does this when its watch cache window expires)."""
+        self.oldest_retained = self.resource_version
+        self.events.clear()
+
+    def list_response(self, req_path: str) -> Any:
+        """Kubernetes LIST honoring ``limit``/``continue`` pagination,
+        stamped with the feed's current resourceVersion."""
+        import urllib.parse
+
+        items = list(self._items.values())
+        query = urllib.parse.parse_qs(urllib.parse.urlparse(req_path).query)
+        limit = int(query.get("limit", ["0"])[0] or 0)
+        metadata: dict[str, Any] = {"resourceVersion": str(self.resource_version)}
+        if not limit:
+            return {"kind": "List", "metadata": metadata, "items": items}
+        offset = int(query.get("continue", ["0"])[0] or 0)
+        page = items[offset : offset + limit]
+        next_offset = offset + limit
+        if next_offset < len(items):
+            metadata["continue"] = str(next_offset)
+        return {"kind": "List", "metadata": metadata, "items": page}
+
+    def events_since(self, resource_version: str) -> list[Any]:
+        """The watch response for ``resourceVersion=N``: every event
+        newer than N, or a single 410 ERROR event when N predates the
+        retained window."""
+        try:
+            rv = int(resource_version)
+        except (TypeError, ValueError):
+            rv = 0
+        if rv < self.oldest_retained:
+            return [
+                {
+                    "type": "ERROR",
+                    "object": {
+                        "kind": "Status",
+                        "code": 410,
+                        "reason": "Expired",
+                        "message": f"too old resource version: {rv}",
+                    },
+                }
+            ]
+        out: list[Any] = []
+        for ev_rv, event in self.events:
+            if ev_rv <= rv:
+                continue
+            # Stamp each event object's resourceVersion the way the
+            # apiserver does — clients advance their cursor from it.
+            obj = dict(event["object"]) if isinstance(event["object"], Mapping) else {}
+            metadata = dict(obj.get("metadata", {}))
+            metadata["resourceVersion"] = str(ev_rv)
+            obj["metadata"] = metadata
+            out.append({"type": event["type"], "object": obj})
+        return out
 
 
 class MockTransport:
@@ -171,7 +330,9 @@ class MockTransport:
         self._prefix_routes: list[tuple[str, Any]] = []
         self._list_routes: dict[str, Any] = {}
         self._overrides: list[tuple[str, Any]] = []
+        self._watch_feeds: dict[str, WatchFeed] = {}
         self.calls: list[str] = []
+        self.watch_calls: list[str] = []
 
     def add(self, path: str, response: Any) -> None:
         self.routes[path] = response
@@ -224,6 +385,41 @@ class MockTransport:
             return {"kind": "List", "metadata": metadata, "items": page}
 
         self._list_routes[path] = respond
+
+    def add_watchable_list(
+        self, path: str, items: list[Any], resource_version: int = 1000
+    ) -> WatchFeed:
+        """Serve ``path`` as a live list+watch source: LIST requests get
+        paginated responses stamped with the feed's resourceVersion,
+        watch requests get the deltas pushed since the requested cursor.
+        Returns the :class:`WatchFeed` — mutate it with ``push``/
+        ``compact`` to drive the scenario."""
+        feed = WatchFeed(items, resource_version)
+        self._list_routes[path] = feed.list_response
+        self._watch_feeds[path] = feed
+        return feed
+
+    def watch(self, path: str, timeout_s: float = DEFAULT_TIMEOUT_S) -> list[Any]:
+        """Watch requests route like any other (overrides and exact
+        routes can inject failures), then fall through to the registered
+        :class:`WatchFeed` for the endpoint. No feed ⇒ 404, matching an
+        apiserver that has the resource but this mock wasn't told to
+        watch — callers must treat it as 'watch unsupported, re-list'."""
+        import urllib.parse
+
+        self.watch_calls.append(path)
+        for prefix, response in reversed(self._overrides):
+            if path.startswith(prefix):
+                return self._resolve(path, response)
+        if path in self.routes:
+            return self._resolve(path, self.routes[path])
+        parsed = urllib.parse.urlparse(path)
+        feed = self._watch_feeds.get(parsed.path)
+        if feed is not None:
+            query = urllib.parse.parse_qs(parsed.query)
+            rv = query.get("resourceVersion", ["0"])[0]
+            return feed.events_since(rv)
+        raise ApiError(path, "HTTP 404", status=404)
 
     def _match_list_route(self, path: str) -> Any | None:
         import urllib.parse
