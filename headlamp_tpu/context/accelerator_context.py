@@ -335,10 +335,20 @@ class AcceleratorDataContext:
         return None
 
     def _sync_reactive(self) -> None:
-        self._node_error = self._sync_track("nodes", NODES_PATH)
+        # The two tracks are independent (separate stores, cursors,
+        # error streams) and run concurrently: with watch enabled a
+        # quiet bounded watch blocks its full server-side window, and
+        # serial polls would double every tick's duration — and the
+        # sync-lock hold time the server's request path can stall on.
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="hl-tpu-reactive"
+        ) as pool:
+            nodes_future = pool.submit(self._sync_track, "nodes", NODES_PATH)
+            pods_future = pool.submit(self._sync_track, "pods", self._pods_path())
+            self._node_error = nodes_future.result()
+            self._pod_error = pods_future.result()
         if self._node_error is None:
             self._all_nodes = list(self._track_store["nodes"].values())
-        self._pod_error = self._sync_track("pods", self._pods_path())
         if self._pod_error is None:
             self._all_pods = list(self._track_store["pods"].values())
 

@@ -36,10 +36,10 @@ from ..ui import (
 from ..ui.vdom import Element
 from .native import node_link, pod_link
 from .common import (
-    NODES_TABLE_CAP,
     age_cell,
     cap_nodes_for_cards,
     error_banner,
+    filter_and_page_nodes,
     phase_label,
     pods_by_node,
     ready_label,
@@ -279,7 +279,9 @@ def intel_device_plugins_page(snap: ClusterSnapshot, *, now: float) -> Element:
     return h("div", {"class_": "hl-page hl-intel-plugins"}, children)
 
 
-def intel_nodes_page(snap: ClusterSnapshot, *, now: float) -> Element:
+def intel_nodes_page(
+    snap: ClusterSnapshot, *, now: float, page: int = 1, query: str = ""
+) -> Element:
     """(`NodesPage.tsx`: summary `:252-282`, alloc bar `:35-63`, cards
     `:69-139`, empty state `:228-249`.)"""
     if snap.loading:
@@ -312,11 +314,16 @@ def intel_nodes_page(snap: ClusterSnapshot, *, now: float) -> Element:
         )
         return UtilizationBar(in_use, intel.get_node_gpu_allocatable(node), unit="GPUs")
 
-    table_nodes, table_hint = cap_nodes_for_cards(
-        state.nodes, NODES_TABLE_CAP, "node rows"
+    table_nodes, table_controls = filter_and_page_nodes(
+        state.nodes,
+        page=page,
+        query=query,
+        base_url="/intel/nodes",
+        what="Intel GPU nodes",
     )
     summary = SectionBox(
         "Intel GPU Nodes",
+        table_controls,
         SimpleTable(
             [
                 {"label": "Name", "getter": node_link},
@@ -335,7 +342,6 @@ def intel_nodes_page(snap: ClusterSnapshot, *, now: float) -> Element:
             ],
             table_nodes,
         ),
-        table_hint,
     )
 
     shown, truncation = cap_nodes_for_cards(state.nodes)

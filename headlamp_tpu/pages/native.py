@@ -33,10 +33,9 @@ if TYPE_CHECKING:  # registration imports pages/* — avoid the cycle
     from ..registration import Registry
 from ..ui.vdom import Element
 from .common import (
-    NODES_TABLE_CAP,
     age_cell,
-    cap_nodes_for_cards,
     error_banner,
+    filter_and_page_nodes,
     phase_label,
     ready_label,
 )
@@ -92,10 +91,18 @@ def _not_found(kind: str, name: str) -> Element:
 
 
 def native_nodes_page(
-    snap: ClusterSnapshot, *, now: float, registry: Registry
+    snap: ClusterSnapshot,
+    *,
+    now: float,
+    registry: Registry,
+    page: int = 1,
+    query: str = "",
 ) -> Element:
     """All cluster nodes with base columns + processor columns — the
-    native nodes table both providers' processors extend."""
+    native nodes table both providers' processors extend. Paged and
+    name-filterable (``?page=N&q=…``) so every row of a 1024-node fleet
+    is reachable — the capability Headlamp's native table gives the
+    reference for free."""
     if snap.loading:
         return h("div", {"class_": "hl-page hl-native-nodes"}, Loader())
 
@@ -111,8 +118,8 @@ def native_nodes_page(
         if proc.table_id == NODES_TABLE_ID:
             columns.extend(proc.build_columns())
 
-    nodes, hint = cap_nodes_for_cards(
-        list(snap.all_nodes or []), NODES_TABLE_CAP, "node rows"
+    nodes, controls = filter_and_page_nodes(
+        list(snap.all_nodes or []), page=page, query=query, base_url="/nodes"
     )
     return h(
         "div",
@@ -120,8 +127,14 @@ def native_nodes_page(
         error_banner(snap),
         SectionBox(
             "Nodes",
-            SimpleTable(columns, nodes, empty_message="No nodes in the cluster"),
-            hint,
+            controls,
+            SimpleTable(
+                columns,
+                nodes,
+                empty_message="No nodes match"
+                if query
+                else "No nodes in the cluster",
+            ),
         ),
     )
 

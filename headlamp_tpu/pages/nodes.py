@@ -25,10 +25,10 @@ from ..ui import (
 from ..ui.vdom import Element
 from .native import node_link
 from .common import (
-    NODES_TABLE_CAP,
     age_cell,
     cap_nodes_for_cards,
     error_banner,
+    filter_and_page_nodes,
     pods_by_node,
     ready_label,
 )
@@ -46,7 +46,12 @@ def _node_allocation(node: Any, node_pods: list[Any]) -> tuple[int, int]:
 
 
 def nodes_page(
-    snap: ClusterSnapshot, *, now: float, provider_name: str = "tpu"
+    snap: ClusterSnapshot,
+    *,
+    now: float,
+    provider_name: str = "tpu",
+    page: int = 1,
+    query: str = "",
 ) -> Element:
     if snap.loading:
         return h("div", {"class_": "hl-page hl-nodes"}, Loader())
@@ -75,13 +80,15 @@ def nodes_page(
         in_use, allocatable = _node_allocation(node, by_node.get(obj.name(node), []))
         return UtilizationBar(in_use, allocatable, unit="chips")
 
-    # The summary table is capped too (rows are lighter than cards but
-    # 1024 of them still unbounds the response).
-    table_nodes, table_hint = cap_nodes_for_cards(
-        state.nodes, NODES_TABLE_CAP, "node rows"
+    # The summary table is paged + name-filterable past the cap (rows
+    # are lighter than cards but 1024 of them still unbounds the
+    # response, and a cap alone made the tail unreachable).
+    table_nodes, table_controls = filter_and_page_nodes(
+        state.nodes, page=page, query=query, base_url="/tpu/nodes", what="TPU nodes"
     )
     summary = SectionBox(
         "TPU Nodes",
+        table_controls,
         SimpleTable(
             [
                 {"label": "Name", "getter": node_link},
@@ -101,7 +108,6 @@ def nodes_page(
             ],
             table_nodes,
         ),
-        table_hint,
     )
 
     # Per-node detail cards (`NodesPage.tsx:69-139,285-291`), capped

@@ -59,6 +59,9 @@ class Route:
     #: 'snapshot' pages take (snap, now=…); 'metrics' takes the metrics
     #: snapshot; 'topology' takes (snap).
     kind: str = "snapshot"
+    #: True for routes whose component accepts ``page=``/``query=`` —
+    #: the big node tables. Hosts forward ?page=N&q=… only to these.
+    paged: bool = False
 
 
 @dataclass(frozen=True)
@@ -153,13 +156,13 @@ def register_plugin(registry: Registry | None = None) -> Registry:
     reg.routes.extend(
         [
             Route("/tpu", "tpu-overview", overview_page),
-            Route("/tpu/nodes", "tpu-nodes", nodes_page),
+            Route("/tpu/nodes", "tpu-nodes", nodes_page, paged=True),
             Route("/tpu/pods", "tpu-pods", pods_page),
             Route("/tpu/deviceplugins", "tpu-deviceplugins", device_plugins_page),
             Route("/tpu/topology", "tpu-topology", topology_page, kind="topology"),
             Route("/tpu/metrics", "tpu-metrics", metrics_page, kind="metrics"),
             Route("/intel", "intel-overview", intel_overview_page),
-            Route("/intel/nodes", "intel-nodes", intel_nodes_page),
+            Route("/intel/nodes", "intel-nodes", intel_nodes_page, paged=True),
             Route("/intel/pods", "intel-pods", intel_pods_page),
             Route(
                 "/intel/deviceplugins",
@@ -172,7 +175,13 @@ def register_plugin(registry: Registry | None = None) -> Registry:
                 intel_metrics_page,
                 kind="intel-metrics",
             ),
-            Route("/nodes", "cluster-nodes", native_nodes_page, kind="native-nodes"),
+            Route(
+                "/nodes",
+                "cluster-nodes",
+                native_nodes_page,
+                kind="native-nodes",
+                paged=True,
+            ),
         ]
     )
 

@@ -115,19 +115,29 @@ class DashboardApp:
         Event (the thread is a daemon either way). Sync failures are
         absorbed — the next tick retries, and the request path's own
         coalesced sync still works."""
-        wake = self._background_wake
-        ctx = self._ctx
+        # Restarting replaces any live loop: stop it first so two loops
+        # never share the context, and give the new loop its OWN wake
+        # event — an orphaned old loop must not consume a /refresh wake
+        # meant for the current one.
+        if self._background_live():
+            self._background_stop.set()
+        wake = threading.Event()
+        self._background_wake = wake
+        app = self
 
         class _StopEvent(threading.Event):
             """Setting stop also wakes the loop so it exits promptly
-            instead of sleeping out the rest of the interval, and turns
-            watch mode back off — the re-enabled inline request-path
-            sync must cost fast LISTs, not two full server-side watch
-            windows per page view."""
+            instead of sleeping out the rest of the interval, and —
+            only while this is still the ACTIVE loop's stop handle —
+            turns watch mode back off, because the re-enabled inline
+            request-path sync must cost fast LISTs, not two full
+            server-side watch windows per page view. A stale handle's
+            set() must not degrade a newer live loop."""
 
             def set(self) -> None:  # noqa: A003 (threading.Event API)
                 super().set()
-                ctx.enable_watch(False)
+                if app._background_stop is self:
+                    app._ctx.enable_watch(False)
                 wake.set()
 
         stop = _StopEvent()
@@ -213,6 +223,12 @@ class DashboardApp:
     #: intervals means the loop is wedged (thread died, sync hanging) —
     #: also flips ``ok`` even when no individual sync reported failure.
     HEALTH_MAX_STALE_INTERVALS = 3.0
+    #: Staleness floor for the wedged check: a tick legitimately spans
+    #: the two bounded watch windows plus imperative-track fetches, so
+    #: at small intervals ``intervals × interval`` alone would flap
+    #: ok:false on a healthy cluster mid-tick. Wedged detection can
+    #: afford to be slow; liveness flapping cannot.
+    HEALTH_MIN_STALE_S = 30.0
 
     #: Forecast results are cached this long — the history grid only
     #: gains a point per step anyway, and the fit (jax compile + scan)
@@ -339,7 +355,11 @@ class DashboardApp:
             wedged = (
                 background
                 and interval is not None
-                and age > self.HEALTH_MAX_STALE_INTERVALS * interval
+                and age
+                > max(
+                    self.HEALTH_MAX_STALE_INTERVALS * interval,
+                    self.HEALTH_MIN_STALE_S,
+                )
             )
             body = json.dumps(
                 {
@@ -418,6 +438,17 @@ class DashboardApp:
 
         snap = self._synced_snapshot()
         now = self._clock()
+        paging: dict[str, Any] = {}
+        if route.paged:
+            params = parse_qs(parsed.query)
+            try:
+                paging["page"] = int(params.get("page", ["1"])[0])
+            except ValueError:
+                paging["page"] = 1
+            # The query is render-escaped downstream like any other
+            # cluster string; cap its length so a hostile URL cannot
+            # make the substring filter arbitrarily expensive.
+            paging["query"] = params.get("q", [""])[0][:253]
         if route.kind == "metrics":
             metrics = self._cached_metrics()
             forecast = self._forecast_for(metrics)
@@ -431,9 +462,9 @@ class DashboardApp:
         elif route.kind == "topology":
             el = route.component(snap)
         elif route.kind == "native-nodes":
-            el = route.component(snap, now=now, registry=self._registry)
+            el = route.component(snap, now=now, registry=self._registry, **paging)
         else:
-            el = route.component(snap, now=now)
+            el = route.component(snap, now=now, **paging)
         return 200, "text/html", self._page_html(route.name, render_html(el), route_path)
 
     def _page_html(self, title: str, body: str, active: str = "") -> str:

@@ -53,6 +53,13 @@ main { max-width:1100px; margin:0 auto; padding:16px; }
 .hl-pctbar-legend { color:var(--muted); font-size:12px; display:flex; gap:12px;
                     margin-top:4px; }
 .hl-hint { color:var(--muted); font-size:12px; }
+.hl-table-controls { display:flex; align-items:center; gap:16px; flex-wrap:wrap;
+                     margin:4px 0 8px; }
+.hl-filter-form { display:flex; gap:6px; }
+.hl-filter-form input { padding:3px 8px; border:1px solid #c5ced6;
+                        border-radius:4px; font-size:13px; }
+.hl-filter-form button { padding:3px 10px; border:1px solid #c5ced6;
+                         border-radius:4px; background:#fff; cursor:pointer; }
 .hl-loader { padding:30px; text-align:center; color:var(--muted); }
 .hl-mesh-grid { margin:10px 0; }
 .hl-mesh-cell { position:absolute; border-radius:4px; border:1px solid #fff; }

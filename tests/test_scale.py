@@ -59,8 +59,49 @@ class TestThousandNodeFleet:
         assert body.count("hl-node-card") <= 64
         assert "Showing 64 of" in body
         # The summary table is bounded too — the card cap alone would
-        # leave the response O(fleet).
-        assert "Showing 512 of" in body
+        # leave the response O(fleet) — but paged, not truncated: every
+        # row stays reachable (VERDICT r2 weak #3).
+        assert "page 1 of 2" in body
+
+    def test_nodes_tables_page_and_filter(self):
+        """VERDICT r2 item 5 acceptance: at the 1024-node fixture, page
+        2 is reachable and the name filter works — on the native /nodes
+        table and the TPU summary table alike."""
+        fleet = fx.fleet_large(1024)
+        app = DashboardApp(fx.fleet_transport(fleet), min_sync_interval_s=0.0)
+
+        _, _, page1 = app.handle("/nodes")
+        assert "page 1 of 2" in page1
+        _, _, page2 = app.handle("/nodes?page=2")
+        assert "page 2 of 2" in page2
+        # The two pages partition the fleet: page 2 rows are the ones
+        # past the first 512, absent from page 1.
+        row = '<a href="/node/'
+        assert page1.count(row) == 512
+        assert 0 < page2.count(row) <= 512
+        # Page-2 sample row is not on page 1.
+        import re
+
+        sample = re.search(r'<a href="(/node/[a-z0-9.-]+)"', page2).group(1)
+        assert sample not in page1
+
+        # Name filter reaches a specific node from either table host.
+        from headlamp_tpu.domain import objects as obj
+
+        target = obj.name(fleet["nodes"][700])
+        _, _, filtered = app.handle(f"/nodes?q={target}")
+        assert f'<a href="/node/{target}"' in filtered
+        assert "matching" in filtered
+        _, _, tpu_filtered = app.handle(f"/tpu/nodes?q={target}")
+        assert f'<a href="/node/{target}"' in tpu_filtered
+
+        # A miss shows the filtered empty state, not the whole fleet.
+        _, _, none = app.handle("/nodes?q=no-such-node-xyz")
+        assert none.count(row) == 0
+
+        # Out-of-range page clamps instead of erroring.
+        status, _, clamped = app.handle("/nodes?page=999")
+        assert status == 200 and "page 2 of 2" in clamped
 
     def test_nodes_page_cap_prioritizes_not_ready(self):
         fleet = fx.fleet_large(1024)

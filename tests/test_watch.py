@@ -19,17 +19,11 @@ import pytest
 
 
 def make_watch_transport(fleet=None):
-    """Fixture fleet on watchable list feeds, plus the imperative-track
-    routes the context also hits every sync."""
-    fleet = fleet or fx.fleet_v5e4()
-    t = MockTransport()
-    node_feed = t.add_watchable_list(NODES_PATH, fleet["nodes"])
-    pod_feed = t.add_watchable_list(PODS_PATH, fleet["pods"])
-    t.add(
-        "/apis/apps/v1/daemonsets?labelSelector=k8s-app%3Dtpu-device-plugin",
-        {"kind": "List", "items": fleet.get("daemonsets", [])},
-    )
-    return t, node_feed, pod_feed
+    """The shared fixture transport — `fleet_transport` registers the
+    watchable node/pod feeds itself, so the watch tests exercise the
+    exact transport shape demo mode and bench.py use."""
+    t = fx.fleet_transport(fleet or fx.fleet_v5e4())
+    return t, t.node_feed, t.pod_feed
 
 
 def reactive_list_calls(t):
@@ -230,6 +224,22 @@ class TestServerIntegration:
             assert len(reactive_list_calls(t)) == 2  # one LIST per track, ever
         finally:
             stop.set()
+
+    def test_restart_replaces_loop_and_stale_stop_is_harmless(self):
+        """start_background_sync stops any live loop, and a STALE stop
+        handle's set() must not disable watch on the newer loop."""
+        from headlamp_tpu.server import DashboardApp, make_demo_transport
+
+        app = DashboardApp(make_demo_transport("v5e4"), min_sync_interval_s=3600.0)
+        stop_a = app.start_background_sync(0.05)
+        stop_b = app.start_background_sync(0.05)
+        assert stop_a.is_set()  # restart stopped the old loop
+        assert not stop_b.is_set()
+        stop_a.set()  # stale handle fired again
+        assert app._ctx._watch_enabled  # newer loop keeps its watch
+        assert app._background_live()
+        stop_b.set()  # the active handle does disable it
+        assert not app._ctx._watch_enabled
 
     def test_refresh_wakes_background_loop(self):
         """ADVICE r2: after /refresh the background loop must re-sync
