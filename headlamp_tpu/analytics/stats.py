@@ -101,29 +101,74 @@ def python_fleet_stats(view: FleetView) -> dict[str, Any]:
     }
 
 
-#: Fleet size at which the XLA rollup takes over from the Python loops.
-#: The crossover is dominated by device *dispatch* latency, not compute:
-#: one rollup dispatch over a tunneled/remote TPU costs ~100-200 ms
-#: while the Python loops finish a 256-node fleet in ~1 ms — but the
-#: loops grow linearly with pods×nodes while the fused program's cost is
-#: flat, so past this size the rollup wins everywhere and below it only
-#: on hosts with local-device dispatch. ADR-006 ("callers choose by
-#: scale") encodes the policy here, in one place.
+#: Fleet size below which the Python loops ALWAYS serve: measured at
+#: ≤ ~5 ms there (BENCH_r03: 2.51 ms @ 256 nodes) — no device dispatch
+#: on any host beats that, so no probe is worth running. Above it, the
+#: winner is HOST-DEPENDENT: the fused program's cost is flat but equals
+#: the device *dispatch* latency, ~155 ms over a tunneled v5e
+#: (BENCH_r03 rollup_xla_ms_{256,1024} ≈ 157/154) yet single-digit ms
+#: on a local PCIe-attached device, while the Python loops grow linearly
+#: (~0.01 ms/node measured). A static crossover constant is therefore
+#: wrong on one host class or the other — so past this floor the policy
+#: MEASURES both backends once per process and picks the winner per
+#: request (ADR-006's "callers choose by scale", upgraded to "chosen by
+#: measured per-host crossover").
 XLA_ROLLUP_MIN_NODES = 512
+
+
+class _Calibration:
+    """Once-per-process rollup timings: one warm-up + timed XLA probe
+    and a timed Python run at scale, then every later at-scale request
+    picks the measured winner. Plain attribute writes (GIL-atomic);
+    worst case under a race is one redundant probe."""
+
+    def __init__(self) -> None:
+        self.xla_ms: float | None = None
+        self.python_ms_per_node: float | None = None
+
+    def reset(self) -> None:
+        self.__init__()
+
+    def predicted_python_ms(self, n_nodes: int) -> float | None:
+        if self.python_ms_per_node is None:
+            return None
+        return self.python_ms_per_node * n_nodes
+
+
+calibration = _Calibration()
+
+
+def chosen_backend(n_nodes: int) -> str:
+    """Which backend the default policy would serve an ``n_nodes`` fleet
+    right now — "python", "xla", or "calibrating" (probe not yet run).
+    Observability for benches/operators: the measured-winner policy must
+    never leave callers guessing which path their numbers exercised."""
+    if n_nodes < XLA_ROLLUP_MIN_NODES:
+        return "python"
+    if calibration.xla_ms is None:
+        return "calibrating"
+    predicted = calibration.predicted_python_ms(n_nodes)
+    if predicted is not None and predicted < calibration.xla_ms:
+        return "python"
+    return "xla"
 
 
 def fleet_stats(view: FleetView, *, backend: str | None = None) -> dict[str, Any]:
     """Serving-path aggregates for one provider view.
 
-    Dispatch policy: the fused XLA rollup for TPU-provider fleets of
-    ``XLA_ROLLUP_MIN_NODES``+ nodes on jax-capable hosts; the
-    pure-Python implementation otherwise. ``backend`` ("xla"/"python")
-    pins a path for tests and benches; an explicit "xla" pin propagates
-    every failure — missing jax, broken rollup, non-TPU provider —
-    instead of silently degrading, so a parity test on a jax-less host
-    must skip, not vacuously compare Python to itself. On the default
-    path any jax-side failure falls back: analytics acceleration must
-    never cost a page."""
+    Dispatch policy (TPU provider, jax-capable hosts): pure Python below
+    ``XLA_ROLLUP_MIN_NODES`` (measured unbeatable there); at scale, the
+    first request runs BOTH backends — an XLA warm-up (compile) plus a
+    timed steady-state dispatch, and a timed Python pass — records both
+    in :data:`calibration`, and serves the XLA result (the parity suite
+    pins them equal); every later request picks whichever measured
+    faster for its fleet size. ``backend`` ("xla"/"python") pins a path
+    for tests and benches; an explicit "xla" pin propagates every
+    failure — missing jax, broken rollup, non-TPU provider — instead of
+    silently degrading, so a parity test on a jax-less host must skip,
+    not vacuously compare Python to itself. On the default path any
+    jax-side failure falls back: analytics acceleration must never cost
+    a page."""
     if backend == "python":
         return python_fleet_stats(view)
     if backend == "xla":
@@ -136,12 +181,47 @@ def fleet_stats(view: FleetView, *, backend: str | None = None) -> dict[str, Any
         return _xla_stats(view)
     if view.provider.name != "tpu":
         return python_fleet_stats(view)
-    if len(view.nodes) < XLA_ROLLUP_MIN_NODES:
-        return python_fleet_stats(view)
+    # The policy lives in chosen_backend — ONE place — so what serves a
+    # request and what benches/operators are told always agree.
     try:
-        return _xla_stats(view)
+        choice = chosen_backend(len(view.nodes))
+        if choice == "calibrating":
+            return _calibrate(view)
+        if choice == "xla":
+            return _xla_stats(view)
     except Exception:  # noqa: BLE001 — degraded, never broken
-        return python_fleet_stats(view)
+        pass
+    return python_fleet_stats(view)
+
+
+def _calibrate(view: FleetView) -> dict[str, Any]:
+    """First at-scale request: measure both backends, record, serve XLA.
+    Median of 3 timed samples per backend — a process-lifetime choice
+    must not hang off one sample that caught a GC pause or a network
+    blip to a tunneled device. Cost over the steady state, paid once per
+    process and only at ≥ XLA_ROLLUP_MIN_NODES sizes: one compile
+    warm-up + 3 XLA dispatches + 3 Python passes — host-dependent, from
+    ~30 ms on a local device to ~600 ms+ over a tunneled one (3×~155 ms
+    dispatch, BENCH_r03) plus the compile. Servers running
+    --background-sync pay it on the first background tick, off the
+    request path; inline-sync servers pay it on the first at-scale page
+    view."""
+    import statistics
+    import time
+
+    def timed(fn) -> float:
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn()
+            samples.append((time.perf_counter() - t0) * 1000)
+        return statistics.median(samples)
+
+    stats = _xla_stats(view)  # warm-up: compile for this fleet-shape bucket
+    calibration.xla_ms = timed(lambda: _xla_stats(view))
+    python_ms = timed(lambda: python_fleet_stats(view))
+    calibration.python_ms_per_node = python_ms / max(1, len(view.nodes))
+    return stats
 
 
 def _xla_stats(view: FleetView) -> dict[str, Any]:

@@ -340,13 +340,17 @@ class AcceleratorDataContext:
         # quiet bounded watch blocks its full server-side window, and
         # serial polls would double every tick's duration — and the
         # sync-lock hold time the server's request path can stall on.
-        with concurrent.futures.ThreadPoolExecutor(
-            max_workers=2, thread_name_prefix="hl-tpu-reactive"
-        ) as pool:
-            nodes_future = pool.submit(self._sync_track, "nodes", NODES_PATH)
-            pods_future = pool.submit(self._sync_track, "pods", self._pods_path())
-            self._node_error = nodes_future.result()
-            self._pod_error = pods_future.result()
+        # One persistent worker (created on first sync, reused for the
+        # context's lifetime) carries the nodes track while the calling
+        # thread runs the pods track — zero per-tick thread churn.
+        pool = getattr(self, "_reactive_pool", None)
+        if pool is None:
+            pool = self._reactive_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="hl-tpu-reactive"
+            )
+        nodes_future = pool.submit(self._sync_track, "nodes", NODES_PATH)
+        self._pod_error = self._sync_track("pods", self._pods_path())
+        self._node_error = nodes_future.result()
         if self._node_error is None:
             self._all_nodes = list(self._track_store["nodes"].values())
         if self._pod_error is None:

@@ -11,8 +11,11 @@ BASELINE metrics page gains on top of parity.
 
 from .forecast import (
     ForecastConfig,
+    InferenceDispatch,
     fit_and_forecast,
+    fit_and_forecast_with_dispatch,
     forecast_next,
+    forecast_next_with_dispatch,
     forward,
     init_params,
     loss_fn,
@@ -24,8 +27,11 @@ from .forecast import (
 
 __all__ = [
     "ForecastConfig",
+    "InferenceDispatch",
     "fit_and_forecast",
+    "fit_and_forecast_with_dispatch",
     "forecast_next",
+    "forecast_next_with_dispatch",
     "forward",
     "init_params",
     "loss_fn",

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -149,25 +149,51 @@ def make_windows(
     return x, y
 
 
-def forecast_next(
+class InferenceDispatch(NamedTuple):
+    """Which inference path actually served a forecast — observability
+    for the silent-fallback policy (a Pallas kernel broken by a jax
+    upgrade must show up in /healthz-adjacent surfaces and the bench,
+    not vanish behind the XLA fallback)."""
+
+    path: str                        #: "pallas" | "xla" | "repeat"
+    fallback_reason: str | None = None  #: set when Pallas was tried and failed
+
+    @property
+    def used_pallas(self) -> bool:
+        return self.path == "pallas"
+
+
+def forecast_next_with_dispatch(
     params: Params, recent: jax.Array, cfg: ForecastConfig | None = None
-) -> jax.Array:
+) -> tuple[jax.Array, InferenceDispatch]:
     """Pages' inference entry: [n_chips, window] recent samples ->
-    [n_chips, horizon] predicted utilization.
+    ([n_chips, horizon] predicted utilization, dispatch record).
 
     Dispatch: on a TPU backend the fused Pallas kernel serves inference
     (``pallas_forward.forecast_forward_pallas`` — every intermediate
     stays in VMEM); elsewhere the plain XLA ``forward``. Any Pallas
     failure falls back to XLA — the kernel is an optimization, never a
-    dependency."""
+    dependency — but the failure is RECORDED in the returned dispatch,
+    never swallowed invisibly."""
     if jax.devices()[0].platform == "tpu":
         try:
             from .pallas_forward import forecast_forward_pallas
 
-            return forecast_forward_pallas(params, recent, cfg, interpret=False)
-        except Exception:  # noqa: BLE001 — optimization, not a dependency
-            pass
-    return forward(params, recent)
+            out = forecast_forward_pallas(params, recent, cfg, interpret=False)
+            return out, InferenceDispatch("pallas")
+        except Exception as exc:  # noqa: BLE001 — optimization, not a dependency
+            reason = f"{type(exc).__name__}: {exc}"[:200]
+            return forward(params, recent), InferenceDispatch("xla", reason)
+    return forward(params, recent), InferenceDispatch("xla")
+
+
+def forecast_next(
+    params: Params, recent: jax.Array, cfg: ForecastConfig | None = None
+) -> jax.Array:
+    """:func:`forecast_next_with_dispatch` without the record, for
+    callers that only want the numbers."""
+    out, _ = forecast_next_with_dispatch(params, recent, cfg)
+    return out
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps"))
@@ -200,17 +226,18 @@ def _fit_program(
     return params
 
 
-def fit_and_forecast(
+def fit_and_forecast_with_dispatch(
     series: jax.Array,
     cfg: ForecastConfig | None = None,
     *,
     steps: int = 60,
     seed: int = 0,
-) -> jax.Array:
+) -> tuple[jax.Array, InferenceDispatch]:
     """Online fit on the given traces, then predict the next horizon
-    from each trace's latest window: [n_chips, T] -> [n_chips, horizon].
-    The fit is one fused XLA program; the predict goes through
-    :func:`forecast_next` (Pallas kernel on TPU, XLA elsewhere).
+    from each trace's latest window: [n_chips, T] -> ([n_chips, horizon],
+    dispatch record). The fit is one fused XLA program; the predict goes
+    through :func:`forecast_next_with_dispatch` (Pallas kernel on TPU,
+    XLA elsewhere).
 
     There is no pre-trained checkpoint by design — utilization dynamics
     are cluster-specific, the model is tiny, and fitting on exactly the
@@ -220,9 +247,24 @@ def fit_and_forecast(
     series = jnp.asarray(series, dtype=jnp.float32)
     _, length = series.shape
     if length < cfg.window + cfg.horizon:
+        # Persistence fallback: no kernel ran at all, and the dispatch
+        # record must say so — not claim an XLA inference that never
+        # happened.
         last = series[:, -1:]
-        return jnp.repeat(last, cfg.horizon, axis=1)
+        return jnp.repeat(last, cfg.horizon, axis=1), InferenceDispatch("repeat")
 
     recent = series[:, -cfg.window:]
     params = _fit_program(series, jax.random.PRNGKey(seed), cfg, steps)
-    return forecast_next(params, recent, cfg)
+    return forecast_next_with_dispatch(params, recent, cfg)
+
+
+def fit_and_forecast(
+    series: jax.Array,
+    cfg: ForecastConfig | None = None,
+    *,
+    steps: int = 60,
+    seed: int = 0,
+) -> jax.Array:
+    """:func:`fit_and_forecast_with_dispatch` without the record."""
+    out, _ = fit_and_forecast_with_dispatch(series, cfg, steps=steps, seed=seed)
+    return out

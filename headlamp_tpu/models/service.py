@@ -11,7 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..metrics.client import UtilizationHistory
-from .forecast import ForecastConfig, fit_and_forecast
+from .forecast import ForecastConfig, fit_and_forecast_with_dispatch
 
 
 @dataclass
@@ -32,6 +32,12 @@ class ForecastView:
     window_s: int
     chips: list[ChipForecast] = field(default_factory=list)
     fit_ms: float = 0.0
+    #: Inference path that actually served the prediction ("pallas" on a
+    #: TPU backend unless the kernel failed, else "xla") — surfaced on
+    #: the metrics page so a silently-broken kernel is visible.
+    inference_path: str = "xla"
+    #: Why Pallas fell back to XLA, when it was tried and failed.
+    inference_fallback_reason: str | None = None
 
     @property
     def at_risk(self) -> list[ChipForecast]:
@@ -83,7 +89,10 @@ def forecast_from_history(
 
     cfg = cfg or ForecastConfig()
     t0 = time.perf_counter()
-    preds = np.asarray(fit_and_forecast(np.asarray(history.series), cfg, steps=steps))
+    preds, dispatch = fit_and_forecast_with_dispatch(
+        np.asarray(history.series), cfg, steps=steps
+    )
+    preds = np.asarray(preds)
     fit_ms = round((time.perf_counter() - t0) * 1000, 1)
 
     chips = []
@@ -109,4 +118,6 @@ def forecast_from_history(
         window_s=max(0, (n_samples - 1)) * history.step_s,
         chips=chips,
         fit_ms=fit_ms,
+        inference_path=dispatch.path,
+        inference_fallback_reason=dispatch.fallback_reason,
     )

@@ -198,9 +198,25 @@ def forecast_section(view: Any) -> Element:
             "p",
             {"class_": "hl-hint"},
             f"Model fit on the last {round(view.window_s / 60)} min of history "
-            f"in {view.fit_ms:g} ms (online MLP, deterministic seed).",
+            f"in {view.fit_ms:g} ms (online MLP, deterministic seed); "
+            f"inference via {_inference_label(view)}.",
         ),
     )
+
+
+def _inference_label(view: Any) -> str:
+    """Human-readable dispatch record: which kernel actually served the
+    prediction, and — when Pallas was tried and failed — why it fell
+    back (the silent-fallback policy must stay observable)."""
+    path = getattr(view, "inference_path", "xla")
+    if path == "pallas":
+        return "Pallas TPU kernel"
+    if path == "repeat":
+        return "persistence (history shorter than one window; no kernel ran)"
+    reason = getattr(view, "inference_fallback_reason", None)
+    if reason:
+        return f"XLA (Pallas fallback: {reason})"
+    return "XLA"
 
 
 def metrics_page(

@@ -102,6 +102,12 @@ class DashboardApp:
         #: inline); read racily by /healthz — int updates are atomic
         #: enough for a health probe.
         self._sync_failures = 0
+        #: Serializes background-loop lifecycle transitions (restart vs
+        #: a stop handle's set()): the stale-handle guard is a
+        #: check-then-act and must not interleave with the restart's
+        #: enable_watch(). Reentrant because a restart set()s the old
+        #: handle while already holding it.
+        self._bg_lock = threading.RLock()
 
     @property
     def registry(self) -> Registry:
@@ -115,14 +121,6 @@ class DashboardApp:
         Event (the thread is a daemon either way). Sync failures are
         absorbed — the next tick retries, and the request path's own
         coalesced sync still works."""
-        # Restarting replaces any live loop: stop it first so two loops
-        # never share the context, and give the new loop its OWN wake
-        # event — an orphaned old loop must not consume a /refresh wake
-        # meant for the current one.
-        if self._background_live():
-            self._background_stop.set()
-        wake = threading.Event()
-        self._background_wake = wake
         app = self
 
         class _StopEvent(threading.Event):
@@ -132,20 +130,37 @@ class DashboardApp:
             turns watch mode back off, because the re-enabled inline
             request-path sync must cost fast LISTs, not two full
             server-side watch windows per page view. A stale handle's
-            set() must not degrade a newer live loop."""
+            set() must not degrade a newer live loop: the check-then-act
+            serializes with restarts under ``_bg_lock``."""
 
             def set(self) -> None:  # noqa: A003 (threading.Event API)
                 super().set()
-                if app._background_stop is self:
-                    app._ctx.enable_watch(False)
-                wake.set()
+                with app._bg_lock:
+                    if app._background_stop is self:
+                        app._ctx.enable_watch(False)
+                self.wake.set()
 
-        stop = _StopEvent()
-        interval = interval_s if interval_s is not None else max(self._min_sync, 1.0)
-        self._background_interval = interval
-        # Steady-state background syncing transfers watch deltas, not
-        # the whole fleet — see AcceleratorDataContext.enable_watch.
-        self._ctx.enable_watch()
+        with self._bg_lock:
+            # Restarting replaces any live loop: stop it first so two
+            # loops never share the context, and give the new loop its
+            # OWN wake event — an orphaned old loop must not consume a
+            # /refresh wake meant for the current one.
+            if self._background_live():
+                self._background_stop.set()
+            wake = threading.Event()
+            self._background_wake = wake
+            stop = _StopEvent()
+            stop.wake = wake
+            interval = (
+                interval_s if interval_s is not None else max(self._min_sync, 1.0)
+            )
+            self._background_interval = interval
+            self._background_stop = stop
+            # Steady-state background syncing transfers watch deltas,
+            # not the whole fleet — enabled only after this handle is
+            # the active one, so a concurrent stale set() cannot undo
+            # it (it re-checks under the same lock and no-ops).
+            self._ctx.enable_watch()
 
         def sync_once() -> None:
             try:
@@ -172,7 +187,6 @@ class DashboardApp:
         # the flag's whole promise. The stop event re-enables inline
         # syncing (checked per request, so a stopped thread does not
         # strand the app with a permanently stale snapshot).
-        self._background_stop = stop
         threading.Thread(target=loop, daemon=True, name="hl-tpu-sync").start()
         return stop
 

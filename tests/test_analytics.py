@@ -224,7 +224,7 @@ class TestServingPathStats:
         from headlamp_tpu.analytics import stats as st
 
         small = tpu_view(fx.fleet_v5p32())  # 4 nodes → python path
-        large = tpu_view(fx.fleet_large(1024))  # ≥512 → XLA path
+        large = tpu_view(fx.fleet_large(1024))  # ≥ floor → calibrated
         assert len(large.nodes) >= st.XLA_ROLLUP_MIN_NODES
 
         called = []
@@ -235,13 +235,57 @@ class TestServingPathStats:
             return original(view)
 
         st.python_fleet_stats = spying
+        st.calibration.reset()
         try:
             st.fleet_stats(small)
-            assert called == [4]
-            st.fleet_stats(large)  # must NOT go through python
-            assert called == [4]
+            assert called == [4]  # below the floor: python, no probe
+
+            # First at-scale request: the calibration probe times BOTH
+            # backends (median of 3 samples each) and records the
+            # measurements.
+            n_large = len(large.nodes)
+            st.fleet_stats(large)
+            assert called == [4] + [n_large] * 3
+            assert st.calibration.xla_ms is not None
+            assert st.calibration.python_ms_per_node is not None
+
+            # Later at-scale requests pick the measured winner — pin the
+            # recorded timings each way and watch the choice flip.
+            called.clear()
+            st.calibration.xla_ms = 1000.0  # slow device dispatch
+            st.calibration.python_ms_per_node = 0.01
+            st.fleet_stats(large)
+            assert called == [n_large]  # python won
+
+            st.calibration.xla_ms = 0.5  # local-device dispatch
+            st.fleet_stats(large)
+            assert called == [n_large]  # xla won: no new python call
         finally:
             st.python_fleet_stats = original
+            st.calibration.reset()
+
+    def test_calibration_probe_runs_once(self):
+        from headlamp_tpu.analytics import stats as st
+
+        large = tpu_view(fx.fleet_large(1024))
+        st.calibration.reset()
+        try:
+            calls = []
+            original = st._calibrate
+
+            def spying(view):
+                calls.append(1)
+                return original(view)
+
+            st._calibrate = spying
+            try:
+                st.fleet_stats(large)
+                st.fleet_stats(large)
+                assert calls == [1]  # probe paid once per process
+            finally:
+                st._calibrate = original
+        finally:
+            st.calibration.reset()
 
     def test_future_generation_preserved_not_bucketed(self):
         # A future accelerator label must surface as its inferred

@@ -186,6 +186,74 @@ class TestForecastService:
         )
         view = forecast_from_history(hist)
         assert abs(view.chips[0].predicted_peak - 0.42) < 1e-4
+        # No kernel ran: the dispatch record must say "repeat", not
+        # claim an inference path that was never taken.
+        assert view.inference_path == "repeat"
+        assert "persistence" in text_content(
+            metrics_page(
+                TpuMetricsSnapshot(
+                    namespace="monitoring",
+                    service="prometheus-k8s:9090",
+                    chips=[TpuChipMetrics(node="n1", accelerator_id="0", duty_cycle=0.4)],
+                    availability={"duty_cycle": True},
+                    fetch_ms=1.0,
+                ),
+                view,
+            )
+        )
+
+    def test_dispatch_record_threaded_to_view(self):
+        # On a CPU test host the recorded path must be "xla" with no
+        # fallback reason (Pallas is never tried off-TPU); the record
+        # must reach the ForecastView and the rendered section.
+        import jax
+
+        t = matrix_transport(lambda c, ts: 0.5)
+        hist = fetch_utilization_history(
+            t, prometheus=PROM, window_s=3600, step_s=60, clock=lambda: 10_000.0
+        )
+        view = forecast_from_history(hist, steps=10)
+        assert view.inference_path in ("pallas", "xla")
+        if jax.devices()[0].platform != "tpu":
+            assert view.inference_path == "xla"
+            assert view.inference_fallback_reason is None
+        el = metrics_page(
+            TpuMetricsSnapshot(
+                namespace="monitoring",
+                service="prometheus-k8s:9090",
+                chips=[TpuChipMetrics(node="n1", accelerator_id="0", duty_cycle=0.4)],
+                availability={"duty_cycle": True},
+                fetch_ms=1.0,
+            ),
+            view,
+        )
+        assert "inference via" in text_content(el)
+
+    def test_fallback_reason_recorded_not_swallowed(self, monkeypatch):
+        # Force the TPU branch with a Pallas kernel that raises: the
+        # dispatch must fall back to XLA AND carry the reason.
+        import numpy as np
+
+        from headlamp_tpu.models import forecast as fc
+
+        class FakeDev:
+            platform = "tpu"
+
+        monkeypatch.setattr(fc.jax, "devices", lambda: [FakeDev()])
+
+        import headlamp_tpu.models.pallas_forward as pf
+
+        def boom(*a, **k):
+            raise RuntimeError("mosaic lowering failed")
+
+        monkeypatch.setattr(pf, "forecast_forward_pallas", boom)
+        cfg = fc.ForecastConfig()
+        params = fc.init_params(fc.jax.random.PRNGKey(0), cfg)
+        x = np.full((4, cfg.window), 0.5, dtype="float32")
+        out, dispatch = fc.forecast_next_with_dispatch(params, x, cfg)
+        assert out.shape == (4, cfg.horizon)
+        assert dispatch.path == "xla" and not dispatch.used_pallas
+        assert "mosaic lowering failed" in dispatch.fallback_reason
 
 
 class TestMetricsPageForecast:
