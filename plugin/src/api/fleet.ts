@@ -1,0 +1,327 @@
+/**
+ * fleet.ts — TPU fleet domain: pod-side chip accounting and the
+ * dashboard fleet-stats aggregate.
+ *
+ * TypeScript mirror of the Python framework's domain + analytics layer
+ * (`headlamp_tpu/domain/tpu.py`, `headlamp_tpu/domain/objects.py`,
+ * `headlamp_tpu/analytics/stats.py:python_fleet_stats`), playing the
+ * role the reference's pure domain layer plays for Intel GPUs
+ * (`/root/reference/src/api/k8s.ts`). The parity contract with the
+ * Python side is enforced by replaying the shared fixtures
+ * (`fixtures/*.json`) in `fleet.test.ts` — both languages must produce
+ * identical fleet stats for identical fleets.
+ *
+ * Node-side helpers (detection, capacity, generations) live in
+ * `./topology` and are re-used here, not duplicated.
+ */
+
+import {
+  getNodeChipCapacity,
+  getTpuGeneration,
+  getNodeAccelerator,
+  isNodeReady,
+  isTpuNode,
+  KubeNode,
+  nodeName,
+  parseIntLenient,
+  TPU_RESOURCE,
+} from './topology';
+
+export type KubePod = Record<string, any>;
+
+/** Label variants that identify TPU device-plugin daemon pods —
+ * mirrors `headlamp_tpu/domain/constants.py:TPU_PLUGIN_POD_LABELS`
+ * (3-variant matching like the reference's k8s.ts:271-282). */
+export const TPU_PLUGIN_POD_LABELS: Array<[string, string]> = [
+  ['k8s-app', 'tpu-device-plugin'],
+  ['app', 'tpu-device-plugin'],
+  ['app.kubernetes.io/name', 'tpu-device-plugin'],
+];
+
+/** Namespace GKE deploys the device plugin into. */
+export const TPU_PLUGIN_NAMESPACE = 'kube-system';
+
+/** Display names per generation — `constants.py:TPU_GENERATION_DISPLAY`. */
+export const TPU_GENERATION_DISPLAY: Record<string, string> = {
+  v4: 'TPU v4',
+  v5e: 'TPU v5e',
+  v5p: 'TPU v5p',
+  v6e: 'TPU v6e (Trillium)',
+  unknown: 'TPU (unknown gen)',
+};
+
+/** Node-utilization percentage at or above which a node counts as hot —
+ * the UI kit's critical threshold (`analytics/stats.py:HOT_NODE_PCT`,
+ * reference `NodesPage.tsx:38`). */
+export const HOT_NODE_PCT = 90.0;
+
+// ---------------------------------------------------------------------------
+// Object plumbing (objects.py analogues — total functions, never throw)
+// ---------------------------------------------------------------------------
+
+function asRecord(value: any): Record<string, any> {
+  return value && typeof value === 'object' && !Array.isArray(value) ? value : {};
+}
+
+/** Python's round(): banker's (half-to-even) rounding — Math.round's
+ * half-up would diverge from python_fleet_stats on exact .5 ties
+ * (e.g. 1 chip in use of 200 → 0.5% → 0 in Python, 1 via Math.round). */
+export function roundHalfEven(value: number): number {
+  const floor = Math.floor(value);
+  const diff = value - floor;
+  if (diff < 0.5) return floor;
+  if (diff > 0.5) return floor + 1;
+  return floor % 2 === 0 ? floor : floor + 1;
+}
+
+export function podLabels(pod: KubePod): Record<string, any> {
+  return asRecord(asRecord(pod?.metadata).labels);
+}
+
+export function podName(pod: KubePod): string {
+  const n = asRecord(pod?.metadata).name;
+  return typeof n === 'string' ? n : String(n ?? '');
+}
+
+export function podNamespace(pod: KubePod): string {
+  const ns = asRecord(pod?.metadata).namespace;
+  return typeof ns === 'string' ? ns : String(ns ?? '');
+}
+
+export function podUid(pod: KubePod): string {
+  const u = asRecord(pod?.metadata).uid;
+  return typeof u === 'string' ? u : String(u ?? '');
+}
+
+/** `objects.pod_phase`: missing/empty phase is "Unknown", never ''. */
+export function podPhase(pod: KubePod): string {
+  const phase = asRecord(pod?.status).phase;
+  return phase ? String(phase) : 'Unknown';
+}
+
+export function podNodeName(pod: KubePod): string | null {
+  const n = asRecord(pod?.spec).nodeName;
+  return n ? String(n) : null;
+}
+
+function containerList(pod: KubePod, key: 'containers' | 'initContainers'): Array<Record<string, any>> {
+  const items = asRecord(pod?.spec)[key];
+  if (!Array.isArray(items)) return [];
+  return items.filter(c => c && typeof c === 'object');
+}
+
+function containerRequests(c: Record<string, any>): Record<string, any> {
+  return asRecord(asRecord(c.resources).requests);
+}
+
+function containerLimits(c: Record<string, any>): Record<string, any> {
+  return asRecord(asRecord(c.resources).limits);
+}
+
+// ---------------------------------------------------------------------------
+// Pod detection & chip accounting (tpu.py:130-173)
+// ---------------------------------------------------------------------------
+
+/** Any container (incl. init) requesting or limited by google.com/tpu —
+ * `tpu.is_tpu_requesting_pod` (requests-OR-limits over the union). */
+export function isTpuRequestingPod(pod: KubePod): boolean {
+  const all = [...containerList(pod, 'containers'), ...containerList(pod, 'initContainers')];
+  return all.some(c => TPU_RESOURCE in containerRequests(c) || TPU_RESOURCE in containerLimits(c));
+}
+
+export function filterTpuRequestingPods(items: KubePod[]): KubePod[] {
+  return items.filter(isTpuRequestingPod);
+}
+
+/** Effective chips the pod occupies: max(max(initContainers),
+ * sum(containers)) — init containers run before the main ones, so their
+ * requests overlap rather than add (`tpu.get_pod_chip_request`; the
+ * reference sums both, k8s.ts:289-301, which overcounts). */
+export function getPodChipRequest(pod: KubePod): number {
+  const chipReq = (c: Record<string, any>): number => {
+    const req = containerRequests(c)[TPU_RESOURCE];
+    return parseIntLenient(req !== undefined ? req : containerLimits(c)[TPU_RESOURCE]);
+  };
+  const mainSum = containerList(pod, 'containers').reduce((acc, c) => acc + chipReq(c), 0);
+  const initMax = containerList(pod, 'initContainers').reduce((acc, c) => Math.max(acc, chipReq(c)), 0);
+  return Math.max(mainSum, initMax);
+}
+
+/** TPU device-plugin daemon pod by any accepted label variant. */
+export function isTpuPluginPod(pod: KubePod): boolean {
+  const l = podLabels(pod);
+  return TPU_PLUGIN_POD_LABELS.some(([k, v]) => l[k] === v);
+}
+
+export function filterTpuPluginPods(items: KubePod[]): KubePod[] {
+  return items.filter(isTpuPluginPod);
+}
+
+export function filterTpuNodes(items: KubeNode[]): KubeNode[] {
+  return items.filter(isTpuNode);
+}
+
+/** Drop objects with duplicate (or missing) UIDs, preserving order —
+ * `objects.dedup_by_uid` (multi-selector merge for plugin pods). */
+export function dedupByUid(items: KubePod[]): KubePod[] {
+  const seen = new Set<string>();
+  const out: KubePod[] = [];
+  for (const o of items) {
+    const u = podUid(o);
+    if (!u || seen.has(u)) continue;
+    seen.add(u);
+    out.push(o);
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Node allocatable (topology.ts carries capacity; stats need both)
+// ---------------------------------------------------------------------------
+
+export function getNodeChipAllocatable(node: KubeNode): number {
+  return parseIntLenient(asRecord(asRecord(node?.status).allocatable)[TPU_RESOURCE]);
+}
+
+export function getNodeGeneration(node: KubeNode): string {
+  return getTpuGeneration(getNodeAccelerator(node));
+}
+
+/** 'v5e' -> 'TPU v5e'; unknown future generations display as
+ * `TPU <gen>` instead of collapsing (`tpu.format_generation`). */
+export function formatGeneration(generation: string): string {
+  const known = TPU_GENERATION_DISPLAY[generation];
+  if (known) return known;
+  if (generation && generation !== 'unknown') return `TPU ${generation}`;
+  return TPU_GENERATION_DISPLAY.unknown;
+}
+
+export function formatChipCount(count: number): string {
+  return count === 1 ? '1 chip' : `${count} chips`;
+}
+
+// ---------------------------------------------------------------------------
+// Fleet stats (stats.py:python_fleet_stats — the dashboard aggregate)
+// ---------------------------------------------------------------------------
+
+export interface FleetStats {
+  capacity: number;
+  allocatable: number;
+  in_use: number;
+  free: number;
+  utilization_pct: number;
+  nodes_total: number;
+  nodes_ready: number;
+  phase_counts: Record<string, number>;
+  generation_counts: Record<string, number>;
+  per_node_in_use: number[];
+  max_node_util_pct: number;
+  hot_nodes: number;
+}
+
+/** Every dashboard aggregate for a TPU fleet view, matching
+ * `python_fleet_stats` key-for-key and value-for-value (the shared
+ * fixtures pin the parity). Inputs are the PRE-FILTERED provider view:
+ * `filterTpuNodes(allNodes)` / `filterTpuRequestingPods(allPods)`,
+ * in input order — per_node_in_use is aligned to the node order. */
+export function fleetStats(tpuNodes: KubeNode[], tpuPods: KubePod[]): FleetStats {
+  const capacity = tpuNodes.reduce((acc, n) => acc + getNodeChipCapacity(n), 0);
+  const allocatable = tpuNodes.reduce((acc, n) => acc + getNodeChipAllocatable(n), 0);
+  const running = tpuPods.filter(p => podPhase(p) === 'Running');
+  const inUse = running.reduce((acc, p) => acc + getPodChipRequest(p), 0);
+  const pct = capacity > 0 ? roundHalfEven((inUse / capacity) * 100) : 0;
+
+  const nodesReady = tpuNodes.filter(isNodeReady).length;
+
+  const phaseCounts: Record<string, number> = {
+    Running: 0,
+    Pending: 0,
+    Succeeded: 0,
+    Failed: 0,
+    Other: 0,
+  };
+  for (const p of tpuPods) {
+    const phase = podPhase(p);
+    phaseCounts[phase in phaseCounts ? phase : 'Other'] += 1;
+  }
+
+  const generationCounts: Record<string, number> = {};
+  for (const n of tpuNodes) {
+    const gen = getNodeGeneration(n);
+    generationCounts[gen] = (generationCounts[gen] ?? 0) + 1;
+  }
+
+  const inUseByNode: Record<string, number> = {};
+  for (const p of running) {
+    const node = podNodeName(p);
+    if (node) inUseByNode[node] = (inUseByNode[node] ?? 0) + getPodChipRequest(p);
+  }
+  const perNodeInUse = tpuNodes.map(n => inUseByNode[nodeName(n)] ?? 0);
+
+  let maxUtil = 0;
+  let hotNodes = 0;
+  tpuNodes.forEach((n, i) => {
+    const alloc = getNodeChipAllocatable(n);
+    if (alloc <= 0) return;
+    const util = (perNodeInUse[i] / alloc) * 100;
+    maxUtil = Math.max(maxUtil, util);
+    if (util >= HOT_NODE_PCT) hotNodes += 1;
+  });
+
+  return {
+    capacity,
+    allocatable,
+    in_use: inUse,
+    free: allocatable - inUse,
+    utilization_pct: pct,
+    nodes_total: tpuNodes.length,
+    nodes_ready: nodesReady,
+    phase_counts: phaseCounts,
+    generation_counts: generationCounts,
+    per_node_in_use: perNodeInUse,
+    max_node_util_pct: maxUtil,
+    hot_nodes: hotNodes,
+  };
+}
+
+// ---------------------------------------------------------------------------
+// DaemonSet status (tpu.py:179-202 — no TPU operator CRD; ADR-003)
+// ---------------------------------------------------------------------------
+
+export type KubeDaemonSet = Record<string, any>;
+
+export function daemonsetStatusToStatus(ds: KubeDaemonSet): 'success' | 'warning' | 'error' {
+  const s = asRecord(ds?.status);
+  const desired = parseIntLenient(s.desiredNumberScheduled);
+  const ready = parseIntLenient(s.numberReady);
+  const unavailable = parseIntLenient(s.numberUnavailable);
+  if (desired === 0) return 'warning';
+  if (unavailable > 0) return 'warning';
+  if (ready === desired) return 'success';
+  return 'error';
+}
+
+export function daemonsetStatusText(ds: KubeDaemonSet): string {
+  const s = asRecord(ds?.status);
+  const desired = parseIntLenient(s.desiredNumberScheduled);
+  const ready = parseIntLenient(s.numberReady);
+  if (desired === 0) return 'No nodes scheduled';
+  return `${ready}/${desired} ready`;
+}
+
+/** Human age from an RFC3339 timestamp: s/m/h/d buckets
+ * (`objects.format_age`; reference k8s.ts:337-348). `nowEpochMs`
+ * explicit so callers and tests control the clock. */
+export function formatAge(timestamp: string | null | undefined, nowEpochMs: number): string {
+  if (!timestamp) return 'unknown';
+  const then = Date.parse(timestamp);
+  if (Number.isNaN(then)) return 'unknown';
+  let secs = Math.floor((nowEpochMs - then) / 1000);
+  if (secs < 0) secs = 0;
+  if (secs < 60) return `${secs}s`;
+  const mins = Math.floor(secs / 60);
+  if (mins < 60) return `${mins}m`;
+  const hours = Math.floor(mins / 60);
+  if (hours < 24) return `${hours}h`;
+  return `${Math.floor(hours / 24)}d`;
+}

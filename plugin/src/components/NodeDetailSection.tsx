@@ -1,0 +1,87 @@
+/**
+ * NodeDetailSection — TPU panel injected into Headlamp's native Node
+ * detail page.
+ *
+ * Mirrors `headlamp_tpu/integrations/node_detail.py` (rebuilding
+ * `/root/reference/src/components/NodeDetailSection.tsx`): chip
+ * capacity/allocation, slice membership, and the TPU pods on this
+ * node. Renders null for non-TPU nodes — the section must cost nothing
+ * on the rest of the cluster.
+ */
+
+import {
+  NameValueTable,
+  SectionBox,
+  StatusLabel,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React from 'react';
+import {
+  formatChipCount,
+  formatGeneration,
+  getNodeChipAllocatable,
+  getNodeGeneration,
+  getPodChipRequest,
+  podName,
+  podNamespace,
+  podNodeName,
+  podPhase,
+} from '../api/fleet';
+import { useTpuContext } from '../api/TpuDataContext';
+import {
+  getNodeChipCapacity,
+  getNodeTopology,
+  getNodeWorkerId,
+  isTpuNode,
+  nodeName,
+} from '../api/topology';
+
+export default function NodeDetailSection({ resource }: { resource: { jsonData?: unknown } }) {
+  const { slices, tpuPods } = useTpuContext();
+  const node = (resource?.jsonData ?? resource) as Record<string, any>;
+
+  if (!isTpuNode(node)) {
+    return null;
+  }
+
+  const name = nodeName(node);
+  const slice = slices.find(s => s.workers.some(w => w.node_name === name));
+  const podsHere = tpuPods.filter(p => podNodeName(p) === name && podPhase(p) === 'Running');
+  const inUse = podsHere.reduce((acc, p) => acc + getPodChipRequest(p), 0);
+  const workerId = getNodeWorkerId(node);
+
+  return (
+    <SectionBox title="Cloud TPU">
+      <NameValueTable
+        rows={[
+          { name: 'Generation', value: formatGeneration(getNodeGeneration(node)) },
+          { name: 'Topology', value: getNodeTopology(node) ?? '—' },
+          { name: 'Capacity', value: formatChipCount(getNodeChipCapacity(node)) },
+          { name: 'Allocatable', value: formatChipCount(getNodeChipAllocatable(node)) },
+          { name: 'In use', value: formatChipCount(inUse) },
+          ...(slice
+            ? [
+                { name: 'Slice', value: slice.slice_id },
+                {
+                  name: 'Slice health',
+                  value: (
+                    <StatusLabel status={slice.health}>
+                      {slice.health === 'success' ? 'Healthy' : slice.health === 'warning' ? 'Degraded' : 'Incomplete'}
+                    </StatusLabel>
+                  ),
+                },
+                ...(workerId !== null ? [{ name: 'Worker', value: workerId }] : []),
+              ]
+            : []),
+          ...(podsHere.length > 0
+            ? [
+                {
+                  name: 'TPU pods',
+                  value: podsHere.map(p => `${podNamespace(p)}/${podName(p)}`).join(', '),
+                },
+              ]
+            : []),
+        ]}
+      />
+    </SectionBox>
+  );
+}

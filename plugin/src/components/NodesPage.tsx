@@ -1,0 +1,98 @@
+/**
+ * NodesPage — every TPU node with readiness, generation, slice
+ * membership, and chip allocation.
+ *
+ * Headlamp-native rendering of `headlamp_tpu/pages/nodes.py` (itself
+ * rebuilding `/root/reference/src/components/NodesPage.tsx` for TPU
+ * primitives). Headlamp's SimpleTable provides sorting/paging, so the
+ * Python host's explicit `?page=/?q=` machinery is not needed here.
+ */
+
+import {
+  Loader,
+  NameValueTable,
+  SectionBox,
+  SectionHeader,
+  SimpleTable,
+  StatusLabel,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React from 'react';
+import { formatGeneration, getNodeChipAllocatable, getNodeGeneration } from '../api/fleet';
+import { useTpuContext } from '../api/TpuDataContext';
+import {
+  getNodeChipCapacity,
+  getNodePool,
+  getNodeTopology,
+  getNodeWorkerId,
+  isNodeReady,
+  KubeNode,
+  nodeName,
+} from '../api/topology';
+
+export default function NodesPage() {
+  const { tpuNodes, stats, loading, error } = useTpuContext();
+
+  // Per-node in-use is aligned to tpuNodes order (fleet.ts contract);
+  // one identity map per render beats indexOf-per-cell (O(n²) at the
+  // 1024-node fleets the table is built for).
+  const inUseByNode = React.useMemo(
+    () => new Map(tpuNodes.map((n, i) => [n, stats.per_node_in_use[i] ?? 0])),
+    [tpuNodes, stats]
+  );
+
+  if (loading) {
+    return <Loader title="Loading TPU nodes" />;
+  }
+
+  return (
+    <>
+      <SectionHeader title="TPU Nodes" />
+      {error && (
+        <SectionBox title="Data errors">
+          <StatusLabel status="error">{error}</StatusLabel>
+        </SectionBox>
+      )}
+      <SectionBox title="Summary">
+        <NameValueTable
+          rows={[
+            { name: 'Nodes', value: stats.nodes_total },
+            { name: 'Ready', value: stats.nodes_ready },
+            { name: 'Chips in use', value: `${stats.in_use}/${stats.capacity}` },
+          ]}
+        />
+      </SectionBox>
+      <SectionBox title="Nodes">
+        <SimpleTable
+          columns={[
+            { label: 'Node', getter: (n: KubeNode) => nodeName(n) },
+            {
+              label: 'Ready',
+              getter: (n: KubeNode) => (
+                <StatusLabel status={isNodeReady(n) ? 'success' : 'error'}>
+                  {isNodeReady(n) ? 'Ready' : 'NotReady'}
+                </StatusLabel>
+              ),
+            },
+            { label: 'Generation', getter: (n: KubeNode) => formatGeneration(getNodeGeneration(n)) },
+            { label: 'Topology', getter: (n: KubeNode) => getNodeTopology(n) ?? '—' },
+            { label: 'Node pool', getter: (n: KubeNode) => getNodePool(n) ?? '—' },
+            {
+              label: 'Worker',
+              getter: (n: KubeNode) => {
+                const id = getNodeWorkerId(n);
+                return id === null ? '—' : id;
+              },
+            },
+            {
+              label: 'Chips (used/alloc/cap)',
+              getter: (n: KubeNode) =>
+                `${inUseByNode.get(n) ?? 0}/${getNodeChipAllocatable(n)}/${getNodeChipCapacity(n)}`,
+            },
+          ]}
+          data={tpuNodes}
+          emptyMessage="No TPU nodes found"
+        />
+      </SectionBox>
+    </>
+  );
+}

@@ -1,0 +1,165 @@
+/**
+ * OverviewPage — TPU fleet dashboard.
+ *
+ * Headlamp-native rendering of the Python framework's overview page
+ * (`headlamp_tpu/pages/overview.py`), which itself rebuilds the
+ * reference's `/root/reference/src/components/OverviewPage.tsx`
+ * section-for-section: plugin status, node summary + generation
+ * distribution, chip allocation, slice health (the TPU-first addition
+ * — the slice, not the node, is the schedulable unit), and workload
+ * phases.
+ */
+
+import {
+  Loader,
+  NameValueTable,
+  SectionBox,
+  SectionHeader,
+  SimpleTable,
+  StatusLabel,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React from 'react';
+import {
+  formatChipCount,
+  formatGeneration,
+  getPodChipRequest,
+  podName,
+  podNamespace,
+  podNodeName,
+  podPhase,
+} from '../api/fleet';
+import { useTpuContext } from '../api/TpuDataContext';
+
+/** Overview caps its pod table like the Python page (ACTIVE_PODS_CAP). */
+const ACTIVE_PODS_CAP = 10;
+
+function phaseStatus(phase: string): 'success' | 'warning' | 'error' {
+  if (phase === 'Running' || phase === 'Succeeded') return 'success';
+  if (phase === 'Pending') return 'warning';
+  return 'error';
+}
+
+export default function OverviewPage() {
+  const { tpuNodes, tpuPods, pluginPods, slices, sliceSummary, stats, pluginInstalled, loading, error } =
+    useTpuContext();
+
+  if (loading) {
+    return <Loader title="Loading TPU fleet" />;
+  }
+
+  const genCounts = Object.entries(stats.generation_counts)
+    .map(([gen, count]) => [formatGeneration(gen), count] as const)
+    .sort(([a], [b]) => (a < b ? -1 : a > b ? 1 : 0));
+
+  const running = tpuPods
+    .filter(p => podPhase(p) === 'Running')
+    .sort((a, b) => {
+      const ta = String(a?.metadata?.creationTimestamp ?? '');
+      const tb = String(b?.metadata?.creationTimestamp ?? '');
+      return ta < tb ? 1 : ta > tb ? -1 : 0;
+    })
+    .slice(0, ACTIVE_PODS_CAP);
+
+  return (
+    <>
+      <SectionHeader title="Cloud TPU Overview" />
+      {error && (
+        <SectionBox title="Data errors">
+          <StatusLabel status="error">{error}</StatusLabel>
+        </SectionBox>
+      )}
+      <SectionBox title="Device Plugin">
+        <NameValueTable
+          rows={[
+            {
+              name: 'Status',
+              value: (
+                <StatusLabel status={pluginInstalled ? 'success' : 'warning'}>
+                  {pluginInstalled ? 'Installed' : 'Not detected'}
+                </StatusLabel>
+              ),
+            },
+            { name: 'Daemon pods', value: pluginPods.length },
+          ]}
+        />
+      </SectionBox>
+      <SectionBox title="TPU Nodes">
+        <NameValueTable
+          rows={[
+            { name: 'Total', value: stats.nodes_total },
+            { name: 'Ready', value: stats.nodes_ready },
+            { name: 'Not Ready', value: stats.nodes_total - stats.nodes_ready },
+            ...genCounts.map(([gen, count]) => ({ name: gen, value: count })),
+          ]}
+        />
+      </SectionBox>
+      <SectionBox title="Chip Allocation">
+        <NameValueTable
+          rows={[
+            { name: 'Capacity', value: formatChipCount(stats.capacity) },
+            { name: 'Allocatable', value: formatChipCount(stats.allocatable) },
+            { name: 'In use', value: formatChipCount(stats.in_use) },
+            { name: 'Free', value: formatChipCount(stats.free) },
+            { name: 'Utilization', value: `${stats.utilization_pct}%` },
+            {
+              name: 'Hot nodes (≥90%)',
+              value:
+                stats.hot_nodes > 0 ? (
+                  <StatusLabel status="error">{stats.hot_nodes}</StatusLabel>
+                ) : (
+                  0
+                ),
+            },
+            { name: 'Max node utilization', value: `${Math.round(stats.max_node_util_pct)}%` },
+          ]}
+        />
+      </SectionBox>
+      {slices.length > 0 && (
+        <SectionBox title="Pod Slices">
+          <NameValueTable
+            rows={[
+              { name: 'Slices', value: sliceSummary.total },
+              { name: 'Healthy', value: sliceSummary.healthy },
+              { name: 'Degraded', value: sliceSummary.degraded },
+              { name: 'Incomplete', value: sliceSummary.incomplete },
+              { name: 'Multi-host', value: sliceSummary.multi_host },
+            ]}
+          />
+        </SectionBox>
+      )}
+      <SectionBox title="TPU Workloads">
+        <NameValueTable
+          rows={Object.entries(stats.phase_counts)
+            .filter(([phase, count]) => count > 0 || phase !== 'Other')
+            .map(([phase, count]) => ({ name: phase, value: count }))}
+        />
+      </SectionBox>
+      <SectionBox title={`Active TPU Pods (top ${ACTIVE_PODS_CAP})`}>
+        <SimpleTable
+          columns={[
+            { label: 'Pod', getter: (p: any) => `${podNamespace(p)}/${podName(p)}` },
+            { label: 'Node', getter: (p: any) => podNodeName(p) ?? '—' },
+            {
+              label: 'Phase',
+              getter: (p: any) => (
+                <StatusLabel status={phaseStatus(podPhase(p))}>{podPhase(p)}</StatusLabel>
+              ),
+            },
+            { label: 'Chips', getter: (p: any) => getPodChipRequest(p) },
+          ]}
+          data={running}
+          emptyMessage="No running TPU pods"
+        />
+      </SectionBox>
+      {tpuNodes.length === 0 && (
+        <SectionBox title="Getting started">
+          <p>
+            No TPU nodes detected. Create a GKE node pool with a TPU accelerator (for example
+            `gcloud container node-pools create ... --machine-type=ct5lp-hightpu-4t`) and the
+            fleet will appear here.
+          </p>
+        </SectionBox>
+      )}
+    </>
+  );
+}

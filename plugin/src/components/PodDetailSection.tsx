@@ -1,0 +1,62 @@
+/**
+ * PodDetailSection — per-container TPU chip requests injected into
+ * Headlamp's native Pod detail page.
+ *
+ * Mirrors `headlamp_tpu/integrations/pod_detail.py` (rebuilding
+ * `/root/reference/src/components/PodDetailSection.tsx`). Renders null
+ * for pods that request no TPU chips. Self-contained on the pod object
+ * — no provider context needed, exactly like the reference's pod
+ * section (`index.tsx:167-170` mounts it without the provider).
+ */
+
+import {
+  NameValueTable,
+  SectionBox,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React from 'react';
+import { getPodChipRequest, isTpuRequestingPod } from '../api/fleet';
+import { TPU_RESOURCE } from '../api/topology';
+
+export default function PodDetailSection({ resource }: { resource: { jsonData?: unknown } }) {
+  const pod = (resource?.jsonData ?? resource) as Record<string, any>;
+
+  if (!isTpuRequestingPod(pod)) {
+    return null;
+  }
+
+  // Init containers included, marked — a pod whose only TPU request is
+  // in an initContainer must explain its effective total
+  // (`integrations/pod_detail.py` iterates the same union).
+  const containers: Array<[Record<string, any>, boolean]> = [
+    ...(Array.isArray(pod?.spec?.containers) ? pod.spec.containers : []).map(
+      (c: Record<string, any>) => [c, false] as [Record<string, any>, boolean]
+    ),
+    ...(Array.isArray(pod?.spec?.initContainers) ? pod.spec.initContainers : []).map(
+      (c: Record<string, any>) => [c, true] as [Record<string, any>, boolean]
+    ),
+  ];
+  const rows = containers
+    .map(([c, isInit]) => {
+      const requests = c?.resources?.requests ?? {};
+      const limits = c?.resources?.limits ?? {};
+      const chips = requests[TPU_RESOURCE] ?? limits[TPU_RESOURCE];
+      return chips !== undefined
+        ? {
+            name: `${String(c.name ?? 'container')}${isInit ? ' (init)' : ''}`,
+            value: `${chips} chips`,
+          }
+        : null;
+    })
+    .filter((r): r is { name: string; value: string } => r !== null);
+
+  return (
+    <SectionBox title="TPU Resources">
+      <NameValueTable
+        rows={[
+          { name: 'Total chips (effective)', value: getPodChipRequest(pod) },
+          ...rows,
+        ]}
+      />
+    </SectionBox>
+  );
+}

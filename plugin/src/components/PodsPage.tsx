@@ -1,0 +1,74 @@
+/**
+ * PodsPage — every pod requesting TPU chips.
+ *
+ * Headlamp-native rendering of `headlamp_tpu/pages/pods.py` (rebuilding
+ * `/root/reference/src/components/PodsPage.tsx` for TPU primitives).
+ */
+
+import {
+  Loader,
+  NameValueTable,
+  SectionBox,
+  SectionHeader,
+  SimpleTable,
+  StatusLabel,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React from 'react';
+import {
+  getPodChipRequest,
+  podName,
+  podNamespace,
+  podNodeName,
+  podPhase,
+} from '../api/fleet';
+import { useTpuContext } from '../api/TpuDataContext';
+
+function phaseStatus(phase: string): 'success' | 'warning' | 'error' {
+  if (phase === 'Running' || phase === 'Succeeded') return 'success';
+  if (phase === 'Pending') return 'warning';
+  return 'error';
+}
+
+export default function PodsPage() {
+  const { tpuPods, stats, loading, error } = useTpuContext();
+
+  if (loading) {
+    return <Loader title="Loading TPU workloads" />;
+  }
+
+  return (
+    <>
+      <SectionHeader title="TPU Workloads" />
+      {error && (
+        <SectionBox title="Data errors">
+          <StatusLabel status="error">{error}</StatusLabel>
+        </SectionBox>
+      )}
+      <SectionBox title="Phases">
+        <NameValueTable
+          rows={Object.entries(stats.phase_counts)
+            .filter(([phase, count]) => count > 0 || phase !== 'Other')
+            .map(([phase, count]) => ({ name: phase, value: count }))}
+        />
+      </SectionBox>
+      <SectionBox title="Pods">
+        <SimpleTable
+          columns={[
+            { label: 'Namespace', getter: (p: any) => podNamespace(p) },
+            { label: 'Pod', getter: (p: any) => podName(p) },
+            { label: 'Node', getter: (p: any) => podNodeName(p) ?? '—' },
+            {
+              label: 'Phase',
+              getter: (p: any) => (
+                <StatusLabel status={phaseStatus(podPhase(p))}>{podPhase(p)}</StatusLabel>
+              ),
+            },
+            { label: 'TPU chips', getter: (p: any) => getPodChipRequest(p) },
+          ]}
+          data={tpuPods}
+          emptyMessage="No pods request TPU chips"
+        />
+      </SectionBox>
+    </>
+  );
+}

@@ -1,0 +1,164 @@
+/**
+ * TopologyPage — pod slices with ICI mesh geometry.
+ *
+ * Headlamp-native rendering of the Python framework's topology page
+ * (`headlamp_tpu/pages/topology_page.py`). No reference analogue: the
+ * reference treats nodes as independent; a TPU fleet's schedulable unit
+ * is the slice, and its health depends on every worker of the slice
+ * being present and Ready (SURVEY.md §2.3). The mesh SVG is computed by
+ * the shared engine (`../api/topology.ts`, fixture-pinned to the
+ * Python `topology/mesh.py`).
+ */
+
+import {
+  Loader,
+  NameValueTable,
+  SectionBox,
+  SectionHeader,
+  SimpleTable,
+  StatusLabel,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React from 'react';
+import { useTpuContext } from '../api/TpuDataContext';
+import {
+  buildMeshLayout,
+  MeshLayout,
+  SliceInfo,
+  sliceExpectedHosts,
+  sliceTotalChips,
+} from '../api/topology';
+
+const WORKER_PALETTE = ['#1f77b4', '#ff7f0e', '#2ca02c', '#d62728', '#9467bd', '#8c564b', '#e377c2', '#7f7f7f'];
+
+function healthLabel(health: SliceInfo['health']): React.ReactNode {
+  const text = health === 'success' ? 'Healthy' : health === 'warning' ? 'Degraded' : 'Incomplete';
+  return <StatusLabel status={health}>{text}</StatusLabel>;
+}
+
+/** Chip-level mesh: one circle per chip at the engine's grid
+ * coordinates (cells are `[chip_index, coord, worker_id, px, py]`
+ * tuples — the shared-fixture wire format), colored by owning worker;
+ * ICI links drawn beneath, wrap links dashed. */
+function MeshSvg({ layout }: { layout: MeshLayout }) {
+  const CELL = 36; // px per grid unit
+  const MARGIN = 20;
+  const r = 8;
+  const x = (gx: number) => MARGIN + gx * CELL;
+  const y = (gy: number) => MARGIN + gy * CELL;
+  const width = (layout.width - 1) * CELL + MARGIN * 2;
+  const height = (layout.height - 1) * CELL + MARGIN * 2;
+  return (
+    <svg
+      width={width}
+      height={height}
+      viewBox={`0 0 ${width} ${height}`}
+      role="img"
+      aria-label="TPU slice interconnect mesh"
+    >
+      {layout.links.map(([a, b, , wrap], i) => {
+        const [, , , ax, ay] = layout.cells[a];
+        const [, , , bx, by] = layout.cells[b];
+        return (
+          <line
+            key={i}
+            x1={x(ax)}
+            y1={y(ay)}
+            x2={x(bx)}
+            y2={y(by)}
+            stroke="#b0b0b0"
+            strokeWidth={1.5}
+            strokeDasharray={wrap ? '4 3' : undefined}
+          />
+        );
+      })}
+      {layout.cells.map(([chipIndex, coord, workerId, px, py]) => (
+        <circle
+          key={chipIndex}
+          cx={x(px)}
+          cy={y(py)}
+          r={r}
+          fill={WORKER_PALETTE[workerId % WORKER_PALETTE.length]}
+        >
+          <title>{`chip ${chipIndex} · worker ${workerId} · (${coord.join(', ')})`}</title>
+        </circle>
+      ))}
+    </svg>
+  );
+}
+
+function SliceCard({ slice }: { slice: SliceInfo }) {
+  const layout = buildMeshLayout(slice);
+  return (
+    <SectionBox title={`Slice ${slice.slice_id}`}>
+      <NameValueTable
+        rows={[
+          { name: 'Health', value: healthLabel(slice.health) },
+          { name: 'Accelerator', value: slice.accelerator ?? 'unknown' },
+          { name: 'Topology', value: slice.topology ?? '—' },
+          { name: 'Chips', value: sliceTotalChips(slice) },
+          {
+            name: 'Hosts',
+            value: `${slice.workers.length}/${sliceExpectedHosts(slice)} present`,
+          },
+        ]}
+      />
+      <MeshSvg layout={layout} />
+      <SimpleTable
+        columns={[
+          { label: 'Worker', getter: (w: any) => w.worker_id },
+          { label: 'Node', getter: (w: any) => w.node_name },
+          {
+            label: 'Ready',
+            getter: (w: any) => (
+              <StatusLabel status={w.ready ? 'success' : 'error'}>
+                {w.ready ? 'Ready' : 'NotReady'}
+              </StatusLabel>
+            ),
+          },
+          { label: 'Chips', getter: (w: any) => w.chip_capacity },
+        ]}
+        data={slice.workers}
+        emptyMessage="No workers present"
+      />
+    </SectionBox>
+  );
+}
+
+export default function TopologyPage() {
+  const { slices, sliceSummary, loading, error } = useTpuContext();
+
+  if (loading) {
+    return <Loader title="Loading TPU topology" />;
+  }
+
+  return (
+    <>
+      <SectionHeader title="TPU Topology" />
+      {error && (
+        <SectionBox title="Data errors">
+          <StatusLabel status="error">{error}</StatusLabel>
+        </SectionBox>
+      )}
+      <SectionBox title="Slice Summary">
+        <NameValueTable
+          rows={[
+            { name: 'Slices', value: sliceSummary.total },
+            { name: 'Healthy', value: sliceSummary.healthy },
+            { name: 'Degraded', value: sliceSummary.degraded },
+            { name: 'Incomplete', value: sliceSummary.incomplete },
+            { name: 'Multi-host', value: sliceSummary.multi_host },
+            { name: 'Total chips', value: sliceSummary.total_chips },
+          ]}
+        />
+      </SectionBox>
+      {slices.map(s => (
+        <SliceCard key={s.slice_id} slice={s} />
+      ))}
+      {slices.length === 0 && (
+        <SectionBox title="No slices">
+          <p>No TPU slices found — no nodes carry the GKE TPU labels.</p>
+        </SectionBox>
+      )}
+    </>
+  );
+}

@@ -1,0 +1,45 @@
+/**
+ * NodeColumns — TPU columns appended to Headlamp's native Nodes table.
+ *
+ * Mirrors `headlamp_tpu/integrations/node_columns.py:build_node_tpu_columns`
+ * (rebuilding `/root/reference/src/components/integrations/
+ * NodeColumns.tsx`): a Generation column and a Chips column, each
+ * rendering '—' for non-TPU nodes so the table stays clean on mixed
+ * clusters.
+ */
+
+import React from 'react';
+import { formatGeneration, getNodeGeneration } from '../../api/fleet';
+import { getNodeChipCapacity, isTpuNode } from '../../api/topology';
+
+export interface NodeTableColumn {
+  id: string;
+  label: string;
+  getValue: (node: { jsonData?: unknown }) => string;
+  render?: (node: { jsonData?: unknown }) => React.ReactNode;
+}
+
+function unwrap(node: { jsonData?: unknown }): Record<string, any> {
+  return (node?.jsonData ?? node) as Record<string, any>;
+}
+
+export function buildNodeTpuColumns(): NodeTableColumn[] {
+  return [
+    {
+      id: 'tpu-generation',
+      label: 'TPU',
+      getValue: node => {
+        const n = unwrap(node);
+        return isTpuNode(n) ? formatGeneration(getNodeGeneration(n)) : '—';
+      },
+    },
+    {
+      id: 'tpu-chips',
+      label: 'TPU Chips',
+      getValue: node => {
+        const n = unwrap(node);
+        return isTpuNode(n) ? String(getNodeChipCapacity(n)) : '—';
+      },
+    },
+  ];
+}

@@ -1,0 +1,167 @@
+/**
+ * Page rendering tests: mount each page inside TpuDataProvider against
+ * the shared fixture fleets (`fixtures/*.json` — the same clusters the
+ * Python pages are tested on) and assert the rendered fleet numbers
+ * match the fixture's recorded `fleet_stats`/topology expectations.
+ */
+
+import { render, screen } from '@testing-library/react';
+import { readFileSync } from 'node:fs';
+import { join } from 'node:path';
+import React from 'react';
+import { beforeEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../testing/mockCommonComponents')
+);
+
+import { TpuDataProvider } from '../api/TpuDataContext';
+import { setMockCluster } from '../testing/mockHeadlampLib';
+import NodeDetailSection from './NodeDetailSection';
+import NodesPage from './NodesPage';
+import OverviewPage from './OverviewPage';
+import PodDetailSection from './PodDetailSection';
+import PodsPage from './PodsPage';
+import TopologyPage from './TopologyPage';
+
+const FIXTURES_DIR = join(__dirname, '..', '..', '..', 'fixtures');
+
+function loadFixture(name: string) {
+  return JSON.parse(readFileSync(join(FIXTURES_DIR, `${name}.json`), 'utf-8'));
+}
+
+function mount(children: React.ReactNode) {
+  return render(<TpuDataProvider>{children}</TpuDataProvider>);
+}
+
+describe('OverviewPage on the mixed fixture', () => {
+  beforeEach(() => {
+    const { fleet } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+  });
+
+  it('renders the fixture fleet stats', async () => {
+    const { expected } = loadFixture('mixed');
+    mount(<OverviewPage />);
+    await screen.findByText('Chip Allocation');
+    // Capacity and Allocatable may format identically — getAllByText.
+    expect(
+      screen.getAllByText(`${expected.fleet_stats.capacity} chips`).length
+    ).toBeGreaterThan(0);
+    expect(screen.getByText(`${expected.fleet_stats.utilization_pct}%`)).toBeTruthy();
+    // Intel-only / plain nodes must not leak into the TPU count.
+    const nodesSection = screen.getByText('TPU Nodes').closest('section')!;
+    expect(nodesSection.textContent).toContain(String(expected.fleet_stats.nodes_total));
+  });
+
+  it('lists running TPU pods', async () => {
+    mount(<OverviewPage />);
+    await screen.findByText('Chip Allocation');
+    for (const name of loadFixture('mixed').expected.tpu_pod_names) {
+      expect(screen.getByText(new RegExp(name))).toBeTruthy();
+    }
+  });
+});
+
+describe('OverviewPage when a list errors', () => {
+  it('surfaces the error instead of an eternal loader', async () => {
+    // Headlamp's useList reports [null, error] when a list fails (e.g.
+    // RBAC forbids the all-namespaces Pod list): the page must leave
+    // the loading state and render the error banner.
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({
+      nodes: fleet.nodes,
+      pods: null,
+      podError: 'pods is forbidden',
+    });
+    mount(<OverviewPage />);
+    await screen.findByText('Data errors');
+    expect(screen.getByText(/pods is forbidden/)).toBeTruthy();
+    expect(screen.queryByTestId('loader')).toBeNull();
+  });
+});
+
+describe('TopologyPage on the degraded fixture', () => {
+  beforeEach(() => {
+    const { fleet } = loadFixture('v5p32-degraded');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+  });
+
+  it('renders slice health and one circle per chip', async () => {
+    const { expected } = loadFixture('v5p32-degraded');
+    const slice = expected.slices[0];
+    const { container } = mount(<TopologyPage />);
+    await screen.findByText('Slice Summary');
+    expect(screen.getByText(`Slice ${slice.slice_id}`)).toBeTruthy();
+    // Worker 3 missing → incomplete: the summary row label AND the
+    // slice card's health StatusLabel both say so.
+    expect(screen.getAllByText('Incomplete').length).toBeGreaterThanOrEqual(2);
+    const circles = container.querySelectorAll('circle');
+    expect(circles).toHaveLength(slice.total_chips);
+    // Wrap links are dashed only for torus generations; v5p 2x2x4 has
+    // a size-4 axis → at least one dashed wrap link.
+    const dashed = container.querySelectorAll('line[stroke-dasharray]');
+    expect(dashed.length).toBeGreaterThan(0);
+  });
+});
+
+describe('NodesPage and PodsPage on v5p32', () => {
+  beforeEach(() => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+  });
+
+  it('lists every TPU node', async () => {
+    mount(<NodesPage />);
+    await screen.findByText('Summary');
+    for (const name of loadFixture('v5p32').expected.tpu_node_names) {
+      expect(screen.getByText(name)).toBeTruthy();
+    }
+  });
+
+  it('lists every TPU pod with its chip request', async () => {
+    mount(<PodsPage />);
+    await screen.findByText('Phases');
+    for (const name of loadFixture('v5p32').expected.tpu_pod_names) {
+      expect(screen.getByText(name)).toBeTruthy();
+    }
+  });
+});
+
+describe('detail sections', () => {
+  beforeEach(() => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+  });
+
+  it('NodeDetailSection renders chips and slice for a TPU node', async () => {
+    const { fleet } = loadFixture('v5p32');
+    mount(<NodeDetailSection resource={{ jsonData: fleet.nodes[0] } as any} />);
+    expect(await screen.findByText('Cloud TPU')).toBeTruthy();
+    expect(screen.getByText('Generation')).toBeTruthy();
+  });
+
+  it('NodeDetailSection renders nothing for a plain node', () => {
+    const { container } = mount(
+      <NodeDetailSection resource={{ jsonData: { metadata: { name: 'plain' } } } as any} />
+    );
+    expect(container.querySelector('section')).toBeNull();
+  });
+
+  it('PodDetailSection renders per-container chips for a TPU pod', () => {
+    const { fleet } = loadFixture('v5p32');
+    const tpuPod = fleet.pods.find((p: any) =>
+      JSON.stringify(p).includes('google.com/tpu')
+    );
+    render(<PodDetailSection resource={{ jsonData: tpuPod } as any} />);
+    expect(screen.getByText('TPU Resources')).toBeTruthy();
+  });
+
+  it('PodDetailSection renders nothing for a plain pod', () => {
+    const { container } = render(
+      <PodDetailSection resource={{ jsonData: { metadata: { name: 'web' } } } as any} />
+    );
+    expect(container.querySelector('section')).toBeNull();
+  });
+});

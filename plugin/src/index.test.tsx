@@ -1,0 +1,73 @@
+/**
+ * Registration-surface test: importing the plugin entry must register
+ * the same TPU surface the Python registry declares
+ * (`headlamp_tpu/registration.py` TPU half, checked structurally by
+ * `tests/test_ts_parity.py`): 5 sidebar entries, 4 routes, 2
+ * kind-guarded detail sections, and the 'headlamp-nodes' column
+ * processor.
+ */
+
+import { describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('./testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('./testing/mockCommonComponents')
+);
+
+import { captured } from './testing/mockHeadlampLib';
+import './index';
+
+describe('plugin registration surface', () => {
+  it('registers the sidebar section and entries', () => {
+    const urls = captured.sidebarEntries.map(e => [e.name, e.url]);
+    expect(urls).toEqual([
+      ['tpu', '/tpu'],
+      ['tpu-overview', '/tpu'],
+      ['tpu-nodes', '/tpu/nodes'],
+      ['tpu-pods', '/tpu/pods'],
+      ['tpu-topology', '/tpu/topology'],
+    ]);
+    expect(captured.sidebarEntries[0].parent).toBeNull();
+    for (const child of captured.sidebarEntries.slice(1)) {
+      expect(child.parent).toBe('tpu');
+    }
+  });
+
+  it('registers one exact route per page', () => {
+    expect(captured.routes.map(r => r.path)).toEqual([
+      '/tpu',
+      '/tpu/nodes',
+      '/tpu/pods',
+      '/tpu/topology',
+    ]);
+    for (const route of captured.routes) {
+      expect(route.exact).toBe(true);
+      expect(typeof route.component).toBe('function');
+      expect(route.sidebar).toBe(route.name);
+    }
+  });
+
+  it('kind-guards both detail sections', () => {
+    expect(captured.detailsViewSections).toHaveLength(2);
+    const [nodeSection, podSection] = captured.detailsViewSections;
+    // Wrong kinds render nothing at all.
+    expect(nodeSection({ resource: { kind: 'ConfigMap' } })).toBeNull();
+    expect(podSection({ resource: { kind: 'Node' } })).toBeNull();
+    expect(nodeSection({ resource: undefined })).toBeNull();
+    // Right kinds produce an element.
+    expect(nodeSection({ resource: { kind: 'Node' } })).not.toBeNull();
+    expect(podSection({ resource: { kind: 'Pod' } })).not.toBeNull();
+  });
+
+  it('appends TPU columns only to the headlamp-nodes table', () => {
+    expect(captured.columnsProcessors).toHaveLength(1);
+    const processor = captured.columnsProcessors[0];
+    const base = [{ id: 'name' }];
+    const extended = processor({ id: 'headlamp-nodes', columns: base });
+    expect(extended).toHaveLength(3);
+    expect((extended[1] as any).id).toBe('tpu-generation');
+    expect((extended[2] as any).id).toBe('tpu-chips');
+    // Other tables pass through untouched.
+    expect(processor({ id: 'headlamp-pods', columns: base })).toBe(base);
+  });
+});

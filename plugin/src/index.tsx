@@ -1,0 +1,161 @@
+/**
+ * headlamp-tpu-plugin — entry point.
+ *
+ * Registers the TPU surface against a live Headlamp instance: sidebar
+ * entries, routes, native detail-view sections, and the Nodes-table
+ * column processor. The registration surface mirrors the Python
+ * framework's registry (`headlamp_tpu/registration.py:register_plugin`,
+ * TPU half) and plays the role the reference's entry point plays for
+ * Intel GPUs (`/root/reference/src/index.tsx:35-182`).
+ *
+ * Pages surfaced:
+ *   - Sidebar section: Overview / Nodes / Workloads / Topology
+ *   - Native Node detail page: Cloud TPU section (chips, slice, pods)
+ *   - Native Pod detail page: TPU resource requests per container
+ *   - Native Nodes table: TPU generation and chip-count columns
+ */
+
+import {
+  registerDetailsViewSection,
+  registerResourceTableColumnsProcessor,
+  registerRoute,
+  registerSidebarEntry,
+} from '@kinvolk/headlamp-plugin/lib';
+import React from 'react';
+import { TpuDataProvider } from './api/TpuDataContext';
+import { buildNodeTpuColumns } from './components/integrations/NodeColumns';
+import NodeDetailSection from './components/NodeDetailSection';
+import NodesPage from './components/NodesPage';
+import OverviewPage from './components/OverviewPage';
+import PodDetailSection from './components/PodDetailSection';
+import PodsPage from './components/PodsPage';
+import TopologyPage from './components/TopologyPage';
+
+// ---------------------------------------------------------------------------
+// Sidebar entries (registration.py:116-127)
+// ---------------------------------------------------------------------------
+
+registerSidebarEntry({
+  parent: null,
+  name: 'tpu',
+  label: 'Cloud TPU',
+  url: '/tpu',
+  icon: 'mdi:memory',
+});
+
+registerSidebarEntry({
+  parent: 'tpu',
+  name: 'tpu-overview',
+  label: 'Overview',
+  url: '/tpu',
+  icon: 'mdi:view-dashboard',
+});
+
+registerSidebarEntry({
+  parent: 'tpu',
+  name: 'tpu-nodes',
+  label: 'Nodes',
+  url: '/tpu/nodes',
+  icon: 'mdi:server',
+});
+
+registerSidebarEntry({
+  parent: 'tpu',
+  name: 'tpu-pods',
+  label: 'Workloads',
+  url: '/tpu/pods',
+  icon: 'mdi:cube-outline',
+});
+
+registerSidebarEntry({
+  parent: 'tpu',
+  name: 'tpu-topology',
+  label: 'Topology',
+  url: '/tpu/topology',
+  icon: 'mdi:grid',
+});
+
+// ---------------------------------------------------------------------------
+// Routes (registration.py:156-163)
+// ---------------------------------------------------------------------------
+
+registerRoute({
+  path: '/tpu',
+  sidebar: 'tpu-overview',
+  name: 'tpu-overview',
+  exact: true,
+  component: () => (
+    <TpuDataProvider>
+      <OverviewPage />
+    </TpuDataProvider>
+  ),
+});
+
+registerRoute({
+  path: '/tpu/nodes',
+  sidebar: 'tpu-nodes',
+  name: 'tpu-nodes',
+  exact: true,
+  component: () => (
+    <TpuDataProvider>
+      <NodesPage />
+    </TpuDataProvider>
+  ),
+});
+
+registerRoute({
+  path: '/tpu/pods',
+  sidebar: 'tpu-pods',
+  name: 'tpu-pods',
+  exact: true,
+  component: () => (
+    <TpuDataProvider>
+      <PodsPage />
+    </TpuDataProvider>
+  ),
+});
+
+registerRoute({
+  path: '/tpu/topology',
+  sidebar: 'tpu-topology',
+  name: 'tpu-topology',
+  exact: true,
+  component: () => (
+    <TpuDataProvider>
+      <TopologyPage />
+    </TpuDataProvider>
+  ),
+});
+
+// ---------------------------------------------------------------------------
+// Detail view sections — kind-guarded like the reference
+// (`index.tsx:153,168`) and the Python registry's DetailSection kinds.
+// ---------------------------------------------------------------------------
+
+registerDetailsViewSection(({ resource }: { resource?: { kind?: string } }) => {
+  if (resource?.kind !== 'Node') return null;
+  return (
+    <TpuDataProvider>
+      <NodeDetailSection resource={resource} />
+    </TpuDataProvider>
+  );
+});
+
+registerDetailsViewSection(({ resource }: { resource?: { kind?: string } }) => {
+  if (resource?.kind !== 'Pod') return null;
+  return <PodDetailSection resource={resource} />;
+});
+
+// ---------------------------------------------------------------------------
+// Native Nodes table columns (registration.py:197-199; reference
+// `index.tsx:177-182` targets the same 'headlamp-nodes' table id).
+// ---------------------------------------------------------------------------
+
+registerResourceTableColumnsProcessor(
+  ({ id, columns }: { id: string; columns: unknown[] }) => {
+    if (id === 'headlamp-nodes') {
+      return [...columns, ...buildNodeTpuColumns()];
+    }
+    return columns;
+  }
+);

@@ -1,0 +1,96 @@
+/**
+ * Minimal stand-ins for `@kinvolk/headlamp-plugin/lib/CommonComponents`
+ * used by the vitest suites: render semantic HTML so tests assert on
+ * text content, not Headlamp's MUI internals. Swapped in via
+ * `vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', ...)`.
+ */
+
+import React from 'react';
+
+export function Loader({ title }: { title?: string }) {
+  return <div data-testid="loader">{title ?? 'Loading'}</div>;
+}
+
+export function SectionHeader({ title }: { title: React.ReactNode }) {
+  return <h1>{title}</h1>;
+}
+
+export function SectionBox({
+  title,
+  children,
+}: {
+  title?: React.ReactNode;
+  children?: React.ReactNode;
+}) {
+  return (
+    <section>
+      {title !== undefined && <h2>{title}</h2>}
+      {children}
+    </section>
+  );
+}
+
+export function NameValueTable({
+  rows,
+}: {
+  rows: Array<{ name: React.ReactNode; value: React.ReactNode }>;
+}) {
+  return (
+    <dl>
+      {rows.map((row, i) => (
+        <div key={i}>
+          <dt>{row.name}</dt>
+          <dd>{row.value}</dd>
+        </div>
+      ))}
+    </dl>
+  );
+}
+
+export function SimpleTable({
+  columns,
+  data,
+  emptyMessage,
+}: {
+  columns: Array<{ label: string; getter: (item: any) => React.ReactNode }>;
+  data: any[];
+  emptyMessage?: string;
+}) {
+  if (!data.length) {
+    return <p>{emptyMessage ?? 'No data'}</p>;
+  }
+  return (
+    <table>
+      <thead>
+        <tr>
+          {columns.map(c => (
+            <th key={c.label}>{c.label}</th>
+          ))}
+        </tr>
+      </thead>
+      <tbody>
+        {data.map((item, i) => (
+          <tr key={i}>
+            {columns.map(c => (
+              <td key={c.label}>{c.getter(item)}</td>
+            ))}
+          </tr>
+        ))}
+      </tbody>
+    </table>
+  );
+}
+
+export function StatusLabel({
+  status,
+  children,
+}: {
+  status: 'success' | 'warning' | 'error';
+  children?: React.ReactNode;
+}) {
+  return <span data-status={status}>{children}</span>;
+}
+
+export function PercentageBar(_props: Record<string, unknown>) {
+  return <div data-testid="percentage-bar" />;
+}

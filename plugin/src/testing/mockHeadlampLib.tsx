@@ -1,0 +1,77 @@
+/**
+ * Mock of `@kinvolk/headlamp-plugin/lib` for the vitest suites.
+ *
+ * - `K8s.ResourceClasses.{Node,Pod}.useList()` serve a fixture cluster
+ *   installed with `setMockCluster` (raw JSON objects, exactly what
+ *   `extractJsonData` unwraps from real KubeObjects).
+ * - `ApiProxy.request` answers pod-list URLs from the same cluster.
+ * - The four `register*` entry points capture their arguments into
+ *   `captured` so registration tests can assert the full surface.
+ */
+
+export interface MockCluster {
+  /** null = the list errored (Headlamp leaves items null then). */
+  nodes: Record<string, any>[] | null;
+  pods: Record<string, any>[] | null;
+  /** Error strings to surface through the useList error slot. */
+  nodeError?: string | null;
+  podError?: string | null;
+}
+
+let cluster: MockCluster = { nodes: [], pods: [] };
+
+export function setMockCluster(next: MockCluster): void {
+  cluster = next;
+}
+
+export const K8s = {
+  ResourceClasses: {
+    Node: {
+      useList: () => [cluster.nodes, cluster.nodeError ?? null],
+    },
+    Pod: {
+      useList: (_opts?: Record<string, unknown>) => [cluster.pods, cluster.podError ?? null],
+    },
+  },
+};
+
+export const ApiProxy = {
+  request: async (url: string): Promise<unknown> => {
+    if (url.includes('/pods')) {
+      return { items: cluster.pods };
+    }
+    throw new Error(`mock ApiProxy: unhandled URL ${url}`);
+  },
+};
+
+export interface CapturedRegistrations {
+  sidebarEntries: Array<Record<string, any>>;
+  routes: Array<Record<string, any>>;
+  detailsViewSections: Array<(props: any) => unknown>;
+  columnsProcessors: Array<(args: { id: string; columns: unknown[] }) => unknown[]>;
+}
+
+export const captured: CapturedRegistrations = {
+  sidebarEntries: [],
+  routes: [],
+  detailsViewSections: [],
+  columnsProcessors: [],
+};
+
+export function registerSidebarEntry(entry: Record<string, any>): void {
+  captured.sidebarEntries.push(entry);
+}
+
+export function registerRoute(route: Record<string, any>): void {
+  captured.routes.push(route);
+}
+
+export function registerDetailsViewSection(section: (props: any) => unknown): void {
+  captured.detailsViewSections.push(section);
+}
+
+export function registerResourceTableColumnsProcessor(
+  processor: (args: { id: string; columns: unknown[] }) => unknown[]
+): void {
+  captured.columnsProcessors.push(processor);
+}

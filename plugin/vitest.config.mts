@@ -1,0 +1,11 @@
+import { defineConfig } from 'vitest/config';
+
+export default defineConfig({
+  test: {
+    environment: 'jsdom',
+    exclude: ['node_modules/**'],
+    env: {
+      NODE_ENV: 'test',
+    },
+  },
+});

@@ -20,6 +20,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from headlamp_tpu.analytics.stats import python_fleet_stats  # noqa: E402
+from headlamp_tpu.domain import objects, tpu  # noqa: E402
+from headlamp_tpu.domain.accelerator import classify_fleet  # noqa: E402
 from headlamp_tpu.fleet import fixtures as fx  # noqa: E402
 from headlamp_tpu.topology.mesh import build_mesh_layout  # noqa: E402
 from headlamp_tpu.topology.slices import group_slices, summarize_slices  # noqa: E402
@@ -99,7 +102,20 @@ def expected_for(fleet: dict) -> dict:
                 },
             }
         )
-    return {"slices": out_slices, "summary": dict(summarize_slices(slices))}
+    # Fleet-stats half of the contract: the TS `fleet.ts` mirror must
+    # reproduce python_fleet_stats (and the provider filters) exactly.
+    view = classify_fleet(fleet["nodes"], fleet.get("pods", []))["tpu"]
+    return {
+        "slices": out_slices,
+        "summary": dict(summarize_slices(slices)),
+        "fleet_stats": python_fleet_stats(view),
+        "tpu_node_names": [objects.name(n) for n in view.nodes],
+        "tpu_pod_names": [objects.name(p) for p in view.pods],
+        "plugin_pod_names": [
+            objects.name(p)
+            for p in tpu.filter_tpu_plugin_pods(fleet.get("pods", []))
+        ],
+    }
 
 
 def main() -> None:
