@@ -16,7 +16,7 @@ per_node_in_use, max_node_util_pct, hot_nodes.
 
 from __future__ import annotations
 
-from typing import Any, Mapping
+from typing import Any
 
 from ..domain import objects, tpu
 from ..domain.accelerator import FleetView

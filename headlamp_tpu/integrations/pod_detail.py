@@ -14,7 +14,7 @@ from typing import Any
 from ..domain import objects as obj
 from ..domain import tpu
 from ..domain.constants import TPU_RESOURCE
-from ..ui import NameValueTable, SectionBox, h
+from ..ui import NameValueTable, SectionBox
 from ..ui.vdom import Element
 from .common import unwrap_json_data
 from ..pages.common import phase_label

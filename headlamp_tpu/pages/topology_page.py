@@ -11,8 +11,6 @@ same information).
 
 from __future__ import annotations
 
-from typing import Any
-
 from ..context.accelerator_context import ClusterSnapshot
 from ..topology.mesh import MeshLayout, build_mesh_layout
 from ..topology.slices import SliceInfo, group_slices, summarize_slices

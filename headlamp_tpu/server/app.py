@@ -32,7 +32,7 @@ from urllib.parse import parse_qs, urlparse
 
 from ..context.accelerator_context import AcceleratorDataContext
 from ..metrics.client import fetch_tpu_metrics
-from ..pages.native import native_node_page, native_nodes_page, native_pod_page
+from ..pages.native import native_node_page, native_pod_page
 from ..registration import Registry, register_plugin
 from ..transport.api_proxy import MockTransport, Transport
 from ..ui import render_html

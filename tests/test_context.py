@@ -13,7 +13,6 @@ from headlamp_tpu.context import (
     NODES_PATH,
     PODS_PATH,
     AcceleratorDataContext,
-    default_sources,
 )
 from headlamp_tpu.fleet import fixtures as fx
 from headlamp_tpu.transport import ApiError, MockTransport

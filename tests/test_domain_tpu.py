@@ -6,7 +6,6 @@ from headlamp_tpu.domain import objects as obj
 from headlamp_tpu.domain import tpu
 from headlamp_tpu.domain.constants import (
     GKE_TPU_ACCELERATOR_LABEL,
-    GKE_TPU_TOPOLOGY_LABEL,
     TPU_RESOURCE,
 )
 from headlamp_tpu.fleet import (

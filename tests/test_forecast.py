@@ -1,8 +1,6 @@
 """Forecast feature tests: range-query history fetch, online fit,
 page section, and the server wiring through demo mode."""
 
-import math
-
 from headlamp_tpu.metrics.client import (
     TpuChipMetrics,
     TpuMetricsSnapshot,

@@ -14,7 +14,7 @@ from headlamp_tpu.integrations import (
 )
 from headlamp_tpu.registration import Registry, register_plugin
 from headlamp_tpu.transport import MockTransport
-from headlamp_tpu.ui import render_html, text_content
+from headlamp_tpu.ui import text_content
 
 
 def snapshot_for(fleet):

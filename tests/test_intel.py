@@ -2,7 +2,7 @@
 (CRD status machine, i915 power metrics, all five pages, native-view
 injections) hosted in this framework."""
 
-from headlamp_tpu.context import AcceleratorDataContext, NODES_PATH, PODS_PATH
+from headlamp_tpu.context import AcceleratorDataContext
 from headlamp_tpu.domain import intel
 from headlamp_tpu.fleet import fixtures as fx
 from headlamp_tpu.integrations import (
