@@ -1,0 +1,139 @@
+/**
+ * Metrics client tests: discovery chain, fallback-chain resolution,
+ * instance→node joining, and per-series scale normalization — the same
+ * behaviors `tests/test_metrics.py` pins on the Python client.
+ */
+
+import { describe, expect, it } from 'vitest';
+
+import {
+  fetchTpuMetrics,
+  findPrometheus,
+  formatBytes,
+  formatPercent,
+  proxyQueryPath,
+} from './metrics';
+
+type Responses = Record<string, unknown>;
+
+/** request fn serving canned vectors keyed by the PromQL expression. */
+function transport(byQuery: Responses, working = 'prometheus-k8s:9090') {
+  const calls: string[] = [];
+  const request = async (path: string): Promise<unknown> => {
+    calls.push(path);
+    if (!path.includes(working)) {
+      throw new Error('service not found');
+    }
+    const q = decodeURIComponent(path.split('query=')[1] ?? '');
+    if (q === '1') return { status: 'success', data: { resultType: 'scalar', result: [0, '1'] } };
+    if (q in byQuery) return byQuery[q];
+    return { status: 'success', data: { resultType: 'vector', result: [] } };
+  };
+  return { request, calls };
+}
+
+function vector(samples: Array<{ labels: Record<string, string>; value: number }>) {
+  return {
+    status: 'success',
+    data: {
+      resultType: 'vector',
+      result: samples.map(s => ({ metric: s.labels, value: [0, String(s.value)] })),
+    },
+  };
+}
+
+describe('discovery', () => {
+  it('probes the chain and returns the first responder', async () => {
+    const { request } = transport({}, 'prometheus-operated:9090');
+    const found = await findPrometheus(request);
+    expect(found).toEqual(['monitoring', 'prometheus-operated:9090']);
+  });
+
+  it('returns null when nothing answers', async () => {
+    const found = await findPrometheus(async () => {
+      throw new Error('nope');
+    });
+    expect(found).toBeNull();
+    expect(await fetchTpuMetrics(async () => ({}), null)).toBeNull();
+  });
+});
+
+describe('fetch + join', () => {
+  it('resolves fallback chains and joins per chip', async () => {
+    const { request } = transport({
+      // Canonical name empty; the tpu_ variant answers — the chain
+      // must record the variant as the resolved series.
+      tpu_tensorcore_utilization: vector([
+        { labels: { node: 'n1', accelerator_id: '0' }, value: 0.7 },
+        { labels: { node: 'n1', accelerator_id: '1' }, value: 0.4 },
+      ]),
+      hbm_bytes_used: vector([{ labels: { node: 'n1', accelerator_id: '0' }, value: 8e9 }]),
+    });
+    const snap = await fetchTpuMetrics(request, ['monitoring', 'prometheus-k8s:9090']);
+    expect(snap).not.toBeNull();
+    expect(snap!.availability.tensorcore_utilization).toBe(true);
+    expect(snap!.resolvedSeries.tensorcore_utilization).toBe('tpu_tensorcore_utilization');
+    expect(snap!.availability.duty_cycle).toBe(false);
+    expect(snap!.chips).toHaveLength(2);
+    expect(snap!.chips[0]).toMatchObject({
+      node: 'n1',
+      accelerator_id: '0',
+      tensorcore_utilization: 0.7,
+      hbm_bytes_used: 8e9,
+    });
+  });
+
+  it('normalizes 0-100 exporters per series', async () => {
+    const { request } = transport({
+      tensorcore_utilization: vector([
+        { labels: { node: 'n1', accelerator_id: '0' }, value: 87 },
+        { labels: { node: 'n1', accelerator_id: '1' }, value: 12 },
+      ]),
+    });
+    const snap = await fetchTpuMetrics(request, ['monitoring', 'prometheus-k8s:9090']);
+    expect(snap!.chips[0].tensorcore_utilization).toBeCloseTo(0.87);
+    expect(snap!.chips[1].tensorcore_utilization).toBeCloseTo(0.12);
+  });
+
+  it('keeps genuine fractions unscaled even at rate-jitter overshoot', async () => {
+    const { request } = transport({
+      tensorcore_utilization: vector([
+        { labels: { node: 'n1', accelerator_id: '0' }, value: 1.1 },
+      ]),
+    });
+    const snap = await fetchTpuMetrics(request, ['monitoring', 'prometheus-k8s:9090']);
+    // 1.1 ≤ FRACTION_MAX: saturated chip with rate overshoot, not a
+    // percent exporter; render-time clamp shows 100%.
+    expect(snap!.chips[0].tensorcore_utilization).toBeCloseTo(1.1);
+    expect(formatPercent(snap!.chips[0].tensorcore_utilization!)).toBe('100%');
+  });
+
+  it('joins instance-only samples through node_uname_info', async () => {
+    const { request } = transport({
+      node_uname_info: vector([
+        { labels: { instance: '10.0.0.7:9100', nodename: 'gke-w0' }, value: 1 },
+      ]),
+      tensorcore_utilization: vector([
+        { labels: { instance: '10.0.0.7:8431' }, value: 0.5 },
+      ]),
+    });
+    const snap = await fetchTpuMetrics(request, ['monitoring', 'prometheus-k8s:9090']);
+    expect(snap!.chips[0].node).toBe('gke-w0');
+  });
+});
+
+describe('formatting', () => {
+  it('formats bytes and percents', () => {
+    expect(formatBytes(8 * 1024 ** 3)).toBe('8.0 GiB');
+    expect(formatBytes(512)).toBe('512.0 B');
+    expect(formatPercent(0.874)).toBe('87%');
+    expect(formatPercent(1.3)).toBe('100%');
+    expect(formatPercent(-0.1)).toBe('0%');
+  });
+
+  it('builds service-proxy paths', () => {
+    expect(proxyQueryPath('monitoring', 'prometheus-k8s:9090', 'up')).toBe(
+      '/api/v1/namespaces/monitoring/services/prometheus-k8s:9090/proxy/api/v1/query?query=up'
+    );
+  });
+});

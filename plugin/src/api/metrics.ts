@@ -1,0 +1,302 @@
+/**
+ * metrics.ts — TPU Prometheus client for the Headlamp plugin.
+ *
+ * TypeScript mirror of `headlamp_tpu/metrics/client.py` (itself the
+ * TPU rebuild of the reference's four-stage client,
+ * `/root/reference/src/api/metrics.ts:61-154`):
+ *
+ * 1. Service discovery — probe the candidate Prometheus services
+ *    through the apiserver service proxy with `query=1`; first
+ *    responder wins.
+ * 2. Fan-out — every candidate of every logical metric plus the node
+ *    map goes out in one `Promise.all` wave.
+ * 3. Schema tolerance — each logical metric is a fallback chain of
+ *    candidate series names (tpu-device-plugin vs libtpu exporters);
+ *    first non-empty result wins, recorded in `resolvedSeries`.
+ * 4. Join — samples join into per-chip rows keyed (node,
+ *    accelerator_id), with an instance→node map from `node_uname_info`
+ *    for samples that carry only `instance`.
+ *
+ * Returns null when no Prometheus answers — the page renders the
+ * guided install box, never crashes. Pure fetch+join: takes a
+ * `request` function so tests inject fixtures without network.
+ */
+
+export type PromSample = { metric?: Record<string, string>; value?: [number, string] };
+
+export interface TpuChipMetrics {
+  node: string;
+  accelerator_id: string;
+  tensorcore_utilization: number | null;
+  memory_bandwidth_utilization: number | null;
+  hbm_bytes_used: number | null;
+  hbm_bytes_total: number | null;
+  duty_cycle: number | null;
+}
+
+export interface TpuMetricsSnapshot {
+  namespace: string;
+  service: string;
+  chips: TpuChipMetrics[];
+  availability: Record<string, boolean>;
+  resolvedSeries: Record<string, string>;
+  fetchMs: number;
+}
+
+/** Candidate (namespace, service:port) pairs, probed in order —
+ * `client.py:PROMETHEUS_SERVICES` (the reference chain plus
+ * prometheus-operator, Helm, and Google Managed Prometheus names). */
+export const PROMETHEUS_SERVICES: Array<[string, string]> = [
+  ['monitoring', 'prometheus-k8s:9090'],
+  ['monitoring', 'kube-prometheus-stack-prometheus:9090'],
+  ['monitoring', 'prometheus-operated:9090'],
+  ['monitoring', 'prometheus:9090'],
+  ['monitoring', 'prometheus-server:80'],
+  ['gmp-system', 'frontend:9090'],
+];
+
+/** logical name -> candidate PromQL expressions —
+ * `client.py:LOGICAL_METRICS` (BASELINE names, then GKE
+ * tpu-device-plugin kubelet-style, then libtpu variants). */
+export const LOGICAL_METRICS: Record<string, string[]> = {
+  tensorcore_utilization: [
+    'tensorcore_utilization',
+    'tpu_tensorcore_utilization',
+    'kubernetes_io_node_accelerator_tensorcore_utilization',
+  ],
+  memory_bandwidth_utilization: [
+    'memory_bandwidth_utilization',
+    'tpu_memory_bandwidth_utilization',
+    'kubernetes_io_node_accelerator_memory_bandwidth_utilization',
+  ],
+  hbm_bytes_used: [
+    'hbm_bytes_used',
+    'tpu_hbm_memory_usage_bytes',
+    'memory_used{accelerator=~"tpu.*"}',
+  ],
+  hbm_bytes_total: [
+    'hbm_bytes_total',
+    'tpu_hbm_memory_total_bytes',
+    'memory_total{accelerator=~"tpu.*"}',
+  ],
+  duty_cycle: ['duty_cycle{accelerator=~"tpu.*"}', 'tpu_duty_cycle'],
+};
+
+/** Operator-facing descriptions for the availability matrix. */
+export const LOGICAL_METRIC_DESCRIPTIONS: Record<string, string> = {
+  tensorcore_utilization: 'TensorCore (MXU) utilization per chip',
+  memory_bandwidth_utilization: 'HBM bandwidth utilization per chip',
+  hbm_bytes_used: 'HBM memory in use',
+  hbm_bytes_total: 'HBM memory capacity',
+  duty_cycle: 'Accelerator duty cycle (device-plugin exporter)',
+};
+
+export const NODE_MAP_QUERY = 'node_uname_info';
+
+const NODE_LABELS = ['node', 'node_name', 'exported_node', 'kubernetes_node'];
+const CHIP_LABELS = ['accelerator_id', 'device', 'chip', 'tpu', 'gpu'];
+const FRACTION_METRICS = [
+  'tensorcore_utilization',
+  'memory_bandwidth_utilization',
+  'duty_cycle',
+];
+
+/** Per-series scale detection threshold — `client.py:FRACTION_MAX`:
+ * a genuine fraction is bounded by 1.0; above this margin the whole
+ * series must be a 0-100 exporter and is divided by 100. */
+export const FRACTION_MAX = 1.2;
+
+export function proxyQueryPath(namespace: string, service: string, promql: string): string {
+  const q = encodeURIComponent(promql);
+  return `/api/v1/namespaces/${namespace}/services/${service}/proxy/api/v1/query?query=${q}`;
+}
+
+export type RequestFn = (path: string) => Promise<unknown>;
+
+function vectorResult(data: unknown): PromSample[] {
+  if (!data || typeof data !== 'object') return [];
+  const d = data as Record<string, any>;
+  if (d.status !== 'success') return [];
+  const inner = d.data;
+  if (!inner || typeof inner !== 'object' || inner.resultType !== 'vector') return [];
+  return Array.isArray(inner.result)
+    ? inner.result.filter((s: unknown) => s && typeof s === 'object')
+    : [];
+}
+
+function sampleValue(sample: PromSample): number | null {
+  const v = sample.value;
+  if (!Array.isArray(v) || v.length !== 2) return null;
+  const parsed = parseFloat(String(v[1]));
+  return Number.isNaN(parsed) ? null : parsed;
+}
+
+function sampleLabels(sample: PromSample): Record<string, string> {
+  return sample.metric && typeof sample.metric === 'object' ? sample.metric : {};
+}
+
+/** '10.0.0.7:9100' -> '10.0.0.7' — Python's rsplit(':', 1)[0]. Shared
+ * by the map build and the lookup so the two can never disagree. */
+function stripPort(instance: string): string {
+  return instance.includes(':') ? instance.slice(0, instance.lastIndexOf(':')) : instance;
+}
+
+function nodeOf(labels: Record<string, string>, instanceMap: Record<string, string>): string {
+  for (const key of NODE_LABELS) {
+    if (labels[key]) return String(labels[key]);
+  }
+  const instance = String(labels.instance ?? '');
+  if (instance in instanceMap) return instanceMap[instance];
+  const host = stripPort(instance);
+  return instanceMap[host] ?? (host || 'unknown');
+}
+
+function chipOf(labels: Record<string, string>): string {
+  for (const key of CHIP_LABELS) {
+    if (labels[key]) return String(labels[key]);
+  }
+  return '0';
+}
+
+function buildInstanceMap(samples: PromSample[]): Record<string, string> {
+  const out: Record<string, string> = {};
+  for (const s of samples) {
+    const labels = sampleLabels(s);
+    const nodename = String(labels.nodename ?? '');
+    const instance = String(labels.instance ?? '');
+    if (nodename && instance) {
+      out[instance] = nodename;
+      out[stripPort(instance)] = nodename;
+    }
+  }
+  return out;
+}
+
+/** Probe the service chain with `query=1`; first success wins. */
+export async function findPrometheus(
+  request: RequestFn
+): Promise<[string, string] | null> {
+  for (const [namespace, service] of PROMETHEUS_SERVICES) {
+    try {
+      const data = await request(proxyQueryPath(namespace, service, '1'));
+      if (data && typeof data === 'object' && (data as any).status === 'success') {
+        return [namespace, service];
+      }
+    } catch {
+      // Probe the next candidate.
+    }
+  }
+  return null;
+}
+
+/** Discover (unless pinned), fan out, join — `client.py:fetch_tpu_metrics`. */
+export async function fetchTpuMetrics(
+  request: RequestFn,
+  prometheus?: [string, string] | null
+): Promise<TpuMetricsSnapshot | null> {
+  const t0 = Date.now();
+  const found = prometheus ?? (await findPrometheus(request));
+  if (!found) return null;
+  const [namespace, service] = found;
+
+  const runQuery = async (promql: string): Promise<PromSample[]> => {
+    try {
+      return vectorResult(await request(proxyQueryPath(namespace, service, promql)));
+    } catch {
+      return [];
+    }
+  };
+
+  // One parallel wave: every candidate of every logical metric plus the
+  // node map — one slow series costs max(latency), not sum(latency).
+  const queries: string[] = [NODE_MAP_QUERY];
+  for (const candidates of Object.values(LOGICAL_METRICS)) {
+    queries.push(...candidates);
+  }
+  const resultList = await Promise.all(queries.map(runQuery));
+  const results = new Map(queries.map((q, i) => [q, resultList[i]]));
+
+  const instanceMap = buildInstanceMap(results.get(NODE_MAP_QUERY) ?? []);
+
+  const chips = new Map<string, TpuChipMetrics>();
+  const availability: Record<string, boolean> = {};
+  const resolvedSeries: Record<string, string> = {};
+  for (const [logical, candidates] of Object.entries(LOGICAL_METRICS)) {
+    let samples: PromSample[] = [];
+    for (const promql of candidates) {
+      samples = results.get(promql) ?? [];
+      if (samples.length) {
+        resolvedSeries[logical] = promql;
+        break;
+      }
+    }
+    availability[logical] = samples.length > 0;
+    // Scale decided ONCE per resolved series (client.py:326-337): any
+    // sample above FRACTION_MAX proves a 0-100 exporter.
+    let scale = 1.0;
+    if (FRACTION_METRICS.includes(logical) && samples.length) {
+      const values = samples.map(sampleValue).filter((v): v is number => v !== null);
+      if (values.length && Math.max(...values) > FRACTION_MAX) scale = 100.0;
+    }
+    for (const sample of samples) {
+      const labels = sampleLabels(sample);
+      let value = sampleValue(sample);
+      if (value === null) continue;
+      if (FRACTION_METRICS.includes(logical)) value = value / scale;
+      const node = nodeOf(labels, instanceMap);
+      const chip = chipOf(labels);
+      const key = `${node}/${chip}`;
+      let row = chips.get(key);
+      if (!row) {
+        row = {
+          node,
+          accelerator_id: chip,
+          tensorcore_utilization: null,
+          memory_bandwidth_utilization: null,
+          hbm_bytes_used: null,
+          hbm_bytes_total: null,
+          duty_cycle: null,
+        };
+        chips.set(key, row);
+      }
+      (row as any)[logical] = value;
+    }
+  }
+
+  const ordered = [...chips.values()].sort((a, b) =>
+    a.node < b.node
+      ? -1
+      : a.node > b.node
+        ? 1
+        : a.accelerator_id < b.accelerator_id
+          ? -1
+          : a.accelerator_id > b.accelerator_id
+            ? 1
+            : 0
+  );
+  return {
+    namespace,
+    service,
+    chips: ordered,
+    availability,
+    resolvedSeries,
+    fetchMs: Date.now() - t0,
+  };
+}
+
+export function formatBytes(n: number): string {
+  const units = ['B', 'KiB', 'MiB', 'GiB', 'TiB'];
+  let value = n;
+  let u = 0;
+  while (value >= 1024 && u < units.length - 1) {
+    value /= 1024;
+    u += 1;
+  }
+  return `${value.toFixed(1)} ${units[u]}`;
+}
+
+export function formatPercent(fraction: number): string {
+  // Render-time clamp bounds the residual (1.0, FRACTION_MAX] band of
+  // an ambiguous near-idle percent exporter (client.py scale notes).
+  return `${Math.round(Math.min(1, Math.max(0, fraction)) * 100)}%`;
+}

@@ -1,0 +1,166 @@
+/**
+ * DevicePluginsPage — the TPU device-plugin DaemonSet rollout.
+ *
+ * Headlamp-native rendering of `headlamp_tpu/pages/device_plugins.py`
+ * (rebuilding `/root/reference/src/components/DevicePluginsPage.tsx`
+ * for a world without an operator CRD): per-DaemonSet cards with
+ * rollout counters, node selector, and image, plus the daemon-pod
+ * table. DaemonSets come from the same fallback chain the Python
+ * provider walks (`context/sources.py:workload_paths` — labeled
+ * cluster-scope list, then the kube-system namespace).
+ */
+
+import { ApiProxy } from '@kinvolk/headlamp-plugin/lib';
+import {
+  Loader,
+  NameValueTable,
+  SectionBox,
+  SectionHeader,
+  SimpleTable,
+  StatusLabel,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React, { useEffect, useState } from 'react';
+import {
+  daemonsetStatusText,
+  daemonsetStatusToStatus,
+  KubeDaemonSet,
+  podName,
+  podNamespace,
+  podPhase,
+  TPU_PLUGIN_NAMESPACE,
+} from '../api/fleet';
+import { useTpuContext } from '../api/TpuDataContext';
+
+const DAEMONSET_PATHS = [
+  `/apis/apps/v1/daemonsets?labelSelector=${encodeURIComponent('k8s-app=tpu-device-plugin')}`,
+  `/apis/apps/v1/namespaces/${TPU_PLUGIN_NAMESPACE}/daemonsets`,
+];
+
+function isTpuPluginDaemonSet(ds: KubeDaemonSet): boolean {
+  // Name mention OR ANY label value — mirrors
+  // `sources.py:workload_matches_provider` (`needle in labels.values()`),
+  // so an install labeled app.kubernetes.io/name=tpu-device-plugin
+  // found by the namespace fallback is kept.
+  const needle = 'tpu-device-plugin';
+  const name = String(ds?.metadata?.name ?? '');
+  const labels = (ds?.metadata?.labels ?? {}) as Record<string, string>;
+  return name.includes(needle) || Object.values(labels).some(v => v === needle);
+}
+
+function dsNodeSelector(ds: KubeDaemonSet): string {
+  const selector = ds?.spec?.template?.spec?.nodeSelector;
+  if (selector && typeof selector === 'object' && Object.keys(selector).length) {
+    return Object.entries(selector)
+      .sort(([a], [b]) => (a < b ? -1 : 1))
+      .map(([k, v]) => `${k}=${v}`)
+      .join(', ');
+  }
+  return '—';
+}
+
+function dsImage(ds: KubeDaemonSet): string {
+  const containers = ds?.spec?.template?.spec?.containers;
+  return Array.isArray(containers) && containers[0]?.image ? String(containers[0].image) : '—';
+}
+
+export default function DevicePluginsPage() {
+  const { pluginPods, loading } = useTpuContext();
+  const [daemonsets, setDaemonsets] = useState<KubeDaemonSet[] | undefined>(undefined);
+  // Python's workload_available: did ANY list call succeed? Separates
+  // "readable but absent" from "nothing was readable (RBAC)".
+  const [sourceAvailable, setSourceAvailable] = useState(true);
+
+  useEffect(() => {
+    let cancelled = false;
+
+    async function fetchDaemonsets() {
+      const found: KubeDaemonSet[] = [];
+      let anySuccess = false;
+      for (const url of DAEMONSET_PATHS) {
+        // Chain semantics mirror `_fetch_workloads`: a path that
+        // succeeds with zero matches does NOT stop the chain.
+        try {
+          const list = (await ApiProxy.request(url)) as { items?: unknown[] };
+          if (Array.isArray(list?.items)) {
+            anySuccess = true;
+            const items = list.items.map(item =>
+              item && typeof item === 'object' && 'jsonData' in (item as object)
+                ? (item as { jsonData: KubeDaemonSet }).jsonData
+                : (item as KubeDaemonSet)
+            );
+            found.push(...items.filter(isTpuPluginDaemonSet));
+            if (found.length) break;
+          }
+        } catch {
+          // Walk the chain.
+        }
+      }
+      if (cancelled) return;
+      setDaemonsets(found);
+      setSourceAvailable(anySuccess);
+    }
+
+    void fetchDaemonsets();
+    return () => {
+      cancelled = true;
+    };
+  }, []);
+
+  if (loading || daemonsets === undefined) {
+    return <Loader title="Loading device plugin" />;
+  }
+
+  return (
+    <>
+      <SectionHeader title="TPU Device Plugin" />
+      {daemonsets.length === 0 && (
+        <SectionBox title={sourceAvailable ? 'Not installed' : 'DaemonSet not readable'}>
+          <p>
+            {sourceAvailable
+              ? 'No TPU device-plugin DaemonSet found. On GKE, TPU node pools deploy it automatically; elsewhere install the tpu-device-plugin DaemonSet.'
+              : 'DaemonSet lists could not be read (RBAC may forbid them) — the plugin may still be installed; daemon pods below are discovered independently.'}
+          </p>
+        </SectionBox>
+      )}
+      {daemonsets.map(ds => (
+        <SectionBox
+          key={String(ds?.metadata?.uid ?? ds?.metadata?.name)}
+          title={`${ds?.metadata?.namespace ?? ''}/${ds?.metadata?.name ?? 'daemonset'}`}
+        >
+          <NameValueTable
+            rows={[
+              {
+                name: 'Rollout',
+                value: (
+                  <StatusLabel status={daemonsetStatusToStatus(ds)}>
+                    {daemonsetStatusText(ds)}
+                  </StatusLabel>
+                ),
+              },
+              { name: 'Node selector', value: dsNodeSelector(ds) },
+              { name: 'Image', value: dsImage(ds) },
+            ]}
+          />
+        </SectionBox>
+      ))}
+      <SectionBox title="Daemon Pods">
+        <SimpleTable
+          columns={[
+            { label: 'Namespace', getter: (p: any) => podNamespace(p) },
+            { label: 'Pod', getter: (p: any) => podName(p) },
+            {
+              label: 'Phase',
+              getter: (p: any) => (
+                <StatusLabel status={podPhase(p) === 'Running' ? 'success' : 'warning'}>
+                  {podPhase(p)}
+                </StatusLabel>
+              ),
+            },
+          ]}
+          data={pluginPods}
+          emptyMessage="No daemon pods matched the selector chain"
+        />
+      </SectionBox>
+    </>
+  );
+}

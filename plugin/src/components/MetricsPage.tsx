@@ -1,0 +1,176 @@
+/**
+ * MetricsPage — TPU telemetry over Prometheus through the apiserver
+ * service proxy.
+ *
+ * Headlamp-native rendering of `headlamp_tpu/pages/metrics_page.py`
+ * (rebuilding `/root/reference/src/components/MetricsPage.tsx`): the
+ * honest Metric Availability matrix, fleet telemetry summary, and
+ * per-chip cards. The forecast section stays server-side (it needs the
+ * jax fit); the dashboard server carries it.
+ */
+
+import { ApiProxy } from '@kinvolk/headlamp-plugin/lib';
+import {
+  Loader,
+  NameValueTable,
+  SectionBox,
+  SectionHeader,
+  SimpleTable,
+  StatusLabel,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React, { useEffect, useState } from 'react';
+import {
+  fetchTpuMetrics,
+  formatBytes,
+  formatPercent,
+  LOGICAL_METRIC_DESCRIPTIONS,
+  LOGICAL_METRICS,
+  TpuChipMetrics,
+  TpuMetricsSnapshot,
+} from '../api/metrics';
+
+function ChipCard({ chip }: { chip: TpuChipMetrics }) {
+  const rows: Array<{ name: string; value: React.ReactNode }> = [];
+  if (chip.tensorcore_utilization !== null) {
+    rows.push({
+      name: 'TensorCore utilization',
+      value: formatPercent(chip.tensorcore_utilization),
+    });
+  }
+  if (chip.memory_bandwidth_utilization !== null) {
+    rows.push({
+      name: 'HBM bandwidth utilization',
+      value: formatPercent(chip.memory_bandwidth_utilization),
+    });
+  }
+  if (chip.hbm_bytes_used !== null && chip.hbm_bytes_total !== null) {
+    rows.push({
+      name: 'HBM used',
+      value: `${formatBytes(chip.hbm_bytes_used)} / ${formatBytes(chip.hbm_bytes_total)}`,
+    });
+  }
+  if (chip.duty_cycle !== null) {
+    rows.push({ name: 'Duty cycle', value: formatPercent(chip.duty_cycle) });
+  }
+  return (
+    <SectionBox title={`${chip.node} · chip ${chip.accelerator_id}`}>
+      {rows.length ? <NameValueTable rows={rows} /> : <p>No samples</p>}
+    </SectionBox>
+  );
+}
+
+export default function MetricsPage() {
+  const [snapshot, setSnapshot] = useState<TpuMetricsSnapshot | null | undefined>(undefined);
+
+  useEffect(() => {
+    let cancelled = false;
+    void fetchTpuMetrics(path => ApiProxy.request(path)).then(snap => {
+      if (!cancelled) setSnapshot(snap);
+    });
+    return () => {
+      cancelled = true;
+    };
+  }, []);
+
+  if (snapshot === undefined) {
+    return <Loader title="Scraping TPU telemetry" />;
+  }
+
+  if (snapshot === null) {
+    return (
+      <>
+        <SectionHeader title="TPU Metrics" />
+        <SectionBox title="Prometheus not reachable">
+          <p>
+            No Prometheus service answered through the apiserver proxy. Install
+            kube-prometheus (or enable Google Managed Prometheus) and expose the TPU
+            device-plugin / libtpu exporters; the page probes the standard service names
+            automatically.
+          </p>
+        </SectionBox>
+      </>
+    );
+  }
+
+  const utils = snapshot.chips
+    .map(c => c.tensorcore_utilization)
+    .filter((v): v is number => v !== null);
+  const hbmUsed = snapshot.chips
+    .map(c => c.hbm_bytes_used)
+    .filter((v): v is number => v !== null);
+  const hbmTotal = snapshot.chips
+    .map(c => c.hbm_bytes_total)
+    .filter((v): v is number => v !== null);
+
+  return (
+    <>
+      <SectionHeader title="TPU Metrics" />
+      <SectionBox title="Metric Availability">
+        <SimpleTable
+          columns={[
+            { label: 'Metric', getter: (m: any) => m.logical },
+            { label: 'Description', getter: (m: any) => LOGICAL_METRIC_DESCRIPTIONS[m.logical] },
+            {
+              label: 'Available',
+              getter: (m: any) => (
+                <StatusLabel status={m.available ? 'success' : 'warning'}>
+                  {m.available ? 'Yes' : 'No data'}
+                </StatusLabel>
+              ),
+            },
+            { label: 'Series', getter: (m: any) => m.series ?? '—' },
+          ]}
+          data={Object.keys(LOGICAL_METRICS).map(logical => ({
+            logical,
+            available: snapshot.availability[logical] ?? false,
+            series: snapshot.resolvedSeries[logical],
+          }))}
+        />
+        <p>
+          TPU series come from the GKE tpu-device-plugin or a libtpu exporter; names vary by
+          exporter version, so each metric resolves through a fallback chain. Scrape→join took{' '}
+          {snapshot.fetchMs} ms via {snapshot.namespace}/{snapshot.service}.
+        </p>
+      </SectionBox>
+      {snapshot.chips.length > 0 && (
+        <SectionBox title="Fleet Telemetry">
+          <NameValueTable
+            rows={[
+              { name: 'Chips reporting', value: snapshot.chips.length },
+              ...(utils.length
+                ? [
+                    {
+                      name: 'Mean TensorCore utilization',
+                      value: formatPercent(utils.reduce((a, b) => a + b, 0) / utils.length),
+                    },
+                  ]
+                : []),
+              ...(hbmUsed.length
+                ? [{ name: 'Total HBM used', value: formatBytes(hbmUsed.reduce((a, b) => a + b, 0)) }]
+                : []),
+              ...(hbmTotal.length
+                ? [
+                    {
+                      name: 'Total HBM capacity',
+                      value: formatBytes(hbmTotal.reduce((a, b) => a + b, 0)),
+                    },
+                  ]
+                : []),
+            ]}
+          />
+        </SectionBox>
+      )}
+      {snapshot.chips.length === 0 && (
+        <SectionBox title="No TPU samples">
+          <p>
+            Prometheus answered but no TPU series returned data — check that the
+            tpu-device-plugin or libtpu exporter is being scraped.
+          </p>
+        </SectionBox>
+      )}
+      {snapshot.chips.map(chip => (
+        <ChipCard key={`${chip.node}-${chip.accelerator_id}`} chip={chip} />
+      ))}
+    </>
+  );
+}

@@ -18,6 +18,8 @@ vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
 
 import { TpuDataProvider } from '../api/TpuDataContext';
 import { setMockCluster } from '../testing/mockHeadlampLib';
+import DevicePluginsPage from './DevicePluginsPage';
+import MetricsPage from './MetricsPage';
 import NodeDetailSection from './NodeDetailSection';
 import NodesPage from './NodesPage';
 import OverviewPage from './OverviewPage';
@@ -124,6 +126,32 @@ describe('NodesPage and PodsPage on v5p32', () => {
     mount(<PodsPage />);
     await screen.findByText('Phases');
     for (const name of loadFixture('v5p32').expected.tpu_pod_names) {
+      expect(screen.getByText(name)).toBeTruthy();
+    }
+  });
+});
+
+describe('MetricsPage without a reachable Prometheus', () => {
+  it('renders the guided install box, never crashes', async () => {
+    // The mock ApiProxy throws for every non-/pods URL, so the whole
+    // discovery chain fails — the reference behavior is a guided box.
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    render(<MetricsPage />);
+    expect(await screen.findByText('Prometheus not reachable')).toBeTruthy();
+  });
+});
+
+describe('DevicePluginsPage on the mixed fixture', () => {
+  it('lists daemon pods and explains the unreadable DaemonSet', async () => {
+    const { fleet } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount(<DevicePluginsPage />);
+    await screen.findByText('Daemon Pods');
+    // The mock ApiProxy rejects every daemonset list — the page must
+    // report "not readable" (RBAC), never claim "Not installed".
+    expect(screen.getByText('DaemonSet not readable')).toBeTruthy();
+    for (const name of loadFixture('mixed').expected.plugin_pod_names) {
       expect(screen.getByText(name)).toBeTruthy();
     }
   });

@@ -2,7 +2,7 @@
  * Registration-surface test: importing the plugin entry must register
  * the same TPU surface the Python registry declares
  * (`headlamp_tpu/registration.py` TPU half, checked structurally by
- * `tests/test_ts_parity.py`): 5 sidebar entries, 4 routes, 2
+ * `tests/test_ts_parity.py`): 7 sidebar entries, 6 routes, 2
  * kind-guarded detail sections, and the 'headlamp-nodes' column
  * processor.
  */
@@ -25,7 +25,9 @@ describe('plugin registration surface', () => {
       ['tpu-overview', '/tpu'],
       ['tpu-nodes', '/tpu/nodes'],
       ['tpu-pods', '/tpu/pods'],
+      ['tpu-deviceplugins', '/tpu/deviceplugins'],
       ['tpu-topology', '/tpu/topology'],
+      ['tpu-metrics', '/tpu/metrics'],
     ]);
     expect(captured.sidebarEntries[0].parent).toBeNull();
     for (const child of captured.sidebarEntries.slice(1)) {
@@ -38,7 +40,9 @@ describe('plugin registration surface', () => {
       '/tpu',
       '/tpu/nodes',
       '/tpu/pods',
+      '/tpu/deviceplugins',
       '/tpu/topology',
+      '/tpu/metrics',
     ]);
     for (const route of captured.routes) {
       expect(route.exact).toBe(true);

@@ -24,6 +24,8 @@ import {
 import React from 'react';
 import { TpuDataProvider } from './api/TpuDataContext';
 import { buildNodeTpuColumns } from './components/integrations/NodeColumns';
+import DevicePluginsPage from './components/DevicePluginsPage';
+import MetricsPage from './components/MetricsPage';
 import NodeDetailSection from './components/NodeDetailSection';
 import NodesPage from './components/NodesPage';
 import OverviewPage from './components/OverviewPage';
@@ -69,10 +71,26 @@ registerSidebarEntry({
 
 registerSidebarEntry({
   parent: 'tpu',
+  name: 'tpu-deviceplugins',
+  label: 'Device Plugin',
+  url: '/tpu/deviceplugins',
+  icon: 'mdi:chip',
+});
+
+registerSidebarEntry({
+  parent: 'tpu',
   name: 'tpu-topology',
   label: 'Topology',
   url: '/tpu/topology',
   icon: 'mdi:grid',
+});
+
+registerSidebarEntry({
+  parent: 'tpu',
+  name: 'tpu-metrics',
+  label: 'Metrics',
+  url: '/tpu/metrics',
+  icon: 'mdi:chart-line',
 });
 
 // ---------------------------------------------------------------------------
@@ -116,6 +134,18 @@ registerRoute({
 });
 
 registerRoute({
+  path: '/tpu/deviceplugins',
+  sidebar: 'tpu-deviceplugins',
+  name: 'tpu-deviceplugins',
+  exact: true,
+  component: () => (
+    <TpuDataProvider>
+      <DevicePluginsPage />
+    </TpuDataProvider>
+  ),
+});
+
+registerRoute({
   path: '/tpu/topology',
   sidebar: 'tpu-topology',
   name: 'tpu-topology',
@@ -125,6 +155,16 @@ registerRoute({
       <TopologyPage />
     </TpuDataProvider>
   ),
+});
+
+registerRoute({
+  path: '/tpu/metrics',
+  sidebar: 'tpu-metrics',
+  name: 'tpu-metrics',
+  exact: true,
+  // MetricsPage fetches through ApiProxy directly (the reference's
+  // MetricsPage also runs its own fetch cycle); no provider needed.
+  component: () => <MetricsPage />,
 });
 
 // ---------------------------------------------------------------------------

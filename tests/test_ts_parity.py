@@ -209,15 +209,12 @@ class TestHeadlampPluginSurface:
         assert "react" in pkg["peerDependencies"]
 
     def test_every_tpu_route_registered(self, index_source, python_registry):
+        # FULL route parity: every /tpu route the Python registry
+        # declares is registered against Headlamp too.
         tpu_routes = [
-            r.path
-            for r in python_registry.routes
-            if r.path.startswith("/tpu") and r.path not in
-            # Server-only routes the Headlamp plugin does not carry
-            # (Headlamp provides its own metrics/deviceplugin surfaces
-            # differently; tracked as the plugin's remaining gap).
-            ("/tpu/metrics", "/tpu/deviceplugins")
+            r.path for r in python_registry.routes if r.path.startswith("/tpu")
         ]
+        assert len(tpu_routes) == 6
         for path in tpu_routes:
             assert f"path: '{path}'" in index_source, path
 
@@ -227,7 +224,6 @@ class TestHeadlampPluginSurface:
             e.name
             for e in python_registry.sidebar_entries
             if e.name.startswith("tpu")
-            and e.name not in ("tpu-metrics", "tpu-deviceplugins")
         }
         assert py_names <= set(ts_names)
 
@@ -249,7 +245,9 @@ class TestHeadlampPluginSurface:
             "OverviewPage",
             "NodesPage",
             "PodsPage",
+            "DevicePluginsPage",
             "TopologyPage",
+            "MetricsPage",
             "NodeDetailSection",
             "PodDetailSection",
         ],
@@ -269,3 +267,25 @@ class TestHeadlampPluginSurface:
         # list+watch), IntelGpuDataContext.tsx:98-99.
         assert "K8s.ResourceClasses.Node.useList()" in src
         assert "K8s.ResourceClasses.Pod.useList" in src
+
+    def test_metrics_client_mirrors_python(self):
+        """The TS Prometheus client must carry the same discovery chain
+        and logical-metric fallback chains as metrics/client.py — a
+        series added on one side only would silently desynchronize the
+        two hosts' availability matrices."""
+        from headlamp_tpu.metrics import client as mc
+
+        with open(
+            os.path.join(PLUGIN_SRC, "api", "metrics.ts"), encoding="utf-8"
+        ) as f:
+            src = f.read()
+        for namespace, service in mc.PROMETHEUS_SERVICES:
+            assert f"['{namespace}', '{service}']" in src, service
+        for logical, candidates in mc.LOGICAL_METRICS.items():
+            assert logical in src, logical
+            for promql in candidates:
+                # TS uses single quotes; PromQL with embedded double
+                # quotes appears verbatim inside them.
+                assert promql in src, promql
+        assert str(mc.FRACTION_MAX) in src
+        assert mc.NODE_MAP_QUERY in src
