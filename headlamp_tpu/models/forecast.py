@@ -226,6 +226,36 @@ def _fit_program(
     return params
 
 
+@partial(jax.jit, static_argnames=("cfg", "steps", "inference", "batch_p"))
+def _fit_forecast_program(
+    series: jax.Array,
+    key: jax.Array,
+    cfg: ForecastConfig,
+    steps: int,
+    inference: str,
+    batch_p: int,
+):
+    """The WHOLE forecast — windowing → fit scan → inference (Pallas
+    kernel or XLA forward, chosen statically) — as ONE XLA program and
+    therefore ONE device dispatch. The split fit/infer path costs two
+    dispatches; over a tunneled/remote TPU each round-trip is ~50-70 ms
+    (BENCH_r03 measured the rollup's dispatch at ~150 ms end-to-end),
+    so fusing the pair nearly halves the serving-path forecast cost.
+
+    The fit is :func:`_fit_program` itself — nested jit inlines into the
+    enclosing trace, so the serving path and the standalone fit (which
+    the bench's parity check uses) can never train different models."""
+    params = _fit_program(series, key, cfg, steps)
+    recent = series[:, -cfg.window:]
+    if inference == "pallas":
+        from .pallas_forward import forecast_forward_padded
+
+        return forecast_forward_padded(
+            params, recent, batch_p=batch_p, horizon=cfg.horizon, interpret=False
+        )
+    return forward(params, recent)
+
+
 def fit_and_forecast_with_dispatch(
     series: jax.Array,
     cfg: ForecastConfig | None = None,
@@ -235,9 +265,10 @@ def fit_and_forecast_with_dispatch(
 ) -> tuple[jax.Array, InferenceDispatch]:
     """Online fit on the given traces, then predict the next horizon
     from each trace's latest window: [n_chips, T] -> ([n_chips, horizon],
-    dispatch record). The fit is one fused XLA program; the predict goes
-    through :func:`forecast_next_with_dispatch` (Pallas kernel on TPU,
-    XLA elsewhere).
+    dispatch record). Fit AND inference run as one fused program
+    (:func:`_fit_forecast_program`) — the Pallas kernel inlined on a TPU
+    backend, plain XLA elsewhere; any Pallas failure falls back to the
+    fused XLA variant with the reason recorded.
 
     There is no pre-trained checkpoint by design — utilization dynamics
     are cluster-specific, the model is tiny, and fitting on exactly the
@@ -245,7 +276,7 @@ def fit_and_forecast_with_dispatch(
     than window+horizon fall back to persistence (repeat last value)."""
     cfg = cfg or ForecastConfig()
     series = jnp.asarray(series, dtype=jnp.float32)
-    _, length = series.shape
+    n_chips, length = series.shape
     if length < cfg.window + cfg.horizon:
         # Persistence fallback: no kernel ran at all, and the dispatch
         # record must say so — not claim an XLA inference that never
@@ -253,9 +284,33 @@ def fit_and_forecast_with_dispatch(
         last = series[:, -1:]
         return jnp.repeat(last, cfg.horizon, axis=1), InferenceDispatch("repeat")
 
-    recent = series[:, -cfg.window:]
-    params = _fit_program(series, jax.random.PRNGKey(seed), cfg, steps)
-    return forecast_next_with_dispatch(params, recent, cfg)
+    key = jax.random.PRNGKey(seed)
+    if jax.devices()[0].platform == "tpu" and _pallas_broken_reason is None:
+        try:
+            from .pallas_forward import check_single_tile, pallas_batch_p
+
+            check_single_tile(cfg.window, cfg.hidden, cfg.horizon)
+            out = _fit_forecast_program(
+                series, key, cfg, steps, "pallas", pallas_batch_p(n_chips)
+            )
+            return out, InferenceDispatch("pallas")
+        except Exception as exc:  # noqa: BLE001 — optimization, not a dependency
+            # Memoize: a kernel that failed to lower/compile would
+            # otherwise re-pay the failed compile on EVERY forecast.
+            _record_pallas_broken(f"{type(exc).__name__}: {exc}"[:200])
+    out = _fit_forecast_program(series, key, cfg, steps, "xla", 0)
+    return out, InferenceDispatch("xla", _pallas_broken_reason)
+
+
+#: Once the fused Pallas variant fails, the reason is memoized and every
+#: later forecast serves the fused-XLA variant immediately — recorded in
+#: each dispatch (and thus the page + bench), reset only per process.
+_pallas_broken_reason: str | None = None
+
+
+def _record_pallas_broken(reason: str) -> None:
+    global _pallas_broken_reason
+    _pallas_broken_reason = reason
 
 
 def fit_and_forecast(

@@ -63,13 +63,12 @@ def _pad2(a: jax.Array, rows: int, cols: int) -> jax.Array:
     return jnp.pad(a, ((0, rows - a.shape[0]), (0, cols - a.shape[1])))
 
 
-@functools.partial(jax.jit, static_argnames=("batch_p", "horizon", "interpret"))
-def _pallas_program(
+def forecast_forward_padded(
     params: Params, x: jax.Array, *, batch_p: int, horizon: int, interpret: bool
 ):
-    """Padding → kernel → un-pad as ONE jitted program: each un-jitted
-    jnp.pad is its own device dispatch, and over a tunneled/remote TPU
-    those seven round-trips cost more than the kernel itself."""
+    """Trace-time body: padding → kernel → un-pad. Call it inside an
+    enclosing jit — the fused fit+infer program does — or through the
+    jitted :func:`_pallas_program` wrapper for standalone inference."""
     x_p = _pad2(x.astype(jnp.float32), batch_p, _LANES)
     w1_p = _pad2(params["w1"].astype(jnp.float32), _LANES, _LANES)
     w2_p = _pad2(params["w2"].astype(jnp.float32), _LANES, _LANES)
@@ -81,6 +80,33 @@ def _pallas_program(
         x_p, w1_p, b1_p, w2_p, b2_p, w3_p, b3_p, interpret=interpret
     )
     return out[: x.shape[0], :horizon]
+
+
+@functools.partial(jax.jit, static_argnames=("batch_p", "horizon", "interpret"))
+def _pallas_program(
+    params: Params, x: jax.Array, *, batch_p: int, horizon: int, interpret: bool
+):
+    """Padding → kernel → un-pad as ONE jitted program: each un-jitted
+    jnp.pad is its own device dispatch, and over a tunneled/remote TPU
+    those seven round-trips cost more than the kernel itself."""
+    return forecast_forward_padded(
+        params, x, batch_p=batch_p, horizon=horizon, interpret=interpret
+    )
+
+
+def pallas_batch_p(batch: int) -> int:
+    """Padded batch rows for the kernel grid (multiple of _BLOCK_B)."""
+    return max(_BLOCK_B, -(-batch // _BLOCK_B) * _BLOCK_B)
+
+
+def check_single_tile(window: int, hidden: int, horizon: int) -> None:
+    """Raise unless every dimension fits the single-tile kernel width —
+    shared guard for the standalone and fused callers."""
+    if hidden > _LANES or window > _LANES or horizon > _LANES:
+        raise ValueError(
+            f"window={window}, hidden={hidden}, horizon={horizon}: every "
+            f"dimension must fit the single-tile kernel width {_LANES}"
+        )
 
 
 def _padded_forward(x_p, w1_p, b1_p, w2_p, b2_p, w3_p, b3_p, *, interpret: bool):
@@ -132,14 +158,11 @@ def forecast_forward_pallas(
     window = x.shape[1]
     hidden = params["w1"].shape[1]
     horizon = params["w3"].shape[1]
-    if hidden > _LANES or window > _LANES or horizon > _LANES:
-        raise ValueError(
-            f"window={window}, hidden={hidden}, horizon={horizon}: every "
-            f"dimension must fit the single-tile kernel width {_LANES}"
-        )
-    del window  # zero-padding makes the contraction width-invariant
-
-    batch_p = max(_BLOCK_B, -(-batch // _BLOCK_B) * _BLOCK_B)
+    check_single_tile(window, hidden, horizon)
     return _pallas_program(
-        params, x, batch_p=batch_p, horizon=horizon, interpret=bool(interpret)
+        params,
+        x,
+        batch_p=pallas_batch_p(batch),
+        horizon=horizon,
+        interpret=bool(interpret),
     )

@@ -64,7 +64,7 @@ function dsImage(ds: KubeDaemonSet): string {
 }
 
 export default function DevicePluginsPage() {
-  const { pluginPods, loading } = useTpuContext();
+  const { pluginPods, loading, refresh, refreshCount } = useTpuContext();
   const [daemonsets, setDaemonsets] = useState<KubeDaemonSet[] | undefined>(undefined);
   // Python's workload_available: did ANY list call succeed? Separates
   // "readable but absent" from "nothing was readable (RBAC)".
@@ -104,7 +104,9 @@ export default function DevicePluginsPage() {
     return () => {
       cancelled = true;
     };
-  }, []);
+    // refreshCount: one Refresh refetches the DaemonSets too, so the
+    // rollout card can never desynchronize from the live pod table.
+  }, [refreshCount]);
 
   if (loading || daemonsets === undefined) {
     return <Loader title="Loading device plugin" />;
@@ -113,6 +115,9 @@ export default function DevicePluginsPage() {
   return (
     <>
       <SectionHeader title="TPU Device Plugin" />
+      <button type="button" onClick={refresh}>
+        Refresh
+      </button>
       {daemonsets.length === 0 && (
         <SectionBox title={sourceAvailable ? 'Not installed' : 'DaemonSet not readable'}>
           <p>

@@ -61,6 +61,7 @@ function ChipCard({ chip }: { chip: TpuChipMetrics }) {
 
 export default function MetricsPage() {
   const [snapshot, setSnapshot] = useState<TpuMetricsSnapshot | null | undefined>(undefined);
+  const [refreshKey, setRefreshKey] = useState(0);
 
   useEffect(() => {
     let cancelled = false;
@@ -70,16 +71,26 @@ export default function MetricsPage() {
     return () => {
       cancelled = true;
     };
-  }, []);
+    // refreshKey: live telemetry must be re-scrapable without a
+    // remount — the reference page re-fetches on its Refresh button
+    // (`MetricsPage.tsx:199-261`).
+  }, [refreshKey]);
 
   if (snapshot === undefined) {
     return <Loader title="Scraping TPU telemetry" />;
   }
 
+  const refreshButton = (
+    <button type="button" onClick={() => setRefreshKey(k => k + 1)}>
+      Refresh
+    </button>
+  );
+
   if (snapshot === null) {
     return (
       <>
         <SectionHeader title="TPU Metrics" />
+        {refreshButton}
         <SectionBox title="Prometheus not reachable">
           <p>
             No Prometheus service answered through the apiserver proxy. Install
@@ -105,6 +116,7 @@ export default function MetricsPage() {
   return (
     <>
       <SectionHeader title="TPU Metrics" />
+      {refreshButton}
       <SectionBox title="Metric Availability">
         <SimpleTable
           columns={[

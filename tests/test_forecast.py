@@ -227,6 +227,38 @@ class TestForecastService:
         )
         assert "inference via" in text_content(el)
 
+    def test_fused_pallas_failure_memoized(self, monkeypatch):
+        # The fused fit+infer program: a Pallas lowering failure must
+        # (a) fall back to the fused XLA variant with the reason
+        # recorded, and (b) be memoized — never re-pay the failed
+        # compile on later forecasts.
+        import numpy as np
+
+        from headlamp_tpu.models import forecast as fc
+        import headlamp_tpu.models.pallas_forward as pf
+
+        class FakeDev:
+            platform = "tpu"
+
+        monkeypatch.setattr(fc.jax, "devices", lambda: [FakeDev()])
+        monkeypatch.setattr(fc, "_pallas_broken_reason", None)
+        calls = []
+
+        def boom(*a, **k):
+            calls.append(1)
+            raise RuntimeError("mosaic lowering failed")
+
+        monkeypatch.setattr(pf, "forecast_forward_padded", boom)
+        series = np.tile(
+            np.linspace(0.2, 0.8, 48, dtype="float32"), (3, 1)
+        )
+        out, d = fc.fit_and_forecast_with_dispatch(series, steps=5)
+        assert out.shape == (3, fc.ForecastConfig().horizon)
+        assert d.path == "xla" and "mosaic lowering failed" in d.fallback_reason
+        _, d2 = fc.fit_and_forecast_with_dispatch(series, steps=5)
+        assert d2.path == "xla" and "mosaic lowering failed" in d2.fallback_reason
+        assert len(calls) == 1  # memoized: no second compile attempt
+
     def test_fallback_reason_recorded_not_swallowed(self, monkeypatch):
         # Force the TPU branch with a Pallas kernel that raises: the
         # dispatch must fall back to XLA AND carry the reason.
