@@ -31,6 +31,14 @@ class Provider:
     node_device_capacity: Callable[[Any], int]
     node_device_allocatable: Callable[[Any], int]
     pod_device_request: Callable[[Any], int]
+    #: Fast-path pod detection: a pure predicate over the pod's merged
+    #: resource-key set (objects.pod_resource_keys). classify_fleet
+    #: computes the set ONCE per pod and asks each provider's predicate,
+    #: instead of every provider re-walking the container list — the
+    #: sync path's hottest loop at fleet scale. Must decide exactly what
+    #: ``is_accel_pod`` decides (pinned by tests); None falls back to
+    #: ``is_accel_pod``.
+    pod_resource_test: Callable[[set[str]], bool] | None = None
 
 
 TPU_PROVIDER = Provider(
@@ -43,6 +51,7 @@ TPU_PROVIDER = Provider(
     node_device_capacity=tpu.get_node_chip_capacity,
     node_device_allocatable=tpu.get_node_chip_allocatable,
     pod_device_request=tpu.get_pod_chip_request,
+    pod_resource_test=lambda keys: tpu.TPU_RESOURCE in keys,
 )
 
 INTEL_PROVIDER = Provider(
@@ -55,6 +64,9 @@ INTEL_PROVIDER = Provider(
     node_device_capacity=intel.get_node_gpu_count,
     node_device_allocatable=intel.get_node_gpu_allocatable,
     pod_device_request=intel.get_pod_device_request,
+    pod_resource_test=lambda keys: any(
+        k.startswith(intel.INTEL_GPU_RESOURCE_PREFIX) for k in keys
+    ),
 )
 
 #: Registration order = sidebar/priority order. TPU first by design.
@@ -105,8 +117,15 @@ def classify_fleet(
             if p.is_accel_node(n):
                 views[p.name].nodes.append(n)
     for pod in pods:
+        # One container walk per pod, shared by every provider's
+        # resource predicate (see Provider.pod_resource_test).
+        resource_keys = objects.pod_resource_keys(pod)
         for p in providers:
-            if p.is_accel_pod(pod):
+            if (
+                p.pod_resource_test(resource_keys)
+                if p.pod_resource_test is not None
+                else p.is_accel_pod(pod)
+            ):
                 views[p.name].pods.append(pod)
             if p.is_plugin_pod(pod):
                 views[p.name].plugin_pods.append(pod)

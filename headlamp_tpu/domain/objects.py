@@ -133,6 +133,18 @@ def is_pod_ready(pod: Any) -> bool:
     return _has_ready_condition(pod)
 
 
+def pod_resource_keys(pod: Any) -> set[str]:
+    """Union of requests∪limits resource names over every container
+    (init included). One pass feeds every provider's pod detection in
+    classify_fleet — each provider re-walking the container list was
+    the sync path's hottest loop at fleet scale."""
+    keys: set[str] = set()
+    for c in pod_containers(pod):
+        keys.update(container_requests(c))
+        keys.update(container_limits(c))
+    return keys
+
+
 def pod_restarts(pod: Any) -> int:
     """Total container restart count (reference: k8s.ts:307-309)."""
     statuses = status(pod).get("containerStatuses")

@@ -53,11 +53,22 @@ _VOID_TAGS = {"br", "hr", "img", "input", "meta", "link"}
 
 def render_html(node: Child) -> str:
     """Escaped HTML. Props: ``class_`` -> class; ``data`` values are
-    str()ed; callables/None skipped."""
+    str()ed; callables/None skipped.
+
+    Single-pass writer into one buffer: the recursive-concat version
+    copied every subtree's string once per ancestor level (O(n·depth)
+    bytes moved per page; thousands of nodes at fleet scale)."""
+    out: list[str] = []
+    _render_html_into(node, out)
+    return "".join(out)
+
+
+def _render_html_into(node: Child, out: list[str]) -> None:
     if node is None:
-        return ""
+        return
     if not isinstance(node, Element):
-        return html.escape(str(node))
+        out.append(html.escape(str(node)))
+        return
     attrs = []
     for key, value in node.props.items():
         if value is None or callable(value):
@@ -69,9 +80,12 @@ def render_html(node: Child) -> str:
             attrs.append(f'{name}="{html.escape(str(value), quote=True)}"')
     attr_str = (" " + " ".join(attrs)) if attrs else ""
     if node.tag in _VOID_TAGS:
-        return f"<{node.tag}{attr_str}/>"
-    inner = "".join(render_html(c) for c in node.children)
-    return f"<{node.tag}{attr_str}>{inner}</{node.tag}>"
+        out.append(f"<{node.tag}{attr_str}/>")
+        return
+    out.append(f"<{node.tag}{attr_str}>")
+    for c in node.children:
+        _render_html_into(c, out)
+    out.append(f"</{node.tag}>")
 
 
 _BLOCK_TAGS = {

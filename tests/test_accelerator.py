@@ -111,3 +111,52 @@ class TestClassifyFleet:
         intel_only = classify_fleet([make_intel_node("i")], [make_intel_pod("p")])
         assert intel_only["intel"].plugin_installed
         assert not intel_only["tpu"].plugin_installed
+
+
+class TestPodResourceFastPath:
+    """classify_fleet's one-walk resource-key predicate must decide
+    exactly what each provider's is_accel_pod decides — the fast path
+    is an optimization, never a semantic change."""
+
+    def _pods(self):
+        from headlamp_tpu.fleet import fixtures as fx
+
+        pods = []
+        for fleet in (fx.fleet_mixed(), fx.fleet_v5p32(), fx.fleet_large(64)):
+            pods.extend(fleet["pods"])
+        # Edge shapes: init-only request, limits-only, empty, garbage.
+        pods.extend(
+            [
+                {
+                    "spec": {
+                        "initContainers": [
+                            {"resources": {"requests": {"google.com/tpu": "8"}}}
+                        ]
+                    }
+                },
+                {
+                    "spec": {
+                        "containers": [
+                            {"resources": {"limits": {"gpu.intel.com/i915": "1"}}}
+                        ]
+                    }
+                },
+                {"spec": {"containers": [{"resources": {}}]}},
+                {},
+                {"spec": None},
+            ]
+        )
+        return pods
+
+    def test_predicates_match_is_accel_pod(self):
+        from headlamp_tpu.domain import objects
+        from headlamp_tpu.domain.accelerator import PROVIDERS
+
+        for pod in self._pods():
+            keys = objects.pod_resource_keys(pod)
+            for p in PROVIDERS:
+                assert p.pod_resource_test is not None
+                assert p.pod_resource_test(keys) == p.is_accel_pod(pod), (
+                    p.name,
+                    pod,
+                )

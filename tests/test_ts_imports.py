@@ -1,0 +1,128 @@
+"""Static import/export cross-check for the plugin's TS sources.
+
+The test image has no JS toolchain, so a symbol imported from a module
+that doesn't export it would surface only in CI's tsc run. This suite
+catches that class blind: for every relative `import { X } from './m'`
+in plugin/src, assert module m exports X. Headlamp/react imports are
+out of scope (resolved by CI against the real packages).
+"""
+
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLUGIN_SRC = os.path.join(REPO, "plugin", "src")
+
+IMPORT_RE = re.compile(
+    r"import\s+(?:type\s+)?\{([^}]+)\}\s+from\s+'(\.[^']+)'", re.DOTALL
+)
+EXPORT_RE = re.compile(
+    r"export\s+(?:default\s+)?(?:async\s+)?"
+    r"(?:function|const|let|var|class|interface|type|enum)\s+(\w+)"
+)
+#: `export { a, b as c }` / `export { a } from './m'` re-export lists.
+EXPORT_LIST_RE = re.compile(r"export\s+(?:type\s+)?\{([^}]+)\}")
+LINE_COMMENT_RE = re.compile(r"//[^\n]*")
+
+
+def ts_files():
+    out = []
+    for root, _, files in os.walk(PLUGIN_SRC):
+        for fn in files:
+            if fn.endswith((".ts", ".tsx")) and not fn.endswith(
+                (".test.ts", ".test.tsx")
+            ):
+                out.append(os.path.join(root, fn))
+    return sorted(out)
+
+
+def resolve(base_dir: str, spec: str) -> str | None:
+    stem = os.path.normpath(os.path.join(base_dir, spec))
+    for candidate in (
+        stem + ".ts",
+        stem + ".tsx",
+        stem + ".d.ts",
+        os.path.join(stem, "index.ts"),
+        os.path.join(stem, "index.tsx"),
+    ):
+        if os.path.exists(candidate):
+            return candidate
+    return None
+
+
+def split_names(blob: str) -> list[str]:
+    """Imported/exported local names from a brace list: comments
+    stripped FIRST (a comment inside the braces must not swallow the
+    names after it), then `x as y` and `type x` normalized."""
+    names = []
+    for raw in LINE_COMMENT_RE.sub("", blob).split(","):
+        name = raw.strip()
+        if not name:
+            continue
+        if name.startswith("type "):
+            name = name[len("type "):].strip()
+        names.append(name)
+    return names
+
+
+def exports_of(path: str) -> set[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    out = set(EXPORT_RE.findall(src))
+    for blob in EXPORT_LIST_RE.findall(src):
+        # `export { a as b }` exposes b.
+        out.update(n.split(" as ")[-1].strip() for n in split_names(blob))
+    return out
+
+
+@pytest.mark.parametrize("path", ts_files(), ids=lambda p: os.path.relpath(p, REPO))
+def test_relative_imports_resolve(path):
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    base_dir = os.path.dirname(path)
+    problems = []
+    for names, spec in IMPORT_RE.findall(src):
+        target = resolve(base_dir, spec)
+        if target is None:
+            problems.append(f"unresolved module {spec!r}")
+            continue
+        available = exports_of(target)
+        for name in split_names(names):
+            # `import { x as y }` references export x.
+            name = name.split(" as ")[0].strip()
+            if name and name not in available:
+                problems.append(f"{spec}: no export named {name!r}")
+    assert not problems, f"{os.path.relpath(path, REPO)}: " + "; ".join(problems)
+
+
+def test_default_imports_have_default_exports():
+    """`import X from './m'` needs `export default` in m."""
+    default_re = re.compile(r"import\s+(\w+)\s+from\s+'(\.[^']+)'")
+    problems = []
+    for path in ts_files():
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        for name, spec in default_re.findall(src):
+            target = resolve(os.path.dirname(path), spec)
+            if target is None:
+                problems.append(f"{path}: unresolved {spec!r}")
+                continue
+            with open(target, encoding="utf-8") as f:
+                if "export default" not in f.read():
+                    problems.append(
+                        f"{os.path.relpath(path, REPO)}: {spec} has no default "
+                        f"export for {name!r}"
+                    )
+    assert not problems, "; ".join(problems)
+
+
+def test_no_control_bytes_in_sources():
+    """A stray NUL (one was once emitted into a template literal) makes
+    the file binary to git/grep and can silently change join keys."""
+    for path in ts_files():
+        with open(path, "rb") as f:
+            data = f.read()
+        bad = [i for i, b in enumerate(data) if b < 9 or 13 < b < 32]
+        assert not bad, f"{os.path.relpath(path, REPO)}: control bytes at {bad[:5]}"
