@@ -4,10 +4,31 @@ The reference has no distributed compute (SURVEY.md §2.3); the TPU
 framework's distributed surface is SPMD analytics and model training
 over a ``jax.sharding.Mesh``: fleet rollups partitioned over hosts with
 XLA collectives doing the reduction, and the telemetry-forecast train
-step sharded data-parallel × model-parallel. Multi-chip is exercised on
-a virtual CPU mesh in tests and by the driver's dryrun.
+step sharded data-parallel × model-parallel. Two explicit ICI
+patterns complement the psum-based rollup: a ppermute ring all-reduce
+(the neighbor-only schedule psum lowers to on torus links) and
+sequence-parallel windowing with halo exchange over a ``seq`` mesh —
+each shard fetches only its boundary samples from its ring neighbor,
+the long-context recipe. Multi-chip is exercised on a virtual CPU mesh
+in tests and by the driver's dryrun.
 """
 
-from .mesh import fleet_mesh, sharded_rollup, train_mesh
+from .mesh import (
+    fleet_mesh,
+    ring_allreduce,
+    ring_rollup,
+    seq_mesh,
+    sharded_make_windows,
+    sharded_rollup,
+    train_mesh,
+)
 
-__all__ = ["fleet_mesh", "sharded_rollup", "train_mesh"]
+__all__ = [
+    "fleet_mesh",
+    "ring_allreduce",
+    "ring_rollup",
+    "seq_mesh",
+    "sharded_make_windows",
+    "sharded_rollup",
+    "train_mesh",
+]

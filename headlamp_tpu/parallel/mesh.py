@@ -25,14 +25,18 @@ from ..analytics.encode import FleetArrays
 from ..analytics.fleet_jax import aggregates_to_host_dict, local_aggregates
 
 
-def fleet_mesh(n_devices: int | None = None) -> Mesh:
-    """1-D ``hosts`` mesh over the first ``n_devices`` devices — fleet
-    rows are the only sharded dimension in analytics."""
+def _mesh_1d(axis_name: str, n_devices: int | None) -> Mesh:
     import numpy as np
 
     devices = jax.devices()
     n = n_devices or len(devices)
-    return Mesh(np.array(devices[:n]).reshape(n), axis_names=("hosts",))
+    return Mesh(np.array(devices[:n]).reshape(n), axis_names=(axis_name,))
+
+
+def fleet_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D ``hosts`` mesh over the first ``n_devices`` devices — fleet
+    rows are the only sharded dimension in analytics."""
+    return _mesh_1d("hosts", n_devices)
 
 
 def train_mesh(n_devices: int | None = None) -> Mesh:
@@ -56,15 +60,13 @@ def _pad_to_multiple(a: jnp.ndarray, multiple: int, fill: int = 0) -> jnp.ndarra
     return jnp.concatenate([a, jnp.full((pad,), fill, a.dtype)])
 
 
-def sharded_rollup(fleet: FleetArrays, mesh: Mesh) -> dict[str, Any]:
-    """Fleet rollup partitioned over the ``hosts`` axis.
-
-    Each shard reduces its local node/pod rows; cross-host reduction is
-    a single ``psum`` per aggregate. The per-node in-use vector is
-    computed as a local segment-sum into the *global* node index space
-    then psum-reduced — pods and their nodes may land on different
-    shards, which plain concatenation would miscount.
-    """
+def _rollup_with_reducer(
+    fleet: FleetArrays, mesh: Mesh, reducer: str
+) -> dict[str, Any]:
+    """Shared body of the sharded rollups: column assembly + padding +
+    per-shard local_aggregates, with the cross-host reduction chosen by
+    ``reducer`` ("psum" | "ring"). One definition so the two reduction
+    schedules can never drift on what they reduce."""
     n_hosts = mesh.shape["hosts"]
 
     node_cols = [
@@ -84,27 +86,157 @@ def sharded_rollup(fleet: FleetArrays, mesh: Mesh) -> dict[str, Any]:
     pod_cols = [_pad_to_multiple(c, n_hosts) for c in pod_cols]
     n_nodes_pad = int(node_cols[0].shape[0])
 
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(P("hosts"),) * 5 + (P("hosts"),) * 4,
-        out_specs=P(),  # fully replicated aggregates (every out is a psum)
-    )
-    def rollup_shard(cap, alloc, ready, gen, nvalid, req, phase, nidx, pvalid):
+    def rollup_body(cap, alloc, ready, gen, nvalid, req, phase, nidx, pvalid):
         # One shared reduction body with the single-device rollup
         # (fleet_jax.local_aggregates) — pod_node_idx already indexes
         # the GLOBAL node space, so each shard's segment-sum lands in
-        # the right global rows and a psum completes every aggregate.
+        # the right global rows and one all-reduce completes every
+        # aggregate.
         local = local_aggregates(
             cap, alloc, ready, gen, nvalid, req, phase, nidx, pvalid,
             n_nodes_pad=n_nodes_pad,
         )
+        if reducer == "ring":
+            return {
+                k: ring_allreduce(v, "hosts", n_hosts) for k, v in local.items()
+            }
         return {k: jax.lax.psum(v, "hosts") for k, v in local.items()}
 
+    specs = dict(
+        mesh=mesh,
+        in_specs=(P("hosts"),) * 5 + (P("hosts"),) * 4,
+        out_specs=P(),  # fully replicated aggregates
+    )
+    # The ring's replicated-in-value output can't be statically inferred.
+    rollup_shard = (
+        shard_map_unchecked(rollup_body, **specs)
+        if reducer == "ring"
+        else shard_map(rollup_body, **specs)
+    )
     with mesh:
         out = jax.device_get(rollup_shard(*node_cols, *pod_cols))
-    result = aggregates_to_host_dict(out, fleet.n_nodes)
-    return result
+    return aggregates_to_host_dict(out, fleet.n_nodes)
+
+
+def sharded_rollup(fleet: FleetArrays, mesh: Mesh) -> dict[str, Any]:
+    """Fleet rollup partitioned over the ``hosts`` axis.
+
+    Each shard reduces its local node/pod rows; cross-host reduction is
+    a single ``psum`` per aggregate. The per-node in-use vector is
+    computed as a local segment-sum into the *global* node index space
+    then psum-reduced — pods and their nodes may land on different
+    shards, which plain concatenation would miscount.
+    """
+    return _rollup_with_reducer(fleet, mesh, "psum")
+
+
+def seq_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D ``seq`` mesh: the time dimension of telemetry traces is the
+    sharded axis (sequence/context parallelism)."""
+    return _mesh_1d("seq", n_devices)
+
+
+def shard_map_unchecked(fn, *, mesh, in_specs, out_specs):
+    """shard_map with the static replication check off: ppermute-ring
+    outputs ARE replicated in value, but the checker can't infer it
+    (only psum-style collectives register as replicating). Kwarg name
+    varies across jax versions."""
+    try:
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    except TypeError:  # older jax
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
+
+
+def ring_allreduce(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """All-reduce as ``axis_size - 1`` explicit ``ppermute`` hops around
+    a ring — the neighbor-only pattern ``psum`` lowers to on ICI torus
+    links, written out so the communication schedule is explicit and
+    testable. Call inside ``shard_map``.
+
+    Schedule: a systolic rotation — each hop forwards the ORIGINAL shard
+    contribution it most recently received (``buf``) to the right
+    neighbor, while ``acc`` sums arrivals locally and is never
+    transmitted. After S-1 hops every shard has seen (and summed) every
+    contribution. A bandwidth-optimal reduce-scatter ring would send
+    partial sums instead; for the few scalars and small histograms
+    reduced here the rotation's simplicity wins, and what is on the wire
+    per hop is exactly one shard's original contribution."""
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def body(_, carry):
+        acc, buf = carry
+        buf = jax.lax.ppermute(buf, axis_name, perm)
+        return acc + buf, buf
+
+    acc, _ = jax.lax.fori_loop(0, axis_size - 1, body, (x, x))
+    return acc
+
+
+def ring_rollup(fleet: FleetArrays, mesh: Mesh) -> dict[str, Any]:
+    """:func:`sharded_rollup` with the cross-host reduction carried by
+    :func:`ring_allreduce` instead of ``psum`` — same numbers (pinned by
+    tests against the Python oracle), explicit ring schedule."""
+    return _rollup_with_reducer(fleet, mesh, "ring")
+
+
+def sharded_make_windows(
+    series: jax.Array, window: int, horizon: int, mesh: Mesh
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Sequence-parallel sliding windows with halo exchange — the
+    long-context primitive: traces shard over TIME (the ``seq`` axis),
+    and each shard fetches only the ``window + horizon - 1`` boundary
+    samples it needs from its right neighbor via one ``ppermute`` (a
+    halo exchange riding one ICI hop), never the whole series.
+
+    Returns global ``(x, y, valid)``: x ``[n_series, T, window]``,
+    y ``[n_series, T, horizon]``, valid ``[T]`` bool — position p valid
+    iff a full window+horizon fits before the end of the trace
+    (``p <= T - window - horizon``; the wrap-around halo the last shard
+    receives is masked out). Masked rows match
+    ``models.make_windows(series, window, horizon)`` exactly (pinned by
+    tests). T must divide by the mesh's ``seq`` size."""
+    n_series, total_t = series.shape
+    s = mesh.shape["seq"]
+    if total_t % s != 0:
+        raise ValueError(f"series length {total_t} must divide seq={s}")
+    local_t = total_t // s
+    halo = window + horizon - 1
+    if halo > local_t:
+        raise ValueError(
+            f"halo {halo} exceeds the per-shard span {local_t}: use fewer "
+            f"seq shards or longer traces"
+        )
+
+    # Shard i must receive shard (i+1)'s head: send left around the ring.
+    perm = [(j, (j - 1) % s) for j in range(s)]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, "seq"),),
+        out_specs=(P(None, "seq", None), P(None, "seq", None), P("seq")),
+    )
+    def windows_shard(block):
+        # block: [n_series, local_t]
+        head = block[:, :halo]
+        halo_block = jax.lax.ppermute(head, "seq", perm)
+        extended = jnp.concatenate([block, halo_block], axis=1)
+        starts = jnp.arange(local_t)
+        x_idx = starts[:, None] + jnp.arange(window)[None, :]
+        y_idx = starts[:, None] + window + jnp.arange(horizon)[None, :]
+        x = extended[:, x_idx]          # [n_series, local_t, window]
+        y = extended[:, y_idx]          # [n_series, local_t, horizon]
+        shard_i = jax.lax.axis_index("seq")
+        global_start = shard_i * local_t + starts
+        valid = global_start <= total_t - window - horizon
+        return x, y, valid
+
+    with mesh:
+        return windows_shard(series)
 
 
 def shard_fleet_arrays(fleet: FleetArrays, mesh: Mesh) -> dict[str, jax.Array]:

@@ -109,6 +109,88 @@ class TestShardedRollup:
         assert rolled["capacity"] == 16
 
 
+class TestRingCollectives:
+    """The explicit ppermute ring schedule must reproduce psum exactly —
+    the neighbor-only ICI pattern, verified against both the psum-based
+    rollup and the Python oracle."""
+
+    def test_ring_allreduce_matches_psum(self):
+        from functools import partial
+
+        from jax.sharding import PartitionSpec as P
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+
+        from headlamp_tpu.parallel import fleet_mesh, ring_allreduce
+        from headlamp_tpu.parallel.mesh import shard_map_unchecked
+
+        mesh = fleet_mesh(8)
+        x = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)
+
+        ring = shard_map_unchecked(
+            lambda v: ring_allreduce(v, "hosts", 8),
+            mesh=mesh,
+            in_specs=(P("hosts"),),
+            out_specs=P(),
+        )
+
+        @partial(shard_map, mesh=mesh, in_specs=(P("hosts"),), out_specs=P())
+        def psum(v):
+            return jax.lax.psum(v, "hosts")
+
+        with mesh:
+            np.testing.assert_array_equal(np.asarray(ring(x)), np.asarray(psum(x)))
+
+    def test_ring_rollup_matches_python_oracle(self):
+        from headlamp_tpu.parallel import fleet_mesh, ring_rollup
+
+        view = tpu_view(fx.fleet_large(128))
+        arrays = encode_fleet(view.nodes, view.pods)
+        rolled = ring_rollup(arrays, fleet_mesh(8))
+        expected = view.allocation_summary()
+        assert rolled["capacity"] == expected["capacity"]
+        assert rolled["in_use"] == expected["in_use"]
+        assert rolled["phase_counts"] == tpu.count_pod_phases(view.pods)
+        single = rollup_to_dict(arrays)
+        assert rolled["per_node_in_use"] == single["per_node_in_use"]
+
+
+class TestSequenceParallelWindows:
+    """Halo-exchange windowing over a ``seq`` mesh must reproduce
+    make_windows exactly on the valid positions — the long-context
+    primitive: each shard fetches only its boundary halo, one ICI hop."""
+
+    def test_matches_make_windows(self):
+        from headlamp_tpu.parallel import seq_mesh, sharded_make_windows
+
+        window, horizon = 16, 4
+        # 192 = 8 shards × 24 ≥ halo 19 per shard.
+        series = synthetic_telemetry(3, 192)
+        mesh = seq_mesh(8)
+        x_sh, y_sh, valid = sharded_make_windows(series, window, horizon, mesh)
+        x_sh, y_sh, valid = map(np.asarray, (x_sh, y_sh, valid))
+
+        n_pos = 192 - window - horizon + 1
+        assert valid.sum() == n_pos
+        # Valid positions are exactly the prefix 0..n_pos-1.
+        np.testing.assert_array_equal(np.nonzero(valid)[0], np.arange(n_pos))
+
+        x_ref, y_ref = make_windows(series, window, horizon)
+        x_ref = np.asarray(x_ref).reshape(3, n_pos, window)
+        y_ref = np.asarray(y_ref).reshape(3, n_pos, horizon)
+        np.testing.assert_allclose(x_sh[:, :n_pos], x_ref, rtol=0, atol=0)
+        np.testing.assert_allclose(y_sh[:, :n_pos], y_ref, rtol=0, atol=0)
+
+    def test_halo_larger_than_shard_rejected(self):
+        from headlamp_tpu.parallel import seq_mesh, sharded_make_windows
+
+        series = synthetic_telemetry(2, 64)  # 8 per shard < halo 19
+        with pytest.raises(ValueError, match="halo"):
+            sharded_make_windows(series, 16, 4, seq_mesh(8))
+
+
 class TestForecaster:
     def test_forward_shapes_and_range(self):
         cfg = ForecastConfig(window=16, hidden=32, horizon=4)
