@@ -202,7 +202,10 @@ def sharded_make_windows(
     n_series, total_t = series.shape
     s = mesh.shape["seq"]
     if total_t % s != 0:
-        raise ValueError(f"series length {total_t} must divide seq={s}")
+        raise ValueError(
+            f"series length {total_t} must be divisible by seq={s}: pad or "
+            f"trim the trace to a multiple of the mesh size"
+        )
     local_t = total_t // s
     halo = window + horizon - 1
     if halo > local_t:

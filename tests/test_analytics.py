@@ -118,13 +118,10 @@ class TestRingCollectives:
         from functools import partial
 
         from jax.sharding import PartitionSpec as P
-        try:
-            from jax import shard_map
-        except ImportError:
-            from jax.experimental.shard_map import shard_map
 
         from headlamp_tpu.parallel import fleet_mesh, ring_allreduce
-        from headlamp_tpu.parallel.mesh import shard_map_unchecked
+        # Reuse the library's version-compat shard_map import.
+        from headlamp_tpu.parallel.mesh import shard_map, shard_map_unchecked
 
         mesh = fleet_mesh(8)
         x = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)

@@ -314,6 +314,34 @@ class TestBackgroundSync:
             stop.set()
 
 
+class TestBackgroundRestartRace:
+    def test_stale_stop_never_degrades_a_newer_loop(self):
+        # A stale handle's set() racing a restart must never leave the
+        # NEW live loop with watch mode off (the check-then-act is
+        # serialized under app._bg_lock). Hammer restarts against
+        # concurrent stale-sets; after every round the active loop must
+        # still have watch enabled.
+        import threading as _threading
+
+        app = DashboardApp(make_demo_transport("v5e4"), min_sync_interval_s=3600.0)
+        stops = [app.start_background_sync(3600.0)]
+        try:
+            for _ in range(30):
+                old = stops[-1]
+                t = _threading.Thread(target=old.set)
+                t.start()
+                stops.append(app.start_background_sync(3600.0))
+                t.join()
+                assert app._ctx._watch_enabled is True
+            # The current handle still works: stopping it re-enables
+            # inline syncs (watch off).
+            stops[-1].set()
+            assert app._ctx._watch_enabled is False
+        finally:
+            for s in stops:
+                s.set()
+
+
 class TestSocketRoundTrip:
     def test_serve_real_http(self):
         app = make_app("mixed")
