@@ -46,6 +46,33 @@ _NODE_DETAIL_RE = re.compile(r"^/node/([a-z0-9.-]{1,253})$")
 _POD_DETAIL_RE = re.compile(r"^/pod/([a-z0-9.-]{1,253})/([a-z0-9.-]{1,253})$")
 
 
+def _analytics_health() -> dict[str, Any]:
+    """Rollup-calibration state for /healthz (ADR-008 observability):
+    which backend at-scale requests would take right now, and the
+    measured timings behind the choice. Import-guarded — a jax-less
+    host serves Python unconditionally and reports just that."""
+    try:
+        from ..analytics.stats import XLA_ROLLUP_MIN_NODES, calibration
+
+        cal = {
+            "calibrated": calibration.xla_ms is not None,
+            "xla_ms": (
+                round(calibration.xla_ms, 2)
+                if calibration.xla_ms is not None
+                else None
+            ),
+            "python_ms_per_node": (
+                round(calibration.python_ms_per_node, 5)
+                if calibration.python_ms_per_node is not None
+                else None
+            ),
+            "floor_nodes": XLA_ROLLUP_MIN_NODES,
+        }
+        return cal
+    except Exception:  # noqa: BLE001 — health must never 500 on analytics
+        return {"calibrated": False}
+
+
 class DashboardApp:
     def __init__(
         self,
@@ -361,6 +388,10 @@ class DashboardApp:
                         "errors": [],
                         "consecutive_sync_failures": failures,
                         "background_sync": background,
+                        # Snapshot-independent; monitors read it during
+                        # startup too, when "probe not yet run" is the
+                        # most informative state.
+                        "analytics": _analytics_health(),
                     }
                 )
                 return 200, "application/json", body
@@ -384,6 +415,7 @@ class DashboardApp:
                     "last_sync_age_s": round(age, 3),
                     "consecutive_sync_failures": failures,
                     "background_sync": background,
+                    "analytics": _analytics_health(),
                 }
             )
             return 200, "application/json", body

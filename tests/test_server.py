@@ -65,6 +65,31 @@ class TestHandle:
         assert payload["last_sync_age_s"] >= 0
         assert payload["background_sync"] is False
 
+    def test_healthz_reports_calibration_state(self):
+        # ADR-008 observability: healthz must show whether the rollup
+        # probe has run and the measured timings behind the choice.
+        from headlamp_tpu.analytics import stats as st
+
+        app = make_app("v5e4")
+        app.handle("/tpu")
+        st.calibration.reset()
+        try:
+            payload = json.loads(app.handle("/healthz")[2])
+            assert payload["analytics"] == {
+                "calibrated": False,
+                "xla_ms": None,
+                "python_ms_per_node": None,
+                "floor_nodes": st.XLA_ROLLUP_MIN_NODES,
+            }
+            st.calibration.xla_ms = 151.234
+            st.calibration.python_ms_per_node = 0.0123456
+            payload = json.loads(app.handle("/healthz")[2])
+            assert payload["analytics"]["calibrated"] is True
+            assert payload["analytics"]["xla_ms"] == 151.23
+            assert payload["analytics"]["python_ms_per_node"] == 0.01235
+        finally:
+            st.calibration.reset()
+
     def test_healthz_degrades_after_consecutive_sync_failures(self):
         """VERDICT r2 weak #5: a persistently failing transport must
         flip /healthz ok to false — 'healthy' and 'sync has been failing

@@ -53,6 +53,10 @@ FLEETS = {
     "v5p32": fx.fleet_v5p32,
     "mixed": fx.fleet_mixed,
     "v5p32-degraded": degraded_v5p32,
+    # Scale diversity for the TS parity replay: many slices, mixed
+    # generations, plain nodes, and enough pods to exercise utilization
+    # rounding and per-node attribution beyond the toy fleets.
+    "large64": lambda: fx.fleet_large(64),
 }
 
 
