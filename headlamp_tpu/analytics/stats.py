@@ -174,9 +174,9 @@ def fleet_stats(view: FleetView, *, backend: str | None = None) -> dict[str, Any
     if backend == "xla":
         if view.provider.name != "tpu":
             raise ValueError(
-                f"backend='xla' unsupported for provider "
+                "backend='xla' unsupported for provider "
                 f"{view.provider.name!r}: the columnar encoding carries "
-                f"TPU device accessors only"
+                "TPU device accessors only"
             )
         return _xla_stats(view)
     if view.provider.name != "tpu":

@@ -266,7 +266,7 @@ class AcceleratorDataContext:
         return (
             f"{path}{sep}watch=true"
             f"&resourceVersion={urllib.parse.quote(resource_version, safe='')}"
-            f"&allowWatchBookmarks=true"
+            "&allowWatchBookmarks=true"
             f"&timeoutSeconds={max(int(self.WATCH_WINDOW_S), 1)}"
         )
 

@@ -204,14 +204,14 @@ def sharded_make_windows(
     if total_t % s != 0:
         raise ValueError(
             f"series length {total_t} must be divisible by seq={s}: pad or "
-            f"trim the trace to a multiple of the mesh size"
+            "trim the trace to a multiple of the mesh size"
         )
     local_t = total_t // s
     halo = window + horizon - 1
     if halo > local_t:
         raise ValueError(
             f"halo {halo} exceeds the per-shard span {local_t}: use fewer "
-            f"seq shards or longer traces"
+            "seq shards or longer traces"
         )
 
     # Shard i must receive shard (i+1)'s head: send left around the ring.

@@ -359,7 +359,7 @@ class DashboardApp:
         except Exception as e:  # noqa: BLE001 — error boundary
             body = self._page_html(
                 "Error",
-                f"<div class='hl-error' role='alert'>Internal error: "
+                "<div class='hl-error' role='alert'>Internal error: "
                 f"{html.escape(type(e).__name__)}: {html.escape(str(e))}</div>",
             )
             return 500, "text/html", body

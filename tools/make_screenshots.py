@@ -106,10 +106,10 @@ def svg_wrap(body_html: str, height: int) -> str:
     return (
         f'<svg xmlns="http://www.w3.org/2000/svg" width="{WIDTH}" '
         f'height="{height}" viewBox="0 0 {WIDTH} {height}">\n'
-        f'<rect width="100%" height="100%" fill="#f4f6f8"/>\n'
+        '<rect width="100%" height="100%" fill="#f4f6f8"/>\n'
         f'<foreignObject x="0" y="0" width="{WIDTH}" height="{height}">\n'
         f'<body xmlns="http://www.w3.org/1999/xhtml">\n{body_html}\n</body>\n'
-        f"</foreignObject>\n</svg>\n"
+        "</foreignObject>\n</svg>\n"
     )
 
 
