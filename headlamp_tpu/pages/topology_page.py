@@ -7,11 +7,20 @@ pure geometry in ``topology.mesh``, colored per worker (host), with ICI
 links summarized per axis (drawing thousands of individual link lines
 at 1024-node scale would swamp the DOM; counts + wrap flags carry the
 same information).
+
+With a metrics snapshot available (progressive enhancement — the host
+passes its TTL-cached snapshot and NEVER fetches for this page), cells
+also carry a live utilization heat band: the topology × telemetry join
+no other surface shows — which chips of which slice are hot, in place
+on the fabric.
 """
 
 from __future__ import annotations
 
+from typing import Any, Mapping
+
 from ..context.accelerator_context import ClusterSnapshot
+from ..metrics.format import format_percent
 from ..topology.mesh import MeshLayout, build_mesh_layout
 from ..topology.slices import SliceInfo, group_slices, summarize_slices
 from ..ui import (
@@ -37,21 +46,81 @@ _HEALTH_TEXT = {
 }
 
 
-def mesh_grid(layout: MeshLayout, sl: SliceInfo) -> Element:
+def _chip_utilization(
+    by_node: Mapping[str, list[Any]] | None, sl: SliceInfo
+) -> dict[tuple[int, int], float]:
+    """(worker_id, local chip ordinal) -> utilization fraction, joined
+    from the snapshot's per-node rows (``by_node`` computed ONCE per
+    page — it rebuilds a fleet-wide dict). The ordinal is the chip's
+    numeric accelerator_id when parseable — an exporter that drops idle
+    chips' samples must not shift the remaining heat onto the wrong
+    cells — falling back to list position for non-numeric ids.
+    TensorCore utilization preferred, duty cycle as the fallback
+    series."""
+    if not by_node:
+        return {}
+    out: dict[tuple[int, int], float] = {}
+    for w in sl.workers:
+        rows = by_node.get(w.node_name)
+        if not rows:
+            continue
+        for position, row in enumerate(rows):
+            util = row.tensorcore_utilization
+            if util is None:
+                util = row.duty_cycle
+            if util is None:
+                continue
+            chip_id = str(row.accelerator_id)
+            ordinal = int(chip_id) if chip_id.isdigit() else position
+            out[(w.worker_id, ordinal)] = util
+    return out
+
+
+def _heat_band(util: float) -> int:
+    """0-4 heat band from a utilization fraction: <25, <50, <70, <90,
+    ≥90 — the top band matching the UI kit's critical threshold. Values
+    above 1.5 are treated as pre-scaled percent, the same normalization
+    format_percent applies."""
+    pct = util * 100 if util <= 1.5 else util
+    for band, ceiling in enumerate((25, 50, 70, 90)):
+        if pct < ceiling:
+            return band
+    return 4
+
+
+def mesh_grid(
+    layout: MeshLayout, sl: SliceInfo, by_node: Mapping[str, list[Any]] | None = None
+) -> Element:
     """Absolute-positioned chip cells; one color class per worker
-    (worker_id % 8). Unready/missing workers render hatched."""
+    (worker_id % 8). Unready/missing workers render hatched. With
+    telemetry rows (``by_node``), cells gain a heat band + utilization
+    in the title."""
     ready_by_worker = {w.worker_id: w.ready for w in sl.workers}
+    utilization = _chip_utilization(by_node, sl)
+    worker_ordinal: dict[int, int] = {}
     cells = []
     for cell in layout.cells:
         ready = ready_by_worker.get(cell.worker_id)
         state = "ok" if ready else ("missing" if ready is None else "down")
+        # Cells arrive in chip_index order, so per-worker arrival order
+        # IS the local chip ordinal the metrics join keys on.
+        ordinal = worker_ordinal.get(cell.worker_id, 0)
+        worker_ordinal[cell.worker_id] = ordinal + 1
+        util = utilization.get((cell.worker_id, ordinal))
+        heat = f" hl-heat-{_heat_band(util)}" if util is not None else ""
+        # Same formatter as the metrics page (clamp + pre-scaled
+        # normalization) so the two surfaces can never disagree on the
+        # same sample.
+        util_text = (
+            f" util {format_percent(util, digits=0)}" if util is not None else ""
+        )
         cells.append(
             h(
                 "div",
                 {
                     "class_": (
                         f"hl-mesh-cell hl-worker-{cell.worker_id % 8} "
-                        f"hl-mesh-{state}"
+                        f"hl-mesh-{state}{heat}"
                     ),
                     "style": (
                         f"left:{cell.px * (_CELL + _GAP)}px;"
@@ -60,7 +129,7 @@ def mesh_grid(layout: MeshLayout, sl: SliceInfo) -> Element:
                     ),
                     "title": (
                         f"chip {cell.chip_index} coord {cell.coord} "
-                        f"worker {cell.worker_id}"
+                        f"worker {cell.worker_id}{util_text}"
                     ),
                     "data-worker": cell.worker_id,
                 },
@@ -94,7 +163,9 @@ def mesh_grid(layout: MeshLayout, sl: SliceInfo) -> Element:
     )
 
 
-def slice_card(sl: SliceInfo) -> Element:
+def slice_card(
+    sl: SliceInfo, by_node: Mapping[str, list[Any]] | None = None
+) -> Element:
     layout = build_mesh_layout(sl)
     worker_table = SimpleTable(
         [
@@ -123,19 +194,25 @@ def slice_card(sl: SliceInfo) -> Element:
                 ),
             ]
         ),
-        mesh_grid(layout, sl),
+        mesh_grid(layout, sl, by_node),
         worker_table,
         class_="hl-slice-card",
     )
 
 
 def topology_page(
-    snap: ClusterSnapshot, *, provider_name: str = "tpu", max_slices: int = 64
+    snap: ClusterSnapshot,
+    *,
+    provider_name: str = "tpu",
+    max_slices: int = 64,
+    metrics: Any = None,
 ) -> Element:
     """Fleet slice summary + per-slice cards. ``max_slices`` caps the
     card list the same way the overview caps its pod table — at the
     1024-node fixture there are hundreds of slices; unhealthy ones sort
-    first so the cap never hides a problem."""
+    first so the cap never hides a problem. ``metrics`` (a TTL-cached
+    TpuMetricsSnapshot, or None) turns the meshes into utilization
+    heatmaps — hosts must pass a cache PEEK, never fetch for this."""
     if snap.loading:
         return h("div", {"class_": "hl-page hl-topology"}, Loader())
 
@@ -180,11 +257,25 @@ def topology_page(
             "(unhealthy first).",
         )
 
+    # The fleet-wide per-node row index is built ONCE per page (the
+    # by_node property rebuilds a dict over every chip row).
+    by_node = metrics.by_node if metrics is not None else None
+    heat_hint = None
+    if by_node:
+        heat_hint = h(
+            "p",
+            {"class_": "hl-hint"},
+            "Mesh cells are tinted by live chip utilization "
+            "(<25 / <50 / <70 / <90 / ≥90%), joined from the cached "
+            "telemetry snapshot.",
+        )
+
     return h(
         "div",
         {"class_": "hl-page hl-topology"},
         error_banner(snap),
         summary,
+        heat_hint,
         truncation,
-        [slice_card(s) for s in shown],
+        [slice_card(s, by_node) for s in shown],
     )

@@ -306,9 +306,42 @@ class DashboardApp:
             metrics = fetch_tpu_metrics(self._transport, clock=self._clock)
             # Stored under the epoch read BEFORE the fetch: a refresh
             # arriving mid-fetch bumps the epoch and this entry is born
-            # stale, so the next view refetches.
-            self._metrics_cache = (epoch, now + self.METRICS_TTL_S, metrics)
+            # stale, so the next view refetches. The TTL, by contrast,
+            # starts AFTER the fetch — a slow fetch (probe chain against
+            # a dark cluster, first jit compile downstream) must not
+            # burn its own freshness window and serve a born-expired
+            # entry.
+            self._metrics_cache = (
+                epoch,
+                self._clock() + self.METRICS_TTL_S,
+                metrics,
+            )
             return metrics
+
+    #: How stale a cached telemetry snapshot may be and still tint the
+    #: topology heatmap. Deliberately looser than METRICS_TTL_S: the
+    #: metrics PAGE re-fetches at 5 s for freshness, but a tint from a
+    #: minute-old snapshot beats no tint — and the serving TTL can
+    #: legitimately lapse inside one slow metrics request (probe chain +
+    #: first forecast compile), which must not blank the heatmap.
+    METRICS_PEEK_MAX_AGE_S = 60.0
+
+    def _peek_metrics(self) -> Any:
+        """The cached metrics snapshot IF recent (see
+        METRICS_PEEK_MAX_AGE_S), else None — never fetches. For pages
+        where telemetry is a progressive enhancement (the topology
+        heatmap): they must not pay the Prometheus probe chain, only
+        reuse what a recent metrics view already paid for. Age is judged
+        from the snapshot's own fetched_at, not the serving TTL."""
+        with self._metrics_lock:
+            if self._metrics_cache is None:
+                return None
+            cached_epoch, _, cached = self._metrics_cache
+            if cached_epoch != self._cache_epoch or cached is None:
+                return None
+            if self._clock() - cached.fetched_at > self.METRICS_PEEK_MAX_AGE_S:
+                return None
+            return cached
 
     def _forecast_for(self, metrics: Any) -> Any:
         """Forecast view for the metrics page, or None. None whenever
@@ -330,7 +363,14 @@ class DashboardApp:
                 if cached_epoch == epoch and now < expiry and cached_key == key:
                     return cached
             forecast = self._compute_forecast(metrics)
-            self._forecast_cache = (epoch, key, now + self.FORECAST_TTL_S, forecast)
+            # TTL stamped after the fit (see _cached_metrics): a first
+            # jit compile can take longer than the TTL itself.
+            self._forecast_cache = (
+                epoch,
+                key,
+                self._clock() + self.FORECAST_TTL_S,
+                forecast,
+            )
             return forecast
 
     def _compute_forecast(self, metrics: Any) -> Any:
@@ -506,7 +546,9 @@ class DashboardApp:
                 fetch_intel_gpu_metrics(self._transport, clock=self._clock)
             )
         elif route.kind == "topology":
-            el = route.component(snap)
+            # Cache PEEK only: the heatmap is a progressive enhancement;
+            # the topology paint must never pay the Prometheus chain.
+            el = route.component(snap, metrics=self._peek_metrics())
         elif route.kind == "native-nodes":
             el = route.component(snap, now=now, registry=self._registry, **paging)
         else:

@@ -63,11 +63,23 @@ main { max-width:1100px; margin:0 auto; padding:16px; }
 .hl-loader { padding:30px; text-align:center; color:var(--muted); }
 .hl-mesh-grid { margin:10px 0; }
 .hl-mesh-cell { position:absolute; border-radius:4px; border:1px solid #fff; }
-.hl-worker-0 { background:#1565c0; } .hl-worker-1 { background:#2e7d32; }
-.hl-worker-2 { background:#ed6c02; } .hl-worker-3 { background:#6a1b9a; }
-.hl-worker-4 { background:#00838f; } .hl-worker-5 { background:#c62828; }
-.hl-worker-6 { background:#4e342e; } .hl-worker-7 { background:#37474f; }
+.hl-worker-0 { background:#1565c0; --worker-color:#1565c0; }
+.hl-worker-1 { background:#2e7d32; --worker-color:#2e7d32; }
+.hl-worker-2 { background:#ed6c02; --worker-color:#ed6c02; }
+.hl-worker-3 { background:#6a1b9a; --worker-color:#6a1b9a; }
+.hl-worker-4 { background:#00838f; --worker-color:#00838f; }
+.hl-worker-5 { background:#c62828; --worker-color:#c62828; }
+.hl-worker-6 { background:#4e342e; --worker-color:#4e342e; }
+.hl-worker-7 { background:#37474f; --worker-color:#37474f; }
 .hl-mesh-down { opacity:0.35; border-style:dashed; }
+/* Live-utilization heat bands (topology x telemetry join): the tint
+   replaces the worker background; worker identity moves to the border
+   via the per-worker custom property set above. */
+.hl-heat-0 { background:#e8f0fe !important; border:2px solid var(--worker-color,#999); }
+.hl-heat-1 { background:#aecbfa !important; border:2px solid var(--worker-color,#999); }
+.hl-heat-2 { background:#fde293 !important; border:2px solid var(--worker-color,#999); }
+.hl-heat-3 { background:#f6ae6b !important; border:2px solid var(--worker-color,#999); }
+.hl-heat-4 { background:#ee675c !important; border:2px solid var(--worker-color,#999); }
 .hl-mesh-missing { background:repeating-linear-gradient(45deg,#ccc,#ccc 4px,
                    #eee 4px,#eee 8px) !important; }
 .hl-mesh-links { color:var(--muted); font-size:12px; }

@@ -393,3 +393,27 @@ class TestDemoTransport:
         status, _, body = app.handle("/tpu")
         assert status == 200
         assert "TPU Nodes" in body
+
+
+class TestTopologyHeatmap:
+    def test_topology_never_fetches_metrics_but_reuses_cache(self):
+        # Before any metrics view: no heat, and crucially no Prometheus
+        # probe traffic from the topology paint (cache PEEK only).
+        app = make_app("v5p32")
+        app.handle("/tpu")  # warm sync
+        calls_before = len(app._transport.calls)
+        status, _, body = app.handle("/tpu/topology")
+        # The stylesheet always carries the band classes; cells USING
+        # them is the signal.
+        assert status == 200 and "hl-mesh-ok hl-heat-" not in body
+        new_calls = app._transport.calls[calls_before:]
+        assert not any("prometheus" in c or "query" in c for c in new_calls)
+
+        # After the metrics page populated the TTL cache, the topology
+        # mesh is tinted — still without new Prometheus calls.
+        app.handle("/tpu/metrics")
+        calls_before = len(app._transport.calls)
+        status, _, body = app.handle("/tpu/topology")
+        assert status == 200 and "hl-mesh-ok hl-heat-" in body
+        new_calls = app._transport.calls[calls_before:]
+        assert not any("prometheus" in c or "query" in c for c in new_calls)

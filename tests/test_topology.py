@@ -304,3 +304,74 @@ class TestMeshLayout:
                              n_workers=max(1, topology_chip_count(parse_topology(topo)) // 4))
             layout = build_mesh_layout(sl)
             assert len(layout.cells) == topology_chip_count(parse_topology(topo))
+
+
+class TestUtilizationHeatmap:
+    """Topology × telemetry join: with a metrics snapshot the mesh cells
+    carry heat bands; without one the page renders exactly as before
+    (progressive enhancement, never a fetch)."""
+
+    def _snap(self):
+        from headlamp_tpu.context import AcceleratorDataContext
+        from headlamp_tpu.fleet import fixtures as fx
+
+        fleet = fx.fleet_v5p32()
+        return AcceleratorDataContext(fx.fleet_transport(fleet)).sync()
+
+    def _metrics(self, util_by_chip):
+        from headlamp_tpu.metrics.client import TpuChipMetrics, TpuMetricsSnapshot
+
+        chips = [
+            TpuChipMetrics(
+                node=node, accelerator_id=str(i), tensorcore_utilization=u
+            )
+            for (node, i), u in util_by_chip.items()
+        ]
+        return TpuMetricsSnapshot(
+            namespace="monitoring",
+            service="prometheus-k8s:9090",
+            chips=sorted(chips, key=lambda c: (c.node, c.accelerator_id)),
+            availability={"tensorcore_utilization": True},
+        )
+
+    def test_cells_carry_heat_bands_and_titles(self):
+        from headlamp_tpu.pages import topology_page
+        from headlamp_tpu.ui import render_html
+
+        metrics = self._metrics(
+            {
+                ("gke-v5p-pool-w0", 0): 0.95,  # band 4
+                ("gke-v5p-pool-w0", 1): 0.05,  # band 0
+                ("gke-v5p-pool-w1", 0): 0.60,  # band 2
+            }
+        )
+        html = render_html(topology_page(self._snap(), metrics=metrics))
+        assert "hl-heat-4" in html and "hl-heat-0" in html and "hl-heat-2" in html
+        assert "util 95%" in html and "util 60%" in html
+        assert "tinted by live chip utilization" in html
+
+    def test_duty_cycle_fallback_series(self):
+        from headlamp_tpu.metrics.client import TpuChipMetrics, TpuMetricsSnapshot
+        from headlamp_tpu.pages import topology_page
+        from headlamp_tpu.ui import render_html
+
+        metrics = TpuMetricsSnapshot(
+            namespace="monitoring",
+            service="prometheus-k8s:9090",
+            chips=[
+                TpuChipMetrics(
+                    node="gke-v5p-pool-w0", accelerator_id="0", duty_cycle=0.8
+                )
+            ],
+            availability={"duty_cycle": True},
+        )
+        html = render_html(topology_page(self._snap(), metrics=metrics))
+        assert "hl-heat-3" in html and "util 80%" in html
+
+    def test_without_metrics_unchanged(self):
+        from headlamp_tpu.pages import topology_page
+        from headlamp_tpu.ui import render_html
+
+        html = render_html(topology_page(self._snap()))
+        assert "hl-heat-" not in html
+        assert "tinted" not in html

@@ -121,6 +121,12 @@ def main() -> None:
         clock=lambda: FIXTURE_NOW_EPOCH,
     )
     os.makedirs(OUT_DIR, exist_ok=True)
+    # Warm the metrics TTL cache first so the topology capture shows the
+    # utilization heatmap (the page only PEEKS at the cache; with a
+    # pinned clock the entry never expires, and the demo Prometheus
+    # values are fixture-deterministic).
+    status, _, _ = app.handle("/tpu/metrics")
+    assert status == 200
     for filename, route, height in CAPTURES:
         status, _, html = app.handle(route)
         assert status == 200, (route, status)
