@@ -20,7 +20,7 @@ from __future__ import annotations
 from typing import Any, Mapping
 
 from ..context.accelerator_context import ClusterSnapshot
-from ..metrics.format import format_percent
+from ..metrics.format import format_percent, normalize_fraction
 from ..topology.mesh import MeshLayout, build_mesh_layout
 from ..topology.slices import SliceInfo, group_slices, summarize_slices
 from ..ui import (
@@ -78,10 +78,12 @@ def _chip_utilization(
 
 def _heat_band(util: float) -> int:
     """0-4 heat band from a utilization fraction: <25, <50, <70, <90,
-    ≥90 — the top band matching the UI kit's critical threshold. Values
-    above 1.5 are treated as pre-scaled percent, the same normalization
-    format_percent applies."""
-    pct = util * 100 if util <= 1.5 else util
+    ≥90 — the top band matching the UI kit's critical threshold.
+    ``normalize_fraction`` is the ONE scale authority (shared with
+    format_percent), so the band and the title percent can never
+    disagree on the same sample."""
+    fraction = normalize_fraction(util) or 0.0
+    pct = fraction * 100
     for band, ceiling in enumerate((25, 50, 70, 90)):
         if pct < ceiling:
             return band

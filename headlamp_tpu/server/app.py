@@ -332,8 +332,15 @@ class DashboardApp:
         where telemetry is a progressive enhancement (the topology
         heatmap): they must not pay the Prometheus probe chain, only
         reuse what a recent metrics view already paid for. Age is judged
-        from the snapshot's own fetched_at, not the serving TTL."""
-        with self._metrics_lock:
+        from the snapshot's own fetched_at, not the serving TTL.
+
+        Non-blocking: _cached_metrics holds the lock across its whole
+        fetch, and a peek that waited for a dark cluster's probe chain
+        would be exactly the stall it exists to avoid — under
+        contention the tint is skipped, never awaited."""
+        if not self._metrics_lock.acquire(blocking=False):
+            return None
+        try:
             if self._metrics_cache is None:
                 return None
             cached_epoch, _, cached = self._metrics_cache
@@ -342,6 +349,8 @@ class DashboardApp:
             if self._clock() - cached.fetched_at > self.METRICS_PEEK_MAX_AGE_S:
                 return None
             return cached
+        finally:
+            self._metrics_lock.release()
 
     def _forecast_for(self, metrics: Any) -> Any:
         """Forecast view for the metrics page, or None. None whenever

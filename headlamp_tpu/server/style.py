@@ -75,11 +75,13 @@ main { max-width:1100px; margin:0 auto; padding:16px; }
 /* Live-utilization heat bands (topology x telemetry join): the tint
    replaces the worker background; worker identity moves to the border
    via the per-worker custom property set above. */
-.hl-heat-0 { background:#e8f0fe !important; border:2px solid var(--worker-color,#999); }
-.hl-heat-1 { background:#aecbfa !important; border:2px solid var(--worker-color,#999); }
-.hl-heat-2 { background:#fde293 !important; border:2px solid var(--worker-color,#999); }
-.hl-heat-3 { background:#f6ae6b !important; border:2px solid var(--worker-color,#999); }
-.hl-heat-4 { background:#ee675c !important; border:2px solid var(--worker-color,#999); }
+/* border-color/width only — border-STYLE stays with the base/.hl-mesh-down
+   rules so a not-ready worker keeps its dashed marker when tinted. */
+.hl-heat-0 { background:#e8f0fe !important; border-color:var(--worker-color,#999); border-width:2px; }
+.hl-heat-1 { background:#aecbfa !important; border-color:var(--worker-color,#999); border-width:2px; }
+.hl-heat-2 { background:#fde293 !important; border-color:var(--worker-color,#999); border-width:2px; }
+.hl-heat-3 { background:#f6ae6b !important; border-color:var(--worker-color,#999); border-width:2px; }
+.hl-heat-4 { background:#ee675c !important; border-color:var(--worker-color,#999); border-width:2px; }
 .hl-mesh-missing { background:repeating-linear-gradient(45deg,#ccc,#ccc 4px,
                    #eee 4px,#eee 8px) !important; }
 .hl-mesh-links { color:var(--muted); font-size:12px; }

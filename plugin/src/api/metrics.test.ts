@@ -137,3 +137,71 @@ describe('formatting', () => {
     );
   });
 });
+
+describe('peek cache + heat join (the topology heatmap feed)', () => {
+  it('peek returns the last fetched snapshot and never fetches', async () => {
+    const { fetchTpuMetricsCached, peekTpuMetrics, resetMetricsCache } = await import(
+      './metrics'
+    );
+    resetMetricsCache();
+    expect(peekTpuMetrics()).toBeNull();
+    const { request } = transport({
+      tensorcore_utilization: vector([
+        { labels: { node: 'n1', accelerator_id: '0' }, value: 0.5 },
+      ]),
+    });
+    const snap = await fetchTpuMetricsCached(request);
+    expect(snap).not.toBeNull();
+    expect(peekTpuMetrics()).toBe(snap);
+    resetMetricsCache();
+    expect(peekTpuMetrics()).toBeNull();
+  });
+
+  it('joins heat by numeric accelerator_id, not list position', async () => {
+    const { chipUtilization } = await import('./metrics');
+    const snap = {
+      namespace: 'monitoring',
+      service: 'prometheus-k8s:9090',
+      // Exporter dropped idle chips 0-1: chips 2 and 3 must land on
+      // ordinals 2 and 3, not 0 and 1.
+      chips: [
+        {
+          node: 'n1',
+          accelerator_id: '2',
+          tensorcore_utilization: 0.9,
+          memory_bandwidth_utilization: null,
+          hbm_bytes_used: null,
+          hbm_bytes_total: null,
+          duty_cycle: null,
+        },
+        {
+          node: 'n1',
+          accelerator_id: '3',
+          tensorcore_utilization: null,
+          memory_bandwidth_utilization: null,
+          hbm_bytes_used: null,
+          hbm_bytes_total: null,
+          duty_cycle: 0.2,
+        },
+      ],
+      availability: {},
+      resolvedSeries: {},
+      fetchMs: 1,
+    };
+    const join = chipUtilization(snap, ['n1']);
+    expect(join.get('n1/2')).toBe(0.9);
+    expect(join.get('n1/3')).toBe(0.2); // duty-cycle fallback
+    expect(join.has('n1/0')).toBe(false);
+    expect(chipUtilization(null, ['n1']).size).toBe(0);
+  });
+
+  it('bands heat like the Python page', async () => {
+    const { heatBand } = await import('./metrics');
+    expect(heatBand(0.1)).toBe(0);
+    expect(heatBand(0.3)).toBe(1);
+    expect(heatBand(0.6)).toBe(2);
+    expect(heatBand(0.8)).toBe(3);
+    expect(heatBand(0.95)).toBe(4);
+    expect(heatBand(95)).toBe(4); // pre-scaled percent input
+  });
+});

@@ -300,3 +300,79 @@ export function formatPercent(fraction: number): string {
   // an ambiguous near-idle percent exporter (client.py scale notes).
   return `${Math.round(Math.min(1, Math.max(0, fraction)) * 100)}%`;
 }
+
+// ---------------------------------------------------------------------------
+// Shared snapshot cache (the plugin-side analogue of the dashboard
+// server's TTL cache + peek: `server/app.py:_cached_metrics` /
+// `_peek_metrics`). MetricsPage owns fetching; other pages — the
+// topology heatmap — only PEEK, so they never pay the probe chain.
+// ---------------------------------------------------------------------------
+
+/** How stale a peeked snapshot may be and still tint the heatmap —
+ * matches the server's METRICS_PEEK_MAX_AGE_S. */
+export const PEEK_MAX_AGE_MS = 60_000;
+
+let lastSnapshot: { at: number; snap: TpuMetricsSnapshot } | null = null;
+
+/** Fetch + record for peeking. MetricsPage calls this instead of
+ * fetchTpuMetrics directly. */
+export async function fetchTpuMetricsCached(
+  request: RequestFn
+): Promise<TpuMetricsSnapshot | null> {
+  const snap = await fetchTpuMetrics(request);
+  if (snap) {
+    lastSnapshot = { at: Date.now(), snap };
+  }
+  return snap;
+}
+
+/** The last fetched snapshot if recent, else null — never fetches. */
+export function peekTpuMetrics(): TpuMetricsSnapshot | null {
+  if (!lastSnapshot) return null;
+  if (Date.now() - lastSnapshot.at > PEEK_MAX_AGE_MS) return null;
+  return lastSnapshot.snap;
+}
+
+/** Test hook: clear the module-level snapshot record. */
+export function resetMetricsCache(): void {
+  lastSnapshot = null;
+}
+
+/** (node name, numeric chip ordinal) -> utilization fraction for a set
+ * of nodes — the topology heatmap join (`pages/topology_page.py:
+ * _chip_utilization` semantics: numeric accelerator_id keys the
+ * ordinal so exporters that drop idle chips cannot shift heat onto the
+ * wrong cells; TensorCore utilization preferred, duty cycle fallback).
+ */
+export function chipUtilization(
+  snap: TpuMetricsSnapshot | null,
+  nodeNames: string[]
+): Map<string, number> {
+  const out = new Map<string, number>();
+  if (!snap) return out;
+  const wanted = new Set(nodeNames);
+  const positionByNode = new Map<string, number>();
+  for (const chip of snap.chips) {
+    if (!wanted.has(chip.node)) continue;
+    const position = positionByNode.get(chip.node) ?? 0;
+    positionByNode.set(chip.node, position + 1);
+    const util = chip.tensorcore_utilization ?? chip.duty_cycle;
+    if (util === null) continue;
+    const ordinal = /^\d+$/.test(chip.accelerator_id)
+      ? parseInt(chip.accelerator_id, 10)
+      : position;
+    out.set(`${chip.node}/${ordinal}`, util);
+  }
+  return out;
+}
+
+/** 0-4 heat band from a utilization fraction — the Python page's
+ * `_heat_band` thresholds (<25/<50/<70/<90/≥90%). */
+export function heatBand(util: number): number {
+  const pct = util <= 1.5 ? util * 100 : util;
+  const ceilings = [25, 50, 70, 90];
+  for (let band = 0; band < ceilings.length; band++) {
+    if (pct < ceilings[band]) return band;
+  }
+  return 4;
+}

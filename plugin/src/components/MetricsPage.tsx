@@ -20,7 +20,7 @@ import {
 } from '@kinvolk/headlamp-plugin/lib/CommonComponents';
 import React, { useEffect, useState } from 'react';
 import {
-  fetchTpuMetrics,
+  fetchTpuMetricsCached,
   formatBytes,
   formatPercent,
   LOGICAL_METRIC_DESCRIPTIONS,
@@ -65,7 +65,9 @@ export default function MetricsPage() {
 
   useEffect(() => {
     let cancelled = false;
-    void fetchTpuMetrics(path => ApiProxy.request(path)).then(snap => {
+    // The cached variant records the snapshot for other pages' peeks
+    // (the topology heatmap) — the server's TTL-cache analogue.
+    void fetchTpuMetricsCached(path => ApiProxy.request(path)).then(snap => {
       if (!cancelled) setSnapshot(snap);
     });
     return () => {

@@ -19,6 +19,7 @@ import {
   StatusLabel,
 } from '@kinvolk/headlamp-plugin/lib/CommonComponents';
 import React from 'react';
+import { chipUtilization, formatPercent, heatBand, peekTpuMetrics } from '../api/metrics';
 import { useTpuContext } from '../api/TpuDataContext';
 import {
   buildMeshLayout,
@@ -29,6 +30,8 @@ import {
 } from '../api/topology';
 
 const WORKER_PALETTE = ['#1f77b4', '#ff7f0e', '#2ca02c', '#d62728', '#9467bd', '#8c564b', '#e377c2', '#7f7f7f'];
+/** Heat-band fills matching the dashboard server's hl-heat-0..4. */
+const HEAT_PALETTE = ['#e8f0fe', '#aecbfa', '#fde293', '#f6ae6b', '#ee675c'];
 
 function healthLabel(health: SliceInfo['health']): React.ReactNode {
   const text = health === 'success' ? 'Healthy' : health === 'warning' ? 'Degraded' : 'Incomplete';
@@ -38,8 +41,21 @@ function healthLabel(health: SliceInfo['health']): React.ReactNode {
 /** Chip-level mesh: one circle per chip at the engine's grid
  * coordinates (cells are `[chip_index, coord, worker_id, px, py]`
  * tuples — the shared-fixture wire format), colored by owning worker;
- * ICI links drawn beneath, wrap links dashed. */
-function MeshSvg({ layout }: { layout: MeshLayout }) {
+ * ICI links drawn beneath, wrap links dashed. With peeked telemetry
+ * (`utilization`: "node/ordinal" -> fraction), circles tint by heat
+ * band with the worker color moving to the stroke — the dashboard
+ * server's topology×telemetry join (`pages/topology_page.py`). */
+function MeshSvg({
+  layout,
+  slice,
+  utilization,
+}: {
+  layout: MeshLayout;
+  slice: SliceInfo;
+  utilization: Map<string, number>;
+}) {
+  const nodeByWorker = new Map(slice.workers.map(w => [w.worker_id, w.node_name]));
+  const workerOrdinal = new Map<number, number>();
   const CELL = 36; // px per grid unit
   const MARGIN = 20;
   const r = 8;
@@ -71,22 +87,43 @@ function MeshSvg({ layout }: { layout: MeshLayout }) {
           />
         );
       })}
-      {layout.cells.map(([chipIndex, coord, workerId, px, py]) => (
-        <circle
-          key={chipIndex}
-          cx={x(px)}
-          cy={y(py)}
-          r={r}
-          fill={WORKER_PALETTE[workerId % WORKER_PALETTE.length]}
-        >
-          <title>{`chip ${chipIndex} · worker ${workerId} · (${coord.join(', ')})`}</title>
-        </circle>
-      ))}
+      {layout.cells.map(([chipIndex, coord, workerId, px, py]) => {
+        // Per-worker arrival order IS the local chip ordinal the
+        // telemetry join keys on (cells arrive in chip_index order).
+        const ordinal = workerOrdinal.get(workerId) ?? 0;
+        workerOrdinal.set(workerId, ordinal + 1);
+        const node = nodeByWorker.get(workerId);
+        const util = node !== undefined ? utilization.get(`${node}/${ordinal}`) : undefined;
+        const workerColor = WORKER_PALETTE[workerId % WORKER_PALETTE.length];
+        const fill = util !== undefined ? HEAT_PALETTE[heatBand(util)] : workerColor;
+        // Same formatter as MetricsPage (clamp policy documented
+        // there) — the two surfaces can never disagree on a sample.
+        const utilText = util !== undefined ? ` · util ${formatPercent(util)}` : '';
+        return (
+          <circle
+            key={chipIndex}
+            cx={x(px)}
+            cy={y(py)}
+            r={r}
+            fill={fill}
+            stroke={util !== undefined ? workerColor : 'none'}
+            strokeWidth={util !== undefined ? 2 : 0}
+          >
+            <title>{`chip ${chipIndex} · worker ${workerId} · (${coord.join(', ')})${utilText}`}</title>
+          </circle>
+        );
+      })}
     </svg>
   );
 }
 
-function SliceCard({ slice }: { slice: SliceInfo }) {
+function SliceCard({
+  slice,
+  utilization,
+}: {
+  slice: SliceInfo;
+  utilization: Map<string, number>;
+}) {
   const layout = buildMeshLayout(slice);
   return (
     <SectionBox title={`Slice ${slice.slice_id}`}>
@@ -102,7 +139,7 @@ function SliceCard({ slice }: { slice: SliceInfo }) {
           },
         ]}
       />
-      <MeshSvg layout={layout} />
+      <MeshSvg layout={layout} slice={slice} utilization={utilization} />
       <SimpleTable
         columns={[
           { label: 'Worker', getter: (w: any) => w.worker_id },
@@ -126,6 +163,16 @@ function SliceCard({ slice }: { slice: SliceInfo }) {
 
 export default function TopologyPage() {
   const { slices, sliceSummary, loading, error } = useTpuContext();
+
+  // Peek only — never fetch: the heatmap is a progressive enhancement
+  // riding whatever a recent Metrics view already paid for. Computed
+  // every render, NOT memoized: the peek is time-dependent (its 60s
+  // staleness budget must actually expire, and a snapshot recorded
+  // after mount must appear), and the join is a cheap single pass.
+  const utilization = chipUtilization(
+    peekTpuMetrics(),
+    slices.flatMap(s => s.workers.map(w => w.node_name))
+  );
 
   if (loading) {
     return <Loader title="Loading TPU topology" />;
@@ -151,8 +198,16 @@ export default function TopologyPage() {
           ]}
         />
       </SectionBox>
+      {utilization.size > 0 && (
+        <SectionBox title="Live utilization">
+          <p>
+            Mesh chips are tinted by live utilization from the last telemetry scrape
+            (&lt;25 / &lt;50 / &lt;70 / &lt;90 / ≥90%); worker identity moves to the ring color.
+          </p>
+        </SectionBox>
+      )}
       {slices.map(s => (
-        <SliceCard key={s.slice_id} slice={s} />
+        <SliceCard key={s.slice_id} slice={s} utilization={utilization} />
       ))}
       {slices.length === 0 && (
         <SectionBox title="No slices">

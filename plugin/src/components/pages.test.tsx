@@ -131,6 +131,52 @@ describe('NodesPage and PodsPage on v5p32', () => {
   });
 });
 
+describe('TopologyPage heatmap from a peeked snapshot', () => {
+  it('tints circles when telemetry was recently fetched', async () => {
+    const { fetchTpuMetricsCached, resetMetricsCache } = await import('../api/metrics');
+    const { fleet, expected } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    const node = expected.tpu_node_names[0];
+    // Record a snapshot for the peek, via an injected request fn.
+    await fetchTpuMetricsCached(async (path: string) => {
+      if (path.includes('query=1'))
+        return { status: 'success', data: { resultType: 'scalar', result: [0, '1'] } };
+      if (decodeURIComponent(path).includes('tensorcore_utilization'))
+        return {
+          status: 'success',
+          data: {
+            resultType: 'vector',
+            result: [
+              { metric: { node, accelerator_id: '0' }, value: [0, '0.95'] },
+            ],
+          },
+        };
+      return { status: 'success', data: { resultType: 'vector', result: [] } };
+    });
+    try {
+      const { container } = mount(<TopologyPage />);
+      await screen.findByText('Slice Summary');
+      expect(screen.getByText(/tinted by live utilization/)).toBeTruthy();
+      const tinted = container.querySelectorAll('circle[stroke-width="2"]');
+      expect(tinted).toHaveLength(1); // exactly the one reporting chip
+      expect(container.textContent).toContain('util 95%');
+    } finally {
+      resetMetricsCache();
+    }
+  });
+
+  it('renders untinted without telemetry', async () => {
+    const { resetMetricsCache } = await import('../api/metrics');
+    resetMetricsCache();
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    const { container } = mount(<TopologyPage />);
+    await screen.findByText('Slice Summary');
+    expect(container.querySelectorAll('circle[stroke-width="2"]')).toHaveLength(0);
+    expect(screen.queryByText(/tinted by live utilization/)).toBeNull();
+  });
+});
+
 describe('MetricsPage without a reachable Prometheus', () => {
   it('renders the guided install box, never crashes', async () => {
     // The mock ApiProxy throws for every non-/pods URL, so the whole
