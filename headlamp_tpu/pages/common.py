@@ -61,18 +61,31 @@ def error_banner(snap: ClusterSnapshot) -> Element | None:
 
 
 def waiting_reason(pod: Any) -> str:
-    """First container's waiting.reason, for the Pending-pods attention
-    table (`PodsPage.tsx:252-260`)."""
+    """Why a Pending pod is stuck, for the attention table
+    (`PodsPage.tsx:252-260`): the first container's waiting.reason when
+    the kubelet has seen the pod, else the PodScheduled condition's
+    reason — an UNSCHEDULED pod (e.g. 'Unschedulable', the most common
+    Pending cause on a full TPU fleet) has empty containerStatuses, so
+    the container-only read would blank exactly when it matters most."""
     statuses = obj.status(pod).get("containerStatuses")
-    if not isinstance(statuses, list):
-        return ""
-    for c in statuses:
-        if isinstance(c, Mapping):
-            state = c.get("state")
-            if isinstance(state, Mapping):
-                waiting = state.get("waiting")
-                if isinstance(waiting, Mapping) and waiting.get("reason"):
-                    return str(waiting["reason"])
+    if isinstance(statuses, list):
+        for c in statuses:
+            if isinstance(c, Mapping):
+                state = c.get("state")
+                if isinstance(state, Mapping):
+                    waiting = state.get("waiting")
+                    if isinstance(waiting, Mapping) and waiting.get("reason"):
+                        return str(waiting["reason"])
+    conditions = obj.status(pod).get("conditions")
+    if isinstance(conditions, list):
+        for c in conditions:
+            if (
+                isinstance(c, Mapping)
+                and c.get("type") == "PodScheduled"
+                and c.get("status") != "True"
+                and c.get("reason")
+            ):
+                return str(c["reason"])
     return ""
 
 

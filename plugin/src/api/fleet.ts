@@ -309,6 +309,41 @@ export function daemonsetStatusText(ds: KubeDaemonSet): string {
   return `${ready}/${desired} ready`;
 }
 
+/** Why a Pending pod is stuck — the attention table
+ * (`pages/common.py:waiting_reason`; reference PodsPage.tsx:252-260):
+ * first container waiting.reason, falling back to the PodScheduled
+ * condition's reason — an unscheduled pod ('Unschedulable') has empty
+ * containerStatuses. */
+export function waitingReason(pod: KubePod): string {
+  const statuses = asRecord(pod?.status).containerStatuses;
+  if (Array.isArray(statuses)) {
+    for (const c of statuses) {
+      const reason = asRecord(asRecord(asRecord(c).state).waiting).reason;
+      if (reason) return String(reason);
+    }
+  }
+  const conditions = asRecord(pod?.status).conditions;
+  if (Array.isArray(conditions)) {
+    for (const c of conditions) {
+      const cond = asRecord(c);
+      if (cond.type === 'PodScheduled' && cond.status !== 'True' && cond.reason) {
+        return String(cond.reason);
+      }
+    }
+  }
+  return '';
+}
+
+/** Total container restart count (`objects.pod_restarts`). */
+export function podRestarts(pod: KubePod): number {
+  const statuses = asRecord(pod?.status).containerStatuses;
+  if (!Array.isArray(statuses)) return 0;
+  return statuses.reduce(
+    (acc, c) => acc + parseIntLenient(asRecord(c).restartCount),
+    0
+  );
+}
+
 /** Human age from an RFC3339 timestamp: s/m/h/d buckets
  * (`objects.format_age`; reference k8s.ts:337-348). `nowEpochMs`
  * explicit so callers and tests control the clock. */

@@ -295,10 +295,18 @@ export function formatBytes(n: number): string {
   return `${value.toFixed(1)} ${units[u]}`;
 }
 
+/** Scale-tolerant 0-1 normalization (0-100 inputs divided down) — the
+ * ONE scale authority (`metrics/format.py:normalize_fraction`); both
+ * formatPercent and heatBand route through it so a band and its title
+ * can never disagree on the same sample. */
+export function normalizeFraction(value: number): number {
+  return value > 1.5 ? value / 100 : value;
+}
+
 export function formatPercent(fraction: number): string {
   // Render-time clamp bounds the residual (1.0, FRACTION_MAX] band of
   // an ambiguous near-idle percent exporter (client.py scale notes).
-  return `${Math.round(Math.min(1, Math.max(0, fraction)) * 100)}%`;
+  return `${Math.round(Math.min(1, Math.max(0, normalizeFraction(fraction))) * 100)}%`;
 }
 
 // ---------------------------------------------------------------------------
@@ -367,9 +375,10 @@ export function chipUtilization(
 }
 
 /** 0-4 heat band from a utilization fraction — the Python page's
- * `_heat_band` thresholds (<25/<50/<70/<90/≥90%). */
+ * `_heat_band` thresholds (<25/<50/<70/<90/≥90%), sharing
+ * normalizeFraction with formatPercent as the one scale decision. */
 export function heatBand(util: number): number {
-  const pct = util <= 1.5 ? util * 100 : util;
+  const pct = normalizeFraction(util) * 100;
   const ceilings = [25, 50, 70, 90];
   for (let band = 0; band < ceilings.length; band++) {
     if (pct < ceilings[band]) return band;

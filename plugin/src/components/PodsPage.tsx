@@ -20,6 +20,8 @@ import {
   podNamespace,
   podNodeName,
   podPhase,
+  podRestarts,
+  waitingReason,
 } from '../api/fleet';
 import { useTpuContext } from '../api/TpuDataContext';
 
@@ -36,6 +38,8 @@ export default function PodsPage() {
     return <Loader title="Loading TPU workloads" />;
   }
 
+  const pending = tpuPods.filter(p => podPhase(p) === 'Pending');
+
   return (
     <>
       <SectionHeader title="TPU Workloads" />
@@ -51,6 +55,19 @@ export default function PodsPage() {
             .map(([phase, count]) => ({ name: phase, value: count }))}
         />
       </SectionBox>
+      {pending.length > 0 && (
+        <SectionBox title="Attention: Pending TPU Pods">
+          <SimpleTable
+            columns={[
+              { label: 'Namespace', getter: (p: any) => podNamespace(p) },
+              { label: 'Pod', getter: (p: any) => podName(p) },
+              { label: 'Chips', getter: (p: any) => getPodChipRequest(p) },
+              { label: 'Reason', getter: (p: any) => waitingReason(p) || '—' },
+            ]}
+            data={pending}
+          />
+        </SectionBox>
+      )}
       <SectionBox title="Pods">
         <SimpleTable
           columns={[
@@ -63,6 +80,7 @@ export default function PodsPage() {
                 <StatusLabel status={phaseStatus(podPhase(p))}>{podPhase(p)}</StatusLabel>
               ),
             },
+            { label: 'Restarts', getter: (p: any) => podRestarts(p) },
             { label: 'TPU chips', getter: (p: any) => getPodChipRequest(p) },
           ]}
           data={tpuPods}

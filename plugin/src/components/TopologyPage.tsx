@@ -165,10 +165,16 @@ export default function TopologyPage() {
   const { slices, sliceSummary, loading, error } = useTpuContext();
 
   // Peek only — never fetch: the heatmap is a progressive enhancement
-  // riding whatever a recent Metrics view already paid for. Computed
-  // every render, NOT memoized: the peek is time-dependent (its 60s
-  // staleness budget must actually expire, and a snapshot recorded
-  // after mount must appear), and the join is a cheap single pass.
+  // riding whatever a recent Metrics view already paid for. The peek is
+  // time-dependent, so a low-rate tick forces re-renders: the 60s
+  // staleness budget actually expires on a quiet cluster, and a
+  // snapshot recorded after mount appears without needing an unrelated
+  // cluster event.
+  const [, setTick] = React.useState(0);
+  React.useEffect(() => {
+    const timer = setInterval(() => setTick(t => t + 1), 10_000);
+    return () => clearInterval(timer);
+  }, []);
   const utilization = chipUtilization(
     peekTpuMetrics(),
     slices.flatMap(s => s.workers.map(w => w.node_name))

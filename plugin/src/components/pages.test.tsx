@@ -129,6 +129,30 @@ describe('NodesPage and PodsPage on v5p32', () => {
       expect(screen.getByText(name)).toBeTruthy();
     }
   });
+
+  it('surfaces pending pods with their waiting reason', async () => {
+    const { fleet } = loadFixture('v5p32');
+    // Realistic unscheduled pod: the kubelet never saw it, so
+    // containerStatuses is EMPTY and the reason lives in the
+    // PodScheduled condition.
+    const stuck = {
+      metadata: { name: 'stuck-train-0', namespace: 'ml', uid: 'uid-stuck' },
+      spec: {
+        containers: [{ resources: { requests: { 'google.com/tpu': '4' } } }],
+      },
+      status: {
+        phase: 'Pending',
+        conditions: [
+          { type: 'PodScheduled', status: 'False', reason: 'Unschedulable' },
+        ],
+      },
+    };
+    setMockCluster({ nodes: fleet.nodes, pods: [...fleet.pods, stuck] });
+    mount(<PodsPage />);
+    await screen.findByText('Attention: Pending TPU Pods');
+    expect(screen.getByText('stuck-train-0')).toBeTruthy();
+    expect(screen.getByText('Unschedulable')).toBeTruthy();
+  });
 });
 
 describe('TopologyPage heatmap from a peeked snapshot', () => {

@@ -167,6 +167,43 @@ class TestPodsPage:
         el = pods_page(v5e4, now=NOW)
         assert "worker: req=4 lim=4" in text_content(el)
 
+    def test_unscheduled_pod_reason_from_conditions(self):
+        # An UNSCHEDULED pod has empty containerStatuses (the kubelet
+        # never saw it); the reason must come from the PodScheduled
+        # condition — blanking here hides the most common Pending cause
+        # on a full fleet.
+        from headlamp_tpu.pages.common import waiting_reason
+
+        stuck = {
+            "metadata": {"name": "stuck", "namespace": "ml"},
+            "spec": {
+                "containers": [
+                    {"resources": {"requests": {"google.com/tpu": "4"}}}
+                ]
+            },
+            "status": {
+                "phase": "Pending",
+                "conditions": [
+                    {
+                        "type": "PodScheduled",
+                        "status": "False",
+                        "reason": "Unschedulable",
+                    }
+                ],
+            },
+        }
+        assert waiting_reason(stuck) == "Unschedulable"
+        snap = snapshot_for(
+            {"nodes": [], "pods": [stuck]}
+        )
+        text = text_content(pods_page(snap, now=NOW))
+        assert "Unschedulable" in text
+        # Container waiting.reason still wins when present.
+        stuck["status"]["containerStatuses"] = [
+            {"state": {"waiting": {"reason": "ImagePullBackOff"}}}
+        ]
+        assert waiting_reason(stuck) == "ImagePullBackOff"
+
     def test_restarts_column(self):
         pods = [fx.make_tpu_pod("p", node="n", restarts=3)]
         snap = snapshot_for({"nodes": [fx.make_tpu_node("n")], "pods": pods})
