@@ -32,7 +32,7 @@ from __future__ import annotations
 import concurrent.futures
 import time
 import urllib.parse
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Mapping
 
 from ..domain import objects as obj
@@ -202,6 +202,16 @@ class AcceleratorDataContext:
         self._plugin_pod_errors: dict[str, str | None] = {}
         self._refresh_count = 0
         self._cached_snapshot: ClusterSnapshot | None = None
+        #: Set by either track when a sync actually changed state (watch
+        #: events applied, a re-list ran, imperative results differed,
+        #: an error stream flipped). A CLEAN tick — quiet watch, stable
+        #: chains — preserves the cached snapshot (and its computed
+        #: fleet stats) instead of reclassifying the fleet: at 1024
+        #: nodes that is the entire steady-state background cost.
+        #: Written from the reactive worker thread too — bool stores are
+        #: GIL-atomic, and it only ever transitions False→True within a
+        #: sync.
+        self._changed = True
 
     # ------------------------------------------------------------------
     # Track 1: reactive lists
@@ -324,6 +334,8 @@ class AcceleratorDataContext:
             else:
                 stats["watches"] += 1
                 stats["events"] += applied
+                if applied:
+                    self._changed = True
                 return None
         try:
             items, resource_version = self._list_paginated(path)
@@ -332,6 +344,7 @@ class AcceleratorDataContext:
         self._track_store[track] = {self._obj_key(o): o for o in items}
         self._track_rv[track] = resource_version
         stats["relists"] += 1
+        self._changed = True
         return None
 
     def _sync_reactive(self) -> None:
@@ -364,7 +377,14 @@ class AcceleratorDataContext:
         """Per-provider chains run concurrently: the chains are
         independent, and a blackholed provider (e.g. firewalled Intel
         namespaces on a TPU-only cluster) must cost the slowest single
-        chain, not the sum of every chain's timeouts."""
+        chain, not the sum of every chain's timeouts.
+
+        Change detection: the refetched results are FINGERPRINT-compared
+        to the previous tick's — (uid, resourceVersion) per object, not
+        a deep dict walk; plugin daemon pods scale with the fleet, and a
+        deep compare every tick would re-spend the CPU the clean-tick
+        snapshot reuse exists to save. Only a real difference marks the
+        sync dirty (see ``_changed``)."""
         sourced = [
             (p, self._sources[p.name])
             for p in self._providers
@@ -376,19 +396,45 @@ class AcceleratorDataContext:
         if not sourced:
             return
 
+        before = self._imperative_fingerprint()
+
         def fetch_one(provider: Provider, source: ProviderSource) -> None:
             self._fetch_workloads(provider, source)
             self._fetch_plugin_pods(provider, source)
 
         if len(sourced) == 1:
             fetch_one(*sourced[0])
-            return
-        with concurrent.futures.ThreadPoolExecutor(
-            max_workers=len(sourced), thread_name_prefix="hl-tpu-provider"
-        ) as pool:
-            futures = [pool.submit(fetch_one, p, s) for p, s in sourced]
-            for f in futures:
-                f.result()
+        else:
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=len(sourced), thread_name_prefix="hl-tpu-provider"
+            ) as pool:
+                futures = [pool.submit(fetch_one, p, s) for p, s in sourced]
+                for f in futures:
+                    f.result()
+
+        if self._imperative_fingerprint() != before:
+            self._changed = True
+
+    def _imperative_fingerprint(self) -> tuple:
+        """Cheap identity of the imperative-track results: (uid,
+        resourceVersion) per object instead of deep dict equality —
+        plugin daemon pods scale with the fleet. An object whose content
+        changed without a resourceVersion bump cannot come from a real
+        apiserver (every write bumps it), so the fingerprint is exact
+        for the transitions that matter."""
+
+        def fp(objs: list[Any]) -> tuple:
+            return tuple(
+                (obj.uid(o), str(obj.metadata(o).get("resourceVersion", "")))
+                for o in objs
+            )
+
+        return (
+            {name: fp(objs) for name, objs in self._workloads.items()},
+            dict(self._workload_available),
+            {name: fp(objs) for name, objs in self._fallback_plugin_pods.items()},
+            dict(self._plugin_pod_errors),
+        )
 
     def _fetch_workloads(self, provider: Provider, source: ProviderSource) -> None:
         """Fallback chain; total failure degrades silently to
@@ -462,9 +508,24 @@ class AcceleratorDataContext:
     # ------------------------------------------------------------------
 
     def sync(self) -> ClusterSnapshot:
-        """Run both tracks and return a fresh snapshot."""
+        """Run both tracks and return a fresh snapshot.
+
+        A CLEAN tick — quiet watch stream, unchanged imperative results,
+        stable error streams — preserves the previous snapshot object
+        (with its lazily-computed fleet stats) and only advances
+        ``fetched_at``: reclassifying an unchanged 1024-node fleet every
+        background tick was the entire steady-state CPU cost."""
+        old_errors = (self._node_error, self._pod_error)
+        self._changed = False
         self._sync_reactive()
         self._sync_imperative()
+        if (self._node_error, self._pod_error) != old_errors:
+            self._changed = True
+        if not self._changed and self._cached_snapshot is not None:
+            self._cached_snapshot = replace(
+                self._cached_snapshot, fetched_at=self._clock()
+            )
+            return self._cached_snapshot
         self._cached_snapshot = None
         return self.snapshot()
 

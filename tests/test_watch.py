@@ -326,3 +326,47 @@ class TestKubeTransportWatch:
             assert exc_info.value.status == 410
         finally:
             server.shutdown()
+
+
+class TestCleanTickSnapshotReuse:
+    def test_quiet_tick_preserves_snapshot_and_stats(self):
+        """A clean sync (quiet watch, stable imperative results) must
+        reuse the previous snapshot object — same provider states, same
+        lazily-computed fleet stats — advancing only fetched_at."""
+        t, node_feed, _ = make_watch_transport()
+        clock = [1000.0]
+        ctx = AcceleratorDataContext(t, watch=True, clock=lambda: clock[0])
+        snap1 = ctx.sync()
+        stats1 = snap1.provider("tpu").fleet_stats()
+
+        clock[0] += 5
+        snap2 = ctx.sync()  # quiet tick: no events, same chains
+        assert snap2.providers is snap1.providers  # no reclassification
+        assert snap2.provider("tpu").fleet_stats() is stats1
+        assert snap2.fetched_at == 1005.0  # freshness still advances
+
+        # A real event dirties the tick: new snapshot, new stats.
+        node = dict(snap1.provider("tpu").nodes[0])
+        node["status"] = {**node["status"], "conditions": [
+            {"type": "Ready", "status": "False"}
+        ]}
+        node_feed.push("MODIFIED", node)
+        clock[0] += 5
+        snap3 = ctx.sync()
+        assert snap3.providers is not snap1.providers
+        assert snap3.provider("tpu").fleet_stats()["nodes_ready"] == (
+            stats1["nodes_ready"] - 1
+        )
+
+    def test_error_transition_dirties_the_tick(self):
+        from headlamp_tpu.transport import ApiError
+
+        t, _, _ = make_watch_transport()
+        ctx = AcceleratorDataContext(t, watch=True)
+        snap1 = ctx.sync()
+        # Watch AND list both start failing: the error stream flips, so
+        # the snapshot must rebuild to carry it.
+        t.add_override("/api/v1/nodes", ApiError("nodes", "down"))
+        snap2 = ctx.sync()
+        assert snap2.providers is not snap1.providers
+        assert any("nodes" in e for e in snap2.errors)
