@@ -161,7 +161,7 @@ def bench_forecaster() -> tuple[float, str, dict]:
 
         cfg = ForecastConfig()
         recent = series[:, -cfg.window:]
-        params = _fit_program(series, jax.random.PRNGKey(0), cfg, 60)
+        params, _ = _fit_program(series, jax.random.PRNGKey(0), cfg, 60)
 
         y_pallas = np.asarray(forecast_forward_pallas(params, recent, cfg, interpret=False))
         y_xla = np.asarray(forward(params, recent))

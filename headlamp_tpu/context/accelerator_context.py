@@ -373,7 +373,7 @@ class AcceleratorDataContext:
     # Track 2: imperative per-provider fetches
     # ------------------------------------------------------------------
 
-    def _sync_imperative(self) -> None:
+    def _sync_imperative(self, detect_changes: bool = True) -> None:
         """Per-provider chains run concurrently: the chains are
         independent, and a blackholed provider (e.g. firewalled Intel
         namespaces on a TPU-only cluster) must cost the slowest single
@@ -396,7 +396,9 @@ class AcceleratorDataContext:
         if not sourced:
             return
 
-        before = self._imperative_fingerprint()
+        # refresh() invalidates the snapshot unconditionally — skip the
+        # fingerprint walks when nobody will read the verdict.
+        before = self._imperative_fingerprint() if detect_changes else None
 
         def fetch_one(provider: Provider, source: ProviderSource) -> None:
             self._fetch_workloads(provider, source)
@@ -412,7 +414,7 @@ class AcceleratorDataContext:
                 for f in futures:
                     f.result()
 
-        if self._imperative_fingerprint() != before:
+        if detect_changes and self._imperative_fingerprint() != before:
             self._changed = True
 
     def _imperative_fingerprint(self) -> tuple:
@@ -534,7 +536,7 @@ class AcceleratorDataContext:
         (`:109-111`: hooks stay reactive, manual refresh re-fires the
         CRD/daemon-pod effect)."""
         self._refresh_count += 1
-        self._sync_imperative()
+        self._sync_imperative(detect_changes=False)
         self._cached_snapshot = None
         return self.snapshot()
 

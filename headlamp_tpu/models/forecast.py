@@ -157,6 +157,11 @@ class InferenceDispatch(NamedTuple):
 
     path: str                        #: "pallas" | "xla" | "repeat"
     fallback_reason: str | None = None  #: set when Pallas was tried and failed
+    #: Final training MSE of the fit, as a DEVICE scalar (None on the
+    #: persistence path) — callers materialize it together with the
+    #: predictions in one device_get; a separate float() would cost an
+    #: extra round-trip over a tunneled chip.
+    fit_mse: Any = None
 
     @property
     def used_pallas(self) -> bool:
@@ -202,14 +207,16 @@ def _fit_program(
     key: jax.Array,
     cfg: ForecastConfig,
     steps: int,
-) -> Params:
-    """windowing → init → ``steps`` optimizer steps (lax.scan) → fitted
-    params, as ONE XLA program. A Python training loop would issue one
-    device dispatch per step — tens of round-trips on a remote/tunneled
-    TPU for a fit the fused program finishes in a single dispatch; the
-    windowing (``make_windows``'s gathers) is fused in too, because each
-    un-jitted jnp op is its own dispatch and over a tunneled chip those
-    round-trips dominate the whole fit."""
+) -> tuple[Params, jax.Array]:
+    """windowing → init → ``steps`` optimizer steps (lax.scan) →
+    (fitted params, final training MSE), as ONE XLA program. A Python
+    training loop would issue one device dispatch per step — tens of
+    round-trips on a remote/tunneled TPU for a fit the fused program
+    finishes in a single dispatch; the windowing (``make_windows``'s
+    gathers) is fused in too, because each un-jitted jnp op is its own
+    dispatch and over a tunneled chip those round-trips dominate the
+    whole fit. The final MSE travels with the params so surfacing fit
+    quality costs no extra dispatch."""
     x, y = make_windows(series, cfg.window, cfg.horizon)
     params = init_params(key, cfg)
     optimizer = optax.adam(cfg.learning_rate)
@@ -223,7 +230,11 @@ def _fit_program(
         return (p, s), loss
 
     (params, _), _ = jax.lax.scan(body, (params, opt_state), None, length=steps)
-    return params
+    # Self-assessment of the RETURNED model: scan losses are computed
+    # before each update, so losses[-1] would describe the penultimate
+    # params. One more loss_fn at the final params stays in the fused
+    # program — negligible next to the scan.
+    return params, loss_fn(params, x, y)
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "inference", "batch_p"))
@@ -245,15 +256,17 @@ def _fit_forecast_program(
     The fit is :func:`_fit_program` itself — nested jit inlines into the
     enclosing trace, so the serving path and the standalone fit (which
     the bench's parity check uses) can never train different models."""
-    params = _fit_program(series, key, cfg, steps)
+    params, final_loss = _fit_program(series, key, cfg, steps)
     recent = series[:, -cfg.window:]
     if inference == "pallas":
         from .pallas_forward import forecast_forward_padded
 
-        return forecast_forward_padded(
+        out = forecast_forward_padded(
             params, recent, batch_p=batch_p, horizon=cfg.horizon, interpret=False
         )
-    return forward(params, recent)
+    else:
+        out = forward(params, recent)
+    return out, final_loss
 
 
 def fit_and_forecast_with_dispatch(
@@ -290,16 +303,16 @@ def fit_and_forecast_with_dispatch(
             from .pallas_forward import check_single_tile, pallas_batch_p
 
             check_single_tile(cfg.window, cfg.hidden, cfg.horizon)
-            out = _fit_forecast_program(
+            out, mse = _fit_forecast_program(
                 series, key, cfg, steps, "pallas", pallas_batch_p(n_chips)
             )
-            return out, InferenceDispatch("pallas")
+            return out, InferenceDispatch("pallas", fit_mse=mse)
         except Exception as exc:  # noqa: BLE001 — optimization, not a dependency
             # Memoize: a kernel that failed to lower/compile would
             # otherwise re-pay the failed compile on EVERY forecast.
             _record_pallas_broken(f"{type(exc).__name__}: {exc}"[:200])
-    out = _fit_forecast_program(series, key, cfg, steps, "xla", 0)
-    return out, InferenceDispatch("xla", _pallas_broken_reason)
+    out, mse = _fit_forecast_program(series, key, cfg, steps, "xla", 0)
+    return out, InferenceDispatch("xla", _pallas_broken_reason, fit_mse=mse)
 
 
 #: Once the fused Pallas variant fails, the reason is memoized and every

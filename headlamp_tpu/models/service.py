@@ -38,6 +38,10 @@ class ForecastView:
     inference_path: str = "xla"
     #: Why Pallas fell back to XLA, when it was tried and failed.
     inference_fallback_reason: str | None = None
+    #: Final training MSE of the online fit (None on the persistence
+    #: path) — the model's self-assessment, shown so operators can judge
+    #: how much to trust the prediction.
+    fit_mse: float | None = None
 
     @property
     def at_risk(self) -> list[ChipForecast]:
@@ -92,7 +96,16 @@ def forecast_from_history(
     preds, dispatch = fit_and_forecast_with_dispatch(
         np.asarray(history.series), cfg, steps=steps
     )
-    preds = np.asarray(preds)
+    if dispatch.fit_mse is not None:
+        # One device_get for predictions AND the fit-quality scalar —
+        # a separate float() would cost an extra tunnel round-trip.
+        import jax
+
+        preds, fit_mse_arr = jax.device_get((preds, dispatch.fit_mse))
+        fit_mse = float(fit_mse_arr)
+    else:
+        preds = np.asarray(preds)
+        fit_mse = None
     fit_ms = round((time.perf_counter() - t0) * 1000, 1)
 
     chips = []
@@ -120,4 +133,5 @@ def forecast_from_history(
         fit_ms=fit_ms,
         inference_path=dispatch.path,
         inference_fallback_reason=dispatch.fallback_reason,
+        fit_mse=fit_mse,
     )

@@ -198,8 +198,13 @@ def forecast_section(view: Any) -> Element:
             "p",
             {"class_": "hl-hint"},
             f"Model fit on the last {round(view.window_s / 60)} min of history "
-            f"in {view.fit_ms:g} ms (online MLP, deterministic seed); "
-            f"inference via {_inference_label(view)}.",
+            f"in {view.fit_ms:g} ms (online MLP, deterministic seed"
+            + (
+                f", final fit MSE {view.fit_mse:.4f}"
+                if getattr(view, "fit_mse", None) is not None
+                else ""
+            )
+            + f"); inference via {_inference_label(view)}.",
         ),
     )
 

@@ -245,6 +245,14 @@ def topology_page(
                 ("Total chips", ssum["total_chips"]),
             ]
         ),
+        h(
+            "p",
+            {"class_": "hl-hint"},
+            "Each slice is one ICI domain — chips inside it talk over the "
+            "high-bandwidth interconnect drawn below; traffic BETWEEN "
+            "slices rides the datacenter network (DCN). Schedule "
+            "collective-heavy workloads within a slice.",
+        ),
     )
 
     health_rank = {"error": 0, "warning": 1, "success": 2}

@@ -212,6 +212,8 @@ class TestForecastService:
         )
         view = forecast_from_history(hist, steps=10)
         assert view.inference_path in ("pallas", "xla")
+        # Fit quality travels with the prediction (no extra dispatch).
+        assert view.fit_mse is not None and 0 <= view.fit_mse < 1.0
         if jax.devices()[0].platform != "tpu":
             assert view.inference_path == "xla"
             assert view.inference_fallback_reason is None
