@@ -200,8 +200,10 @@ def forecast_section(view: Any) -> Element:
             f"Model fit on the last {round(view.window_s / 60)} min of history "
             f"in {view.fit_ms:g} ms (online MLP, deterministic seed"
             + (
-                f", final fit MSE {view.fit_mse:.4f}"
-                if getattr(view, "fit_mse", None) is not None
+                # :g keeps tiny well-fit MSEs legible (1.2e-06, not
+                # the indistinguishable 0.0000).
+                f", final fit MSE {view.fit_mse:g}"
+                if view.fit_mse is not None
                 else ""
             )
             + f"); inference via {_inference_label(view)}.",

@@ -137,6 +137,20 @@ REQUIRED_FLEET_TS_EXPORTS = (
     "roundHalfEven",
     "podPhase",
     "podNodeName",
+    "waitingReason",
+    "podRestarts",
+)
+
+#: Exports the TS metrics client must provide beyond the fetch core.
+REQUIRED_METRICS_TS_EXPORTS = (
+    "fetchTpuMetrics",
+    "fetchTpuMetricsCached",
+    "peekTpuMetrics",
+    "chipUtilization",
+    "heatBand",
+    "normalizeFraction",
+    "formatPercent",
+    "formatBytes",
 )
 
 TS_FLEET = os.path.join(REPO, "plugin", "src", "api", "fleet.ts")
@@ -289,3 +303,13 @@ class TestHeadlampPluginSurface:
                 assert promql in src, promql
         assert str(mc.FRACTION_MAX) in src
         assert mc.NODE_MAP_QUERY in src
+
+    @pytest.mark.parametrize("symbol", REQUIRED_METRICS_TS_EXPORTS)
+    def test_metrics_export_present(self, symbol):
+        with open(
+            os.path.join(PLUGIN_SRC, "api", "metrics.ts"), encoding="utf-8"
+        ) as f:
+            src = f.read()
+        assert re.search(
+            rf"export (async )?(function|const|interface) {symbol}\b", src
+        ), f"metrics.ts must export {symbol}"
