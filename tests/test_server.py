@@ -387,6 +387,46 @@ class TestSocketRoundTrip:
             server.shutdown()
 
 
+class TestConcurrentLoad:
+    def test_parallel_mixed_routes_never_500(self):
+        """Race-discipline smoke (SURVEY §5): ThreadingHTTPServer serves
+        requests concurrently, so every lock path — sync lock, metrics
+        TTL lock, forecast lock, background lifecycle lock, the
+        non-blocking peek — runs under real contention here. Any
+        deadlock shows up as the 10s timeout; any race that throws
+        shows up as a 500 from the error boundary."""
+        import concurrent.futures
+        import urllib.error
+
+        app = make_app("v5p32")
+        stop = app.start_background_sync(0.05)
+        server = app.serve(port=0)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        routes = [
+            "/tpu", "/tpu/metrics", "/tpu/topology", "/tpu/nodes",
+            "/tpu/pods", "/healthz", "/refresh?back=/tpu", "/nodes",
+        ]
+
+        def hit(i: int) -> int:
+            url = f"http://127.0.0.1:{port}{routes[i % len(routes)]}"
+            try:
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        try:
+            with concurrent.futures.ThreadPoolExecutor(max_workers=16) as pool:
+                statuses = list(pool.map(hit, range(64)))
+            assert all(s in (200, 302) for s in statuses), statuses
+            # The app is still coherent afterwards.
+            assert json.loads(app.handle("/healthz")[2])["ok"] is True
+        finally:
+            stop.set()
+            server.shutdown()
+
+
 class TestDemoTransport:
     def test_large_fleet_served(self):
         app = DashboardApp(make_demo_transport("large"), min_sync_interval_s=0.0)
