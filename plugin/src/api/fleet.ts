@@ -63,6 +63,14 @@ function asRecord(value: any): Record<string, any> {
   return value && typeof value === 'object' && !Array.isArray(value) ? value : {};
 }
 
+/** Headlamp hands components KubeObject wrappers holding the raw
+ * manifest under `.jsonData`; every pure helper here speaks plain
+ * manifests. One shared unwrap so the contract lives in one place. */
+export function rawObjectOf(item: unknown): Record<string, any> {
+  const wrapped = item as { jsonData?: Record<string, any> } | null;
+  return wrapped?.jsonData ?? (item as Record<string, any>);
+}
+
 /** Python's round(): banker's (half-to-even) rounding — Math.round's
  * half-up would diverge from python_fleet_stats on exact .5 ties
  * (e.g. 1 chip in use of 200 → 0.5% → 0 in Python, 1 via Math.round). */

@@ -27,6 +27,7 @@ import {
   podName,
   podNamespace,
   podPhase,
+  rawObjectOf,
   TPU_PLUGIN_NAMESPACE,
 } from '../api/fleet';
 import { useTpuContext } from '../api/TpuDataContext';
@@ -83,12 +84,7 @@ export default function DevicePluginsPage() {
           const list = (await ApiProxy.request(url)) as { items?: unknown[] };
           if (Array.isArray(list?.items)) {
             anySuccess = true;
-            const items = list.items.map(item =>
-              item && typeof item === 'object' && 'jsonData' in (item as object)
-                ? (item as { jsonData: KubeDaemonSet }).jsonData
-                : (item as KubeDaemonSet)
-            );
-            found.push(...items.filter(isTpuPluginDaemonSet));
+            found.push(...list.items.map(rawObjectOf).filter(isTpuPluginDaemonSet));
             if (found.length) break;
           }
         } catch {

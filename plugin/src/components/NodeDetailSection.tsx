@@ -25,6 +25,7 @@ import {
   podNamespace,
   podNodeName,
   podPhase,
+  rawObjectOf,
 } from '../api/fleet';
 import { useTpuContext } from '../api/TpuDataContext';
 import {
@@ -37,7 +38,7 @@ import {
 
 export default function NodeDetailSection({ resource }: { resource: { jsonData?: unknown } }) {
   const { slices, tpuPods } = useTpuContext();
-  const node = (resource?.jsonData ?? resource) as Record<string, any>;
+  const node = rawObjectOf(resource);
 
   if (!isTpuNode(node)) {
     return null;

@@ -14,11 +14,11 @@ import {
   SectionBox,
 } from '@kinvolk/headlamp-plugin/lib/CommonComponents';
 import React from 'react';
-import { getPodChipRequest, isTpuRequestingPod } from '../api/fleet';
+import { getPodChipRequest, isTpuRequestingPod, rawObjectOf } from '../api/fleet';
 import { TPU_RESOURCE } from '../api/topology';
 
 export default function PodDetailSection({ resource }: { resource: { jsonData?: unknown } }) {
-  const pod = (resource?.jsonData ?? resource) as Record<string, any>;
+  const pod = rawObjectOf(resource);
 
   if (!isTpuRequestingPod(pod)) {
     return null;

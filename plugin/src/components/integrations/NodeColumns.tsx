@@ -9,7 +9,7 @@
  */
 
 import React from 'react';
-import { formatGeneration, getNodeGeneration } from '../../api/fleet';
+import { formatGeneration, getNodeGeneration, rawObjectOf } from '../../api/fleet';
 import { getNodeChipCapacity, isTpuNode } from '../../api/topology';
 
 export interface NodeTableColumn {
@@ -19,17 +19,13 @@ export interface NodeTableColumn {
   render?: (node: { jsonData?: unknown }) => React.ReactNode;
 }
 
-function unwrap(node: { jsonData?: unknown }): Record<string, any> {
-  return (node?.jsonData ?? node) as Record<string, any>;
-}
-
 export function buildNodeTpuColumns(): NodeTableColumn[] {
   return [
     {
       id: 'tpu-generation',
       label: 'TPU',
       getValue: node => {
-        const n = unwrap(node);
+        const n = rawObjectOf(node);
         return isTpuNode(n) ? formatGeneration(getNodeGeneration(n)) : '—';
       },
     },
@@ -37,7 +33,7 @@ export function buildNodeTpuColumns(): NodeTableColumn[] {
       id: 'tpu-chips',
       label: 'TPU Chips',
       getValue: node => {
-        const n = unwrap(node);
+        const n = rawObjectOf(node);
         return isTpuNode(n) ? String(getNodeChipCapacity(n)) : '—';
       },
     },

@@ -3,7 +3,7 @@
  *
  * - `K8s.ResourceClasses.{Node,Pod}.useList()` serve a fixture cluster
  *   installed with `setMockCluster` (raw JSON objects, exactly what
- *   `extractJsonData` unwraps from real KubeObjects).
+ *   `rawObjectOf` unwraps from real KubeObjects).
  * - `ApiProxy.request` answers pod-list URLs from the same cluster.
  * - The four `register*` entry points capture their arguments into
  *   `captured` so registration tests can assert the full surface.
