@@ -55,6 +55,10 @@ export const TPU_GENERATION_DISPLAY: Record<string, string> = {
  * reference `NodesPage.tsx:38`). */
 export const HOT_NODE_PCT = 90.0;
 
+/** Warn threshold for the allocation meters
+ * (`ui/components.py:BAR_WARN_PCT`, reference `NodesPage.tsx:38`). */
+export const WARM_NODE_PCT = 70.0;
+
 // ---------------------------------------------------------------------------
 // Object plumbing (objects.py analogues — total functions, never throw)
 // ---------------------------------------------------------------------------
@@ -153,6 +157,36 @@ export function getPodChipRequest(pod: KubePod): number {
   const mainSum = containerList(pod, 'containers').reduce((acc, c) => acc + chipReq(c), 0);
   const initMax = containerList(pod, 'initContainers').reduce((acc, c) => Math.max(acc, chipReq(c)), 0);
   return Math.max(mainSum, initMax);
+}
+
+export interface ContainerChips {
+  name: string;
+  req: number;
+  lim: number;
+  init: boolean;
+}
+
+/** Per-container chip budget for every container touching the TPU
+ * resource, init containers marked — the data behind the pages'
+ * `name: req=N lim=M` lines (`pages/pods.py:container_chip_list`,
+ * reference `PodsPage.tsx:49-88`). */
+export function containerChipBreakdown(pod: KubePod): ContainerChips[] {
+  const out: ContainerChips[] = [];
+  for (const key of ['containers', 'initContainers'] as const) {
+    for (const c of containerList(pod, key)) {
+      const req = parseIntLenient(containerRequests(c)[TPU_RESOURCE]);
+      const lim = parseIntLenient(containerLimits(c)[TPU_RESOURCE]);
+      if (req > 0 || lim > 0) {
+        out.push({ name: String(c.name ?? '?'), req, lim, init: key === 'initContainers' });
+      }
+    }
+  }
+  return out;
+}
+
+/** `status.nodeInfo` (OS image, kernel, kubelet) — `objects.node_info`. */
+export function nodeInfo(node: KubeNode): Record<string, any> {
+  return asRecord(asRecord(node?.status).nodeInfo);
 }
 
 /** TPU device-plugin daemon pod by any accepted label variant. */

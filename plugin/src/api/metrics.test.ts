@@ -105,7 +105,7 @@ describe('fetch + join', () => {
     // 1.1 ≤ FRACTION_MAX: saturated chip with rate overshoot, not a
     // percent exporter; render-time clamp shows 100%.
     expect(snap!.chips[0].tensorcore_utilization).toBeCloseTo(1.1);
-    expect(formatPercent(snap!.chips[0].tensorcore_utilization!)).toBe('100%');
+    expect(formatPercent(snap!.chips[0].tensorcore_utilization!)).toBe('100.0%');
   });
 
   it('joins instance-only samples through node_uname_info', async () => {
@@ -126,9 +126,19 @@ describe('formatting', () => {
   it('formats bytes and percents', () => {
     expect(formatBytes(8 * 1024 ** 3)).toBe('8.0 GiB');
     expect(formatBytes(512)).toBe('512.0 B');
-    expect(formatPercent(0.874)).toBe('87%');
-    expect(formatPercent(1.3)).toBe('100%');
-    expect(formatPercent(-0.1)).toBe('0%');
+    // Same default precision + banker's rounding as the Python
+    // format_percent — both surfaces print identical strings.
+    expect(formatPercent(0.874)).toBe('87.4%');
+    expect(formatPercent(1.3)).toBe('100.0%');
+    expect(formatPercent(-0.1)).toBe('0.0%');
+    expect(formatPercent(null)).toBe('—');
+    // True representable tie: 12.5 -> 12 under half-even (13 half-up).
+    expect(formatPercent(0.125, 0)).toBe('12%');
+    // Not a tie despite appearances: 0.0005*100 sits just ABOVE 0.05 in
+    // binary, so both surfaces print 0.1 — a scaled-integer rounding
+    // (x*10 lands on exactly 4.5) would wrongly print 0.0.
+    expect(formatPercent(0.0005)).toBe('0.1%');
+    expect(formatPercent(0.55, 0)).toBe('55%');
   });
 
   it('builds service-proxy paths', () => {

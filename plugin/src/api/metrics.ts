@@ -303,10 +303,37 @@ export function normalizeFraction(value: number): number {
   return value > 1.5 ? value / 100 : value;
 }
 
-export function formatPercent(fraction: number): string {
-  // Render-time clamp bounds the residual (1.0, FRACTION_MAX] band of
-  // an ambiguous near-idle percent exporter (client.py scale notes).
-  return `${Math.round(Math.min(1, Math.max(0, normalizeFraction(fraction))) * 100)}%`;
+const percentFormatters = new Map<number, Intl.NumberFormat>();
+
+function percentFormatter(digits: number): Intl.NumberFormat {
+  let fmt = percentFormatters.get(digits);
+  if (!fmt) {
+    fmt = new Intl.NumberFormat('en-US', {
+      minimumFractionDigits: digits,
+      maximumFractionDigits: digits,
+      useGrouping: false,
+      // Python's %.Nf rounds the EXACT binary value half-to-even, and
+      // so does Intl with this mode. A hand-rolled
+      // round(pct * 10**digits) double-rounds: 0.0005*100 is slightly
+      // above 0.05, but *10 lands on exactly 4.5 and half-even then
+      // drops what Python prints as '0.1'.
+      roundingMode: 'halfEven',
+    } as Intl.NumberFormatOptions);
+    percentFormatters.set(digits, fmt);
+  }
+  return fmt;
+}
+
+/** 0.874 -> '87.4%', null -> '—' — mirrors `metrics/format.py:
+ * format_percent` digit-for-digit (same default precision, same
+ * banker's rounding on the exact value) so the two delivery surfaces
+ * can never render the same sample differently. The render-time clamp
+ * bounds the residual (1.0, FRACTION_MAX] band of an ambiguous
+ * near-idle percent exporter (client.py scale notes). */
+export function formatPercent(fraction: number | null, digits: number = 1): string {
+  if (fraction === null) return '—';
+  const pct = Math.min(100, Math.max(0, normalizeFraction(fraction) * 100));
+  return `${percentFormatter(digits).format(pct)}%`;
 }
 
 // ---------------------------------------------------------------------------

@@ -1,0 +1,114 @@
+/**
+ * DevicePluginsPage branch coverage: loading, unreadable DaemonSet
+ * lists (RBAC), installed DaemonSet with rollout card, not-installed
+ * empty chain, daemon-pod table, and refresh re-fetch.
+ */
+
+import { fireEvent, render, screen } from '@testing-library/react';
+import React from 'react';
+import { afterEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../testing/mockCommonComponents')
+);
+
+import { TpuDataProvider } from '../api/TpuDataContext';
+import { loadFixture } from '../testing/fixtures';
+import {
+  requestLog,
+  resetRequestLog,
+  setMockApiHandler,
+  setMockCluster,
+} from '../testing/mockHeadlampLib';
+import DevicePluginsPage from './DevicePluginsPage';
+
+const TPU_DAEMONSET = {
+  metadata: {
+    name: 'tpu-device-plugin',
+    namespace: 'kube-system',
+    uid: 'uid-ds-1',
+    labels: { 'k8s-app': 'tpu-device-plugin' },
+  },
+  spec: {
+    template: {
+      spec: {
+        nodeSelector: { 'cloud.google.com/gke-tpu-accelerator': 'tpu-v5p-slice' },
+        containers: [{ name: 'plugin', image: 'gke.gcr.io/tpu-device-plugin:v1.2' }],
+      },
+    },
+  },
+  status: { desiredNumberScheduled: 4, numberReady: 3 },
+};
+
+function mount() {
+  return render(
+    <TpuDataProvider>
+      <DevicePluginsPage />
+    </TpuDataProvider>
+  );
+}
+
+afterEach(() => {
+  setMockApiHandler(null);
+  resetRequestLog();
+});
+
+describe('unreadable DaemonSet lists', () => {
+  it('reports not-readable, never claims not-installed', async () => {
+    const { fleet, expected } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('Daemon Pods');
+    // The mock ApiProxy rejects every daemonset list — the page must
+    // report "not readable" (RBAC), never claim "Not installed".
+    expect(screen.getByText('DaemonSet not readable')).toBeTruthy();
+    for (const name of expected.plugin_pod_names) {
+      expect(screen.getByText(name)).toBeTruthy();
+    }
+  });
+});
+
+describe('installed DaemonSet', () => {
+  it('renders the rollout card with selector and image', async () => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    setMockApiHandler(url =>
+      url.includes('/daemonsets') ? { items: [TPU_DAEMONSET] } : undefined
+    );
+    mount();
+    await screen.findByText('kube-system/tpu-device-plugin');
+    expect(screen.getByText(/cloud.google.com\/gke-tpu-accelerator=tpu-v5p-slice/)).toBeTruthy();
+    expect(screen.getByText('gke.gcr.io/tpu-device-plugin:v1.2')).toBeTruthy();
+    expect(screen.queryByText('DaemonSet not readable')).toBeNull();
+    expect(screen.queryByText('Not installed')).toBeNull();
+  });
+});
+
+describe('readable but absent', () => {
+  it('says not installed when the chain succeeds with zero matches', async () => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    setMockApiHandler(url => (url.includes('/daemonsets') ? { items: [] } : undefined));
+    mount();
+    await screen.findByText('Not installed');
+    expect(screen.getByText(/No TPU device-plugin DaemonSet found/)).toBeTruthy();
+  });
+});
+
+describe('refresh', () => {
+  it('refetches the DaemonSets and the pod chain together', async () => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    setMockApiHandler(url =>
+      url.includes('/daemonsets') ? { items: [TPU_DAEMONSET] } : undefined
+    );
+    mount();
+    await screen.findByText('kube-system/tpu-device-plugin');
+    const before = requestLog.filter(u => u.includes('/daemonsets')).length;
+    fireEvent.click(screen.getByRole('button', { name: /Refresh TPU Device Plugin/ }));
+    await vi.waitFor(() =>
+      expect(requestLog.filter(u => u.includes('/daemonsets')).length).toBeGreaterThan(before)
+    );
+  });
+});

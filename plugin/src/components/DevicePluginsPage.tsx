@@ -15,7 +15,6 @@ import {
   Loader,
   NameValueTable,
   SectionBox,
-  SectionHeader,
   SimpleTable,
   StatusLabel,
 } from '@kinvolk/headlamp-plugin/lib/CommonComponents';
@@ -31,6 +30,7 @@ import {
   TPU_PLUGIN_NAMESPACE,
 } from '../api/fleet';
 import { useTpuContext } from '../api/TpuDataContext';
+import { PageHeader } from './common';
 
 const DAEMONSET_PATHS = [
   `/apis/apps/v1/daemonsets?labelSelector=${encodeURIComponent('k8s-app=tpu-device-plugin')}`,
@@ -110,10 +110,7 @@ export default function DevicePluginsPage() {
 
   return (
     <>
-      <SectionHeader title="TPU Device Plugin" />
-      <button type="button" onClick={refresh}>
-        Refresh
-      </button>
+      <PageHeader title="TPU Device Plugin" onRefresh={refresh} />
       {daemonsets.length === 0 && (
         <SectionBox title={sourceAvailable ? 'Not installed' : 'DaemonSet not readable'}>
           <p>

@@ -1,0 +1,120 @@
+/**
+ * MetricsPage branch coverage: loading, unreachable Prometheus (guided
+ * box), reachable-with-samples (availability matrix + fleet telemetry
+ * + chip cards), reachable-without-samples, and refresh re-scrape.
+ */
+
+import { fireEvent, render, screen } from '@testing-library/react';
+import React from 'react';
+import { afterEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../testing/mockCommonComponents')
+);
+
+import {
+  requestLog,
+  resetRequestLog,
+  setMockApiHandler,
+  setMockCluster,
+} from '../testing/mockHeadlampLib';
+import MetricsPage from './MetricsPage';
+
+/** Simulated Prometheus behind the apiserver proxy: answers the probe,
+ * node map, and whichever series `vectors` carries; everything else is
+ * an empty success vector (a reachable Prometheus that simply has no
+ * such series). */
+function promHandler(vectors: Record<string, unknown[]>) {
+  return (url: string): unknown => {
+    if (!url.includes('/proxy/api/v1/query')) return undefined; // fall through
+    const promql = decodeURIComponent(url.split('query=')[1] ?? '');
+    if (promql === '1') {
+      return { status: 'success', data: { resultType: 'scalar', result: [0, '1'] } };
+    }
+    for (const [series, result] of Object.entries(vectors)) {
+      if (promql.startsWith(series)) {
+        return { status: 'success', data: { resultType: 'vector', result } };
+      }
+    }
+    return { status: 'success', data: { resultType: 'vector', result: [] } };
+  };
+}
+
+afterEach(async () => {
+  setMockApiHandler(null);
+  resetRequestLog();
+  const { resetMetricsCache } = await import('../api/metrics');
+  resetMetricsCache();
+});
+
+describe('unreachable Prometheus', () => {
+  it('renders the guided install box, never crashes', async () => {
+    // The mock ApiProxy throws for every non-/pods URL, so the whole
+    // discovery chain fails — the reference behavior is a guided box.
+    setMockCluster({ nodes: [], pods: [] });
+    render(<MetricsPage />);
+    expect(await screen.findByText('Prometheus not reachable')).toBeTruthy();
+  });
+});
+
+describe('reachable Prometheus with TPU samples', () => {
+  it('renders availability, fleet telemetry, and chip cards', async () => {
+    setMockApiHandler(
+      promHandler({
+        tensorcore_utilization: [
+          { metric: { node: 'gke-w0', accelerator_id: '0' }, value: [0, '0.8'] },
+          { metric: { node: 'gke-w0', accelerator_id: '1' }, value: [0, '0.6'] },
+        ],
+        hbm_bytes_used: [
+          { metric: { node: 'gke-w0', accelerator_id: '0' }, value: [0, String(8 * 1024 ** 3)] },
+        ],
+        hbm_bytes_total: [
+          { metric: { node: 'gke-w0', accelerator_id: '0' }, value: [0, String(16 * 1024 ** 3)] },
+        ],
+      })
+    );
+    render(<MetricsPage />);
+    await screen.findByText('Metric Availability');
+
+    // Availability matrix: resolved series named for the available
+    // metrics, honest "No data" for the missing ones.
+    const availabilitySection = screen.getByText('Metric Availability').closest('section')!;
+    expect(availabilitySection.textContent).toContain('tensorcore_utilization');
+    expect(screen.getAllByText('Yes').length).toBe(3);
+    expect(screen.getAllByText('No data').length).toBe(2); // bandwidth + duty_cycle
+
+    // Fleet telemetry aggregates over reporting chips.
+    const telemetry = screen.getByText('Fleet Telemetry').closest('section')!;
+    expect(telemetry.textContent).toContain('Chips reporting');
+    expect(telemetry.textContent).toContain('70.0%'); // mean of 0.8/0.6
+    expect(telemetry.textContent).toContain('8.0 GiB');
+    expect(telemetry.textContent).toContain('16.0 GiB');
+
+    // One card per (node, chip).
+    expect(screen.getByText('gke-w0 · chip 0')).toBeTruthy();
+    expect(screen.getByText('gke-w0 · chip 1')).toBeTruthy();
+    expect(screen.getByText('80.0%')).toBeTruthy();
+  });
+});
+
+describe('reachable Prometheus without TPU series', () => {
+  it('says so instead of pretending the exporter is down', async () => {
+    setMockApiHandler(promHandler({}));
+    render(<MetricsPage />);
+    await screen.findByText('No TPU samples');
+    expect(screen.getByText(/no TPU series returned data/)).toBeTruthy();
+    expect(screen.getAllByText('No data').length).toBe(5);
+  });
+});
+
+describe('refresh', () => {
+  it('re-scrapes without a remount', async () => {
+    setMockApiHandler(promHandler({}));
+    render(<MetricsPage />);
+    await screen.findByText('No TPU samples');
+    const before = requestLog.length;
+    fireEvent.click(screen.getByRole('button', { name: /Refresh TPU Metrics/ }));
+    await vi.waitFor(() => expect(requestLog.length).toBeGreaterThan(before));
+  });
+});

@@ -14,7 +14,6 @@ import {
   Loader,
   NameValueTable,
   SectionBox,
-  SectionHeader,
   SimpleTable,
   StatusLabel,
 } from '@kinvolk/headlamp-plugin/lib/CommonComponents';
@@ -28,6 +27,7 @@ import {
   TpuChipMetrics,
   TpuMetricsSnapshot,
 } from '../api/metrics';
+import { PageHeader } from './common';
 
 function ChipCard({ chip }: { chip: TpuChipMetrics }) {
   const rows: Array<{ name: string; value: React.ReactNode }> = [];
@@ -82,17 +82,10 @@ export default function MetricsPage() {
     return <Loader title="Scraping TPU telemetry" />;
   }
 
-  const refreshButton = (
-    <button type="button" onClick={() => setRefreshKey(k => k + 1)}>
-      Refresh
-    </button>
-  );
-
   if (snapshot === null) {
     return (
       <>
-        <SectionHeader title="TPU Metrics" />
-        {refreshButton}
+        <PageHeader title="TPU Metrics" onRefresh={() => setRefreshKey(k => k + 1)} />
         <SectionBox title="Prometheus not reachable">
           <p>
             No Prometheus service answered through the apiserver proxy. Install
@@ -117,8 +110,7 @@ export default function MetricsPage() {
 
   return (
     <>
-      <SectionHeader title="TPU Metrics" />
-      {refreshButton}
+      <PageHeader title="TPU Metrics" onRefresh={() => setRefreshKey(k => k + 1)} />
       <SectionBox title="Metric Availability">
         <SimpleTable
           columns={[

@@ -1,0 +1,137 @@
+/**
+ * NodesPage branch coverage: loading, empty, loaded table with
+ * allocation meters, per-node detail cards (OS/kernel/kubelet), card
+ * capping not-ready-first, list error, and refresh.
+ */
+
+import { fireEvent, render, screen } from '@testing-library/react';
+import React from 'react';
+import { afterEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../testing/mockCommonComponents')
+);
+
+import { TpuDataProvider } from '../api/TpuDataContext';
+import { loadFixture } from '../testing/fixtures';
+import { requestLog, resetRequestLog, setMockCluster } from '../testing/mockHeadlampLib';
+import NodesPage from './NodesPage';
+
+function mount() {
+  return render(
+    <TpuDataProvider>
+      <NodesPage />
+    </TpuDataProvider>
+  );
+}
+
+afterEach(() => {
+  resetRequestLog();
+});
+
+describe('loading and empty states', () => {
+  it('shows the loader while lists are pending', () => {
+    setMockCluster({ nodes: null, pods: null });
+    mount();
+    expect(screen.getByTestId('loader')).toBeTruthy();
+  });
+
+  it('renders the empty message on a TPU-free cluster', async () => {
+    setMockCluster({ nodes: [], pods: [] });
+    mount();
+    await screen.findByText('Summary');
+    expect(screen.getByText('No TPU nodes found')).toBeTruthy();
+  });
+});
+
+describe('loaded on v5p32', () => {
+  it('lists every TPU node', async () => {
+    const { fleet, expected } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('Summary');
+    for (const name of expected.tpu_node_names) {
+      // Name appears in the table row AND as its detail-card title.
+      expect(screen.getAllByText(name).length).toBeGreaterThanOrEqual(2);
+    }
+  });
+
+  it('renders per-node allocation meters with fixture percentages', async () => {
+    const { fleet, expected } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    const { container } = mount();
+    await screen.findByText('Summary');
+    const meters = container.querySelectorAll('.hl-utilbar');
+    // One fleet meter + one per node row + one "in use" line per card.
+    expect(meters.length).toBeGreaterThanOrEqual(expected.fleet_stats.nodes_total);
+    // v5p32: three saturated nodes (4/4 = 100%) → err meters exist.
+    expect(container.querySelectorAll('.hl-utilbar-err').length).toBeGreaterThan(0);
+    // The saturated node meter carries the exact percentage.
+    const pcts = [...meters].map(m => m.getAttribute('data-pct'));
+    expect(pcts).toContain('100');
+  });
+
+  it('renders detail cards with OS, kernel, and kubelet from nodeInfo', async () => {
+    const { fleet, expected } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('Summary');
+    const info = fleet.nodes.find(n => n.metadata?.name === expected.tpu_node_names[0])!.status
+      .nodeInfo;
+    expect(screen.getAllByText(info.osImage).length).toBeGreaterThan(0);
+    expect(screen.getAllByText(info.kernelVersion).length).toBeGreaterThan(0);
+    expect(screen.getAllByText(info.kubeletVersion).length).toBeGreaterThan(0);
+    // Card body also carries topology + worker index rows.
+    expect(screen.getAllByText('Worker index').length).toBe(expected.tpu_node_names.length);
+  });
+});
+
+describe('detail-card capping', () => {
+  it('caps cards not-ready-first past the 64-node cap', async () => {
+    // Synthetic 70-node fleet: node-00 … node-69, with node-65
+    // NotReady. The card list must include node-65 (not-ready nodes
+    // surface first) and drop 6 ready stragglers, with a hint.
+    const nodes = Array.from({ length: 70 }, (_, i) => ({
+      metadata: {
+        name: `node-${String(i).padStart(2, '0')}`,
+        uid: `uid-${i}`,
+        labels: { 'cloud.google.com/gke-tpu-accelerator': 'tpu-v5-lite-podslice' },
+      },
+      status: {
+        allocatable: { 'google.com/tpu': '4' },
+        capacity: { 'google.com/tpu': '4' },
+        conditions: [{ type: 'Ready', status: i === 65 ? 'False' : 'True' }],
+      },
+    }));
+    setMockCluster({ nodes, pods: [] });
+    mount();
+    await screen.findByText('Summary');
+    expect(screen.getByText(/Showing 64 of 70 node detail cards/)).toBeTruthy();
+    // The NotReady node keeps a card (two name occurrences: row+card)…
+    expect(screen.getAllByText('node-65').length).toBeGreaterThanOrEqual(2);
+    // …while the last ready node lost its card (row occurrence only).
+    expect(screen.getAllByText('node-69')).toHaveLength(1);
+  });
+});
+
+describe('list error', () => {
+  it('surfaces the node-list error', async () => {
+    setMockCluster({ nodes: null, pods: [], nodeError: 'nodes is forbidden' });
+    mount();
+    await screen.findByText('Data errors');
+    expect(screen.getByText(/nodes is forbidden/)).toBeTruthy();
+  });
+});
+
+describe('refresh', () => {
+  it('re-triggers the imperative track', async () => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('Summary');
+    const before = requestLog.length;
+    fireEvent.click(screen.getByRole('button', { name: /Refresh TPU Nodes/ }));
+    await vi.waitFor(() => expect(requestLog.length).toBeGreaterThan(before));
+  });
+});

@@ -1,25 +1,34 @@
 /**
  * NodesPage — every TPU node with readiness, generation, slice
- * membership, and chip allocation.
+ * membership, chip allocation meters, and per-node detail cards.
  *
  * Headlamp-native rendering of `headlamp_tpu/pages/nodes.py` (itself
- * rebuilding `/root/reference/src/components/NodesPage.tsx` for TPU
- * primitives). Headlamp's SimpleTable provides sorting/paging, so the
- * Python host's explicit `?page=/?q=` machinery is not needed here.
+ * rebuilding `/root/reference/src/components/NodesPage.tsx`: summary
+ * table with allocation bar `:35-63`, detail cards with OS/kernel/
+ * kubelet `:69-139`). Headlamp's SimpleTable provides sorting/paging,
+ * so the Python host's explicit `?page=/?q=` machinery is not needed
+ * here; the detail cards are capped not-ready-first exactly like the
+ * Python page (`pages/common.py:cap_nodes_for_cards`).
  */
 
 import {
   Loader,
   NameValueTable,
   SectionBox,
-  SectionHeader,
   SimpleTable,
   StatusLabel,
 } from '@kinvolk/headlamp-plugin/lib/CommonComponents';
 import React from 'react';
-import { formatGeneration, getNodeChipAllocatable, getNodeGeneration } from '../api/fleet';
+import {
+  formatAge,
+  formatGeneration,
+  getNodeChipAllocatable,
+  getNodeGeneration,
+  nodeInfo,
+} from '../api/fleet';
 import { useTpuContext } from '../api/TpuDataContext';
 import {
+  getNodeAccelerator,
   getNodeChipCapacity,
   getNodePool,
   getNodeTopology,
@@ -28,9 +37,44 @@ import {
   KubeNode,
   nodeName,
 } from '../api/topology';
+import { capNodesForCards, PageHeader, UtilizationBar } from './common';
+
+function readyLabel(node: KubeNode) {
+  return (
+    <StatusLabel status={isNodeReady(node) ? 'success' : 'error'}>
+      {isNodeReady(node) ? 'Ready' : 'NotReady'}
+    </StatusLabel>
+  );
+}
+
+function NodeDetailCard({ node, inUse, nowMs }: { node: KubeNode; inUse: number; nowMs: number }) {
+  const info = nodeInfo(node);
+  const worker = getNodeWorkerId(node);
+  return (
+    <SectionBox title={nodeName(node)}>
+      <NameValueTable
+        rows={[
+          { name: 'Status', value: readyLabel(node) },
+          { name: 'Generation', value: formatGeneration(getNodeGeneration(node)) },
+          { name: 'Accelerator label', value: getNodeAccelerator(node) ?? '—' },
+          { name: 'Topology', value: getNodeTopology(node) ?? '—' },
+          { name: 'Node pool', value: getNodePool(node) ?? '—' },
+          { name: 'Worker index', value: worker === null ? '—' : worker },
+          { name: 'Chips (capacity)', value: getNodeChipCapacity(node) },
+          { name: 'Chips (allocatable)', value: getNodeChipAllocatable(node) },
+          { name: 'Chips in use', value: inUse },
+          { name: 'OS', value: String(info.osImage ?? '—') },
+          { name: 'Kernel', value: String(info.kernelVersion ?? '—') },
+          { name: 'Kubelet', value: String(info.kubeletVersion ?? '—') },
+          { name: 'Age', value: formatAge(node?.metadata?.creationTimestamp, nowMs) },
+        ]}
+      />
+    </SectionBox>
+  );
+}
 
 export default function NodesPage() {
-  const { tpuNodes, stats, loading, error } = useTpuContext();
+  const { tpuNodes, stats, loading, error, refresh } = useTpuContext();
 
   // Per-node in-use is aligned to tpuNodes order (fleet.ts contract);
   // one identity map per render beats indexOf-per-cell (O(n²) at the
@@ -40,13 +84,20 @@ export default function NodesPage() {
     [tpuNodes, stats]
   );
 
+  const { shown: cardNodes, truncationNote } = React.useMemo(
+    () => capNodesForCards(tpuNodes),
+    [tpuNodes]
+  );
+
   if (loading) {
     return <Loader title="Loading TPU nodes" />;
   }
 
+  const nowMs = Date.now();
+
   return (
     <>
-      <SectionHeader title="TPU Nodes" />
+      <PageHeader title="TPU Nodes" onRefresh={refresh} />
       {error && (
         <SectionBox title="Data errors">
           <StatusLabel status="error">{error}</StatusLabel>
@@ -58,6 +109,10 @@ export default function NodesPage() {
             { name: 'Nodes', value: stats.nodes_total },
             { name: 'Ready', value: stats.nodes_ready },
             { name: 'Chips in use', value: `${stats.in_use}/${stats.capacity}` },
+            {
+              name: 'Fleet allocation',
+              value: <UtilizationBar used={stats.in_use} capacity={stats.allocatable} unit="chips" />,
+            },
           ]}
         />
       </SectionBox>
@@ -65,14 +120,7 @@ export default function NodesPage() {
         <SimpleTable
           columns={[
             { label: 'Node', getter: (n: KubeNode) => nodeName(n) },
-            {
-              label: 'Ready',
-              getter: (n: KubeNode) => (
-                <StatusLabel status={isNodeReady(n) ? 'success' : 'error'}>
-                  {isNodeReady(n) ? 'Ready' : 'NotReady'}
-                </StatusLabel>
-              ),
-            },
+            { label: 'Ready', getter: readyLabel },
             { label: 'Generation', getter: (n: KubeNode) => formatGeneration(getNodeGeneration(n)) },
             { label: 'Topology', getter: (n: KubeNode) => getNodeTopology(n) ?? '—' },
             { label: 'Node pool', getter: (n: KubeNode) => getNodePool(n) ?? '—' },
@@ -84,6 +132,15 @@ export default function NodesPage() {
               },
             },
             {
+              label: 'Allocation',
+              getter: (n: KubeNode) => (
+                <UtilizationBar
+                  used={inUseByNode.get(n) ?? 0}
+                  capacity={getNodeChipAllocatable(n)}
+                />
+              ),
+            },
+            {
               label: 'Chips (used/alloc/cap)',
               getter: (n: KubeNode) =>
                 `${inUseByNode.get(n) ?? 0}/${getNodeChipAllocatable(n)}/${getNodeChipCapacity(n)}`,
@@ -93,6 +150,15 @@ export default function NodesPage() {
           emptyMessage="No TPU nodes found"
         />
       </SectionBox>
+      {truncationNote && <p className="hl-hint">{truncationNote}</p>}
+      {cardNodes.map(n => (
+        <NodeDetailCard
+          key={nodeName(n) || String(n?.metadata?.uid ?? '')}
+          node={n}
+          inUse={inUseByNode.get(n) ?? 0}
+          nowMs={nowMs}
+        />
+      ))}
     </>
   );
 }

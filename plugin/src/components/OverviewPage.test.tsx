@@ -1,0 +1,127 @@
+/**
+ * OverviewPage branch coverage: loading, empty fleet, loaded (fixture
+ * stats + generation distribution), list error, and refresh — the
+ * five states the reference's page suite walks
+ * (`/root/reference/src/components/OverviewPage.test.tsx` pattern).
+ */
+
+import { fireEvent, render, screen } from '@testing-library/react';
+import React from 'react';
+import { afterEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../testing/mockCommonComponents')
+);
+
+import { formatGeneration } from '../api/fleet';
+import { TpuDataProvider } from '../api/TpuDataContext';
+import { loadFixture } from '../testing/fixtures';
+import { resetRequestLog, requestLog, setMockCluster } from '../testing/mockHeadlampLib';
+import OverviewPage from './OverviewPage';
+
+function mount() {
+  return render(
+    <TpuDataProvider>
+      <OverviewPage />
+    </TpuDataProvider>
+  );
+}
+
+afterEach(() => {
+  resetRequestLog();
+});
+
+describe('loading state', () => {
+  it('shows the loader while both lists are pending', () => {
+    // Headlamp useList: null items + null error = still loading.
+    setMockCluster({ nodes: null, pods: null });
+    mount();
+    expect(screen.getByTestId('loader')).toBeTruthy();
+  });
+});
+
+describe('empty fleet', () => {
+  it('renders the getting-started box and no distribution chart', async () => {
+    setMockCluster({ nodes: [], pods: [] });
+    mount();
+    await screen.findByText('Getting started');
+    expect(screen.getByText(/No TPU nodes detected/)).toBeTruthy();
+    expect(screen.queryByTestId('percentage-bar')).toBeNull();
+    // Plugin must read "Not detected", not crash on zero stats.
+    expect(screen.getByText('Not detected')).toBeTruthy();
+  });
+});
+
+describe('loaded on the mixed fixture', () => {
+  it('renders the fixture fleet stats', async () => {
+    const { fleet, expected } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('Chip Allocation');
+    // Capacity and Allocatable may format identically — getAllByText.
+    expect(screen.getAllByText(`${expected.fleet_stats.capacity} chips`).length).toBeGreaterThan(
+      0
+    );
+    expect(screen.getByText(`${expected.fleet_stats.utilization_pct}%`)).toBeTruthy();
+    // Intel-only / plain nodes must not leak into the TPU count.
+    const nodesSection = screen.getByText('TPU Nodes').closest('section')!;
+    expect(nodesSection.textContent).toContain(String(expected.fleet_stats.nodes_total));
+  });
+
+  it('renders the generation distribution chart from fleet stats', async () => {
+    const { fleet, expected } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('Generation distribution');
+    const bar = screen.getByTestId('percentage-bar');
+    expect(bar.getAttribute('data-total')).toBe(String(expected.fleet_stats.nodes_total));
+    for (const [gen, count] of Object.entries(expected.fleet_stats.generation_counts)) {
+      // Display names, not raw generation keys ('v5e' -> 'TPU v5e').
+      expect(bar.textContent).toContain(`${formatGeneration(gen)}: ${count}`);
+    }
+  });
+
+  it('lists running TPU pods', async () => {
+    const { fleet, expected } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('Chip Allocation');
+    for (const name of expected.tpu_pod_names) {
+      expect(screen.getByText(new RegExp(name))).toBeTruthy();
+    }
+  });
+});
+
+describe('list error', () => {
+  it('surfaces the error instead of an eternal loader', async () => {
+    // Headlamp's useList reports [null, error] when a list fails (e.g.
+    // RBAC forbids the all-namespaces Pod list): the page must leave
+    // the loading state and render the error banner.
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({
+      nodes: fleet.nodes,
+      pods: null,
+      podError: 'pods is forbidden',
+    });
+    mount();
+    await screen.findByText('Data errors');
+    expect(screen.getByText(/pods is forbidden/)).toBeTruthy();
+    expect(screen.queryByTestId('loader')).toBeNull();
+  });
+});
+
+describe('refresh', () => {
+  it('re-runs the plugin-pod selector chain', async () => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('Chip Allocation');
+    const before = requestLog.length;
+    expect(before).toBeGreaterThan(0); // initial imperative fetch ran
+    fireEvent.click(screen.getByRole('button', { name: /Refresh Cloud TPU Overview/ }));
+    await screen.findByText('Chip Allocation');
+    // The selector chain went out again — same page, fresh data.
+    await vi.waitFor(() => expect(requestLog.length).toBeGreaterThan(before));
+  });
+});

@@ -13,8 +13,8 @@
 import {
   Loader,
   NameValueTable,
+  PercentageBar,
   SectionBox,
-  SectionHeader,
   SimpleTable,
   StatusLabel,
 } from '@kinvolk/headlamp-plugin/lib/CommonComponents';
@@ -29,19 +29,24 @@ import {
   podPhase,
 } from '../api/fleet';
 import { useTpuContext } from '../api/TpuDataContext';
+import { PageHeader, phaseStatus } from './common';
 
 /** Overview caps its pod table like the Python page (ACTIVE_PODS_CAP). */
 const ACTIVE_PODS_CAP = 10;
 
-function phaseStatus(phase: string): 'success' | 'warning' | 'error' {
-  if (phase === 'Running' || phase === 'Succeeded') return 'success';
-  if (phase === 'Pending') return 'warning';
-  return 'error';
-}
-
 export default function OverviewPage() {
-  const { tpuNodes, tpuPods, pluginPods, slices, sliceSummary, stats, pluginInstalled, loading, error } =
-    useTpuContext();
+  const {
+    tpuNodes,
+    tpuPods,
+    pluginPods,
+    slices,
+    sliceSummary,
+    stats,
+    pluginInstalled,
+    loading,
+    error,
+    refresh,
+  } = useTpuContext();
 
   if (loading) {
     return <Loader title="Loading TPU fleet" />;
@@ -62,7 +67,7 @@ export default function OverviewPage() {
 
   return (
     <>
-      <SectionHeader title="Cloud TPU Overview" />
+      <PageHeader title="Cloud TPU Overview" onRefresh={refresh} />
       {error && (
         <SectionBox title="Data errors">
           <StatusLabel status="error">{error}</StatusLabel>
@@ -84,6 +89,18 @@ export default function OverviewPage() {
         />
       </SectionBox>
       <SectionBox title="TPU Nodes">
+        {stats.nodes_total > 0 && genCounts.length > 0 && (
+          <div style={{ marginBottom: '12px' }}>
+            {/* Generation distribution — the role the reference's
+                type-distribution chart plays (`OverviewPage.tsx:275-312`),
+                over TPU generations instead of GPU types. */}
+            <div style={{ fontSize: '14px', marginBottom: '6px' }}>Generation distribution</div>
+            <PercentageBar
+              data={genCounts.map(([gen, count]) => ({ name: gen, value: count }))}
+              total={stats.nodes_total}
+            />
+          </div>
+        )}
         <NameValueTable
           rows={[
             { name: 'Total', value: stats.nodes_total },

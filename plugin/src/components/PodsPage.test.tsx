@@ -1,0 +1,141 @@
+/**
+ * PodsPage branch coverage: loading, empty, loaded with per-container
+ * req=/lim= lines, the pending-attention table, list error, refresh.
+ */
+
+import { fireEvent, render, screen } from '@testing-library/react';
+import React from 'react';
+import { afterEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../testing/mockCommonComponents')
+);
+
+import { TpuDataProvider } from '../api/TpuDataContext';
+import { loadFixture } from '../testing/fixtures';
+import { requestLog, resetRequestLog, setMockCluster } from '../testing/mockHeadlampLib';
+import PodsPage from './PodsPage';
+
+function mount() {
+  return render(
+    <TpuDataProvider>
+      <PodsPage />
+    </TpuDataProvider>
+  );
+}
+
+afterEach(() => {
+  resetRequestLog();
+});
+
+describe('loading and empty states', () => {
+  it('shows the loader while lists are pending', () => {
+    setMockCluster({ nodes: null, pods: null });
+    mount();
+    expect(screen.getByTestId('loader')).toBeTruthy();
+  });
+
+  it('renders the empty message when nothing requests chips', async () => {
+    setMockCluster({ nodes: [], pods: [] });
+    mount();
+    await screen.findByText('Phases');
+    expect(screen.getByText('No pods request TPU chips')).toBeTruthy();
+  });
+});
+
+describe('loaded on v5p32', () => {
+  it('lists every TPU pod with its chip request', async () => {
+    const { fleet, expected } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('Phases');
+    for (const name of expected.tpu_pod_names) {
+      expect(screen.getByText(name)).toBeTruthy();
+    }
+  });
+
+  it('renders per-container req=/lim= lines', async () => {
+    const { fleet } = loadFixture('v5p32');
+    const pod = {
+      metadata: { name: 'two-stage-train', namespace: 'ml', uid: 'uid-two-stage' },
+      spec: {
+        containers: [
+          {
+            name: 'trainer',
+            resources: { requests: { 'google.com/tpu': '4' }, limits: { 'google.com/tpu': '4' } },
+          },
+          { name: 'sidecar', resources: {} },
+        ],
+        initContainers: [
+          { name: 'warmup', resources: { limits: { 'google.com/tpu': '2' } } },
+        ],
+      },
+      status: { phase: 'Running' },
+    };
+    setMockCluster({ nodes: fleet.nodes, pods: [...fleet.pods, pod] });
+    mount();
+    await screen.findByText('Phases');
+    const row = screen.getByText('two-stage-train').closest('tr')!;
+    // Chip-bearing containers get a line each; the chipless sidecar none.
+    expect(row.textContent).toContain('trainer');
+    expect(row.textContent).toContain('req=4 lim=4');
+    expect(row.textContent).toContain('warmup');
+    expect(row.textContent).toContain('(init)');
+    expect(row.textContent).toContain('req=0 lim=2');
+    expect(row.textContent).not.toContain('sidecar');
+  });
+});
+
+describe('pending attention table', () => {
+  it('surfaces pending pods with their waiting reason', async () => {
+    const { fleet } = loadFixture('v5p32');
+    // Realistic unscheduled pod: the kubelet never saw it, so
+    // containerStatuses is EMPTY and the reason lives in the
+    // PodScheduled condition.
+    const stuck = {
+      metadata: { name: 'stuck-train-0', namespace: 'ml', uid: 'uid-stuck' },
+      spec: {
+        containers: [{ resources: { requests: { 'google.com/tpu': '4' } } }],
+      },
+      status: {
+        phase: 'Pending',
+        conditions: [{ type: 'PodScheduled', status: 'False', reason: 'Unschedulable' }],
+      },
+    };
+    setMockCluster({ nodes: fleet.nodes, pods: [...fleet.pods, stuck] });
+    mount();
+    await screen.findByText('Attention: Pending TPU Pods');
+    expect(screen.getByText('stuck-train-0')).toBeTruthy();
+    expect(screen.getByText('Unschedulable')).toBeTruthy();
+  });
+
+  it('omits the attention table when nothing is pending', async () => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('Phases');
+    expect(screen.queryByText('Attention: Pending TPU Pods')).toBeNull();
+  });
+});
+
+describe('list error', () => {
+  it('surfaces the pod-list error', async () => {
+    setMockCluster({ nodes: [], pods: null, podError: 'pods is forbidden' });
+    mount();
+    await screen.findByText('Data errors');
+    expect(screen.getByText(/pods is forbidden/)).toBeTruthy();
+  });
+});
+
+describe('refresh', () => {
+  it('re-triggers the imperative track', async () => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('Phases');
+    const before = requestLog.length;
+    fireEvent.click(screen.getByRole('button', { name: /Refresh TPU Workloads/ }));
+    await vi.waitFor(() => expect(requestLog.length).toBeGreaterThan(before));
+  });
+});

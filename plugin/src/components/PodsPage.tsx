@@ -9,13 +9,14 @@ import {
   Loader,
   NameValueTable,
   SectionBox,
-  SectionHeader,
   SimpleTable,
   StatusLabel,
 } from '@kinvolk/headlamp-plugin/lib/CommonComponents';
 import React from 'react';
 import {
+  containerChipBreakdown,
   getPodChipRequest,
+  KubePod,
   podName,
   podNamespace,
   podNodeName,
@@ -24,15 +25,28 @@ import {
   waitingReason,
 } from '../api/fleet';
 import { useTpuContext } from '../api/TpuDataContext';
+import { PageHeader, phaseStatus } from './common';
 
-function phaseStatus(phase: string): 'success' | 'warning' | 'error' {
-  if (phase === 'Running' || phase === 'Succeeded') return 'success';
-  if (phase === 'Pending') return 'warning';
-  return 'error';
+/** Per-container `name: req=N lim=M` lines — same content as the
+ * Python page's `container_chip_list` (`pages/pods.py:30-46`, rebuilt
+ * from reference `PodsPage.tsx:49-88`), init containers marked. */
+function ContainerChipList({ pod }: { pod: KubePod }) {
+  const rows = containerChipBreakdown(pod);
+  if (rows.length === 0) return <span>—</span>;
+  return (
+    <>
+      {rows.map(c => (
+        <div key={c.name} className="hl-container-chips" style={{ fontSize: '13px' }}>
+          <strong>{c.name}</strong>
+          {c.init ? ' (init)' : ''}: req={c.req} lim={c.lim}
+        </div>
+      ))}
+    </>
+  );
 }
 
 export default function PodsPage() {
-  const { tpuPods, stats, loading, error } = useTpuContext();
+  const { tpuPods, stats, loading, error, refresh } = useTpuContext();
 
   if (loading) {
     return <Loader title="Loading TPU workloads" />;
@@ -42,7 +56,7 @@ export default function PodsPage() {
 
   return (
     <>
-      <SectionHeader title="TPU Workloads" />
+      <PageHeader title="TPU Workloads" onRefresh={refresh} />
       {error && (
         <SectionBox title="Data errors">
           <StatusLabel status="error">{error}</StatusLabel>
@@ -82,6 +96,7 @@ export default function PodsPage() {
             },
             { label: 'Restarts', getter: (p: any) => podRestarts(p) },
             { label: 'TPU chips', getter: (p: any) => getPodChipRequest(p) },
+            { label: 'Containers', getter: (p: any) => <ContainerChipList pod={p} /> },
           ]}
           data={tpuPods}
           emptyMessage="No pods request TPU chips"

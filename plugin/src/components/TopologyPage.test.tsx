@@ -1,0 +1,118 @@
+/**
+ * TopologyPage branch coverage: loading, no-slices, degraded slice
+ * rendering (mesh SVG), the live-utilization heatmap from a peeked
+ * snapshot, and refresh.
+ */
+
+import { fireEvent, render, screen } from '@testing-library/react';
+import React from 'react';
+import { afterEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../testing/mockCommonComponents')
+);
+
+import { TpuDataProvider } from '../api/TpuDataContext';
+import { loadFixture } from '../testing/fixtures';
+import { requestLog, resetRequestLog, setMockCluster } from '../testing/mockHeadlampLib';
+import TopologyPage from './TopologyPage';
+
+function mount() {
+  return render(
+    <TpuDataProvider>
+      <TopologyPage />
+    </TpuDataProvider>
+  );
+}
+
+afterEach(async () => {
+  resetRequestLog();
+  const { resetMetricsCache } = await import('../api/metrics');
+  resetMetricsCache();
+});
+
+describe('loading and empty states', () => {
+  it('shows the loader while lists are pending', () => {
+    setMockCluster({ nodes: null, pods: null });
+    mount();
+    expect(screen.getByTestId('loader')).toBeTruthy();
+  });
+
+  it('explains when no nodes carry TPU labels', async () => {
+    setMockCluster({ nodes: [], pods: [] });
+    mount();
+    await screen.findByText('No slices');
+    expect(screen.getByText(/no nodes carry the GKE TPU labels/)).toBeTruthy();
+  });
+});
+
+describe('degraded fixture', () => {
+  it('renders slice health and one circle per chip', async () => {
+    const { fleet, expected } = loadFixture('v5p32-degraded');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    const slice = expected.slices[0];
+    const { container } = mount();
+    await screen.findByText('Slice Summary');
+    expect(screen.getByText(`Slice ${slice.slice_id}`)).toBeTruthy();
+    // Worker 3 missing → incomplete: the summary row label AND the
+    // slice card's health StatusLabel both say so.
+    expect(screen.getAllByText('Incomplete').length).toBeGreaterThanOrEqual(2);
+    const circles = container.querySelectorAll('circle');
+    expect(circles).toHaveLength(slice.total_chips);
+    // Wrap links are dashed only for torus generations; v5p 2x2x4 has
+    // a size-4 axis → at least one dashed wrap link.
+    const dashed = container.querySelectorAll('line[stroke-dasharray]');
+    expect(dashed.length).toBeGreaterThan(0);
+  });
+});
+
+describe('heatmap from a peeked snapshot', () => {
+  it('tints circles when telemetry was recently fetched', async () => {
+    const { fetchTpuMetricsCached } = await import('../api/metrics');
+    const { fleet, expected } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    const node = expected.tpu_node_names[0];
+    // Record a snapshot for the peek, via an injected request fn.
+    await fetchTpuMetricsCached(async (path: string) => {
+      if (path.includes('query=1'))
+        return { status: 'success', data: { resultType: 'scalar', result: [0, '1'] } };
+      if (decodeURIComponent(path).includes('tensorcore_utilization'))
+        return {
+          status: 'success',
+          data: {
+            resultType: 'vector',
+            result: [{ metric: { node, accelerator_id: '0' }, value: [0, '0.95'] }],
+          },
+        };
+      return { status: 'success', data: { resultType: 'vector', result: [] } };
+    });
+    const { container } = mount();
+    await screen.findByText('Slice Summary');
+    expect(screen.getByText(/tinted by live utilization/)).toBeTruthy();
+    const tinted = container.querySelectorAll('circle[stroke-width="2"]');
+    expect(tinted).toHaveLength(1); // exactly the one reporting chip
+    expect(container.textContent).toContain('util 95%');
+  });
+
+  it('renders untinted without telemetry', async () => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    const { container } = mount();
+    await screen.findByText('Slice Summary');
+    expect(container.querySelectorAll('circle[stroke-width="2"]')).toHaveLength(0);
+    expect(screen.queryByText(/tinted by live utilization/)).toBeNull();
+  });
+});
+
+describe('refresh', () => {
+  it('re-triggers the imperative track', async () => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('Slice Summary');
+    const before = requestLog.length;
+    fireEvent.click(screen.getByRole('button', { name: /Refresh TPU Topology/ }));
+    await vi.waitFor(() => expect(requestLog.length).toBeGreaterThan(before));
+  });
+});

@@ -14,13 +14,13 @@ import {
   Loader,
   NameValueTable,
   SectionBox,
-  SectionHeader,
   SimpleTable,
   StatusLabel,
 } from '@kinvolk/headlamp-plugin/lib/CommonComponents';
 import React from 'react';
 import { chipUtilization, formatPercent, heatBand, peekTpuMetrics } from '../api/metrics';
 import { useTpuContext } from '../api/TpuDataContext';
+import { PageHeader } from './common';
 import {
   buildMeshLayout,
   MeshLayout,
@@ -98,7 +98,7 @@ function MeshSvg({
         const fill = util !== undefined ? HEAT_PALETTE[heatBand(util)] : workerColor;
         // Same formatter as MetricsPage (clamp policy documented
         // there) — the two surfaces can never disagree on a sample.
-        const utilText = util !== undefined ? ` · util ${formatPercent(util)}` : '';
+        const utilText = util !== undefined ? ` · util ${formatPercent(util, 0)}` : '';
         return (
           <circle
             key={chipIndex}
@@ -162,7 +162,7 @@ function SliceCard({
 }
 
 export default function TopologyPage() {
-  const { slices, sliceSummary, loading, error } = useTpuContext();
+  const { slices, sliceSummary, loading, error, refresh } = useTpuContext();
 
   // Peek only — never fetch: the heatmap is a progressive enhancement
   // riding whatever a recent Metrics view already paid for. The peek is
@@ -186,7 +186,7 @@ export default function TopologyPage() {
 
   return (
     <>
-      <SectionHeader title="TPU Topology" />
+      <PageHeader title="TPU Topology" onRefresh={refresh} />
       {error && (
         <SectionBox title="Data errors">
           <StatusLabel status="error">{error}</StatusLabel>

@@ -1,0 +1,88 @@
+/**
+ * Shared page chrome: UtilizationBar thresholds + banker's-rounded
+ * labels, capNodesForCards ordering/truncation, PageHeader wiring.
+ */
+
+import { fireEvent, render, screen } from '@testing-library/react';
+import React from 'react';
+import { describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../testing/mockCommonComponents')
+);
+
+import { capNodesForCards, PageHeader, phaseStatus, UtilizationBar } from './common';
+
+function node(name: string, ready: boolean) {
+  return {
+    metadata: { name },
+    status: { conditions: [{ type: 'Ready', status: ready ? 'True' : 'False' }] },
+  };
+}
+
+describe('UtilizationBar', () => {
+  it('colors by the 70/90 thresholds', () => {
+    const { container: ok } = render(<UtilizationBar used={2} capacity={4} />);
+    expect(ok.querySelector('.hl-utilbar-ok')).toBeTruthy();
+    const { container: warn } = render(<UtilizationBar used={3} capacity={4} />);
+    expect(warn.querySelector('.hl-utilbar-warn')).toBeTruthy();
+    const { container: err } = render(<UtilizationBar used={4} capacity={4} />);
+    expect(err.querySelector('.hl-utilbar-err')).toBeTruthy();
+  });
+
+  it('labels with banker-rounded percent and raw counts', () => {
+    render(<UtilizationBar used={1} capacity={200} unit="chips" />);
+    // 0.5% rounds half-to-even → 0, matching the Python meter label.
+    expect(screen.getByText('1/200 chips (0%)')).toBeTruthy();
+  });
+
+  it('renders a dash for zero capacity', () => {
+    const { container } = render(<UtilizationBar used={0} capacity={0} />);
+    expect(container.textContent).toBe('—');
+    expect(container.querySelector('.hl-utilbar')).toBeNull();
+  });
+});
+
+describe('capNodesForCards', () => {
+  it('orders not-ready-first then by name', () => {
+    const nodes = [node('b-ready', true), node('c-bad', false), node('a-ready', true)];
+    const { shown, truncationNote } = capNodesForCards(nodes);
+    expect(shown.map(n => n.metadata.name)).toEqual(['c-bad', 'a-ready', 'b-ready']);
+    expect(truncationNote).toBeNull();
+  });
+
+  it('caps with a hint and never drops a not-ready node', () => {
+    const nodes = [
+      ...Array.from({ length: 70 }, (_, i) => node(`ready-${String(i).padStart(2, '0')}`, true)),
+      node('zz-broken', false),
+    ];
+    const { shown, truncationNote } = capNodesForCards(nodes);
+    expect(shown).toHaveLength(64);
+    expect(shown[0].metadata.name).toBe('zz-broken');
+    expect(truncationNote).toContain('64 of 71');
+  });
+});
+
+describe('PageHeader', () => {
+  it('wires the refresh button with an accessible name', () => {
+    const onRefresh = vi.fn();
+    render(<PageHeader title="TPU Nodes" onRefresh={onRefresh} />);
+    fireEvent.click(screen.getByRole('button', { name: 'Refresh TPU Nodes' }));
+    expect(onRefresh).toHaveBeenCalledTimes(1);
+  });
+
+  it('omits the button without a handler', () => {
+    render(<PageHeader title="TPU Nodes" />);
+    expect(screen.queryByRole('button')).toBeNull();
+  });
+});
+
+describe('phaseStatus', () => {
+  it('maps phases to severities', () => {
+    expect(phaseStatus('Running')).toBe('success');
+    expect(phaseStatus('Succeeded')).toBe('success');
+    expect(phaseStatus('Pending')).toBe('warning');
+    expect(phaseStatus('Failed')).toBe('error');
+    expect(phaseStatus('Unknown')).toBe('error');
+  });
+});

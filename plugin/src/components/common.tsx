@@ -1,0 +1,117 @@
+/**
+ * Shared page chrome for the plugin pages — the TS counterpart of the
+ * Python host's UI kit (`headlamp_tpu/ui/components.py`) and page
+ * helpers (`headlamp_tpu/pages/common.py`). Keeps every page's header,
+ * refresh affordance, meters, and card capping identical so the six
+ * routes read as one surface (the reference styles these per-page,
+ * e.g. `OverviewPage.tsx:143-158`, `NodesPage.tsx:35-63`).
+ */
+
+import { SectionHeader } from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React from 'react';
+import { HOT_NODE_PCT, roundHalfEven, WARM_NODE_PCT } from '../api/fleet';
+import { isNodeReady, KubeNode, nodeName } from '../api/topology';
+
+/**
+ * Page title row with the refresh affordance every page carries
+ * (reference has one only on Overview, `OverviewPage.tsx:143-158`;
+ * the Python host refreshes via `/refresh`). `onRefresh` re-triggers
+ * the context's imperative track AND any page-local fetches keyed on
+ * `refreshCount`.
+ */
+export function PageHeader({ title, onRefresh }: { title: string; onRefresh?: () => void }) {
+  return (
+    <div style={{ display: 'flex', alignItems: 'baseline', gap: '12px' }}>
+      <SectionHeader title={title} />
+      {onRefresh && (
+        <button
+          type="button"
+          aria-label={`Refresh ${title}`}
+          onClick={onRefresh}
+          style={{ marginLeft: 'auto', cursor: 'pointer' }}
+        >
+          Refresh
+        </button>
+      )}
+    </div>
+  );
+}
+
+const METER_COLORS = { ok: '#2e7d32', warn: '#ef6c00', err: '#c62828' } as const;
+
+/**
+ * Single-value meter with 70/90% warn/crit coloring — TS mirror of
+ * `ui/components.py:UtilizationBar` (the role the reference's
+ * GpuAllocationBar plays, `NodesPage.tsx:35-63`). Percent labels use
+ * banker's rounding so both delivery surfaces print the same number.
+ */
+export function UtilizationBar({
+  used,
+  capacity,
+  unit,
+}: {
+  used: number;
+  capacity: number;
+  unit?: string;
+}) {
+  if (capacity <= 0) return <span>—</span>;
+  const pct = Math.min(100, (used / capacity) * 100);
+  const level = pct >= HOT_NODE_PCT ? 'err' : pct >= WARM_NODE_PCT ? 'warn' : 'ok';
+  const label = `${used}/${capacity}${unit ? ` ${unit}` : ''} (${roundHalfEven(pct)}%)`;
+  return (
+    <span
+      className={`hl-utilbar hl-utilbar-${level}`}
+      data-pct={String(roundHalfEven(pct))}
+      style={{ display: 'inline-flex', alignItems: 'center', gap: '6px' }}
+    >
+      <span
+        aria-hidden
+        style={{
+          display: 'inline-block',
+          width: '72px',
+          height: '7px',
+          borderRadius: '3.5px',
+          background: `linear-gradient(to right, ${METER_COLORS[level]} ${pct.toFixed(1)}%, #e0e0e0 ${pct.toFixed(1)}%)`,
+        }}
+      />
+      <span className="hl-utilbar-label" style={{ fontSize: '12px' }}>
+        {label}
+      </span>
+    </span>
+  );
+}
+
+/**
+ * Order nodes not-ready-first (the ones an operator opens the page
+ * for), then by name, and cap — mirror of
+ * `pages/common.py:cap_nodes_for_cards` (same sort key, so both
+ * surfaces truncate identically at fleet scale).
+ */
+export const NODES_DETAIL_CAP = 64;
+
+export function capNodesForCards(
+  nodes: KubeNode[],
+  cap: number = NODES_DETAIL_CAP
+): { shown: KubeNode[]; truncationNote: string | null } {
+  const ordered = [...nodes].sort((a, b) => {
+    const readyDelta = Number(isNodeReady(a)) - Number(isNodeReady(b));
+    if (readyDelta !== 0) return readyDelta;
+    const na = nodeName(a);
+    const nb = nodeName(b);
+    return na < nb ? -1 : na > nb ? 1 : 0;
+  });
+  if (ordered.length <= cap) {
+    return { shown: ordered, truncationNote: null };
+  }
+  return {
+    shown: ordered.slice(0, cap),
+    truncationNote: `Showing ${cap} of ${ordered.length} node detail cards (not-ready first).`,
+  };
+}
+
+/** Pod-phase → StatusLabel severity, shared by Overview and Pods. */
+export function phaseStatus(phase: string): 'success' | 'warning' | 'error' {
+  if (phase === 'Running' || phase === 'Succeeded') return 'success';
+  if (phase === 'Pending') return 'warning';
+  return 'error';
+}

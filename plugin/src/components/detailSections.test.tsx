@@ -1,0 +1,82 @@
+/**
+ * NodeDetailSection + PodDetailSection: the integrations injected into
+ * Headlamp's native detail pages. Both must render null (no empty
+ * boxes) for non-TPU resources.
+ */
+
+import { render, screen } from '@testing-library/react';
+import React from 'react';
+import { beforeEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../testing/mockCommonComponents')
+);
+
+import { TpuDataProvider } from '../api/TpuDataContext';
+import { loadFixture } from '../testing/fixtures';
+import { setMockCluster } from '../testing/mockHeadlampLib';
+import NodeDetailSection from './NodeDetailSection';
+import PodDetailSection from './PodDetailSection';
+
+function mount(children: React.ReactNode) {
+  return render(<TpuDataProvider>{children}</TpuDataProvider>);
+}
+
+describe('NodeDetailSection', () => {
+  beforeEach(() => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+  });
+
+  it('renders chips and slice for a TPU node', async () => {
+    const { fleet } = loadFixture('v5p32');
+    mount(<NodeDetailSection resource={{ jsonData: fleet.nodes[0] } as any} />);
+    expect(await screen.findByText('Cloud TPU')).toBeTruthy();
+    expect(screen.getByText('Generation')).toBeTruthy();
+  });
+
+  it('renders nothing for a plain node', () => {
+    const { container } = mount(
+      <NodeDetailSection resource={{ jsonData: { metadata: { name: 'plain' } } } as any} />
+    );
+    expect(container.querySelector('section')).toBeNull();
+  });
+});
+
+describe('PodDetailSection', () => {
+  it('renders per-container chips for a TPU pod', () => {
+    const { fleet } = loadFixture('v5p32');
+    const tpuPod = fleet.pods.find((p: any) => JSON.stringify(p).includes('google.com/tpu'));
+    render(<PodDetailSection resource={{ jsonData: tpuPod } as any} />);
+    expect(screen.getByText('TPU Resources')).toBeTruthy();
+  });
+
+  it('marks init containers and explains the effective total', () => {
+    const pod = {
+      metadata: { name: 'warmup-train', namespace: 'ml', uid: 'uid-warmup' },
+      spec: {
+        containers: [
+          { name: 'trainer', resources: { requests: { 'google.com/tpu': '4' } } },
+        ],
+        initContainers: [
+          { name: 'prefetch', resources: { requests: { 'google.com/tpu': '8' } } },
+        ],
+      },
+      status: { phase: 'Running' },
+    };
+    render(<PodDetailSection resource={{ jsonData: pod } as any} />);
+    expect(screen.getByText('prefetch (init)')).toBeTruthy();
+    // Effective = max(sum(main)=4, max(init)=8) — init overlaps, not adds.
+    const section = screen.getByText('TPU Resources').closest('section')!;
+    expect(section.textContent).toContain('Total chips (effective)');
+    expect(section.textContent).toContain('8');
+  });
+
+  it('renders nothing for a plain pod', () => {
+    const { container } = render(
+      <PodDetailSection resource={{ jsonData: { metadata: { name: 'web' } } } as any} />
+    );
+    expect(container.querySelector('section')).toBeNull();
+  });
+});

@@ -1,0 +1,29 @@
+/**
+ * Shared-fixture loading for the vitest suites. The JSON files under
+ * `fixtures/` are the SAME clusters the Python pages are tested on
+ * (`tests/test_ts_parity.py` replays them through both engines) — the
+ * per-page suites here assert the rendered numbers match each
+ * fixture's recorded expectations.
+ */
+
+import { readFileSync } from 'node:fs';
+import { join } from 'node:path';
+
+export const FIXTURES_DIR = join(__dirname, '..', '..', '..', 'fixtures');
+
+export interface Fixture {
+  name: string;
+  fleet: { nodes: Record<string, any>[]; pods: Record<string, any>[] };
+  expected: {
+    fleet_stats: Record<string, any>;
+    plugin_pod_names: string[];
+    slices: Array<Record<string, any>>;
+    summary: Record<string, any>;
+    tpu_node_names: string[];
+    tpu_pod_names: string[];
+  };
+}
+
+export function loadFixture(name: string): Fixture {
+  return JSON.parse(readFileSync(join(FIXTURES_DIR, `${name}.json`), 'utf-8'));
+}

@@ -91,6 +91,20 @@ export function StatusLabel({
   return <span data-status={status}>{children}</span>;
 }
 
-export function PercentageBar(_props: Record<string, unknown>) {
-  return <div data-testid="percentage-bar" />;
+export function PercentageBar({
+  data,
+  total,
+}: {
+  data: Array<{ name: string; value: number }>;
+  total?: number;
+}) {
+  return (
+    <div data-testid="percentage-bar" data-total={total}>
+      {data.map(d => (
+        <span key={d.name}>
+          {d.name}: {d.value}
+        </span>
+      ))}
+    </div>
+  );
 }

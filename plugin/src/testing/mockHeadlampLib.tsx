@@ -35,8 +35,31 @@ export const K8s = {
   },
 };
 
+/** Optional per-test request handler consulted before the default
+ * pod-list behavior — lets suites simulate reachable DaemonSet lists
+ * or a live Prometheus proxy. Return `undefined` to fall through. */
+type MockRequestHandler = (url: string) => unknown;
+let requestHandler: MockRequestHandler | null = null;
+
+export function setMockApiHandler(next: MockRequestHandler | null): void {
+  requestHandler = next;
+}
+
+/** Calls observed by ApiProxy.request since the last reset — refresh
+ * tests assert the count grows when the button re-triggers fetches. */
+export const requestLog: string[] = [];
+
+export function resetRequestLog(): void {
+  requestLog.length = 0;
+}
+
 export const ApiProxy = {
   request: async (url: string): Promise<unknown> => {
+    requestLog.push(url);
+    if (requestHandler) {
+      const answer = requestHandler(url);
+      if (answer !== undefined) return answer;
+    }
     if (url.includes('/pods')) {
       return { items: cluster.pods };
     }
