@@ -189,6 +189,23 @@ export function nodeInfo(node: KubeNode): Record<string, any> {
   return asRecord(asRecord(node?.status).nodeInfo);
 }
 
+/** Phase histogram with an Other bucket — `objects.count_pod_phases`.
+ * Provider-neutral: the TPU and Intel overview/pods pages share it. */
+export function countPodPhases(pods: KubePod[]): Record<string, number> {
+  const counts: Record<string, number> = {
+    Running: 0,
+    Pending: 0,
+    Succeeded: 0,
+    Failed: 0,
+    Other: 0,
+  };
+  for (const p of pods) {
+    const phase = podPhase(p);
+    counts[phase in counts ? phase : 'Other'] += 1;
+  }
+  return counts;
+}
+
 /** TPU device-plugin daemon pod by any accepted label variant. */
 export function isTpuPluginPod(pod: KubePod): boolean {
   const l = podLabels(pod);
@@ -275,17 +292,7 @@ export function fleetStats(tpuNodes: KubeNode[], tpuPods: KubePod[]): FleetStats
 
   const nodesReady = tpuNodes.filter(isNodeReady).length;
 
-  const phaseCounts: Record<string, number> = {
-    Running: 0,
-    Pending: 0,
-    Succeeded: 0,
-    Failed: 0,
-    Other: 0,
-  };
-  for (const p of tpuPods) {
-    const phase = podPhase(p);
-    phaseCounts[phase in phaseCounts ? phase : 'Other'] += 1;
-  }
+  const phaseCounts = countPodPhases(tpuPods);
 
   const generationCounts: Record<string, number> = {};
   for (const n of tpuNodes) {

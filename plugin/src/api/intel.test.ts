@@ -1,0 +1,146 @@
+/**
+ * intel.ts parity suite: replay the shared fixtures and assert the TS
+ * Intel engine reproduces the Python engine's recorded classification
+ * (`expected.intel` in fixtures/*.json, exported by
+ * tools/export_fixtures.py) byte-for-byte.
+ */
+
+import { describe, expect, it } from 'vitest';
+import { loadFixture } from '../testing/fixtures';
+import {
+  filterGpuRequestingPods,
+  filterIntelGpuNodes,
+  filterIntelPluginPods,
+  formatGpuResourceName,
+  formatGpuType,
+  getContainerGpuResources,
+  getNodeGpuCount,
+  getNodeGpuType,
+  getPodDeviceRequest,
+  getPodGpuRequests,
+  intelAllocationSummary,
+  pluginStatusText,
+  pluginStatusToStatus,
+} from './intel';
+
+const FIXTURES = ['v5e4', 'v5p32', 'mixed', 'v5p32-degraded', 'large64'];
+
+describe('fixture parity with the Python engine', () => {
+  for (const name of FIXTURES) {
+    it(`classifies ${name} identically`, () => {
+      const { fleet, expected } = loadFixture(name);
+      const want = expected.intel as any;
+
+      const nodes = filterIntelGpuNodes(fleet.nodes);
+      expect(nodes.map(n => n.metadata.name)).toEqual(want.node_names);
+
+      const types = Object.fromEntries(nodes.map(n => [n.metadata.name, getNodeGpuType(n)]));
+      expect(types).toEqual(want.node_types);
+
+      const counts = Object.fromEntries(nodes.map(n => [n.metadata.name, getNodeGpuCount(n)]));
+      expect(counts).toEqual(want.node_device_counts);
+
+      const pods = filterGpuRequestingPods(fleet.pods);
+      expect(pods.map(p => p.metadata.name)).toEqual(want.gpu_pod_names);
+
+      const requests = Object.fromEntries(
+        pods.map(p => [p.metadata.name, getPodDeviceRequest(p)])
+      );
+      expect(requests).toEqual(want.pod_device_requests);
+
+      expect(filterIntelPluginPods(fleet.pods).map(p => p.metadata.name)).toEqual(
+        want.plugin_pod_names
+      );
+
+      expect(intelAllocationSummary(nodes, pods)).toEqual(want.allocation);
+    });
+  }
+});
+
+describe('pod GPU accounting', () => {
+  it('init containers overlap rather than add', () => {
+    const pod = {
+      spec: {
+        containers: [
+          { name: 'a', resources: { requests: { 'gpu.intel.com/i915': '1' } } },
+          { name: 'b', resources: { requests: { 'gpu.intel.com/i915': '1' } } },
+        ],
+        initContainers: [
+          { name: 'warm', resources: { requests: { 'gpu.intel.com/i915': '3' } } },
+        ],
+      },
+    };
+    // max(sum(main)=2, max(init)=3) = 3 — the reference sums to 5.
+    expect(getPodGpuRequests(pod)).toEqual({ 'gpu.intel.com/i915': 3 });
+    expect(getPodDeviceRequest(pod)).toBe(3);
+  });
+
+  it('counts only device resources, not millicores/memory', () => {
+    const pod = {
+      spec: {
+        containers: [
+          {
+            name: 'shared',
+            resources: {
+              requests: {
+                'gpu.intel.com/i915': '1',
+                'gpu.intel.com/millicores': '500',
+                'gpu.intel.com/memory.max': '1Gi',
+              },
+            },
+          },
+        ],
+      },
+    };
+    expect(getPodDeviceRequest(pod)).toBe(1);
+    // …but the per-container view surfaces every gpu.intel.com/* key.
+    const resources = getContainerGpuResources(pod.spec.containers[0]);
+    expect(Object.keys(resources).sort()).toEqual([
+      'gpu.intel.com/i915',
+      'gpu.intel.com/memory.max',
+      'gpu.intel.com/millicores',
+    ]);
+  });
+
+  it('merges request-only and limit-only containers', () => {
+    const c = {
+      name: 'x',
+      resources: {
+        requests: { 'gpu.intel.com/i915': '1' },
+        limits: { 'gpu.intel.com/xe': '2' },
+      },
+    };
+    expect(getContainerGpuResources(c)).toEqual({
+      'gpu.intel.com/i915': [1, 0],
+      'gpu.intel.com/xe': [0, 2],
+    });
+  });
+});
+
+describe('GpuDevicePlugin status machine', () => {
+  it('maps rollout counters like the Python helpers', () => {
+    expect(pluginStatusToStatus({ status: {} })).toBe('warning');
+    expect(pluginStatusText({ status: {} })).toBe('No nodes scheduled');
+    expect(
+      pluginStatusToStatus({ status: { desiredNumberScheduled: 2, numberReady: 2 } })
+    ).toBe('success');
+    expect(
+      pluginStatusToStatus({ status: { desiredNumberScheduled: 2, numberReady: 1 } })
+    ).toBe('error');
+    expect(pluginStatusText({ status: { desiredNumberScheduled: 2, numberReady: 1 } })).toBe(
+      '1/2 ready'
+    );
+  });
+});
+
+describe('formatting', () => {
+  it('prettifies resource names and types', () => {
+    expect(formatGpuResourceName('gpu.intel.com/i915')).toBe('GPU (i915)');
+    expect(formatGpuResourceName('gpu.intel.com/memory.max')).toBe('GPU memory');
+    expect(formatGpuResourceName('gpu.intel.com/i915_monitoring')).toBe('GPU (i915_monitoring)');
+    expect(formatGpuResourceName('cpu')).toBe('cpu');
+    expect(formatGpuType('discrete')).toBe('Discrete GPU');
+    expect(formatGpuType('integrated')).toBe('Integrated GPU');
+    expect(formatGpuType('unknown')).toBe('Intel GPU');
+  });
+});

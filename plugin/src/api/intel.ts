@@ -1,0 +1,257 @@
+/**
+ * intel.ts — Intel GPU domain: node detection, device accounting, and
+ * GpuDevicePlugin CRD status.
+ *
+ * TypeScript mirror of the framework's Intel provider
+ * (`headlamp_tpu/domain/intel.py`), which re-implements the semantics
+ * of the reference's detection layer
+ * (`/root/reference/src/api/k8s.ts:17-31,125-152,250-301`). The parity
+ * contract with the Python engine is enforced by replaying the shared
+ * fixtures (`fixtures/*.json` carry an `expected.intel` block) in
+ * `intel.test.ts` — both languages must classify the same cluster
+ * identically. TPU stays the first-class provider; Intel is the
+ * compatibility provider a reference user keeps.
+ */
+
+import { KubePod, roundHalfEven } from './fleet';
+import { KubeNode, parseIntLenient } from './topology';
+
+export const INTEL_GPU_RESOURCE_PREFIX = 'gpu.intel.com/';
+export const INTEL_GPU_I915_RESOURCE = 'gpu.intel.com/i915';
+export const INTEL_GPU_XE_RESOURCE = 'gpu.intel.com/xe';
+
+export const INTEL_GPU_NODE_LABEL = 'intel.feature.node.kubernetes.io/gpu';
+export const INTEL_DISCRETE_GPU_ROLE = 'node-role.kubernetes.io/gpu';
+export const INTEL_INTEGRATED_GPU_ROLE = 'node-role.kubernetes.io/igpu';
+
+export const INTEL_PLUGIN_POD_LABELS: Array<[string, string]> = [
+  ['app', 'intel-gpu-plugin'],
+  ['app.kubernetes.io/name', 'intel-gpu-plugin'],
+  ['component', 'intel-gpu-plugin'],
+];
+
+/** Device-counting resources. Shared/monitoring resources (millicores,
+ * memory.max, tiles) are capacity metadata, not devices. */
+const DEVICE_RESOURCES = [INTEL_GPU_I915_RESOURCE, INTEL_GPU_XE_RESOURCE];
+
+function labelsOf(o: Record<string, any>): Record<string, any> {
+  const l = o?.metadata?.labels;
+  return l && typeof l === 'object' ? l : {};
+}
+
+function capacityOf(node: KubeNode): Record<string, any> {
+  const c = node?.status?.capacity;
+  return c && typeof c === 'object' ? c : {};
+}
+
+function allocatableOf(node: KubeNode): Record<string, any> {
+  const a = node?.status?.allocatable;
+  return a && typeof a === 'object' ? a : {};
+}
+
+function containersOf(pod: KubePod, key: 'containers' | 'initContainers'): Array<Record<string, any>> {
+  const items = pod?.spec?.[key];
+  return Array.isArray(items) ? items.filter(c => c && typeof c === 'object') : [];
+}
+
+function requestsOf(c: Record<string, any>): Record<string, any> {
+  const r = c?.resources?.requests;
+  return r && typeof r === 'object' ? r : {};
+}
+
+function limitsOf(c: Record<string, any>): Record<string, any> {
+  const l = c?.resources?.limits;
+  return l && typeof l === 'object' ? l : {};
+}
+
+/** NFD-label OR gpu.intel.com/* capacity (`intel.py:is_intel_gpu_node`,
+ * reference k8s.ts:125-152). */
+export function isIntelGpuNode(node: KubeNode): boolean {
+  const labels = labelsOf(node);
+  if (
+    labels[INTEL_GPU_NODE_LABEL] === 'true' ||
+    labels[INTEL_DISCRETE_GPU_ROLE] === 'true' ||
+    labels[INTEL_INTEGRATED_GPU_ROLE] === 'true'
+  ) {
+    return true;
+  }
+  return Object.keys(capacityOf(node)).some(k => k.startsWith(INTEL_GPU_RESOURCE_PREFIX));
+}
+
+export function filterIntelGpuNodes(items: KubeNode[]): KubeNode[] {
+  return items.filter(isIntelGpuNode);
+}
+
+/** i915 + xe capacity sum (`intel.py:get_node_gpu_count`). */
+export function getNodeGpuCount(node: KubeNode): number {
+  const capacity = capacityOf(node);
+  return DEVICE_RESOURCES.reduce((acc, r) => acc + parseIntLenient(capacity[r]), 0);
+}
+
+export function getNodeGpuAllocatable(node: KubeNode): number {
+  const allocatable = allocatableOf(node);
+  return DEVICE_RESOURCES.reduce((acc, r) => acc + parseIntLenient(allocatable[r]), 0);
+}
+
+/** 'discrete' | 'integrated' | 'unknown' (`intel.py:get_node_gpu_type`). */
+export function getNodeGpuType(node: KubeNode): string {
+  const labels = labelsOf(node);
+  if (labels[INTEL_DISCRETE_GPU_ROLE] === 'true') return 'discrete';
+  if (labels[INTEL_INTEGRATED_GPU_ROLE] === 'true') return 'integrated';
+  return 'unknown';
+}
+
+/** Any container (incl. init) with a gpu.intel.com/* request or limit
+ * (`intel.py:is_gpu_requesting_pod`). */
+export function isGpuRequestingPod(pod: KubePod): boolean {
+  for (const key of ['containers', 'initContainers'] as const) {
+    for (const c of containersOf(pod, key)) {
+      const merged = { ...requestsOf(c), ...limitsOf(c) };
+      if (Object.keys(merged).some(k => k.startsWith(INTEL_GPU_RESOURCE_PREFIX))) {
+        return true;
+      }
+    }
+  }
+  return false;
+}
+
+export function filterGpuRequestingPods(items: KubePod[]): KubePod[] {
+  return items.filter(isGpuRequestingPod);
+}
+
+/** Per-container `{resource: [request, limit]}` over the merged
+ * requests∪limits key set, gpu.intel.com/* only — the single definition
+ * behind the pods-page container list and the pod detail-section rows
+ * (`intel.py:get_container_gpu_resources`). */
+export function getContainerGpuResources(
+  container: Record<string, any>
+): Record<string, [number, number]> {
+  const requests = requestsOf(container);
+  const limits = limitsOf(container);
+  const out: Record<string, [number, number]> = {};
+  for (const resource of [...new Set([...Object.keys(requests), ...Object.keys(limits)])].sort()) {
+    if (resource.startsWith(INTEL_GPU_RESOURCE_PREFIX)) {
+      out[resource] = [parseIntLenient(requests[resource]), parseIntLenient(limits[resource])];
+    }
+  }
+  return out;
+}
+
+/** Per-resource effective requests: max(sum over main containers, max
+ * over init containers) — init containers run before the main ones and
+ * overlap rather than add (`intel.py:get_pod_gpu_requests`; the
+ * reference sums both, k8s.ts:289-301, which overcounts). */
+export function getPodGpuRequests(pod: KubePod): Record<string, number> {
+  const main: Record<string, number> = {};
+  for (const c of containersOf(pod, 'containers')) {
+    for (const [key, value] of Object.entries(requestsOf(c))) {
+      if (key.startsWith(INTEL_GPU_RESOURCE_PREFIX)) {
+        main[key] = (main[key] ?? 0) + parseIntLenient(value);
+      }
+    }
+  }
+  const init: Record<string, number> = {};
+  for (const c of containersOf(pod, 'initContainers')) {
+    for (const [key, value] of Object.entries(requestsOf(c))) {
+      if (key.startsWith(INTEL_GPU_RESOURCE_PREFIX)) {
+        init[key] = Math.max(init[key] ?? 0, parseIntLenient(value));
+      }
+    }
+  }
+  const out: Record<string, number> = {};
+  for (const key of new Set([...Object.keys(main), ...Object.keys(init)])) {
+    out[key] = Math.max(main[key] ?? 0, init[key] ?? 0);
+  }
+  return out;
+}
+
+/** Device-count request (i915 + xe only), for allocation math. */
+export function getPodDeviceRequest(pod: KubePod): number {
+  const totals = getPodGpuRequests(pod);
+  return DEVICE_RESOURCES.reduce((acc, r) => acc + (totals[r] ?? 0), 0);
+}
+
+export function isIntelPluginPod(pod: KubePod): boolean {
+  const labels = labelsOf(pod);
+  return INTEL_PLUGIN_POD_LABELS.some(([k, v]) => labels[k] === v);
+}
+
+export function filterIntelPluginPods(items: KubePod[]): KubePod[] {
+  return items.filter(isIntelPluginPod);
+}
+
+// ---------------------------------------------------------------------------
+// GpuDevicePlugin CRD status (intel.py:140-161; reference k8s.ts:56-80)
+// ---------------------------------------------------------------------------
+
+export type GpuDevicePlugin = Record<string, any>;
+
+/** 'success' | 'warning' | 'error' from the CRD's rollout counters —
+ * no desired nodes ⇒ warning; all ready ⇒ success; else error. */
+export function pluginStatusToStatus(plugin: GpuDevicePlugin): 'success' | 'warning' | 'error' {
+  const s = plugin?.status ?? {};
+  const desired = parseIntLenient(s.desiredNumberScheduled);
+  const ready = parseIntLenient(s.numberReady);
+  if (desired === 0) return 'warning';
+  return ready === desired ? 'success' : 'error';
+}
+
+export function pluginStatusText(plugin: GpuDevicePlugin): string {
+  const s = plugin?.status ?? {};
+  const desired = parseIntLenient(s.desiredNumberScheduled);
+  const ready = parseIntLenient(s.numberReady);
+  if (desired === 0) return 'No nodes scheduled';
+  return `${ready}/${desired} ready`;
+}
+
+/** 'gpu.intel.com/i915' -> 'GPU (i915)' (`intel.py:
+ * format_gpu_resource_name`). */
+export function formatGpuResourceName(resourceKey: string): string {
+  if (!resourceKey.startsWith(INTEL_GPU_RESOURCE_PREFIX)) return resourceKey;
+  const suffix = resourceKey.slice(INTEL_GPU_RESOURCE_PREFIX.length);
+  const pretty: Record<string, string> = {
+    i915: 'GPU (i915)',
+    xe: 'GPU (xe)',
+    millicores: 'GPU millicores',
+    'memory.max': 'GPU memory',
+    tiles: 'GPU tiles',
+  };
+  return pretty[suffix] ?? `GPU (${suffix})`;
+}
+
+export function formatGpuType(gpuType: string): string {
+  const pretty: Record<string, string> = {
+    discrete: 'Discrete GPU',
+    integrated: 'Integrated GPU',
+  };
+  return pretty[gpuType] ?? 'Intel GPU';
+}
+
+/** Fleet allocation totals over device resources — the Intel analogue
+ * of fleetStats, matching `objects.allocation_summary` through the
+ * provider's accessors. */
+export interface IntelAllocation {
+  capacity: number;
+  allocatable: number;
+  in_use: number;
+  free: number;
+  utilization_pct: number;
+}
+
+export function intelAllocationSummary(nodes: KubeNode[], pods: KubePod[]): IntelAllocation {
+  const capacity = nodes.reduce((acc, n) => acc + getNodeGpuCount(n), 0);
+  const allocatable = nodes.reduce((acc, n) => acc + getNodeGpuAllocatable(n), 0);
+  const inUse = pods.reduce(
+    (acc, p) => acc + (p?.status?.phase === 'Running' ? getPodDeviceRequest(p) : 0),
+    0
+  );
+  return {
+    capacity,
+    allocatable,
+    in_use: inUse,
+    // Unclamped like objects.allocation_summary — a fixture where
+    // requests exceed allocatable must read the same in both engines.
+    free: allocatable - inUse,
+    utilization_pct: capacity > 0 ? roundHalfEven((inUse / capacity) * 100) : 0,
+  };
+}

@@ -1,0 +1,121 @@
+/**
+ * intelMetrics.ts suite: the 4-query i915 power join, the (node, chip)
+ * keying through node_uname_info, and the unreachable contract —
+ * mirroring the Python client's tests over the same shapes.
+ */
+
+import { describe, expect, it } from 'vitest';
+import {
+  fetchIntelGpuMetrics,
+  formatWatts,
+  INTEL_METRIC_AVAILABILITY,
+  INTEL_QUERIES,
+} from './intelMetrics';
+
+type Vector = Array<{ labels: Record<string, string>; value: number }>;
+
+function vector(samples: Vector) {
+  return {
+    status: 'success',
+    data: {
+      resultType: 'vector',
+      result: samples.map(s => ({ metric: s.labels, value: [0, String(s.value)] })),
+    },
+  };
+}
+
+/** Fake Prometheus proxy answering the probe and the named queries. */
+function transport(answers: Record<string, unknown>) {
+  const calls: string[] = [];
+  const request = async (path: string): Promise<unknown> => {
+    calls.push(path);
+    const promql = decodeURIComponent(path.split('query=')[1] ?? '');
+    if (promql === '1') {
+      return { status: 'success', data: { resultType: 'scalar', result: [0, '1'] } };
+    }
+    for (const [name, answer] of Object.entries(answers)) {
+      if (promql === INTEL_QUERIES[name]) return answer;
+    }
+    return { status: 'success', data: { resultType: 'vector', result: [] } };
+  };
+  return { request, calls };
+}
+
+describe('fetchIntelGpuMetrics', () => {
+  it('returns null when no Prometheus answers', async () => {
+    const request = async () => {
+      throw new Error('nothing here');
+    };
+    expect(await fetchIntelGpuMetrics(request)).toBeNull();
+  });
+
+  it('joins chips, power, and TDP per (node, chip)', async () => {
+    const { request } = transport({
+      chips: vector([
+        { labels: { chip: 'platform_i915_0', instance: '10.0.0.7:9100' }, value: 1 },
+      ]),
+      power: vector([
+        { labels: { chip: 'platform_i915_0', instance: '10.0.0.7:9100' }, value: 23.5 },
+      ]),
+      tdp: vector([
+        { labels: { chip: 'platform_i915_0', instance: '10.0.0.7:9100' }, value: 150 },
+      ]),
+      node_map: vector([
+        { labels: { nodename: 'arc-node-1', instance: '10.0.0.7:9100' }, value: 1 },
+      ]),
+    });
+    const snap = await fetchIntelGpuMetrics(request, ['monitoring', 'prometheus-k8s:9090']);
+    expect(snap).not.toBeNull();
+    expect(snap!.chips).toHaveLength(1);
+    const chip = snap!.chips[0];
+    expect(chip.node).toBe('arc-node-1'); // instance joined through node_map
+    expect(chip.chip).toBe('platform_i915_0');
+    expect(chip.power_watts).toBeCloseTo(23.5);
+    expect(chip.tdp_watts).toBe(150);
+  });
+
+  it('keeps chips discovered without power samples (cold rate window)', async () => {
+    const { request } = transport({
+      chips: vector([{ labels: { chip: 'platform_i915_0', node: 'arc-node-2' }, value: 1 }]),
+    });
+    const snap = await fetchIntelGpuMetrics(request, ['monitoring', 'prometheus-k8s:9090']);
+    expect(snap!.chips).toHaveLength(1);
+    expect(snap!.chips[0].power_watts).toBeNull();
+    expect(snap!.chips[0].tdp_watts).toBeNull();
+  });
+
+  it('orders chips by (node, chip)', async () => {
+    const { request } = transport({
+      chips: vector([
+        { labels: { chip: 'b', node: 'node-2' }, value: 1 },
+        { labels: { chip: 'a', node: 'node-2' }, value: 1 },
+        { labels: { chip: 'z', node: 'node-1' }, value: 1 },
+      ]),
+    });
+    const snap = await fetchIntelGpuMetrics(request, ['monitoring', 'prometheus-k8s:9090']);
+    expect(snap!.chips.map(c => `${c.node}/${c.chip}`)).toEqual([
+      'node-1/z',
+      'node-2/a',
+      'node-2/b',
+    ]);
+  });
+});
+
+describe('availability matrix', () => {
+  it('documents the node-exporter honesty facts', () => {
+    const byName = Object.fromEntries(INTEL_METRIC_AVAILABILITY.map(r => [r[0], r[1]]));
+    expect(byName['Package power (W)']).toBe(true);
+    expect(byName['TDP / power limit (W)']).toBe(true);
+    expect(byName['GPU frequency']).toBe(false);
+    expect(byName['GPU utilization %']).toBe(false);
+    expect(byName['Integrated GPU power']).toBe(false);
+  });
+});
+
+describe('formatWatts', () => {
+  it('formats like the Python format_watts', () => {
+    expect(formatWatts(23.456)).toBe('23.5 W');
+    expect(formatWatts(0)).toBe('0.0 W');
+    expect(formatWatts(null)).toBe('—');
+  });
+});

@@ -1,0 +1,126 @@
+/**
+ * intelMetrics.ts — i915 hwmon power telemetry over Prometheus.
+ *
+ * TypeScript mirror of `headlamp_tpu/metrics/intel_client.py` (a
+ * capability port of the reference's client,
+ * `/root/reference/src/api/metrics.ts:96-159`): chip discovery,
+ * 5-minute energy rate → power W, TDP, and the instance→node map,
+ * joined per (node, chip). Shares the TPU client's service-discovery
+ * chain and join helpers so both providers key chips identically under
+ * identical failures.
+ */
+
+import {
+  buildInstanceMap,
+  findPrometheus,
+  nodeOf,
+  PromSample,
+  proxyQueryPath,
+  RequestFn,
+  sampleLabels,
+  sampleValue,
+  vectorResult,
+} from './metrics';
+
+/** The reference's PromQL set (`metrics.ts:101-116`). The power rate
+ * needs ≥5m of scrape history before it returns data. */
+export const INTEL_QUERIES: Record<string, string> = {
+  chips: 'node_hwmon_chip_names{chip_name="i915"}',
+  power:
+    'rate(node_hwmon_energy_joule_total[5m]) ' +
+    '* on(chip,instance) group_left(chip_name) ' +
+    'node_hwmon_chip_names{chip_name="i915"}',
+  tdp:
+    'node_hwmon_power_max_watt ' +
+    '* on(chip,instance) group_left(chip_name) ' +
+    'node_hwmon_chip_names{chip_name="i915"}',
+  node_map: 'node_uname_info',
+};
+
+/** What a standard node-exporter i915 hwmon setup can and cannot
+ * provide — the honesty matrix the metrics page renders
+ * (`intel_client.py:INTEL_METRIC_AVAILABILITY`). */
+export const INTEL_METRIC_AVAILABILITY: Array<[string, boolean, string]> = [
+  ['Package power (W)', true, 'rate of node_hwmon_energy_joule_total, discrete i915'],
+  ['TDP / power limit (W)', true, 'node_hwmon_power_max_watt'],
+  ['GPU frequency', false, "node-exporter's drm collector is AMD-only"],
+  ['GPU utilization %', false, 'needs intel-gpu-exporter / XPU manager'],
+  ['Integrated GPU power', false, 'iGPU shares the package sensor'],
+];
+
+export interface GpuChipMetrics {
+  node: string;
+  chip: string;
+  power_watts: number | null;
+  tdp_watts: number | null;
+}
+
+export interface IntelMetricsSnapshot {
+  namespace: string;
+  service: string;
+  chips: GpuChipMetrics[];
+  fetchMs: number;
+}
+
+export function formatWatts(watts: number | null): string {
+  if (watts === null) return '—';
+  return `${watts.toFixed(1)} W`;
+}
+
+/** Discover (shared chain) then run the 4 queries in one parallel wave
+ * and join per (node, chip). Null when no Prometheus answers. */
+export async function fetchIntelGpuMetrics(
+  request: RequestFn,
+  prometheus?: [string, string] | null
+): Promise<IntelMetricsSnapshot | null> {
+  const t0 = Date.now();
+  const found = prometheus ?? (await findPrometheus(request));
+  if (!found) return null;
+  const [namespace, service] = found;
+
+  const runQuery = async (promql: string): Promise<PromSample[]> => {
+    try {
+      return vectorResult(await request(proxyQueryPath(namespace, service, promql)));
+    } catch {
+      return [];
+    }
+  };
+
+  const names = Object.keys(INTEL_QUERIES);
+  const resultList = await Promise.all(names.map(n => runQuery(INTEL_QUERIES[n])));
+  const results = new Map(names.map((n, i) => [n, resultList[i]]));
+
+  const instanceMap = buildInstanceMap(results.get('node_map') ?? []);
+
+  const chips = new Map<string, GpuChipMetrics>();
+  const rowFor = (labels: Record<string, string>): GpuChipMetrics => {
+    const node = nodeOf(labels, instanceMap);
+    const chip = String(labels.chip ?? '?');
+    const key = `${node}/${chip}`;
+    let row = chips.get(key);
+    if (!row) {
+      row = { node, chip, power_watts: null, tdp_watts: null };
+      chips.set(key, row);
+    }
+    return row;
+  };
+
+  for (const sample of results.get('chips') ?? []) {
+    rowFor(sampleLabels(sample));
+  }
+  for (const [field, resultKey] of [
+    ['power_watts', 'power'],
+    ['tdp_watts', 'tdp'],
+  ] as const) {
+    for (const sample of results.get(resultKey) ?? []) {
+      const value = sampleValue(sample);
+      if (value === null) continue;
+      rowFor(sampleLabels(sample))[field] = value;
+    }
+  }
+
+  const ordered = [...chips.values()].sort((a, b) =>
+    a.node < b.node ? -1 : a.node > b.node ? 1 : a.chip < b.chip ? -1 : a.chip > b.chip ? 1 : 0
+  );
+  return { namespace, service, chips: ordered, fetchMs: Date.now() - t0 };
+}

@@ -113,7 +113,7 @@ export function proxyQueryPath(namespace: string, service: string, promql: strin
 
 export type RequestFn = (path: string) => Promise<unknown>;
 
-function vectorResult(data: unknown): PromSample[] {
+export function vectorResult(data: unknown): PromSample[] {
   if (!data || typeof data !== 'object') return [];
   const d = data as Record<string, any>;
   if (d.status !== 'success') return [];
@@ -124,14 +124,14 @@ function vectorResult(data: unknown): PromSample[] {
     : [];
 }
 
-function sampleValue(sample: PromSample): number | null {
+export function sampleValue(sample: PromSample): number | null {
   const v = sample.value;
   if (!Array.isArray(v) || v.length !== 2) return null;
   const parsed = parseFloat(String(v[1]));
   return Number.isNaN(parsed) ? null : parsed;
 }
 
-function sampleLabels(sample: PromSample): Record<string, string> {
+export function sampleLabels(sample: PromSample): Record<string, string> {
   return sample.metric && typeof sample.metric === 'object' ? sample.metric : {};
 }
 
@@ -141,7 +141,7 @@ function stripPort(instance: string): string {
   return instance.includes(':') ? instance.slice(0, instance.lastIndexOf(':')) : instance;
 }
 
-function nodeOf(labels: Record<string, string>, instanceMap: Record<string, string>): string {
+export function nodeOf(labels: Record<string, string>, instanceMap: Record<string, string>): string {
   for (const key of NODE_LABELS) {
     if (labels[key]) return String(labels[key]);
   }
@@ -158,7 +158,7 @@ function chipOf(labels: Record<string, string>): string {
   return '0';
 }
 
-function buildInstanceMap(samples: PromSample[]): Record<string, string> {
+export function buildInstanceMap(samples: PromSample[]): Record<string, string> {
   const out: Record<string, string> = {};
   for (const s of samples) {
     const labels = sampleLabels(s);
@@ -303,6 +303,20 @@ export function normalizeFraction(value: number): number {
   return value > 1.5 ? value / 100 : value;
 }
 
+/** Intl.NumberFormat v3 ships `roundingMode` (Node ≥ 18.14, modern
+ * browsers); older engines silently ignore unknown options, so probe
+ * `resolvedOptions()` once instead of trusting the cast. */
+const HALF_EVEN_SUPPORTED = (() => {
+  try {
+    const probe = new Intl.NumberFormat('en-US', {
+      roundingMode: 'halfEven',
+    } as Intl.NumberFormatOptions);
+    return (probe.resolvedOptions() as { roundingMode?: string }).roundingMode === 'halfEven';
+  } catch {
+    return false;
+  }
+})();
+
 const percentFormatters = new Map<number, Intl.NumberFormat>();
 
 function percentFormatter(digits: number): Intl.NumberFormat {
@@ -329,10 +343,18 @@ function percentFormatter(digits: number): Intl.NumberFormat {
  * banker's rounding on the exact value) so the two delivery surfaces
  * can never render the same sample differently. The render-time clamp
  * bounds the residual (1.0, FRACTION_MAX] band of an ambiguous
- * near-idle percent exporter (client.py scale notes). */
+ * near-idle percent exporter (client.py scale notes).
+ *
+ * Pre-v3 runtimes (no `roundingMode`) fall back to `toFixed`, which
+ * rounds the exact value too but breaks ties away from zero — only
+ * exactly-representable decimal ties (x.5 at digits=0, x.25/x.75 at
+ * digits=1, …) can differ from the Python surface there. */
 export function formatPercent(fraction: number | null, digits: number = 1): string {
   if (fraction === null) return '—';
   const pct = Math.min(100, Math.max(0, normalizeFraction(fraction) * 100));
+  if (!HALF_EVEN_SUPPORTED) {
+    return `${pct.toFixed(digits)}%`;
+  }
   return `${percentFormatter(digits).format(pct)}%`;
 }
 

@@ -1,0 +1,30 @@
+/**
+ * Shared ApiProxy request plumbing for the provider contexts — the TS
+ * counterpart of `headlamp_tpu/transport/api_proxy.py`'s budget and
+ * list-shape helpers.
+ */
+
+/** Per-request budget — mirrors the reference's
+ * (`IntelGpuDataContext.tsx:72`) and the Python transport's
+ * `with_timeout`. */
+export const REQUEST_TIMEOUT_MS = 2_000;
+
+/** Run a request against a hard deadline. Unlike a bare `Promise.race`
+ * against a dangling timer, the deadline timer is disposed as soon as
+ * the request settles, so a page polling every few seconds never
+ * strands a queue of live timers behind resolved requests. */
+export function raceDeadline<T>(work: Promise<T>, deadlineMs: number): Promise<T> {
+  let timer: ReturnType<typeof setTimeout> | undefined;
+  const expiry = new Promise<never>((_resolve, fail) => {
+    timer = setTimeout(() => fail(new Error(`deadline of ${deadlineMs}ms elapsed`)), deadlineMs);
+  });
+  return Promise.race([work, expiry]).finally(() => {
+    if (timer !== undefined) clearTimeout(timer);
+  });
+}
+
+export function isKubeList(value: unknown): value is { items: unknown[] } {
+  return (
+    !!value && typeof value === 'object' && Array.isArray((value as { items?: unknown }).items)
+  );
+}

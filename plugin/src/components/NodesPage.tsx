@@ -33,19 +33,10 @@ import {
   getNodePool,
   getNodeTopology,
   getNodeWorkerId,
-  isNodeReady,
   KubeNode,
   nodeName,
 } from '../api/topology';
-import { capNodesForCards, PageHeader, UtilizationBar } from './common';
-
-function readyLabel(node: KubeNode) {
-  return (
-    <StatusLabel status={isNodeReady(node) ? 'success' : 'error'}>
-      {isNodeReady(node) ? 'Ready' : 'NotReady'}
-    </StatusLabel>
-  );
-}
+import { capNodesForCards, PageHeader, readyLabel, UtilizationBar } from './common';
 
 function NodeDetailCard({ node, inUse, nowMs }: { node: KubeNode; inUse: number; nowMs: number }) {
   const info = nodeInfo(node);

@@ -7,7 +7,7 @@
  * e.g. `OverviewPage.tsx:143-158`, `NodesPage.tsx:35-63`).
  */
 
-import { SectionHeader } from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import { SectionHeader, StatusLabel } from '@kinvolk/headlamp-plugin/lib/CommonComponents';
 import React from 'react';
 import { HOT_NODE_PCT, roundHalfEven, WARM_NODE_PCT } from '../api/fleet';
 import { isNodeReady, KubeNode, nodeName } from '../api/topology';
@@ -114,4 +114,14 @@ export function phaseStatus(phase: string): 'success' | 'warning' | 'error' {
   if (phase === 'Running' || phase === 'Succeeded') return 'success';
   if (phase === 'Pending') return 'warning';
   return 'error';
+}
+
+/** Node readiness StatusLabel, shared by both providers' node tables
+ * and detail cards so readiness can never render differently. */
+export function readyLabel(node: KubeNode) {
+  return (
+    <StatusLabel status={isNodeReady(node) ? 'success' : 'error'}>
+      {isNodeReady(node) ? 'Ready' : 'NotReady'}
+    </StatusLabel>
+  );
 }

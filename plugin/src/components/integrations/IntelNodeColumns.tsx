@@ -1,0 +1,40 @@
+/**
+ * IntelNodeColumns — Intel GPU columns appended to Headlamp's native
+ * Nodes table, beside the TPU ones.
+ *
+ * Mirrors `headlamp_tpu/integrations/intel_views.py:
+ * build_node_intel_columns` (rebuilding the reference's
+ * `integrations/NodeColumns.tsx:17-48`): a GPU Type column and a GPU
+ * Devices column, each rendering '—' for non-Intel nodes.
+ */
+
+import React from 'react';
+import { rawObjectOf } from '../../api/fleet';
+import {
+  formatGpuType,
+  getNodeGpuCount,
+  getNodeGpuType,
+  isIntelGpuNode,
+} from '../../api/intel';
+import { NodeTableColumn } from './NodeColumns';
+
+export function buildNodeIntelColumns(): NodeTableColumn[] {
+  return [
+    {
+      id: 'intel-gpu-type',
+      label: 'GPU Type',
+      getValue: node => {
+        const n = rawObjectOf(node);
+        return isIntelGpuNode(n) ? formatGpuType(getNodeGpuType(n)) : '—';
+      },
+    },
+    {
+      id: 'intel-gpu-devices',
+      label: 'GPU Devices',
+      getValue: node => {
+        const n = rawObjectOf(node);
+        return isIntelGpuNode(n) ? String(getNodeGpuCount(n)) : '—';
+      },
+    },
+  ];
+}

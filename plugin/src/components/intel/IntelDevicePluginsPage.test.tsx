@@ -1,0 +1,107 @@
+/**
+ * IntelDevicePluginsPage branch coverage: loading, CRD unreadable, CRD
+ * readable-but-empty, CRD cards with spec fields, plugin-pod table,
+ * refresh.
+ */
+
+import { fireEvent, render, screen } from '@testing-library/react';
+import React from 'react';
+import { afterEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../../testing/mockCommonComponents')
+);
+
+import { IntelDataProvider } from '../../api/IntelDataContext';
+import { loadFixture } from '../../testing/fixtures';
+import {
+  requestLog,
+  resetRequestLog,
+  setMockApiHandler,
+  setMockCluster,
+} from '../../testing/mockHeadlampLib';
+import IntelDevicePluginsPage from './IntelDevicePluginsPage';
+
+const SAMPLE_CRD = {
+  metadata: { name: 'gpudeviceplugin-sample', uid: 'uid-crd-1' },
+  spec: {
+    image: 'intel/intel-gpu-plugin:0.30.0',
+    sharedDevNum: 2,
+    preferredAllocationPolicy: 'balanced',
+    enableMonitoring: true,
+    nodeSelector: { 'intel.feature.node.kubernetes.io/gpu': 'true' },
+  },
+  status: { desiredNumberScheduled: 2, numberReady: 1 },
+};
+
+function mount() {
+  return render(
+    <IntelDataProvider>
+      <IntelDevicePluginsPage />
+    </IntelDataProvider>
+  );
+}
+
+afterEach(() => {
+  setMockApiHandler(null);
+  resetRequestLog();
+});
+
+describe('CRD unreadable', () => {
+  it('renders the CRD notice, keeps the pod table', async () => {
+    const { fleet } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    // Default mock ApiProxy throws for the CRD path.
+    mount();
+    await screen.findByText('GpuDevicePlugin CRD not available');
+    expect(screen.getByText(/node and pod visibility remains available/)).toBeTruthy();
+    expect(screen.getByText(/intel-gpu-plugin-a/)).toBeTruthy();
+  });
+});
+
+describe('CRD readable but empty', () => {
+  it('says none found instead of unavailable', async () => {
+    const { fleet } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    setMockApiHandler(url => (url.includes('/gpudeviceplugins') ? { items: [] } : undefined));
+    mount();
+    await screen.findByText('No GpuDevicePlugin resources found');
+    expect(screen.queryByText('GpuDevicePlugin CRD not available')).toBeNull();
+  });
+});
+
+describe('CRD present', () => {
+  it('renders the card with spec fields and rollout state', async () => {
+    const { fleet } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    setMockApiHandler(url =>
+      url.includes('/gpudeviceplugins') ? { items: [SAMPLE_CRD] } : undefined
+    );
+    mount();
+    await screen.findByText('GpuDevicePlugin: gpudeviceplugin-sample');
+    expect(screen.getByText('intel/intel-gpu-plugin:0.30.0')).toBeTruthy();
+    expect(screen.getByText('balanced')).toBeTruthy();
+    expect(screen.getByText('1/2 ready')).toBeTruthy();
+    expect(screen.getByText(/intel.feature.node.kubernetes.io\/gpu=true/)).toBeTruthy();
+  });
+});
+
+describe('refresh', () => {
+  it('refetches the CRD list', async () => {
+    const { fleet } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    setMockApiHandler(url =>
+      url.includes('/gpudeviceplugins') ? { items: [SAMPLE_CRD] } : undefined
+    );
+    mount();
+    await screen.findByText('GpuDevicePlugin: gpudeviceplugin-sample');
+    const before = requestLog.filter(u => u.includes('/gpudeviceplugins')).length;
+    fireEvent.click(screen.getByRole('button', { name: /Refresh Intel Device Plugins/ }));
+    await vi.waitFor(() =>
+      expect(requestLog.filter(u => u.includes('/gpudeviceplugins')).length).toBeGreaterThan(
+        before
+      )
+    );
+  });
+});

@@ -1,0 +1,115 @@
+/**
+ * IntelDevicePluginsPage — the GpuDevicePlugin operator CRDs.
+ *
+ * Headlamp-native rendering of `headlamp_tpu/pages/intel.py:
+ * intel_device_plugins_page` (rebuilding the reference's
+ * `DevicePluginsPage.tsx`: per-CRD cards `:110-182`, unavailable box
+ * `:64-85`, empty state `:88-108`, pod table `:185-217`).
+ */
+
+import {
+  Loader,
+  NameValueTable,
+  SectionBox,
+  SimpleTable,
+  StatusLabel,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React from 'react';
+import { podName, podNamespace, podPhase, podRestarts } from '../../api/fleet';
+import { GpuDevicePlugin, pluginStatusText, pluginStatusToStatus } from '../../api/intel';
+import { useIntelContext } from '../../api/IntelDataContext';
+import { parseIntLenient } from '../../api/topology';
+import { PageHeader, phaseStatus } from '../common';
+
+function nodeSelectorText(plugin: GpuDevicePlugin): string {
+  const selector = plugin?.spec?.nodeSelector;
+  if (selector && typeof selector === 'object' && Object.keys(selector).length) {
+    return Object.entries(selector)
+      .sort(([a], [b]) => (a < b ? -1 : 1))
+      .map(([k, v]) => `${k}=${v}`)
+      .join(', ');
+  }
+  return '—';
+}
+
+function PluginCard({ plugin }: { plugin: GpuDevicePlugin }) {
+  const spec = plugin?.spec ?? {};
+  const status = plugin?.status ?? {};
+  return (
+    <SectionBox title={`GpuDevicePlugin: ${String(plugin?.metadata?.name ?? '')}`}>
+      <NameValueTable
+        rows={[
+          {
+            name: 'Status',
+            value: (
+              <StatusLabel status={pluginStatusToStatus(plugin)}>
+                {pluginStatusText(plugin)}
+              </StatusLabel>
+            ),
+          },
+          { name: 'Image', value: String(spec.image ?? '—') },
+          { name: 'Shared devices', value: spec.sharedDevNum ?? 1 },
+          { name: 'Allocation policy', value: String(spec.preferredAllocationPolicy ?? 'none') },
+          { name: 'Monitoring', value: spec.enableMonitoring ? 'yes' : 'no' },
+          { name: 'Resource manager', value: spec.resourceManager ? 'yes' : 'no' },
+          { name: 'Desired', value: parseIntLenient(status.desiredNumberScheduled) },
+          { name: 'Ready', value: parseIntLenient(status.numberReady) },
+          { name: 'Node selector', value: nodeSelectorText(plugin) },
+        ]}
+      />
+    </SectionBox>
+  );
+}
+
+export default function IntelDevicePluginsPage() {
+  const { devicePlugins, workloadAvailable, pluginPods, loading, error, refresh } =
+    useIntelContext();
+
+  if (loading) {
+    return <Loader title="Loading Intel device plugins" />;
+  }
+
+  return (
+    <>
+      <PageHeader title="Intel Device Plugins" onRefresh={refresh} />
+      {error && (
+        <SectionBox title="Data errors">
+          <StatusLabel status="error">{error}</StatusLabel>
+        </SectionBox>
+      )}
+      {!workloadAvailable && (
+        <SectionBox title="GpuDevicePlugin CRD not available">
+          <p>
+            The Intel Device Plugins Operator CRD could not be read; node and pod visibility
+            remains available.
+          </p>
+        </SectionBox>
+      )}
+      {workloadAvailable && devicePlugins.length === 0 && (
+        <SectionBox title="No GpuDevicePlugin resources found">
+          <p>The CRD exists but no GpuDevicePlugin has been created.</p>
+        </SectionBox>
+      )}
+      {devicePlugins.map(plugin => (
+        <PluginCard key={String(plugin?.metadata?.uid ?? plugin?.metadata?.name)} plugin={plugin} />
+      ))}
+      <SectionBox title="Plugin Pods">
+        <SimpleTable
+          columns={[
+            { label: 'Pod', getter: (p: any) => `${podNamespace(p)}/${podName(p)}` },
+            { label: 'Node', getter: (p: any) => String(p?.spec?.nodeName ?? '—') },
+            {
+              label: 'Phase',
+              getter: (p: any) => (
+                <StatusLabel status={phaseStatus(podPhase(p))}>{podPhase(p)}</StatusLabel>
+              ),
+            },
+            { label: 'Restarts', getter: (p: any) => podRestarts(p) },
+          ]}
+          data={pluginPods}
+          emptyMessage="No device-plugin pods found"
+        />
+      </SectionBox>
+    </>
+  );
+}

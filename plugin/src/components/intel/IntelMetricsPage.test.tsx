@@ -1,0 +1,118 @@
+/**
+ * IntelMetricsPage branch coverage: unreachable Prometheus, reachable
+ * without i915 series, reachable with power+TDP chips, refresh.
+ * The availability matrix renders in every branch.
+ */
+
+import { fireEvent, render, screen } from '@testing-library/react';
+import React from 'react';
+import { afterEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../../testing/mockCommonComponents')
+);
+
+import { INTEL_QUERIES } from '../../api/intelMetrics';
+import {
+  requestLog,
+  resetRequestLog,
+  setMockApiHandler,
+  setMockCluster,
+} from '../../testing/mockHeadlampLib';
+import IntelMetricsPage from './IntelMetricsPage';
+
+function promHandler(answers: Record<string, unknown>) {
+  return (url: string): unknown => {
+    if (!url.includes('/proxy/api/v1/query')) return undefined;
+    const promql = decodeURIComponent(url.split('query=')[1] ?? '');
+    if (promql === '1') {
+      return { status: 'success', data: { resultType: 'scalar', result: [0, '1'] } };
+    }
+    for (const [name, answer] of Object.entries(answers)) {
+      if (promql === INTEL_QUERIES[name]) return answer;
+    }
+    return { status: 'success', data: { resultType: 'vector', result: [] } };
+  };
+}
+
+function vector(samples: Array<{ labels: Record<string, string>; value: number }>) {
+  return {
+    status: 'success',
+    data: {
+      resultType: 'vector',
+      result: samples.map(s => ({ metric: s.labels, value: [0, String(s.value)] })),
+    },
+  };
+}
+
+afterEach(() => {
+  setMockApiHandler(null);
+  resetRequestLog();
+});
+
+describe('unreachable Prometheus', () => {
+  it('renders the availability matrix and the probe list', async () => {
+    setMockCluster({ nodes: [], pods: [] });
+    render(<IntelMetricsPage />);
+    await screen.findByText('Prometheus not reachable');
+    expect(screen.getByText('Metric Availability')).toBeTruthy();
+    expect(screen.getByText(/monitoring\/prometheus-k8s:9090/)).toBeTruthy();
+    // Honesty rows: frequency/utilization/iGPU power are marked No.
+    expect(screen.getAllByText('No').length).toBe(3);
+  });
+});
+
+describe('reachable without i915 series', () => {
+  it('renders the no-i915 diagnostic', async () => {
+    setMockApiHandler(promHandler({}));
+    render(<IntelMetricsPage />);
+    await screen.findByText('No i915 Metrics');
+    expect(screen.getByText(/no node_hwmon i915 series/)).toBeTruthy();
+  });
+});
+
+describe('reachable with chips', () => {
+  it('renders power summary and per-chip cards with the TDP meter', async () => {
+    setMockApiHandler(
+      promHandler({
+        chips: vector([{ labels: { chip: 'platform_i915_0', node: 'arc-node-1' }, value: 1 }]),
+        power: vector([{ labels: { chip: 'platform_i915_0', node: 'arc-node-1' }, value: 42.25 }]),
+        tdp: vector([{ labels: { chip: 'platform_i915_0', node: 'arc-node-1' }, value: 150 }]),
+      })
+    );
+    const { container } = render(<IntelMetricsPage />);
+    await screen.findByText('Power Summary');
+    const summary = screen.getByText('Power Summary').closest('section')!;
+    expect(summary.textContent).toContain('42.3 W'); // formatWatts(.1f)
+    expect(summary.textContent).toContain('150.0 W');
+    expect(screen.getByText('arc-node-1 · platform_i915_0')).toBeTruthy();
+    // The Of-TDP meter renders in the ok band (42/150 ≈ 28%).
+    expect(container.querySelector('.hl-utilbar-ok')).toBeTruthy();
+  });
+
+  it('hints instead of asserting zero when power has no samples yet', async () => {
+    setMockApiHandler(
+      promHandler({
+        chips: vector([{ labels: { chip: 'platform_i915_0', node: 'arc-node-1' }, value: 1 }]),
+      })
+    );
+    render(<IntelMetricsPage />);
+    await screen.findByText('Power Summary');
+    const summary = screen.getByText('Power Summary').closest('section')!;
+    // '—', never 'Total power 0.0 W'.
+    expect(summary.textContent).not.toContain('0.0 W');
+    expect(screen.getByText(/needs ≥5m of scrape history/)).toBeTruthy();
+  });
+});
+
+describe('refresh', () => {
+  it('re-scrapes without a remount', async () => {
+    setMockApiHandler(promHandler({}));
+    render(<IntelMetricsPage />);
+    await screen.findByText('No i915 Metrics');
+    const before = requestLog.length;
+    fireEvent.click(screen.getByRole('button', { name: /Refresh Intel GPU Metrics/ }));
+    await vi.waitFor(() => expect(requestLog.length).toBeGreaterThan(before));
+  });
+});

@@ -1,0 +1,171 @@
+/**
+ * IntelMetricsPage — i915 hwmon power telemetry.
+ *
+ * Headlamp-native rendering of `headlamp_tpu/pages/intel.py:
+ * intel_metrics_page` (rebuilding the reference's `MetricsPage.tsx`:
+ * availability matrix `:125-185`, unreachable box `:270-286`, no-i915
+ * diagnostic `:288-316`, power summary `:318-346`, per-chip power bars
+ * `:50-119`).
+ */
+
+import { ApiProxy } from '@kinvolk/headlamp-plugin/lib';
+import {
+  Loader,
+  NameValueTable,
+  SectionBox,
+  SimpleTable,
+  StatusLabel,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React, { useEffect, useState } from 'react';
+import {
+  fetchIntelGpuMetrics,
+  formatWatts,
+  GpuChipMetrics,
+  INTEL_METRIC_AVAILABILITY,
+  IntelMetricsSnapshot,
+} from '../../api/intelMetrics';
+import { PROMETHEUS_SERVICES } from '../../api/metrics';
+import { PageHeader, UtilizationBar } from '../common';
+
+function AvailabilityMatrix() {
+  return (
+    <SectionBox title="Metric Availability">
+      <SimpleTable
+        columns={[
+          { label: 'Metric', getter: (r: any) => r[0] },
+          {
+            label: 'Available',
+            getter: (r: any) => (
+              <StatusLabel status={r[1] ? 'success' : 'warning'}>
+                {r[1] ? 'Yes' : 'No'}
+              </StatusLabel>
+            ),
+          },
+          { label: 'Notes', getter: (r: any) => r[2] },
+        ]}
+        data={INTEL_METRIC_AVAILABILITY as unknown as any[]}
+      />
+    </SectionBox>
+  );
+}
+
+function ChipPowerCard({ chip }: { chip: GpuChipMetrics }) {
+  const rows: Array<{ name: string; value: React.ReactNode }> = [
+    { name: 'Power', value: formatWatts(chip.power_watts) },
+  ];
+  if (chip.tdp_watts) {
+    rows.push({ name: 'TDP', value: formatWatts(chip.tdp_watts) });
+    if (chip.power_watts !== null) {
+      rows.push({
+        name: 'Of TDP',
+        value: (
+          <UtilizationBar
+            used={Math.round(chip.power_watts * 10) / 10}
+            capacity={Math.round(chip.tdp_watts * 10) / 10}
+            unit="W"
+          />
+        ),
+      });
+    }
+  } else {
+    rows.push({ name: 'Hint', value: 'needs ≥5m of scrape history for rate() to produce data' });
+  }
+  return (
+    <SectionBox title={`${chip.node} · ${chip.chip}`}>
+      <NameValueTable rows={rows} />
+    </SectionBox>
+  );
+}
+
+export default function IntelMetricsPage() {
+  const [snapshot, setSnapshot] = useState<IntelMetricsSnapshot | null | undefined>(undefined);
+  const [refreshKey, setRefreshKey] = useState(0);
+
+  useEffect(() => {
+    let cancelled = false;
+    void fetchIntelGpuMetrics(path => ApiProxy.request(path)).then(snap => {
+      if (!cancelled) setSnapshot(snap);
+    });
+    return () => {
+      cancelled = true;
+    };
+  }, [refreshKey]);
+
+  if (snapshot === undefined) {
+    return <Loader title="Scraping Intel GPU telemetry" />;
+  }
+
+  const header = (
+    <PageHeader title="Intel GPU Metrics" onRefresh={() => setRefreshKey(k => k + 1)} />
+  );
+
+  if (snapshot === null) {
+    return (
+      <>
+        {header}
+        <AvailabilityMatrix />
+        <SectionBox title="Prometheus not reachable">
+          <p>No Prometheus service answered through the apiserver proxy. Probed:</p>
+          <ul>
+            {PROMETHEUS_SERVICES.map(([ns, svc]) => (
+              <li key={`${ns}/${svc}`}>
+                {ns}/{svc}
+              </li>
+            ))}
+          </ul>
+        </SectionBox>
+      </>
+    );
+  }
+
+  if (snapshot.chips.length === 0) {
+    return (
+      <>
+        {header}
+        <AvailabilityMatrix />
+        <SectionBox title="No i915 Metrics">
+          <p>
+            Prometheus at {snapshot.namespace}/{snapshot.service} is reachable but has no
+            node_hwmon i915 series. Power needs discrete i915 GPUs, node-exporter hwmon, and ≥5m
+            of scrape history.
+          </p>
+        </SectionBox>
+      </>
+    );
+  }
+
+  const powerSamples = snapshot.chips
+    .map(c => c.power_watts)
+    .filter((v): v is number => v !== null);
+  const totalTdp = snapshot.chips.reduce((acc, c) => acc + (c.tdp_watts ?? 0), 0);
+
+  return (
+    <>
+      {header}
+      <AvailabilityMatrix />
+      <SectionBox title="Power Summary">
+        <NameValueTable
+          rows={[
+            { name: 'Chips reporting', value: snapshot.chips.length },
+            // '—' when NO chip has a power sample yet (<5m of scrape
+            // history) — 'Total power 0.0 W' would assert the GPUs
+            // draw nothing.
+            {
+              name: 'Total power',
+              value: powerSamples.length
+                ? formatWatts(powerSamples.reduce((a, b) => a + b, 0))
+                : '—',
+            },
+            { name: 'Total TDP', value: totalTdp ? formatWatts(totalTdp) : '—' },
+          ]}
+        />
+        <p className="hl-hint">
+          Source: {snapshot.namespace}/{snapshot.service}; scrape→join took {snapshot.fetchMs} ms.
+        </p>
+      </SectionBox>
+      {snapshot.chips.map(chip => (
+        <ChipPowerCard key={`${chip.node}-${chip.chip}`} chip={chip} />
+      ))}
+    </>
+  );
+}

@@ -1,0 +1,76 @@
+/**
+ * IntelNodeDetailSection — Intel GPU section injected into Headlamp's
+ * native Node detail page.
+ *
+ * Mirrors `headlamp_tpu/integrations/intel_views.py:
+ * intel_node_detail_section` (rebuilding the reference's
+ * `NodeDetailSection.tsx`: non-GPU null `:44`, no-capacity null
+ * `:64-66`, utilization `:69-123`, pods list `:125-133`).
+ */
+
+import {
+  NameValueTable,
+  SectionBox,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React from 'react';
+import { podName, podNamespace, podPhase, rawObjectOf } from '../../api/fleet';
+import {
+  formatGpuType,
+  getNodeGpuAllocatable,
+  getNodeGpuCount,
+  getNodeGpuType,
+  getPodDeviceRequest,
+  isIntelGpuNode,
+} from '../../api/intel';
+import { useIntelContext } from '../../api/IntelDataContext';
+import { nodeName } from '../../api/topology';
+import { UtilizationBar } from '../common';
+
+export default function IntelNodeDetailSection({ resource }: { resource: { jsonData?: unknown } }) {
+  const { gpuPods, loading } = useIntelContext();
+  const node = rawObjectOf(resource);
+
+  if (!isIntelGpuNode(node)) {
+    return null;
+  }
+  const capacity = getNodeGpuCount(node);
+  const allocatable = getNodeGpuAllocatable(node);
+  if (capacity === 0 && allocatable === 0) {
+    return null;
+  }
+
+  const name = nodeName(node);
+  const nodePods = gpuPods.filter(p => p?.spec?.nodeName === name);
+  const inUse = nodePods.reduce(
+    (acc, p) => acc + (podPhase(p) === 'Running' ? getPodDeviceRequest(p) : 0),
+    0
+  );
+
+  return (
+    <SectionBox title="Intel GPU">
+      <NameValueTable
+        rows={[
+          { name: 'Type', value: formatGpuType(getNodeGpuType(node)) },
+          { name: 'Devices (capacity)', value: capacity },
+          { name: 'Devices (allocatable)', value: allocatable },
+          {
+            name: 'In use',
+            value: <UtilizationBar used={inUse} capacity={allocatable} unit="GPUs" />,
+          },
+        ]}
+      />
+      {loading ? (
+        <p>Loading…</p>
+      ) : (
+        <ul className="hl-node-pods">
+          {nodePods.length === 0 && <li>No GPU pods on this node</li>}
+          {nodePods.map(p => (
+            <li key={`${podNamespace(p)}/${podName(p)}`}>
+              {podNamespace(p)}/{podName(p)} ({getPodDeviceRequest(p)} GPUs)
+            </li>
+          ))}
+        </ul>
+      )}
+    </SectionBox>
+  );
+}

@@ -1,0 +1,94 @@
+/**
+ * IntelNodesPage branch coverage: loading, empty, loaded table with
+ * allocation meters + detail cards, list error, refresh.
+ */
+
+import { fireEvent, render, screen } from '@testing-library/react';
+import React from 'react';
+import { afterEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../../testing/mockCommonComponents')
+);
+
+import { IntelDataProvider } from '../../api/IntelDataContext';
+import { loadFixture } from '../../testing/fixtures';
+import {
+  requestLog,
+  resetRequestLog,
+  setMockApiHandler,
+  setMockCluster,
+} from '../../testing/mockHeadlampLib';
+import IntelNodesPage from './IntelNodesPage';
+
+function mount() {
+  return render(
+    <IntelDataProvider>
+      <IntelNodesPage />
+    </IntelDataProvider>
+  );
+}
+
+afterEach(() => {
+  setMockApiHandler(null);
+  resetRequestLog();
+});
+
+describe('loading and empty states', () => {
+  it('shows the loader while lists are pending', () => {
+    setMockCluster({ nodes: null, pods: null });
+    mount();
+    expect(screen.getByTestId('loader')).toBeTruthy();
+  });
+
+  it('explains when no node is an Intel GPU node', async () => {
+    const { fleet } = loadFixture('v5p32'); // TPU-only fleet
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('No Intel GPU nodes found');
+    expect(screen.getByText(/NFD Intel GPU labels/)).toBeTruthy();
+  });
+});
+
+describe('loaded on the mixed fixture', () => {
+  it('lists every Intel node with devices and a meter, plus cards', async () => {
+    const { fleet, expected } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    const { container } = mount();
+    const want = expected.intel as any;
+    await screen.findByText('Intel GPU Nodes');
+    for (const name of want.node_names) {
+      // Table row + detail card title.
+      expect(screen.getAllByText(name).length).toBeGreaterThanOrEqual(2);
+    }
+    // TPU nodes must not leak into the Intel table.
+    expect(screen.queryByText('gke-v5e16-pool-w0')).toBeNull();
+    expect(container.querySelectorAll('.hl-utilbar').length).toBeGreaterThanOrEqual(
+      want.node_names.length
+    );
+    // Cards carry the prettified resource rows and nodeInfo.
+    expect(screen.getAllByText('GPU (i915)').length).toBeGreaterThan(0);
+  });
+});
+
+describe('list error', () => {
+  it('surfaces the node-list error', async () => {
+    setMockCluster({ nodes: null, pods: [], nodeError: 'nodes is forbidden' });
+    mount();
+    await screen.findByText('Data errors');
+    expect(screen.getByText(/nodes is forbidden/)).toBeTruthy();
+  });
+});
+
+describe('refresh', () => {
+  it('re-triggers the imperative track', async () => {
+    const { fleet } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('Intel GPU Nodes');
+    const before = requestLog.length;
+    fireEvent.click(screen.getByRole('button', { name: /Refresh Intel GPU Nodes/ }));
+    await vi.waitFor(() => expect(requestLog.length).toBeGreaterThan(before));
+  });
+});

@@ -1,0 +1,150 @@
+/**
+ * IntelNodesPage — every Intel GPU node with type, devices, allocation
+ * meters, and per-node detail cards.
+ *
+ * Headlamp-native rendering of `headlamp_tpu/pages/intel.py:
+ * intel_nodes_page` (rebuilding the reference's `NodesPage.tsx`:
+ * summary `:252-282`, alloc bar `:35-63`, cards `:69-139`, empty state
+ * `:228-249`).
+ */
+
+import {
+  Loader,
+  NameValueTable,
+  SectionBox,
+  SimpleTable,
+  StatusLabel,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React from 'react';
+import { nodeInfo, podNodeName, podPhase } from '../../api/fleet';
+import {
+  formatGpuResourceName,
+  formatGpuType,
+  getNodeGpuAllocatable,
+  getNodeGpuCount,
+  getNodeGpuType,
+  getPodDeviceRequest,
+  INTEL_GPU_RESOURCE_PREFIX,
+} from '../../api/intel';
+import { useIntelContext } from '../../api/IntelDataContext';
+import { KubeNode, nodeName } from '../../api/topology';
+import { capNodesForCards, PageHeader, readyLabel, UtilizationBar } from '../common';
+
+function IntelNodeCard({ node, inUse }: { node: KubeNode; inUse: number }) {
+  const info = nodeInfo(node);
+  const capacity = (node?.status?.capacity ?? {}) as Record<string, any>;
+  const gpuResources = Object.entries(capacity)
+    .filter(([k]) => k.startsWith(INTEL_GPU_RESOURCE_PREFIX))
+    .sort(([a], [b]) => (a < b ? -1 : 1));
+  return (
+    <SectionBox title={nodeName(node)}>
+      <NameValueTable
+        rows={[
+          { name: 'Status', value: readyLabel(node) },
+          { name: 'Type', value: formatGpuType(getNodeGpuType(node)) },
+          ...gpuResources.map(([key, value]) => ({
+            name: formatGpuResourceName(key),
+            value: String(value),
+          })),
+          { name: 'GPUs in use', value: inUse },
+          { name: 'OS', value: String(info.osImage ?? '—') },
+          { name: 'Kernel', value: String(info.kernelVersion ?? '—') },
+          { name: 'Kubelet', value: String(info.kubeletVersion ?? '—') },
+        ]}
+      />
+    </SectionBox>
+  );
+}
+
+export default function IntelNodesPage() {
+  const { gpuNodes, gpuPods, loading, error, refresh } = useIntelContext();
+
+  // Per-node in-use from Running pods' device requests, one pass.
+  const inUseByNode = React.useMemo(() => {
+    const out = new Map<string, number>();
+    for (const p of gpuPods) {
+      if (podPhase(p) !== 'Running') continue;
+      const node = podNodeName(p);
+      if (node) out.set(node, (out.get(node) ?? 0) + getPodDeviceRequest(p));
+    }
+    return out;
+  }, [gpuPods]);
+
+  const podsByNode = React.useMemo(() => {
+    const out = new Map<string, number>();
+    for (const p of gpuPods) {
+      const node = podNodeName(p);
+      if (node) out.set(node, (out.get(node) ?? 0) + 1);
+    }
+    return out;
+  }, [gpuPods]);
+
+  const { shown: cardNodes, truncationNote } = React.useMemo(
+    () => capNodesForCards(gpuNodes),
+    [gpuNodes]
+  );
+
+  if (loading) {
+    return <Loader title="Loading Intel GPU nodes" />;
+  }
+
+  if (gpuNodes.length === 0) {
+    return (
+      <>
+        <PageHeader title="Intel GPU Nodes" onRefresh={refresh} />
+        {error && (
+          <SectionBox title="Data errors">
+            <StatusLabel status="error">{error}</StatusLabel>
+          </SectionBox>
+        )}
+        <SectionBox title="No Intel GPU nodes found">
+          <p>
+            No node carries the NFD Intel GPU labels or advertises gpu.intel.com/* capacity.
+          </p>
+        </SectionBox>
+      </>
+    );
+  }
+
+  return (
+    <>
+      <PageHeader title="Intel GPU Nodes" onRefresh={refresh} />
+      {error && (
+        <SectionBox title="Data errors">
+          <StatusLabel status="error">{error}</StatusLabel>
+        </SectionBox>
+      )}
+      <SectionBox title="Intel GPU Nodes">
+        <SimpleTable
+          columns={[
+            { label: 'Name', getter: (n: KubeNode) => nodeName(n) },
+            { label: 'Ready', getter: readyLabel },
+            { label: 'Type', getter: (n: KubeNode) => formatGpuType(getNodeGpuType(n)) },
+            { label: 'Devices', getter: (n: KubeNode) => getNodeGpuCount(n) },
+            {
+              label: 'Allocation',
+              getter: (n: KubeNode) => (
+                <UtilizationBar
+                  used={inUseByNode.get(nodeName(n)) ?? 0}
+                  capacity={getNodeGpuAllocatable(n)}
+                  unit="GPUs"
+                />
+              ),
+            },
+            { label: 'GPU Pods', getter: (n: KubeNode) => podsByNode.get(nodeName(n)) ?? 0 },
+          ]}
+          data={gpuNodes}
+          emptyMessage="No Intel GPU nodes found"
+        />
+      </SectionBox>
+      {truncationNote && <p className="hl-hint">{truncationNote}</p>}
+      {cardNodes.map(n => (
+        <IntelNodeCard
+          key={nodeName(n) || String(n?.metadata?.uid ?? '')}
+          node={n}
+          inUse={inUseByNode.get(nodeName(n)) ?? 0}
+        />
+      ))}
+    </>
+  );
+}

@@ -1,0 +1,138 @@
+/**
+ * IntelOverviewPage branch coverage: loading, loaded on the mixed
+ * fixture (type distribution + allocation), not-detected + CRD-missing
+ * notices, list error, refresh — and the cross-provider independence
+ * contract: a TPU-only failure must not degrade the Intel pages.
+ */
+
+import { fireEvent, render, screen } from '@testing-library/react';
+import React from 'react';
+import { afterEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../../testing/mockCommonComponents')
+);
+
+import { IntelDataProvider } from '../../api/IntelDataContext';
+import { loadFixture } from '../../testing/fixtures';
+import {
+  requestLog,
+  resetRequestLog,
+  setMockApiHandler,
+  setMockCluster,
+} from '../../testing/mockHeadlampLib';
+import IntelOverviewPage from './IntelOverviewPage';
+
+function mount() {
+  return render(
+    <IntelDataProvider>
+      <IntelOverviewPage />
+    </IntelDataProvider>
+  );
+}
+
+/** The operator is present: CRD list answers with one healthy plugin. */
+const CRD_HANDLER = (url: string) =>
+  url.includes('/gpudeviceplugins')
+    ? {
+        items: [
+          {
+            metadata: { name: 'gpudeviceplugin-sample', uid: 'uid-crd-1' },
+            spec: { image: 'intel/intel-gpu-plugin:0.30.0', sharedDevNum: 2 },
+            status: { desiredNumberScheduled: 2, numberReady: 2 },
+          },
+        ],
+      }
+    : undefined;
+
+afterEach(() => {
+  setMockApiHandler(null);
+  resetRequestLog();
+});
+
+describe('loading state', () => {
+  it('shows the loader while lists are pending', () => {
+    setMockCluster({ nodes: null, pods: null });
+    mount();
+    expect(screen.getByTestId('loader')).toBeTruthy();
+  });
+});
+
+describe('loaded on the mixed fixture', () => {
+  it('renders allocation and type distribution from the fixture', async () => {
+    const { fleet, expected } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    setMockApiHandler(CRD_HANDLER);
+    mount();
+    await screen.findByText('GPU Allocation');
+    const want = expected.intel as any;
+    const alloc = screen.getByText('GPU Allocation').closest('section')!;
+    expect(alloc.textContent).toContain(`${want.allocation.capacity} devices`);
+    expect(alloc.textContent).toContain(`${want.allocation.in_use} devices`);
+    const bar = screen.getByTestId('percentage-bar');
+    expect(bar.textContent).toContain('Discrete GPU');
+    expect(bar.getAttribute('data-total')).toBe(String(want.node_names.length));
+    // The operator CRD renders with its rollout state.
+    expect(screen.getByText('gpudeviceplugin-sample')).toBeTruthy();
+    expect(screen.getByText('2/2 ready')).toBeTruthy();
+    // Plugin pods from the fixture's selector chain.
+    for (const name of want.plugin_pod_names) {
+      expect(screen.getByText(new RegExp(name))).toBeTruthy();
+    }
+  });
+
+  it('stays healthy when only the TPU daemon namespace is unreadable', async () => {
+    // Independence contract: this handler fails every TPU plugin-pod
+    // path but answers the Intel chains — the Intel overview must
+    // render with no error banner (the TPU provider would degrade).
+    const { fleet } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    setMockApiHandler(url => {
+      if (url.includes('tpu-device-plugin') || url.includes('kube-system')) {
+        throw new Error('tpu paths are down');
+      }
+      return CRD_HANDLER(url);
+    });
+    mount();
+    await screen.findByText('GPU Allocation');
+    expect(screen.queryByText('Data errors')).toBeNull();
+  });
+});
+
+describe('not detected / CRD missing', () => {
+  it('renders the Helm hint and the CRD notice on an empty cluster', async () => {
+    setMockCluster({ nodes: [], pods: [] });
+    // Default mock ApiProxy throws for the CRD path → not readable.
+    mount();
+    await screen.findByText('Intel GPU Plugin Not Detected');
+    expect(screen.getByText(/helm install/)).toBeTruthy();
+    expect(screen.getByText('GpuDevicePlugin CRD not available')).toBeTruthy();
+  });
+});
+
+describe('list error', () => {
+  it('surfaces the node-list error', async () => {
+    setMockCluster({ nodes: null, pods: [], nodeError: 'nodes is forbidden' });
+    mount();
+    await screen.findByText('Data errors');
+    expect(screen.getByText(/nodes is forbidden/)).toBeTruthy();
+  });
+});
+
+describe('refresh', () => {
+  it('re-runs the CRD and plugin-pod chains', async () => {
+    const { fleet } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    setMockApiHandler(CRD_HANDLER);
+    mount();
+    await screen.findByText('GPU Allocation');
+    const before = requestLog.filter(u => u.includes('/gpudeviceplugins')).length;
+    fireEvent.click(screen.getByRole('button', { name: /Refresh Intel GPU Overview/ }));
+    await vi.waitFor(() =>
+      expect(requestLog.filter(u => u.includes('/gpudeviceplugins')).length).toBeGreaterThan(
+        before
+      )
+    );
+  });
+});

@@ -1,0 +1,189 @@
+/**
+ * IntelOverviewPage — Intel GPU fleet dashboard.
+ *
+ * Headlamp-native rendering of `headlamp_tpu/pages/intel.py:
+ * intel_overview_page` (rebuilding the reference's own
+ * `/root/reference/src/components/OverviewPage.tsx` section for
+ * section): plugin detection with the Helm hint, CRD notice, device
+ * plugins, plugin pods, node summary + type distribution, allocation,
+ * workload phases, and the active top-10.
+ */
+
+import {
+  Loader,
+  NameValueTable,
+  PercentageBar,
+  SectionBox,
+  SimpleTable,
+  StatusLabel,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React from 'react';
+import { countPodPhases, podName, podNamespace, podNodeName, podPhase } from '../../api/fleet';
+import {
+  formatGpuType,
+  getNodeGpuType,
+  getPodDeviceRequest,
+  pluginStatusText,
+  pluginStatusToStatus,
+} from '../../api/intel';
+import { useIntelContext } from '../../api/IntelDataContext';
+import { isNodeReady } from '../../api/topology';
+import { PageHeader, phaseStatus, UtilizationBar } from '../common';
+
+/** Running-pods cap (`pages/intel.py:_ACTIVE_CAP`). */
+const ACTIVE_CAP = 10;
+
+export default function IntelOverviewPage() {
+  const {
+    gpuNodes,
+    gpuPods,
+    pluginPods,
+    devicePlugins,
+    workloadAvailable,
+    allocation,
+    pluginInstalled,
+    loading,
+    error,
+    refresh,
+  } = useIntelContext();
+
+  if (loading) {
+    return <Loader title="Loading Intel GPU fleet" />;
+  }
+
+  const typeCounts: Record<string, number> = {};
+  let readyNodes = 0;
+  for (const n of gpuNodes) {
+    const key = formatGpuType(getNodeGpuType(n));
+    typeCounts[key] = (typeCounts[key] ?? 0) + 1;
+    if (isNodeReady(n)) readyNodes += 1;
+  }
+  const phases = countPodPhases(gpuPods);
+  const running = gpuPods
+    .filter(p => podPhase(p) === 'Running')
+    .sort((a, b) => {
+      const ta = String(a?.metadata?.creationTimestamp ?? '');
+      const tb = String(b?.metadata?.creationTimestamp ?? '');
+      return ta < tb ? 1 : ta > tb ? -1 : 0;
+    })
+    .slice(0, ACTIVE_CAP);
+
+  return (
+    <>
+      <PageHeader title="Intel GPU Overview" onRefresh={refresh} />
+      {error && (
+        <SectionBox title="Data errors">
+          <StatusLabel status="error">{error}</StatusLabel>
+        </SectionBox>
+      )}
+      {!pluginInstalled && (
+        <SectionBox title="Intel GPU Plugin Not Detected">
+          <p>
+            Install the device plugin operator: helm repo add intel
+            https://intel.github.io/helm-charts &amp;&amp; helm install
+            intel-device-plugins-operator intel/intel-device-plugins-operator
+          </p>
+        </SectionBox>
+      )}
+      {!workloadAvailable && (
+        <SectionBox title="GpuDevicePlugin CRD not available">
+          <p>
+            The Intel Device Plugins Operator CRD could not be read; node and pod visibility
+            remains available.
+          </p>
+        </SectionBox>
+      )}
+      {devicePlugins.length > 0 && (
+        <SectionBox title="Device Plugins">
+          <SimpleTable
+            columns={[
+              { label: 'Name', getter: (p: any) => String(p?.metadata?.name ?? '') },
+              {
+                label: 'Status',
+                getter: (p: any) => (
+                  <StatusLabel status={pluginStatusToStatus(p)}>{pluginStatusText(p)}</StatusLabel>
+                ),
+              },
+            ]}
+            data={devicePlugins}
+          />
+        </SectionBox>
+      )}
+      {pluginPods.length > 0 && (
+        <SectionBox title="Plugin Pods">
+          <SimpleTable
+            columns={[
+              { label: 'Pod', getter: (p: any) => `${podNamespace(p)}/${podName(p)}` },
+              { label: 'Node', getter: (p: any) => podNodeName(p) ?? '—' },
+              {
+                label: 'Phase',
+                getter: (p: any) => (
+                  <StatusLabel status={phaseStatus(podPhase(p))}>{podPhase(p)}</StatusLabel>
+                ),
+              },
+            ]}
+            data={pluginPods}
+          />
+        </SectionBox>
+      )}
+      <SectionBox title="GPU Nodes">
+        {gpuNodes.length > 0 && Object.keys(typeCounts).length > 0 && (
+          <div style={{ marginBottom: '12px' }}>
+            <div style={{ fontSize: '14px', marginBottom: '6px' }}>Type distribution</div>
+            <PercentageBar
+              data={Object.entries(typeCounts)
+                .sort(([a], [b]) => (a < b ? -1 : 1))
+                .map(([name, value]) => ({ name, value }))}
+              total={gpuNodes.length}
+            />
+          </div>
+        )}
+        <NameValueTable
+          rows={[
+            { name: 'Total', value: gpuNodes.length },
+            { name: 'Ready', value: readyNodes },
+            { name: 'Not Ready', value: gpuNodes.length - readyNodes },
+          ]}
+        />
+      </SectionBox>
+      <SectionBox title="GPU Allocation">
+        <NameValueTable
+          rows={[
+            { name: 'Capacity', value: `${allocation.capacity} devices` },
+            { name: 'Allocatable', value: `${allocation.allocatable} devices` },
+            { name: 'In use', value: `${allocation.in_use} devices` },
+            { name: 'Free', value: `${allocation.free} devices` },
+            {
+              name: 'Utilization',
+              value: (
+                <UtilizationBar
+                  used={allocation.in_use}
+                  capacity={allocation.capacity}
+                  unit="devices"
+                />
+              ),
+            },
+          ]}
+        />
+      </SectionBox>
+      <SectionBox title="GPU Workloads">
+        <NameValueTable
+          rows={Object.entries(phases)
+            .filter(([phase, count]) => count > 0 || phase !== 'Other')
+            .map(([phase, count]) => ({ name: phase, value: count }))}
+        />
+      </SectionBox>
+      <SectionBox title={`Active GPU Pods (top ${ACTIVE_CAP})`}>
+        <SimpleTable
+          columns={[
+            { label: 'Pod', getter: (p: any) => `${podNamespace(p)}/${podName(p)}` },
+            { label: 'Node', getter: (p: any) => podNodeName(p) ?? '—' },
+            { label: 'GPUs', getter: (p: any) => getPodDeviceRequest(p) },
+          ]}
+          data={running}
+          emptyMessage="No running GPU pods"
+        />
+      </SectionBox>
+    </>
+  );
+}

@@ -1,0 +1,60 @@
+/**
+ * IntelPodDetailSection — per-container GPU resources injected into
+ * Headlamp's native Pod detail page.
+ *
+ * Mirrors `headlamp_tpu/integrations/intel_views.py:
+ * intel_pod_detail_section` (rebuilding the reference's
+ * `PodDetailSection.tsx`: pure props `:25`, non-GPU null `:31`, per
+ * container×resource rows `:57-83`). Self-contained on the pod object —
+ * no provider context needed.
+ */
+
+import {
+  NameValueTable,
+  SectionBox,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React from 'react';
+import { podNodeName, podPhase, rawObjectOf } from '../../api/fleet';
+import {
+  formatGpuResourceName,
+  getContainerGpuResources,
+  isGpuRequestingPod,
+} from '../../api/intel';
+
+export default function IntelPodDetailSection({ resource }: { resource: { jsonData?: unknown } }) {
+  const pod = rawObjectOf(resource);
+
+  if (!isGpuRequestingPod(pod)) {
+    return null;
+  }
+
+  const containers = [
+    ...(Array.isArray(pod?.spec?.containers) ? pod.spec.containers : []),
+    ...(Array.isArray(pod?.spec?.initContainers) ? pod.spec.initContainers : []),
+  ];
+  let gpuContainers = 0;
+  const resourceRows: Array<{ name: string; value: string }> = [];
+  for (const c of containers) {
+    const resources = getContainerGpuResources(c);
+    if (Object.keys(resources).length) gpuContainers += 1;
+    for (const [resource, [req, lim]] of Object.entries(resources)) {
+      resourceRows.push({
+        name: `${String(c?.name ?? '?')} → ${formatGpuResourceName(resource)}`,
+        value: `request ${req} / limit ${lim}`,
+      });
+    }
+  }
+
+  return (
+    <SectionBox title="Intel GPU">
+      <NameValueTable
+        rows={[
+          { name: 'Phase', value: podPhase(pod) },
+          { name: 'Node', value: podNodeName(pod) ?? '—' },
+          { name: 'GPU containers', value: gpuContainers },
+          ...resourceRows,
+        ]}
+      />
+    </SectionBox>
+  );
+}

@@ -1,0 +1,105 @@
+/**
+ * IntelPodsPage branch coverage: loading, empty, loaded with
+ * per-container resource lines, pending attention, list error, refresh.
+ */
+
+import { fireEvent, render, screen } from '@testing-library/react';
+import React from 'react';
+import { afterEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../../testing/mockCommonComponents')
+);
+
+import { IntelDataProvider } from '../../api/IntelDataContext';
+import { loadFixture } from '../../testing/fixtures';
+import { requestLog, resetRequestLog, setMockCluster } from '../../testing/mockHeadlampLib';
+import IntelPodsPage from './IntelPodsPage';
+
+function mount() {
+  return render(
+    <IntelDataProvider>
+      <IntelPodsPage />
+    </IntelDataProvider>
+  );
+}
+
+afterEach(() => {
+  resetRequestLog();
+});
+
+describe('loading and empty states', () => {
+  it('shows the loader while lists are pending', () => {
+    setMockCluster({ nodes: null, pods: null });
+    mount();
+    expect(screen.getByTestId('loader')).toBeTruthy();
+  });
+
+  it('explains when nothing requests Intel GPUs', async () => {
+    const { fleet } = loadFixture('v5p32'); // TPU-only fleet
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('No GPU pods found');
+    expect(screen.getByText(/No pod requests gpu.intel.com/)).toBeTruthy();
+  });
+});
+
+describe('loaded on the mixed fixture', () => {
+  it('lists GPU pods with per-container resource lines', async () => {
+    const { fleet, expected } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    const want = expected.intel as any;
+    await screen.findByText('GPU Workload Summary');
+    for (const name of want.gpu_pod_names) {
+      expect(screen.getByText(new RegExp(`/${name}$`))).toBeTruthy();
+    }
+    // TPU pods must not leak into the Intel table.
+    expect(screen.queryByText(/llm-shard-0/)).toBeNull();
+    // Container lines carry the prettified resource with req=/lim=.
+    expect(screen.getAllByText(/GPU \(i915\) req=\d+ lim=\d+/).length).toBeGreaterThan(0);
+  });
+
+  it('surfaces pending GPU pods with their waiting reason', async () => {
+    const { fleet } = loadFixture('mixed');
+    const stuck = {
+      metadata: { name: 'stuck-transcode', namespace: 'media', uid: 'uid-stuck-gpu' },
+      spec: {
+        containers: [
+          { name: 'enc', resources: { requests: { 'gpu.intel.com/i915': '1' } } },
+        ],
+      },
+      status: {
+        phase: 'Pending',
+        conditions: [{ type: 'PodScheduled', status: 'False', reason: 'Unschedulable' }],
+      },
+    };
+    setMockCluster({ nodes: fleet.nodes, pods: [...fleet.pods, stuck] });
+    mount();
+    await screen.findByText('Attention: Pending GPU Pods');
+    expect(screen.getByText(/stuck-transcode/)).toBeTruthy();
+    expect(screen.getByText('Unschedulable')).toBeTruthy();
+  });
+});
+
+describe('list error', () => {
+  it('surfaces the pod-list error', async () => {
+    setMockCluster({ nodes: [], pods: null, podError: 'pods is forbidden' });
+    mount();
+    await screen.findByText('Data errors');
+    expect(screen.getByText(/pods is forbidden/)).toBeTruthy();
+  });
+});
+
+describe('refresh', () => {
+  it('re-triggers the imperative track', async () => {
+    const { fleet } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    await screen.findByText('GPU Workload Summary');
+    const before = requestLog.length;
+    fireEvent.click(screen.getByRole('button', { name: /Refresh Intel GPU Workloads/ }));
+    await vi.waitFor(() => expect(requestLog.length).toBeGreaterThan(before));
+  });
+});

@@ -1,0 +1,137 @@
+/**
+ * IntelPodsPage — every pod requesting gpu.intel.com/* resources.
+ *
+ * Headlamp-native rendering of `headlamp_tpu/pages/intel.py:
+ * intel_pods_page` (rebuilding the reference's `PodsPage.tsx`: summary
+ * `:166-198`, container req/lim list `:49-88`, pending attention
+ * `:239-268`).
+ */
+
+import {
+  Loader,
+  NameValueTable,
+  SectionBox,
+  SimpleTable,
+  StatusLabel,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React from 'react';
+import {
+  countPodPhases,
+  KubePod,
+  podName,
+  podNamespace,
+  podNodeName,
+  podPhase,
+  podRestarts,
+  waitingReason,
+} from '../../api/fleet';
+import {
+  formatGpuResourceName,
+  getContainerGpuResources,
+  getPodDeviceRequest,
+} from '../../api/intel';
+import { useIntelContext } from '../../api/IntelDataContext';
+import { PageHeader, phaseStatus } from '../common';
+
+/** Per-container `name: resource req=N lim=M` lines over the merged
+ * requests∪limits key set (`pages/intel.py:container_list`). */
+function GpuContainerList({ pod }: { pod: KubePod }) {
+  const lines: Array<{ key: string; text: string }> = [];
+  const containers = Array.isArray(pod?.spec?.containers) ? pod.spec.containers : [];
+  const initContainers = Array.isArray(pod?.spec?.initContainers) ? pod.spec.initContainers : [];
+  for (const c of [...containers, ...initContainers]) {
+    for (const [resource, [req, lim]] of Object.entries(getContainerGpuResources(c))) {
+      lines.push({
+        key: `${c?.name}/${resource}`,
+        text: `${String(c?.name ?? '?')}: ${formatGpuResourceName(resource)} req=${req} lim=${lim}`,
+      });
+    }
+  }
+  if (lines.length === 0) return <span>—</span>;
+  return (
+    <>
+      {lines.map(line => (
+        <div key={line.key} className="hl-container-chips" style={{ fontSize: '13px' }}>
+          {line.text}
+        </div>
+      ))}
+    </>
+  );
+}
+
+export default function IntelPodsPage() {
+  const { gpuPods, loading, error, refresh } = useIntelContext();
+
+  if (loading) {
+    return <Loader title="Loading Intel GPU workloads" />;
+  }
+
+  if (gpuPods.length === 0) {
+    return (
+      <>
+        <PageHeader title="Intel GPU Workloads" onRefresh={refresh} />
+        {error && (
+          <SectionBox title="Data errors">
+            <StatusLabel status="error">{error}</StatusLabel>
+          </SectionBox>
+        )}
+        <SectionBox title="No GPU pods found">
+          <p>No pod requests gpu.intel.com/* in any namespace.</p>
+        </SectionBox>
+      </>
+    );
+  }
+
+  const phases = countPodPhases(gpuPods);
+  const pending = gpuPods.filter(p => podPhase(p) === 'Pending');
+
+  return (
+    <>
+      <PageHeader title="Intel GPU Workloads" onRefresh={refresh} />
+      {error && (
+        <SectionBox title="Data errors">
+          <StatusLabel status="error">{error}</StatusLabel>
+        </SectionBox>
+      )}
+      <SectionBox title="GPU Workload Summary">
+        <NameValueTable
+          rows={[
+            { name: 'Total pods', value: gpuPods.length },
+            ...Object.entries(phases)
+              .filter(([phase, count]) => count > 0 || phase !== 'Other')
+              .map(([phase, count]) => ({ name: phase, value: count })),
+          ]}
+        />
+      </SectionBox>
+      {pending.length > 0 && (
+        <SectionBox title="Attention: Pending GPU Pods">
+          <SimpleTable
+            columns={[
+              { label: 'Pod', getter: (p: any) => `${podNamespace(p)}/${podName(p)}` },
+              { label: 'GPUs requested', getter: (p: any) => getPodDeviceRequest(p) },
+              { label: 'Reason', getter: (p: any) => waitingReason(p) || '—' },
+            ]}
+            data={pending}
+          />
+        </SectionBox>
+      )}
+      <SectionBox title="All GPU Pods">
+        <SimpleTable
+          columns={[
+            { label: 'Pod', getter: (p: any) => `${podNamespace(p)}/${podName(p)}` },
+            {
+              label: 'Phase',
+              getter: (p: any) => (
+                <StatusLabel status={phaseStatus(podPhase(p))}>{podPhase(p)}</StatusLabel>
+              ),
+            },
+            { label: 'Node', getter: (p: any) => podNodeName(p) ?? '—' },
+            { label: 'Containers', getter: (p: any) => <GpuContainerList pod={p} /> },
+            { label: 'Restarts', getter: (p: any) => podRestarts(p) },
+          ]}
+          data={gpuPods}
+        />
+      </SectionBox>
+    </>
+  );
+}

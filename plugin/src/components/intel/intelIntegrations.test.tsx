@@ -1,0 +1,85 @@
+/**
+ * Intel native-view integrations: node/pod detail sections (null for
+ * foreign resources) and the Nodes-table columns, on the shared mixed
+ * fixture.
+ */
+
+import { render, screen } from '@testing-library/react';
+import React from 'react';
+import { afterEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../../testing/mockCommonComponents')
+);
+
+import { IntelDataProvider } from '../../api/IntelDataContext';
+import { loadFixture } from '../../testing/fixtures';
+import { resetRequestLog, setMockCluster } from '../../testing/mockHeadlampLib';
+import { buildNodeIntelColumns } from '../integrations/IntelNodeColumns';
+import IntelNodeDetailSection from './IntelNodeDetailSection';
+import IntelPodDetailSection from './IntelPodDetailSection';
+
+function mount(children: React.ReactNode) {
+  return render(<IntelDataProvider>{children}</IntelDataProvider>);
+}
+
+afterEach(() => {
+  resetRequestLog();
+});
+
+describe('IntelNodeDetailSection', () => {
+  it('renders devices, utilization, and the pods list for a GPU node', async () => {
+    const { fleet } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    const arc = fleet.nodes.find(n => n.metadata.name === 'arc-node-1')!;
+    mount(<IntelNodeDetailSection resource={{ jsonData: arc } as any} />);
+    expect(await screen.findByText('Intel GPU')).toBeTruthy();
+    expect(screen.getByText('Devices (capacity)')).toBeTruthy();
+    // transcode-1 runs on arc-node-1 in the fixture.
+    expect(screen.getByText(/transcode-1/)).toBeTruthy();
+  });
+
+  it('renders nothing for a TPU node', () => {
+    const { fleet } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    const tpuNode = fleet.nodes.find(n => n.metadata.name === 'gke-v5e16-pool-w0')!;
+    const { container } = mount(
+      <IntelNodeDetailSection resource={{ jsonData: tpuNode } as any} />
+    );
+    expect(container.querySelector('section')).toBeNull();
+  });
+});
+
+describe('IntelPodDetailSection', () => {
+  it('renders per-container resource rows for a GPU pod', () => {
+    const { fleet } = loadFixture('mixed');
+    const pod = fleet.pods.find(p => p.metadata.name === 'transcode-1')!;
+    render(<IntelPodDetailSection resource={{ jsonData: pod } as any} />);
+    expect(screen.getByText('Intel GPU')).toBeTruthy();
+    expect(screen.getByText('GPU containers')).toBeTruthy();
+    expect(screen.getAllByText(/→ GPU \(i915\)/).length).toBeGreaterThan(0);
+  });
+
+  it('renders nothing for a TPU pod', () => {
+    const { fleet } = loadFixture('mixed');
+    const pod = fleet.pods.find(p => p.metadata.name === 'llm-shard-0')!;
+    const { container } = render(
+      <IntelPodDetailSection resource={{ jsonData: pod } as any} />
+    );
+    expect(container.querySelector('section')).toBeNull();
+  });
+});
+
+describe('buildNodeIntelColumns', () => {
+  it('labels Intel nodes and dashes the rest', () => {
+    const { fleet } = loadFixture('mixed');
+    const [typeCol, devicesCol] = buildNodeIntelColumns();
+    const arc = fleet.nodes.find(n => n.metadata.name === 'arc-node-1')!;
+    const tpu = fleet.nodes.find(n => n.metadata.name === 'gke-v5e16-pool-w0')!;
+    expect(typeCol.getValue({ jsonData: arc })).toBe('Discrete GPU');
+    expect(devicesCol.getValue({ jsonData: arc })).toBe('2');
+    expect(typeCol.getValue({ jsonData: tpu })).toBe('—');
+    expect(devicesCol.getValue({ jsonData: tpu })).toBe('—');
+  });
+});

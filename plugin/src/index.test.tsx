@@ -1,10 +1,10 @@
 /**
  * Registration-surface test: importing the plugin entry must register
- * the same TPU surface the Python registry declares
- * (`headlamp_tpu/registration.py` TPU half, checked structurally by
- * `tests/test_ts_parity.py`): 7 sidebar entries, 6 routes, 2
- * kind-guarded detail sections, and the 'headlamp-nodes' column
- * processor.
+ * BOTH provider surfaces the Python registry declares
+ * (`headlamp_tpu/registration.py`, checked structurally by
+ * `tests/test_ts_parity.py`): 7 TPU + 6 Intel sidebar entries, 6 TPU +
+ * 5 Intel routes, 4 kind-guarded detail sections, and the
+ * 'headlamp-nodes' column processor carrying both providers' columns.
  */
 
 import { describe, expect, it, vi } from 'vitest';
@@ -18,7 +18,7 @@ import { captured } from './testing/mockHeadlampLib';
 import './index';
 
 describe('plugin registration surface', () => {
-  it('registers the sidebar section and entries', () => {
+  it('registers both sidebar sections and their entries', () => {
     const urls = captured.sidebarEntries.map(e => [e.name, e.url]);
     expect(urls).toEqual([
       ['tpu', '/tpu'],
@@ -28,10 +28,21 @@ describe('plugin registration surface', () => {
       ['tpu-deviceplugins', '/tpu/deviceplugins'],
       ['tpu-topology', '/tpu/topology'],
       ['tpu-metrics', '/tpu/metrics'],
+      ['intel', '/intel'],
+      ['intel-overview', '/intel'],
+      ['intel-deviceplugins', '/intel/deviceplugins'],
+      ['intel-nodes', '/intel/nodes'],
+      ['intel-pods', '/intel/pods'],
+      ['intel-metrics', '/intel/metrics'],
     ]);
+    // TPU registers first: first-class provider, Intel compatibility.
     expect(captured.sidebarEntries[0].parent).toBeNull();
-    for (const child of captured.sidebarEntries.slice(1)) {
+    expect(captured.sidebarEntries[7].parent).toBeNull();
+    for (const child of captured.sidebarEntries.slice(1, 7)) {
       expect(child.parent).toBe('tpu');
+    }
+    for (const child of captured.sidebarEntries.slice(8)) {
+      expect(child.parent).toBe('intel');
     }
   });
 
@@ -43,6 +54,11 @@ describe('plugin registration surface', () => {
       '/tpu/deviceplugins',
       '/tpu/topology',
       '/tpu/metrics',
+      '/intel',
+      '/intel/deviceplugins',
+      '/intel/nodes',
+      '/intel/pods',
+      '/intel/metrics',
     ]);
     for (const route of captured.routes) {
       expect(route.exact).toBe(true);
@@ -51,26 +67,49 @@ describe('plugin registration surface', () => {
     }
   });
 
-  it('kind-guards both detail sections', () => {
-    expect(captured.detailsViewSections).toHaveLength(2);
-    const [nodeSection, podSection] = captured.detailsViewSections;
+  it('kind-guards all four detail sections', () => {
+    expect(captured.detailsViewSections).toHaveLength(4);
+    const [tpuNode, tpuPod, intelNode, intelPod] = captured.detailsViewSections;
+    const tpuNodeResource = {
+      kind: 'Node',
+      jsonData: {
+        metadata: { labels: { 'cloud.google.com/gke-tpu-accelerator': 'tpu-v5p-slice' } },
+      },
+    };
+    const intelNodeResource = {
+      kind: 'Node',
+      jsonData: { metadata: { labels: { 'intel.feature.node.kubernetes.io/gpu': 'true' } } },
+    };
     // Wrong kinds render nothing at all.
-    expect(nodeSection({ resource: { kind: 'ConfigMap' } })).toBeNull();
-    expect(podSection({ resource: { kind: 'Node' } })).toBeNull();
-    expect(nodeSection({ resource: undefined })).toBeNull();
-    // Right kinds produce an element.
-    expect(nodeSection({ resource: { kind: 'Node' } })).not.toBeNull();
-    expect(podSection({ resource: { kind: 'Pod' } })).not.toBeNull();
+    for (const section of captured.detailsViewSections) {
+      expect(section({ resource: { kind: 'ConfigMap' } })).toBeNull();
+      expect(section({ resource: undefined })).toBeNull();
+    }
+    expect(tpuPod({ resource: { kind: 'Node' } })).toBeNull();
+    expect(intelPod({ resource: { kind: 'Node' } })).toBeNull();
+    // The node sections guard on provider membership BEFORE mounting
+    // the data provider — a foreign node must not cost a provider tree.
+    expect(tpuNode({ resource: { kind: 'Node' } })).toBeNull();
+    expect(intelNode({ resource: { kind: 'Node' } })).toBeNull();
+    expect(tpuNode({ resource: intelNodeResource })).toBeNull();
+    expect(intelNode({ resource: tpuNodeResource })).toBeNull();
+    // Right kinds + membership produce an element.
+    expect(tpuNode({ resource: tpuNodeResource })).not.toBeNull();
+    expect(tpuPod({ resource: { kind: 'Pod' } })).not.toBeNull();
+    expect(intelNode({ resource: intelNodeResource })).not.toBeNull();
+    expect(intelPod({ resource: { kind: 'Pod' } })).not.toBeNull();
   });
 
-  it('appends TPU columns only to the headlamp-nodes table', () => {
+  it('appends both providers’ columns only to the headlamp-nodes table', () => {
     expect(captured.columnsProcessors).toHaveLength(1);
     const processor = captured.columnsProcessors[0];
     const base = [{ id: 'name' }];
     const extended = processor({ id: 'headlamp-nodes', columns: base });
-    expect(extended).toHaveLength(3);
+    expect(extended).toHaveLength(5);
     expect((extended[1] as any).id).toBe('tpu-generation');
     expect((extended[2] as any).id).toBe('tpu-chips');
+    expect((extended[3] as any).id).toBe('intel-gpu-type');
+    expect((extended[4] as any).id).toBe('intel-gpu-devices');
     // Other tables pass through untouched.
     expect(processor({ id: 'headlamp-pods', columns: base })).toBe(base);
   });

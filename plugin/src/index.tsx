@@ -1,18 +1,23 @@
 /**
  * headlamp-tpu-plugin — entry point.
  *
- * Registers the TPU surface against a live Headlamp instance: sidebar
- * entries, routes, native detail-view sections, and the Nodes-table
- * column processor. The registration surface mirrors the Python
- * framework's registry (`headlamp_tpu/registration.py:register_plugin`,
- * TPU half) and plays the role the reference's entry point plays for
- * Intel GPUs (`/root/reference/src/index.tsx:35-182`).
+ * Registers BOTH provider surfaces against a live Headlamp instance:
+ * sidebar entries, routes, native detail-view sections, and the
+ * Nodes-table column processor. The registration surface mirrors the
+ * Python framework's registry (`headlamp_tpu/registration.py:
+ * register_plugin` — TPU first-class, Intel as the compatibility
+ * provider) and carries the reference's entire Intel surface
+ * (`/root/reference/src/index.tsx:35-182`) behind the same
+ * abstraction, so a reference user keeps every view they had.
  *
  * Pages surfaced:
- *   - Sidebar section: Overview / Nodes / Workloads / Topology
- *   - Native Node detail page: Cloud TPU section (chips, slice, pods)
- *   - Native Pod detail page: TPU resource requests per container
- *   - Native Nodes table: TPU generation and chip-count columns
+ *   - TPU sidebar: Overview / Nodes / Workloads / Device Plugin /
+ *     Topology / Metrics
+ *   - Intel sidebar: Overview / Device Plugins / Nodes / Pods / Metrics
+ *     (the reference's five views)
+ *   - Native Node detail page: Cloud TPU + Intel GPU sections
+ *   - Native Pod detail page: TPU + Intel per-container resources
+ *   - Native Nodes table: TPU generation/chips + Intel type/devices
  */
 
 import {
@@ -22,9 +27,21 @@ import {
   registerSidebarEntry,
 } from '@kinvolk/headlamp-plugin/lib';
 import React from 'react';
+import { rawObjectOf } from './api/fleet';
+import { isIntelGpuNode } from './api/intel';
+import { IntelDataProvider } from './api/IntelDataContext';
+import { isTpuNode } from './api/topology';
 import { TpuDataProvider } from './api/TpuDataContext';
+import { buildNodeIntelColumns } from './components/integrations/IntelNodeColumns';
 import { buildNodeTpuColumns } from './components/integrations/NodeColumns';
 import DevicePluginsPage from './components/DevicePluginsPage';
+import IntelDevicePluginsPage from './components/intel/IntelDevicePluginsPage';
+import IntelMetricsPage from './components/intel/IntelMetricsPage';
+import IntelNodeDetailSection from './components/intel/IntelNodeDetailSection';
+import IntelNodesPage from './components/intel/IntelNodesPage';
+import IntelOverviewPage from './components/intel/IntelOverviewPage';
+import IntelPodDetailSection from './components/intel/IntelPodDetailSection';
+import IntelPodsPage from './components/intel/IntelPodsPage';
 import MetricsPage from './components/MetricsPage';
 import NodeDetailSection from './components/NodeDetailSection';
 import NodesPage from './components/NodesPage';
@@ -168,12 +185,127 @@ registerRoute({
 });
 
 // ---------------------------------------------------------------------------
+// Intel GPU sidebar + routes (registration.py Intel half; the
+// reference's full surface, `/root/reference/src/index.tsx:35-140`).
+// ---------------------------------------------------------------------------
+
+registerSidebarEntry({
+  parent: null,
+  name: 'intel',
+  label: 'Intel GPU',
+  url: '/intel',
+  icon: 'mdi:expansion-card',
+});
+
+registerSidebarEntry({
+  parent: 'intel',
+  name: 'intel-overview',
+  label: 'Overview',
+  url: '/intel',
+  icon: 'mdi:view-dashboard',
+});
+
+registerSidebarEntry({
+  parent: 'intel',
+  name: 'intel-deviceplugins',
+  label: 'Device Plugins',
+  url: '/intel/deviceplugins',
+  icon: 'mdi:chip',
+});
+
+registerSidebarEntry({
+  parent: 'intel',
+  name: 'intel-nodes',
+  label: 'GPU Nodes',
+  url: '/intel/nodes',
+  icon: 'mdi:server',
+});
+
+registerSidebarEntry({
+  parent: 'intel',
+  name: 'intel-pods',
+  label: 'GPU Pods',
+  url: '/intel/pods',
+  icon: 'mdi:cube-outline',
+});
+
+registerSidebarEntry({
+  parent: 'intel',
+  name: 'intel-metrics',
+  label: 'Metrics',
+  url: '/intel/metrics',
+  icon: 'mdi:chart-line',
+});
+
+registerRoute({
+  path: '/intel',
+  sidebar: 'intel-overview',
+  name: 'intel-overview',
+  exact: true,
+  component: () => (
+    <IntelDataProvider>
+      <IntelOverviewPage />
+    </IntelDataProvider>
+  ),
+});
+
+registerRoute({
+  path: '/intel/deviceplugins',
+  sidebar: 'intel-deviceplugins',
+  name: 'intel-deviceplugins',
+  exact: true,
+  component: () => (
+    <IntelDataProvider>
+      <IntelDevicePluginsPage />
+    </IntelDataProvider>
+  ),
+});
+
+registerRoute({
+  path: '/intel/nodes',
+  sidebar: 'intel-nodes',
+  name: 'intel-nodes',
+  exact: true,
+  component: () => (
+    <IntelDataProvider>
+      <IntelNodesPage />
+    </IntelDataProvider>
+  ),
+});
+
+registerRoute({
+  path: '/intel/pods',
+  sidebar: 'intel-pods',
+  name: 'intel-pods',
+  exact: true,
+  component: () => (
+    <IntelDataProvider>
+      <IntelPodsPage />
+    </IntelDataProvider>
+  ),
+});
+
+registerRoute({
+  path: '/intel/metrics',
+  sidebar: 'intel-metrics',
+  name: 'intel-metrics',
+  exact: true,
+  // IntelMetricsPage fetches through ApiProxy directly (the
+  // reference's MetricsPage also runs its own fetch cycle).
+  component: () => <IntelMetricsPage />,
+});
+
+// ---------------------------------------------------------------------------
 // Detail view sections — kind-guarded like the reference
 // (`index.tsx:153,168`) and the Python registry's DetailSection kinds.
+// The node sections ALSO guard on provider membership out here, before
+// mounting the data provider: the provider subscribes cluster-wide
+// lists and fires the imperative chains, which would be paid on every
+// Node detail page just to render null for a foreign node.
 // ---------------------------------------------------------------------------
 
 registerDetailsViewSection(({ resource }: { resource?: { kind?: string } }) => {
-  if (resource?.kind !== 'Node') return null;
+  if (resource?.kind !== 'Node' || !isTpuNode(rawObjectOf(resource))) return null;
   return (
     <TpuDataProvider>
       <NodeDetailSection resource={resource} />
@@ -186,15 +318,30 @@ registerDetailsViewSection(({ resource }: { resource?: { kind?: string } }) => {
   return <PodDetailSection resource={resource} />;
 });
 
+registerDetailsViewSection(({ resource }: { resource?: { kind?: string } }) => {
+  if (resource?.kind !== 'Node' || !isIntelGpuNode(rawObjectOf(resource))) return null;
+  return (
+    <IntelDataProvider>
+      <IntelNodeDetailSection resource={resource} />
+    </IntelDataProvider>
+  );
+});
+
+registerDetailsViewSection(({ resource }: { resource?: { kind?: string } }) => {
+  if (resource?.kind !== 'Pod') return null;
+  return <IntelPodDetailSection resource={resource} />;
+});
+
 // ---------------------------------------------------------------------------
 // Native Nodes table columns (registration.py:197-199; reference
 // `index.tsx:177-182` targets the same 'headlamp-nodes' table id).
+// One processor appends both providers' columns in registration order.
 // ---------------------------------------------------------------------------
 
 registerResourceTableColumnsProcessor(
   ({ id, columns }: { id: string; columns: unknown[] }) => {
     if (id === 'headlamp-nodes') {
-      return [...columns, ...buildNodeTpuColumns()];
+      return [...columns, ...buildNodeTpuColumns(), ...buildNodeIntelColumns()];
     }
     return columns;
   }

@@ -21,6 +21,8 @@ export interface Fixture {
     summary: Record<string, any>;
     tpu_node_names: string[];
     tpu_pod_names: string[];
+    /** Intel half of the contract (tools/export_fixtures.py). */
+    intel: Record<string, any>;
   };
 }
 

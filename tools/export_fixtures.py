@@ -21,7 +21,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from headlamp_tpu.analytics.stats import python_fleet_stats  # noqa: E402
-from headlamp_tpu.domain import objects, tpu  # noqa: E402
+from headlamp_tpu.domain import intel, objects, tpu  # noqa: E402
 from headlamp_tpu.domain.accelerator import classify_fleet  # noqa: E402
 from headlamp_tpu.fleet import fixtures as fx  # noqa: E402
 from headlamp_tpu.topology.mesh import build_mesh_layout  # noqa: E402
@@ -108,7 +108,11 @@ def expected_for(fleet: dict) -> dict:
         )
     # Fleet-stats half of the contract: the TS `fleet.ts` mirror must
     # reproduce python_fleet_stats (and the provider filters) exactly.
-    view = classify_fleet(fleet["nodes"], fleet.get("pods", []))["tpu"]
+    views = classify_fleet(fleet["nodes"], fleet.get("pods", []))
+    view = views["tpu"]
+    # Intel half of the contract: the TS `intel.ts` mirror must classify
+    # the same cluster identically (`plugin/src/api/intel.test.ts`).
+    iview = views["intel"]
     return {
         "slices": out_slices,
         "summary": dict(summarize_slices(slices)),
@@ -119,6 +123,21 @@ def expected_for(fleet: dict) -> dict:
             objects.name(p)
             for p in tpu.filter_tpu_plugin_pods(fleet.get("pods", []))
         ],
+        "intel": {
+            "node_names": [objects.name(n) for n in iview.nodes],
+            "node_types": {
+                objects.name(n): intel.get_node_gpu_type(n) for n in iview.nodes
+            },
+            "node_device_counts": {
+                objects.name(n): intel.get_node_gpu_count(n) for n in iview.nodes
+            },
+            "gpu_pod_names": [objects.name(p) for p in iview.pods],
+            "pod_device_requests": {
+                objects.name(p): intel.get_pod_device_request(p) for p in iview.pods
+            },
+            "plugin_pod_names": [objects.name(p) for p in iview.plugin_pods],
+            "allocation": dict(iview.allocation_summary()),
+        },
     }
 
 
