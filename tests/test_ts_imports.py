@@ -1,10 +1,13 @@
-"""Static import/export cross-check for the plugin's TS sources.
+"""Regex-level import/export cross-check for the plugin's TS sources.
 
-The test image has no JS toolchain, so a symbol imported from a module
-that doesn't export it would surface only in CI's tsc run. This suite
-catches that class blind: for every relative `import { X } from './m'`
-in plugin/src, assert module m exports X. Headlamp/react imports are
-out of scope (resolved by CI against the real packages).
+The fast first line of defense: for every relative `import { X } from
+'./m'` in plugin/src, assert module m exports X. The materially
+stronger gate is `tests/test_ts_static.py` (tools/ts_static_check.py —
+a real lexer + JSX parser covering termination, balance, JSX trees,
+prop contracts, and the import graph); this suite stays as an
+independent implementation of the import half, so a bug in either
+checker can't silently blind both. `plugin/VERIFIED.md` records the
+full verification split with CI.
 """
 
 import os
