@@ -16,6 +16,7 @@ per_node_in_use, max_node_util_pct, hot_nodes.
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 from ..domain import objects, tpu
@@ -116,18 +117,69 @@ def python_fleet_stats(view: FleetView) -> dict[str, Any]:
 XLA_ROLLUP_MIN_NODES = 512
 
 
+#: Consecutive calibrate/XLA failures after which the process stops
+#:  re-attempting device work (mirrors forecast.py's
+#: `_record_pallas_broken` memoization — a persistently broken backend
+#: must not re-pay a failed compile on every at-scale request).
+CALIBRATE_BROKEN_AFTER = 3
+
+#: Probe expiry. A single anomalous probe (tunnel blip, GC pause — the
+#: median-of-3 narrows but cannot eliminate it) must not lock a
+#: suboptimal backend for the process lifetime, and host conditions
+#: drift. One re-probe per window is noise next to its ~600 ms worst
+#: case. Deliberately NOT tied to /refresh: that is the routine header
+#: link on every page, and per-click recalibration would re-pay the
+#: probe constantly.
+CALIBRATION_TTL_S = 15 * 60.0
+
+
 class _Calibration:
-    """Once-per-process rollup timings: one warm-up + timed XLA probe
-    and a timed Python run at scale, then every later at-scale request
-    picks the measured winner. Plain attribute writes (GIL-atomic);
-    worst case under a race is one redundant probe."""
+    """Rollup timings, re-probed at most once per ``CALIBRATION_TTL_S``:
+    one warm-up + timed XLA probe and a timed Python run at scale, then
+    every later at-scale request inside the window picks the measured
+    winner. Plain attribute writes (GIL-atomic); worst case under a
+    race is one redundant probe.
+
+    Failure memoization: a host where jax imports but the backend is
+    persistently broken would otherwise re-enter the probe (and re-pay
+    the failed compile/dispatch) on EVERY at-scale request. After
+    ``CALIBRATE_BROKEN_AFTER`` consecutive failures the last reason is
+    pinned, ``chosen_backend`` answers "python" without touching the
+    device, and /healthz surfaces the reason. ``clear_broken()`` (wired
+    to the operator's /refresh lever) unpins it, forcing a fresh probe;
+    a pinned broken state never expires by TTL (retrying a dead backend
+    on a schedule is how the repeated-failure cost comes back)."""
 
     def __init__(self) -> None:
         self.xla_ms: float | None = None
         self.python_ms_per_node: float | None = None
+        self.calibrated_at: float | None = None
+        self.consecutive_failures = 0
+        self.broken_reason: str | None = None
 
     def reset(self) -> None:
         self.__init__()
+
+    def clear_broken(self) -> None:
+        """Unpin a memoized broken backend (and its failure streak) so
+        the next at-scale request re-probes. Measured timings survive —
+        clearing them belongs to the TTL, not the routine refresh."""
+        self.consecutive_failures = 0
+        self.broken_reason = None
+
+    def expired(self, now: float) -> bool:
+        return (
+            self.calibrated_at is not None
+            and now - self.calibrated_at > CALIBRATION_TTL_S
+        )
+
+    def record_failure(self, reason: str) -> None:
+        self.consecutive_failures += 1
+        if self.consecutive_failures >= CALIBRATE_BROKEN_AFTER and self.broken_reason is None:
+            self.broken_reason = reason
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
 
     def predicted_python_ms(self, n_nodes: int) -> float | None:
         if self.python_ms_per_node is None:
@@ -145,7 +197,9 @@ def chosen_backend(n_nodes: int) -> str:
     never leave callers guessing which path their numbers exercised."""
     if n_nodes < XLA_ROLLUP_MIN_NODES:
         return "python"
-    if calibration.xla_ms is None:
+    if calibration.broken_reason is not None:
+        return "python"
+    if calibration.xla_ms is None or calibration.expired(time.monotonic()):
         return "calibrating"
     predicted = calibration.predicted_python_ms(n_nodes)
     if predicted is not None and predicted < calibration.xla_ms:
@@ -186,11 +240,15 @@ def fleet_stats(view: FleetView, *, backend: str | None = None) -> dict[str, Any
     try:
         choice = chosen_backend(len(view.nodes))
         if choice == "calibrating":
-            return _calibrate(view)
+            stats = _calibrate(view)
+            calibration.record_success()
+            return stats
         if choice == "xla":
-            return _xla_stats(view)
-    except Exception:  # noqa: BLE001 — degraded, never broken
-        pass
+            stats = _xla_stats(view)
+            calibration.record_success()
+            return stats
+    except Exception as exc:  # noqa: BLE001 — degraded, never broken
+        calibration.record_failure(f"{type(exc).__name__}: {exc}"[:200])
     return python_fleet_stats(view)
 
 
@@ -207,7 +265,6 @@ def _calibrate(view: FleetView) -> dict[str, Any]:
     request path; inline-sync servers pay it on the first at-scale page
     view."""
     import statistics
-    import time
 
     def timed(fn) -> float:
         samples = []
@@ -221,6 +278,7 @@ def _calibrate(view: FleetView) -> dict[str, Any]:
     calibration.xla_ms = timed(lambda: _xla_stats(view))
     python_ms = timed(lambda: python_fleet_stats(view))
     calibration.python_ms_per_node = python_ms / max(1, len(view.nodes))
+    calibration.calibrated_at = time.monotonic()
     return stats
 
 

@@ -540,6 +540,26 @@ class AcceleratorDataContext:
         self._cached_snapshot = None
         return self.snapshot()
 
+    def close(self) -> None:
+        """Release the reactive-track worker thread. The single server
+        context lives for the process, but bulk context creation (tests,
+        embedding) would otherwise pin one idle thread per context until
+        GC. Idempotent; a closed context can still sync (the pool is
+        recreated lazily)."""
+        pool = getattr(self, "_reactive_pool", None)
+        if pool is not None:
+            self._reactive_pool = None
+            pool.shutdown(wait=False)
+
+    def __enter__(self) -> "AcceleratorDataContext":
+        return self
+
+    def __exit__(self, *_exc: Any) -> None:
+        self.close()
+
+    def __del__(self) -> None:  # pragma: no cover — GC-timing dependent
+        self.close()
+
     def snapshot(self) -> ClusterSnapshot:
         """The current snapshot. Built once per sync/refresh and cached —
         the ``useMemo`` discipline (`:200-208,228-251`): N page reads

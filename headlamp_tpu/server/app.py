@@ -67,10 +67,30 @@ def _analytics_health() -> dict[str, Any]:
                 else None
             ),
             "floor_nodes": XLA_ROLLUP_MIN_NODES,
+            # Memoized backend breakage: non-null means at-scale
+            # requests serve Python WITHOUT re-attempting device work
+            # (N consecutive failures pinned this reason); /refresh
+            # clears it and forces a fresh probe.
+            "broken_reason": calibration.broken_reason,
         }
         return cal
     except Exception:  # noqa: BLE001 — health must never 500 on analytics
         return {"calibrated": False}
+
+
+def _unpin_calibration() -> None:
+    """Operator recovery lever: /refresh unpins a memoized
+    broken-backend state so the next at-scale request re-probes.
+    Deliberately does NOT drop measured timings — /refresh is the
+    routine header link on every page, and per-click recalibration
+    would re-pay the ~600 ms probe constantly; stale timings expire via
+    CALIBRATION_TTL_S instead. Import-guarded like _analytics_health."""
+    try:
+        from ..analytics.stats import calibration
+
+        calibration.clear_broken()
+    except Exception:  # noqa: BLE001 — refresh must never 500 on analytics
+        pass
 
 
 class DashboardApp:
@@ -489,6 +509,7 @@ class DashboardApp:
             # across multi-second fetches/fits, and the redirect must
             # return immediately.
             self._cache_epoch += 1
+            _unpin_calibration()
             back = parse_qs(parsed.query).get("back", ["/tpu"])[0]
             # Only registered route paths and strictly-shaped native
             # detail paths may be redirect targets: kills open redirects

@@ -366,6 +366,97 @@ class TestServingPathStats:
         finally:
             st.calibration.reset()
 
+    def test_persistent_backend_failure_is_memoized(self):
+        # ADVICE r3: a host where jax imports but the backend is broken
+        # must not re-pay the failed compile/dispatch on every at-scale
+        # request. After CALIBRATE_BROKEN_AFTER consecutive failures the
+        # reason pins, chosen_backend answers python without device
+        # work, and reset() (the /refresh lever) clears it.
+        from headlamp_tpu.analytics import stats as st
+
+        large = tpu_view(fx.fleet_large(1024))
+        st.calibration.reset()
+        attempts = []
+
+        def broken(_view):
+            attempts.append(1)
+            raise RuntimeError("backend exploded")
+
+        original = st._calibrate
+        st._calibrate = broken
+        try:
+            for _ in range(st.CALIBRATE_BROKEN_AFTER):
+                out = st.fleet_stats(large)  # degrades to python each time
+                assert out["nodes_total"] == len(large.nodes)
+            assert len(attempts) == st.CALIBRATE_BROKEN_AFTER
+            assert st.calibration.broken_reason is not None
+            assert "backend exploded" in st.calibration.broken_reason
+            assert st.chosen_backend(len(large.nodes)) == "python"
+
+            # Memoized: further at-scale requests never re-enter the probe.
+            st.fleet_stats(large)
+            st.fleet_stats(large)
+            assert len(attempts) == st.CALIBRATE_BROKEN_AFTER
+
+            # The operator lever forces a fresh probe.
+            st.calibration.reset()
+            assert st.calibration.broken_reason is None
+            st.fleet_stats(large)
+            assert len(attempts) == st.CALIBRATE_BROKEN_AFTER + 1
+        finally:
+            st._calibrate = original
+            st.calibration.reset()
+
+    def test_transient_failure_does_not_pin_broken(self):
+        from headlamp_tpu.analytics import stats as st
+
+        large = tpu_view(fx.fleet_large(1024))
+        st.calibration.reset()
+        fail_once = [True]
+        original = st._calibrate
+
+        def flaky(view):
+            if fail_once[0]:
+                fail_once[0] = False
+                raise RuntimeError("tunnel blip")
+            return original(view)
+
+        st._calibrate = flaky
+        try:
+            st.fleet_stats(large)  # blip → python fallback, 1 failure
+            assert st.calibration.consecutive_failures == 1
+            st.fleet_stats(large)  # probe succeeds → counter clears
+            assert st.calibration.consecutive_failures == 0
+            assert st.calibration.broken_reason is None
+        finally:
+            st._calibrate = original
+            st.calibration.reset()
+
+    def test_calibration_expires_by_ttl(self):
+        # A single anomalous probe must not lock the choice for the
+        # process lifetime: past CALIBRATION_TTL_S the next at-scale
+        # request re-probes.
+        from headlamp_tpu.analytics import stats as st
+
+        st.calibration.reset()
+        try:
+            st.calibration.xla_ms = 1.0
+            st.calibration.python_ms_per_node = 1.0
+            st.calibration.calibrated_at = 1000.0
+            original_monotonic = st.time.monotonic
+            st.time.monotonic = lambda: 1000.0 + st.CALIBRATION_TTL_S - 1
+            try:
+                assert st.chosen_backend(1024) == "xla"  # fresh: winner
+            finally:
+                st.time.monotonic = original_monotonic
+            st.time.monotonic = lambda: 1000.0 + st.CALIBRATION_TTL_S + 1
+            try:
+                assert st.chosen_backend(1024) == "calibrating"  # stale
+            finally:
+                st.time.monotonic = original_monotonic
+        finally:
+            st.calibration.reset()
+
     def test_future_generation_preserved_not_bucketed(self):
         # A future accelerator label must surface as its inferred
         # generation ("v7x" → "TPU v7x" in the UI), not collapse to

@@ -345,3 +345,26 @@ class TestPagination:
         ]
         assert pod_calls and all("fieldSelector=" in c for c in pod_calls)
         assert "status.phase" in pod_calls[0]
+
+
+class TestLifecycle:
+    def test_close_releases_the_reactive_worker(self):
+        # ADVICE r3: bulk context creation (tests, embedding) must not
+        # pin one idle thread per context until GC.
+        ctx = AcceleratorDataContext(make_transport())
+        ctx.sync()  # spawns the persistent reactive worker
+        pool = getattr(ctx, "_reactive_pool", None)
+        assert pool is not None
+        ctx.close()
+        assert getattr(ctx, "_reactive_pool", None) is None
+        # Idempotent, and a closed context can still sync (lazy respawn).
+        ctx.close()
+        snap = ctx.sync()
+        assert snap.provider("tpu").nodes
+        ctx.close()
+
+    def test_context_manager_closes(self):
+        with AcceleratorDataContext(make_transport()) as ctx:
+            ctx.sync()
+            assert getattr(ctx, "_reactive_pool", None) is not None
+        assert getattr(ctx, "_reactive_pool", None) is None

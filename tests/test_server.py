@@ -80,6 +80,7 @@ class TestHandle:
                 "xla_ms": None,
                 "python_ms_per_node": None,
                 "floor_nodes": st.XLA_ROLLUP_MIN_NODES,
+                "broken_reason": None,
             }
             st.calibration.xla_ms = 151.234
             st.calibration.python_ms_per_node = 0.0123456
@@ -269,6 +270,44 @@ class TestCaching:
         app.handle("/refresh?back=/tpu/metrics")
         app.handle("/tpu/metrics")  # same clock, but refresh invalidated
         assert self._probe_count(app._transport) == probes + 1
+
+    def test_refresh_unpins_broken_backend_keeps_timings(self):
+        # ADVICE r3 + review: /refresh is the ROUTINE header link, so it
+        # must not drop the measured timings (per-click recalibration
+        # would re-pay the ~600 ms probe constantly) — it only unpins a
+        # memoized broken backend; stale timings expire via the TTL.
+        from headlamp_tpu.analytics import stats as st
+
+        app = DashboardApp(make_demo_transport("v5e4"), min_sync_interval_s=0.0)
+        st.calibration.xla_ms = 42.0
+        st.calibration.python_ms_per_node = 0.01
+        st.calibration.broken_reason = "pinned by a blip"
+        st.calibration.consecutive_failures = 5
+        try:
+            app.handle("/refresh?back=/tpu")
+            assert st.calibration.broken_reason is None
+            assert st.calibration.consecutive_failures == 0
+            assert st.calibration.xla_ms == 42.0  # timings survive
+        finally:
+            st.calibration.reset()
+
+    def test_healthz_surfaces_calibration_broken_reason(self):
+        import json
+
+        from headlamp_tpu.analytics import stats as st
+
+        app = DashboardApp(make_demo_transport("v5e4"), min_sync_interval_s=0.0)
+        st.calibration.broken_reason = "RuntimeError: backend exploded"
+        try:
+            status, _ctype, body = app.handle("/healthz")
+            assert status == 200
+            payload = json.loads(body)
+            assert (
+                payload["analytics"]["broken_reason"]
+                == "RuntimeError: backend exploded"
+            )
+        finally:
+            st.calibration.reset()
 
     def test_forecast_cache_keyed_on_fleet_content(self):
         from types import SimpleNamespace

@@ -190,6 +190,16 @@ def test_undefined_jsx_component_is_caught(tmp_path):
 
 
 def test_unknown_prop_on_mocked_component_is_caught(tmp_path):
+    # The contract is DERIVED from the tree's own mock kit — the mock's
+    # destructured props are the single source of truth.
+    write(
+        tmp_path,
+        "testing/mockCommonComponents.tsx",
+        "import React from 'react';\n"
+        "export function SectionBox({ title, children }: { title?: string; children?: any }) {\n"
+        "  return <section><h2>{title}</h2>{children}</section>;\n"
+        "}\n",
+    )
     write(
         tmp_path,
         "a.tsx",
@@ -199,6 +209,27 @@ def test_unknown_prop_on_mocked_component_is_caught(tmp_path):
     )
     diags = check_tree(str(tmp_path))
     assert any("does not accept prop 'heading'" in d.message for d in diags)
+
+
+def test_mock_kit_prop_additions_admit_themselves(tmp_path):
+    # Adding a prop to the mock must automatically admit it — no
+    # second hand-maintained contract table to forget.
+    write(
+        tmp_path,
+        "testing/mockCommonComponents.tsx",
+        "import React from 'react';\n"
+        "export function SectionBox({ title, subtitle }: { title?: string; subtitle?: string }) {\n"
+        "  return <section><h2>{title}</h2><h3>{subtitle}</h3></section>;\n"
+        "}\n",
+    )
+    write(
+        tmp_path,
+        "a.tsx",
+        "import { SectionBox } from '@kinvolk/headlamp-plugin/lib/CommonComponents';\n"
+        "import React from 'react';\n"
+        "export default function P() { return <SectionBox subtitle=\"x\" key=\"k\" />; }\n",
+    )
+    assert check_tree(str(tmp_path)) == []
 
 
 def test_lowercase_tag_typo_is_caught(tmp_path):

@@ -566,19 +566,54 @@ def _extract_modules(result: ParseResult) -> ModuleInfo:
 # The checks
 # ---------------------------------------------------------------------------
 
-#: Prop contract for the mocked Headlamp CommonComponents — the names
-#: the mock kit (plugin/src/testing/mockCommonComponents.tsx) accepts.
-#: A prop unknown to the mock renders nothing in vitest AND signals a
-#: likely misuse of the real component.
-COMPONENT_PROPS: dict[str, set[str]] = {
-    "Loader": {"title"},
-    "SectionHeader": {"title"},
-    "SectionBox": {"title", "children", "key"},
-    "NameValueTable": {"rows"},
-    "SimpleTable": {"columns", "data", "emptyMessage"},
-    "StatusLabel": {"status", "children"},
-    "PercentageBar": {"data", "total"},
-}
+#: The mock kit IS the prop contract: a prop its components don't
+#: destructure renders nothing in vitest and signals likely misuse of
+#: the real component. The allowed sets are DERIVED from this file's
+#: exported function signatures, so the contract lives in exactly one
+#: place — adding a prop to the mock automatically admits it here.
+MOCK_KIT_RELPATH = os.path.join("testing", "mockCommonComponents.tsx")
+
+#: Props React itself consumes — legal on any component.
+REACT_BUILTIN_PROPS = {"key", "children", "ref"}
+
+
+def derive_component_props(result: ParseResult) -> dict[str, set[str]]:
+    """{ComponentName: destructured prop names} from every
+    `export function Name({ a, b }: …)` in the mock kit's token stream.
+    The first word of each comma-chunk inside the first brace group is
+    the prop name (destructure renames `{a: local}` keep `a`)."""
+    toks = [t for t in result.tokens if t[0] != "comment"]
+    out: dict[str, set[str]] = {}
+    i = 0
+    while i < len(toks) - 3:
+        if (
+            toks[i][1] == "export"
+            and toks[i + 1][1] == "function"
+            and toks[i + 2][0] == "word"
+            and toks[i + 3][1] == "("
+        ):
+            name = toks[i + 2][1]
+            j = i + 4
+            if j < len(toks) and toks[j][1] == "{":
+                props: set[str] = set()
+                depth = 1
+                j += 1
+                chunk_head: str | None = None
+                while j < len(toks) and depth > 0:
+                    kind, value, _ln = toks[j]
+                    if value in "{[(":
+                        depth += 1
+                    elif value in "}])":
+                        depth -= 1
+                    elif depth == 1 and value == ",":
+                        chunk_head = None
+                    elif depth == 1 and kind == "word" and chunk_head is None:
+                        chunk_head = value
+                        props.add(value)
+                    j += 1
+                out[name] = props | REACT_BUILTIN_PROPS
+        i += 1
+    return out
 
 #: Modules resolved outside plugin/src — import targets we accept
 #: without resolving (runtime-provided or test-runner-provided).
@@ -658,6 +693,13 @@ def check_tree(root: str) -> list[Diagnostic]:
                         )
                     )
 
+    # Prop contracts come from the tree's own mock kit (single source);
+    # a tree without one simply gets no contract checks.
+    component_props: dict[str, set[str]] = {}
+    for path, result in parsed.items():
+        if path.endswith(MOCK_KIT_RELPATH) and not result.errors:
+            component_props = derive_component_props(result)
+
     # JSX: component resolution + prop contracts.
     for path, result in parsed.items():
         if result.errors:
@@ -684,7 +726,7 @@ def check_tree(root: str) -> list[Diagnostic]:
                         f"JSX component <{tag.name}> is neither imported nor defined",
                     )
                 )
-            allowed = COMPONENT_PROPS.get(tag.name)
+            allowed = component_props.get(tag.name)
             if allowed is not None:
                 for attr in tag.attrs:
                     if attr == "{...}" or attr.startswith("data-") or attr.startswith("aria-"):
