@@ -361,9 +361,20 @@ class AcceleratorDataContext:
             pool = self._reactive_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="hl-tpu-reactive"
             )
-        nodes_future = pool.submit(self._sync_track, "nodes", NODES_PATH)
-        self._pod_error = self._sync_track("pods", self._pods_path())
-        self._node_error = nodes_future.result()
+        try:
+            nodes_future = pool.submit(self._sync_track, "nodes", NODES_PATH)
+        except RuntimeError:
+            # close() raced this sync and shut the pool down between the
+            # getattr and the submit. A crashed tick would be worse than
+            # a serial one: run both tracks inline this once; the next
+            # sync recreates the pool.
+            nodes_future = None
+        if nodes_future is None:
+            self._node_error = self._sync_track("nodes", NODES_PATH)
+            self._pod_error = self._sync_track("pods", self._pods_path())
+        else:
+            self._pod_error = self._sync_track("pods", self._pods_path())
+            self._node_error = nodes_future.result()
         if self._node_error is None:
             self._all_nodes = list(self._track_store["nodes"].values())
         if self._pod_error is None:

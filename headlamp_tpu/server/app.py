@@ -52,10 +52,23 @@ def _analytics_health() -> dict[str, Any]:
     measured timings behind the choice. Import-guarded — a jax-less
     host serves Python unconditionally and reports just that."""
     try:
+        import time as _time
+
         from ..analytics.stats import XLA_ROLLUP_MIN_NODES, calibration
 
+        now = _time.monotonic()
         cal = {
             "calibrated": calibration.xla_ms is not None,
+            # TTL state: stale timings mean the NEXT at-scale request
+            # re-probes (chosen_backend answers "calibrating") — without
+            # this an operator debugging the re-probe's latency spike
+            # would see a healthy calibrated snapshot.
+            "stale": calibration.expired(now),
+            "age_s": (
+                round(now - calibration.calibrated_at, 1)
+                if calibration.calibrated_at is not None
+                else None
+            ),
             "xla_ms": (
                 round(calibration.xla_ms, 2)
                 if calibration.xla_ms is not None
@@ -69,8 +82,8 @@ def _analytics_health() -> dict[str, Any]:
             "floor_nodes": XLA_ROLLUP_MIN_NODES,
             # Memoized backend breakage: non-null means at-scale
             # requests serve Python WITHOUT re-attempting device work
-            # (N consecutive failures pinned this reason); /refresh
-            # clears it and forces a fresh probe.
+            # (N consecutive failures pinned this reason);
+            # /refresh?recalibrate=1 clears it and forces a fresh probe.
             "broken_reason": calibration.broken_reason,
         }
         return cal
@@ -78,17 +91,19 @@ def _analytics_health() -> dict[str, Any]:
         return {"calibrated": False}
 
 
-def _unpin_calibration() -> None:
-    """Operator recovery lever: /refresh unpins a memoized
-    broken-backend state so the next at-scale request re-probes.
-    Deliberately does NOT drop measured timings — /refresh is the
-    routine header link on every page, and per-click recalibration
-    would re-pay the ~600 ms probe constantly; stale timings expire via
-    CALIBRATION_TTL_S instead. Import-guarded like _analytics_health."""
+def _force_recalibration() -> None:
+    """Operator recovery lever: ``/refresh?recalibrate=1`` drops the
+    rollup timings AND any pinned broken-backend state, so the next
+    at-scale request re-probes. Explicit opt-in only — the bare
+    /refresh is the routine header link on every page, and wiring
+    either reset to it would defeat both the probe amortization (per-
+    click recalibration re-pays ~600 ms) and the broken-backend
+    memoization (every navigation refresh would re-pay the failed
+    compile three more times). Import-guarded like _analytics_health."""
     try:
         from ..analytics.stats import calibration
 
-        calibration.clear_broken()
+        calibration.reset()
     except Exception:  # noqa: BLE001 — refresh must never 500 on analytics
         pass
 
@@ -509,8 +524,10 @@ class DashboardApp:
             # across multi-second fetches/fits, and the redirect must
             # return immediately.
             self._cache_epoch += 1
-            _unpin_calibration()
-            back = parse_qs(parsed.query).get("back", ["/tpu"])[0]
+            query = parse_qs(parsed.query)
+            if query.get("recalibrate", ["0"])[0] in ("1", "true"):
+                _force_recalibration()
+            back = query.get("back", ["/tpu"])[0]
             # Only registered route paths and strictly-shaped native
             # detail paths may be redirect targets: kills open redirects
             # ('//evil', absolute URLs) and header injection (CR/LF) in

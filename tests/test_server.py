@@ -77,6 +77,8 @@ class TestHandle:
             payload = json.loads(app.handle("/healthz")[2])
             assert payload["analytics"] == {
                 "calibrated": False,
+                "stale": False,
+                "age_s": None,
                 "xla_ms": None,
                 "python_ms_per_node": None,
                 "floor_nodes": st.XLA_ROLLUP_MIN_NODES,
@@ -271,11 +273,12 @@ class TestCaching:
         app.handle("/tpu/metrics")  # same clock, but refresh invalidated
         assert self._probe_count(app._transport) == probes + 1
 
-    def test_refresh_unpins_broken_backend_keeps_timings(self):
-        # ADVICE r3 + review: /refresh is the ROUTINE header link, so it
-        # must not drop the measured timings (per-click recalibration
-        # would re-pay the ~600 ms probe constantly) — it only unpins a
-        # memoized broken backend; stale timings expire via the TTL.
+    def test_routine_refresh_leaves_calibration_alone(self):
+        # ADVICE r3 + review: /refresh is the ROUTINE header link on
+        # every page. It must drop NEITHER the measured timings (per-
+        # click recalibration would re-pay the ~600 ms probe) NOR a
+        # pinned broken backend (unpinning per navigation would re-pay
+        # the failed compile three more times per click).
         from headlamp_tpu.analytics import stats as st
 
         app = DashboardApp(make_demo_transport("v5e4"), min_sync_interval_s=0.0)
@@ -285,9 +288,27 @@ class TestCaching:
         st.calibration.consecutive_failures = 5
         try:
             app.handle("/refresh?back=/tpu")
+            assert st.calibration.broken_reason == "pinned by a blip"
+            assert st.calibration.xla_ms == 42.0
+        finally:
+            st.calibration.reset()
+
+    def test_explicit_recalibrate_resets_everything(self):
+        # The operator's recovery lever is the EXPLICIT
+        # /refresh?recalibrate=1 — it drops timings and unpins a broken
+        # backend so the next at-scale request re-probes.
+        from headlamp_tpu.analytics import stats as st
+
+        app = DashboardApp(make_demo_transport("v5e4"), min_sync_interval_s=0.0)
+        st.calibration.xla_ms = 42.0
+        st.calibration.broken_reason = "pinned by a blip"
+        st.calibration.consecutive_failures = 5
+        try:
+            status, _, _ = app.handle("/refresh?back=/tpu&recalibrate=1")
+            assert status in (302, 303)
             assert st.calibration.broken_reason is None
             assert st.calibration.consecutive_failures == 0
-            assert st.calibration.xla_ms == 42.0  # timings survive
+            assert st.calibration.xla_ms is None
         finally:
             st.calibration.reset()
 

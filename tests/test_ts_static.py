@@ -272,3 +272,23 @@ def test_real_tree_mutations_are_caught(tmp_path, mutation, needle):
     target.write_text(mutation(target.read_text()))
     diags = check_tree(str(tree))
     assert any(needle in d.message for d in diags), [str(d) for d in diags]
+
+
+def test_missing_mock_kit_is_loud_when_common_components_used(tmp_path):
+    # Moving/renaming the mock kit (or rewriting it in a style the
+    # deriver can't read) must not silently disable the prop-contract
+    # check — the gate says so instead.
+    write(
+        tmp_path,
+        "a.tsx",
+        "import { SectionBox } from '@kinvolk/headlamp-plugin/lib/CommonComponents';\n"
+        "import React from 'react';\n"
+        "export default function P() { return <SectionBox title=\"x\" />; }\n",
+    )
+    diags = check_tree(str(tmp_path))
+    assert any("prop-misuse check is OFF" in d.message for d in diags)
+
+
+def test_no_common_components_no_mock_kit_is_fine(tmp_path):
+    write(tmp_path, "a.ts", "export const x = 1;\n")
+    assert check_tree(str(tmp_path)) == []

@@ -693,12 +693,34 @@ def check_tree(root: str) -> list[Diagnostic]:
                         )
                     )
 
-    # Prop contracts come from the tree's own mock kit (single source);
-    # a tree without one simply gets no contract checks.
+    # Prop contracts come from the tree's own mock kit (single source).
+    # The weakening must be LOUD: if CommonComponents are imported
+    # anywhere but no contract could be derived (kit moved/renamed, or
+    # rewritten in a style the deriver can't read), that is itself a
+    # diagnostic — otherwise every prop-misuse check would vanish
+    # silently.
     component_props: dict[str, set[str]] = {}
+    mock_kit_path: str | None = None
     for path, result in parsed.items():
         if path.endswith(MOCK_KIT_RELPATH) and not result.errors:
+            mock_kit_path = path
             component_props = derive_component_props(result)
+    uses_common_components = any(
+        any("CommonComponents" in module for module in info.imports)
+        for info in modules.values()
+    )
+    if uses_common_components and not component_props:
+        where = mock_kit_path or os.path.join(root, MOCK_KIT_RELPATH)
+        diagnostics.append(
+            Diagnostic(
+                where,
+                1,
+                "CommonComponents are imported but no prop contract could "
+                f"be derived from {MOCK_KIT_RELPATH} — the prop-misuse "
+                "check is OFF (kit missing, moved, or not written as "
+                "'export function Name({ props }: …)')",
+            )
+        )
 
     # JSX: component resolution + prop contracts.
     for path, result in parsed.items():
