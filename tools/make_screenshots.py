@@ -36,12 +36,19 @@ OUT_DIR = os.path.join(
     "screenshots",
 )
 
-#: (filename, route, viewport height)
+#: (filename, route, viewport height) over the v5p32 demo fleet.
 CAPTURES = [
     ("01-overview.svg", "/tpu", 1180),
     ("02-topology.svg", "/tpu/topology", 1280),
     ("03-metrics.svg", "/tpu/metrics", 1380),
     ("04-node-detail.svg", "/node/gke-v5p-pool-w0", 900),
+]
+
+#: Second provider, captured over the mixed Intel+TPU fleet — the
+#: surface a reference user lands on.
+INTEL_CAPTURES = [
+    ("05-intel-overview.svg", "/intel", 1180),
+    ("06-intel-nodes.svg", "/intel/nodes", 1080),
 ]
 
 WIDTH = 1060
@@ -127,13 +134,19 @@ def main() -> None:
     # values are fixture-deterministic).
     status, _, _ = app.handle("/tpu/metrics")
     assert status == 200
-    for filename, route, height in CAPTURES:
-        status, _, html = app.handle(route)
-        assert status == 200, (route, status)
-        path = os.path.join(OUT_DIR, filename)
-        with open(path, "w", encoding="utf-8") as f:
-            f.write(svg_wrap(extract_capture(html), height))
-        print(f"wrote {path} ({len(html)} bytes of page HTML)")
+    intel_app = DashboardApp(
+        make_demo_transport("mixed"),
+        min_sync_interval_s=0.0,
+        clock=lambda: FIXTURE_NOW_EPOCH,
+    )
+    for source, captures in ((app, CAPTURES), (intel_app, INTEL_CAPTURES)):
+        for filename, route, height in captures:
+            status, _, html = source.handle(route)
+            assert status == 200, (route, status)
+            path = os.path.join(OUT_DIR, filename)
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(svg_wrap(extract_capture(html), height))
+            print(f"wrote {path} ({len(html)} bytes of page HTML)")
 
 
 if __name__ == "__main__":
