@@ -292,3 +292,29 @@ def test_missing_mock_kit_is_loud_when_common_components_used(tmp_path):
 def test_no_common_components_no_mock_kit_is_fine(tmp_path):
     write(tmp_path, "a.ts", "export const x = 1;\n")
     assert check_tree(str(tmp_path)) == []
+
+
+def test_multiline_jsx_attribute_strings_are_legal():
+    # JSX attribute values are HTML-style: a prettier-wrapped string
+    # spanning lines must not read as an unterminated JS string.
+    src = (
+        'const el = (\n'
+        '  <img\n'
+        '    alt="a long description\n'
+        '         wrapped across lines"\n'
+        '    src="x.png"\n'
+        '  />\n'
+        ');\n'
+    )
+    assert errors_of("x.tsx", src) == []
+
+
+def test_jsx_attribute_backslash_is_literal():
+    # JSX attribute strings have NO escape sequences: a trailing
+    # backslash must not swallow the closing quote (tsc accepts this),
+    # and a would-be escaped quote ends the string (tsc rejects the
+    # rest as malformed — so must the gate).
+    ok = 'const el = <img alt="C:\\" src="x.png" />;\n'
+    assert errors_of("x.tsx", ok) == []
+    bad = 'const el = <img alt="a\\" b" />;\n'
+    assert errors_of("x.tsx", bad) != []

@@ -154,15 +154,19 @@ class _Parser:
             else:
                 return
 
-    def scan_string(self, quote: str) -> None:
+    def scan_string(self, quote: str, jsx_attr: bool = False) -> None:
+        """JS string literals process backslash escapes and end at the
+        line. JSX attribute values are HTML-style: NO escape sequences
+        (a backslash is a literal character and must not swallow the
+        closing quote) and they may legally span lines."""
         start = self.line
         body_start = self.pos + 1
         self.advance()
         while self.pos < self.n:
             c = self.peek()
-            if c == "\\":
+            if c == "\\" and not jsx_attr:
                 self.advance(2)
-            elif c == "\n":
+            elif c == "\n" and not jsx_attr:
                 self.error(f"unterminated string (opened with {quote})", start)
                 return
             elif c == quote:
@@ -294,7 +298,7 @@ class _Parser:
             self.skip_ws_and_comments()
             c = self.peek()
             if c in "'\"":
-                self.scan_string(c)
+                self.scan_string(c, jsx_attr=True)
             elif c == "{":
                 self.advance()
                 self.scan_js(stop_at="}")
