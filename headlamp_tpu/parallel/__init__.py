@@ -14,6 +14,7 @@ in tests and by the driver's dryrun.
 """
 
 from .mesh import (
+    alltoall_generation_histogram,
     fleet_mesh,
     ring_allreduce,
     ring_rollup,
@@ -24,6 +25,7 @@ from .mesh import (
 )
 
 __all__ = [
+    "alltoall_generation_histogram",
     "fleet_mesh",
     "ring_allreduce",
     "ring_rollup",

@@ -183,6 +183,62 @@ def ring_rollup(fleet: FleetArrays, mesh: Mesh) -> dict[str, Any]:
     return _rollup_with_reducer(fleet, mesh, "ring")
 
 
+def alltoall_generation_histogram(fleet: FleetArrays, mesh: Mesh) -> "np.ndarray":  # noqa: F821
+    """Generation histogram via ``lax.all_to_all`` bucket regrouping —
+    the MoE-router/expert-parallel communication pattern on fleet data.
+
+    Rows arrive host-sharded (each shard holds a slice of the node
+    columns); generations are the "experts". Each shard builds its
+    LOCAL per-generation partial histogram, splits it into per-owner
+    bucket chunks, and one ``all_to_all`` transposes ownership: shard
+    *b* receives every peer's partials for the buckets it owns, sums
+    them locally (its buckets are now complete), and a tiled
+    ``all_gather`` republishes the full histogram. Communication per
+    shard is one vocab-sized vector each way — the same volume a psum
+    of the full histogram moves, but the reduction lands distributed
+    (each shard finalizes only its own buckets), which is the shape
+    that scales when the bucket space is large.
+
+    Returns the ``[len(GENERATION_IDS)]`` histogram, pinned by tests to
+    both the psum path and the Python oracle."""
+    from ..analytics.encode import GENERATION_IDS
+
+    n_hosts = mesh.shape["hosts"]
+    vocab = len(GENERATION_IDS)
+    # Bucket space padded so every shard owns an equal chunk.
+    vocab_pad = ((vocab + n_hosts - 1) // n_hosts) * n_hosts
+    chunk = vocab_pad // n_hosts
+
+    gen = _pad_to_multiple(jnp.asarray(fleet.node_generation), n_hosts)
+    valid = _pad_to_multiple(jnp.asarray(fleet.node_valid), n_hosts)
+
+    def shard_fn(gen_block, valid_block):
+        # Local partial histogram over the FULL bucket space — the same
+        # segment_sum idiom fleet_jax uses (O(rows), no [rows, vocab]
+        # one-hot materialization).
+        local = jax.ops.segment_sum(
+            (valid_block > 0).astype(jnp.int32), gen_block, num_segments=vocab_pad
+        )  # [vocab_pad]
+        # Regroup: chunk c of my partials belongs to shard c.
+        outgoing = local.reshape(n_hosts, chunk)
+        arrived = jax.lax.all_to_all(
+            outgoing, "hosts", split_axis=0, concat_axis=0
+        )  # [n_hosts, chunk]: every peer's partials for MY buckets
+        mine = arrived.sum(axis=0)  # my buckets, complete
+        return jax.lax.all_gather(mine, "hosts", tiled=True)  # [vocab_pad]
+
+    with mesh:
+        # all_gather-tiled output is replicated-by-construction, which
+        # the static checker can't infer (same as the ring reducer).
+        full = shard_map_unchecked(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P("hosts"), P("hosts")),
+            out_specs=P(),
+        )(gen, valid)
+    return jax.device_get(full)[:vocab]
+
+
 def sharded_make_windows(
     series: jax.Array, window: int, horizon: int, mesh: Mesh
 ) -> tuple[jax.Array, jax.Array, jax.Array]:

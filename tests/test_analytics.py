@@ -154,6 +154,59 @@ class TestRingCollectives:
         assert rolled["per_node_in_use"] == single["per_node_in_use"]
 
 
+class TestAllToAllRegrouping:
+    """lax.all_to_all bucket regrouping — the expert-parallel routing
+    pattern on fleet data: host-sharded rows, generation buckets
+    redistributed so each shard finalizes its own buckets."""
+
+    def test_matches_oracle_and_psum_path(self):
+        import numpy as np
+
+        from headlamp_tpu.analytics.encode import GENERATION_IDS, encode_fleet
+        from headlamp_tpu.domain.accelerator import classify_fleet
+        from headlamp_tpu.parallel import alltoall_generation_histogram, fleet_mesh
+
+        fleet = fx.fleet_large(64)
+        view = classify_fleet(fleet["nodes"], fleet["pods"])["tpu"]
+        arrays = encode_fleet(view.nodes, view.pods)
+        mesh = fleet_mesh(8)
+        hist = np.asarray(alltoall_generation_histogram(arrays, mesh))
+        oracle = np.bincount(
+            np.asarray(arrays.node_generation)[np.asarray(arrays.node_valid) > 0],
+            minlength=len(GENERATION_IDS),
+        )
+        assert np.array_equal(hist, oracle)
+        # Every live node accounted for exactly once after regrouping.
+        assert int(hist.sum()) == arrays.n_nodes
+        # And the psum path (sharded_rollup's vocabulary histogram)
+        # agrees bucket for bucket — two collectives, one answer.
+        from headlamp_tpu.parallel import sharded_rollup
+
+        rolled = sharded_rollup(arrays, mesh)
+        psum_hist = [rolled["generation_counts"].get(g, 0) for g in GENERATION_IDS]
+        assert list(hist) == psum_hist
+
+    def test_uneven_rows_and_empty_shards(self):
+        # 4 nodes over 8 shards: some shards hold only padding; their
+        # all_to_all contributions must be zeros, not phantom counts.
+        import numpy as np
+
+        from headlamp_tpu.analytics.encode import GENERATION_IDS, encode_fleet
+        from headlamp_tpu.domain.accelerator import classify_fleet
+        from headlamp_tpu.parallel import alltoall_generation_histogram, fleet_mesh
+
+        fleet = fx.fleet_v5p32()
+        view = classify_fleet(fleet["nodes"], fleet["pods"])["tpu"]
+        arrays = encode_fleet(view.nodes, view.pods)
+        hist = np.asarray(alltoall_generation_histogram(arrays, fleet_mesh(8)))
+        oracle = np.bincount(
+            np.asarray(arrays.node_generation)[np.asarray(arrays.node_valid) > 0],
+            minlength=len(GENERATION_IDS),
+        )
+        assert np.array_equal(hist, oracle)
+        assert int(hist.sum()) == arrays.n_nodes
+
+
 class TestSequenceParallelWindows:
     """Halo-exchange windowing over a ``seq`` mesh must reproduce
     make_windows exactly on the valid positions — the long-context
