@@ -1,0 +1,77 @@
+/**
+ * Provider-context contracts shared by both hooks: throw outside the
+ * provider (the reference's first context test, SURVEY §4) and
+ * independent provider values on the same mixed cluster.
+ */
+
+import { render, screen } from '@testing-library/react';
+import React from 'react';
+import { describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../testing/mockCommonComponents')
+);
+
+import { loadFixture } from '../testing/fixtures';
+import { setMockCluster } from '../testing/mockHeadlampLib';
+import { IntelDataProvider, useIntelContext } from './IntelDataContext';
+import { TpuDataProvider, useTpuContext } from './TpuDataContext';
+
+describe('hooks outside their provider', () => {
+  it('useTpuContext throws a named error', () => {
+    function Orphan() {
+      useTpuContext();
+      return null;
+    }
+    expect(() => render(<Orphan />)).toThrow(/within a TpuDataProvider/);
+  });
+
+  it('useIntelContext throws a named error', () => {
+    function Orphan() {
+      useIntelContext();
+      return null;
+    }
+    expect(() => render(<Orphan />)).toThrow(/within an IntelDataProvider/);
+  });
+});
+
+describe('both providers over one mixed cluster', () => {
+  it('partition the same lists without cross-contamination', async () => {
+    const { fleet, expected } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+
+    function Probe() {
+      const tpu = useTpuContext();
+      const intel = useIntelContext();
+      if (tpu.loading || intel.loading) return <div data-testid="loader" />;
+      return (
+        <div>
+          <span data-testid="tpu-nodes">{tpu.tpuNodes.length}</span>
+          <span data-testid="intel-nodes">{intel.gpuNodes.length}</span>
+          <span data-testid="tpu-chips">{tpu.stats.capacity}</span>
+          <span data-testid="intel-devices">{intel.allocation.capacity}</span>
+        </div>
+      );
+    }
+
+    render(
+      <TpuDataProvider>
+        <IntelDataProvider>
+          <Probe />
+        </IntelDataProvider>
+      </TpuDataProvider>
+    );
+    const tpuNodes = await screen.findByTestId('tpu-nodes');
+    expect(tpuNodes.textContent).toBe(String(expected.fleet_stats.nodes_total));
+    expect(screen.getByTestId('intel-nodes').textContent).toBe(
+      String((expected.intel as any).node_names.length)
+    );
+    expect(screen.getByTestId('tpu-chips').textContent).toBe(
+      String(expected.fleet_stats.capacity)
+    );
+    expect(screen.getByTestId('intel-devices').textContent).toBe(
+      String((expected.intel as any).allocation.capacity)
+    );
+  });
+});

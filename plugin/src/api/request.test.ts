@@ -1,0 +1,58 @@
+/**
+ * raceDeadline — the per-request budget both provider contexts race
+ * their ApiProxy calls against. Fake-timer tests mirror the
+ * reference's 2 s CRD-timeout case (SURVEY §4: IntelGpuDataContext
+ * fake-timer pattern), plus the timer-disposal contract the rewrite
+ * added (ADVICE r3: no stray timers behind resolved requests).
+ */
+
+import { afterEach, beforeEach, describe, expect, it, vi } from 'vitest';
+import { raceDeadline, REQUEST_TIMEOUT_MS } from './request';
+
+describe('raceDeadline', () => {
+  beforeEach(() => {
+    vi.useFakeTimers();
+  });
+
+  afterEach(() => {
+    vi.useRealTimers();
+  });
+
+  it('passes through a request that settles inside the budget', async () => {
+    const result = raceDeadline(Promise.resolve('fleet'), REQUEST_TIMEOUT_MS);
+    await expect(result).resolves.toBe('fleet');
+  });
+
+  it('propagates the request rejection unchanged', async () => {
+    const result = raceDeadline(Promise.reject(new Error('403')), REQUEST_TIMEOUT_MS);
+    await expect(result).rejects.toThrow('403');
+  });
+
+  it('rejects a hung request once the deadline elapses', async () => {
+    const hung = new Promise(() => {
+      // Never settles — a blackholed apiserver path.
+    });
+    const result = raceDeadline(hung, REQUEST_TIMEOUT_MS);
+    const outcome = expect(result).rejects.toThrow(`deadline of ${REQUEST_TIMEOUT_MS}ms elapsed`);
+    await vi.advanceTimersByTimeAsync(REQUEST_TIMEOUT_MS + 1);
+    await outcome;
+  });
+
+  it('does not fire the deadline just short of the budget', async () => {
+    let settled: string | null = null;
+    const work = new Promise<string>(resolve =>
+      setTimeout(() => resolve('slow-but-ok'), REQUEST_TIMEOUT_MS - 5)
+    );
+    const result = raceDeadline(work, REQUEST_TIMEOUT_MS).then(v => (settled = v));
+    await vi.advanceTimersByTimeAsync(REQUEST_TIMEOUT_MS - 4);
+    await result;
+    expect(settled).toBe('slow-but-ok');
+  });
+
+  it('disposes the deadline timer once the request settles', async () => {
+    await raceDeadline(Promise.resolve('done'), REQUEST_TIMEOUT_MS);
+    // The losing deadline timer must not linger: a page polling every
+    // few seconds would otherwise strand a queue of live 2 s timers.
+    expect(vi.getTimerCount()).toBe(0);
+  });
+});
