@@ -49,7 +49,10 @@ def make_prom_transport(series=None, *, namespace="monitoring", service="prometh
     t = MockTransport()
     prefix = f"/api/v1/namespaces/{namespace}/services/{service}/proxy/api/v1/query"
     t.add_prefix(prefix, vector([]))
-    t.add(proxy_path("1", namespace, service), {"status": "success", "data": {"resultType": "scalar", "result": [0, "1"]}})
+    t.add(
+        proxy_path("1", namespace, service),
+        {"status": "success", "data": {"resultType": "scalar", "result": [0, "1"]}},
+    )
     for promql, samples in (series or {}).items():
         t.add(proxy_path(promql, namespace, service), vector(samples))
     return t

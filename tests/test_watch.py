@@ -109,7 +109,8 @@ class TestIncrementalSync:
         ctx = AcceleratorDataContext(t, watch=True)
         snap = ctx.sync()
         node = dict(snap.all_nodes[0])
-        node["metadata"] = {**node["metadata"], "labels": {**node["metadata"].get("labels", {}), "marker": "yes"}}
+        labels = {**node["metadata"].get("labels", {}), "marker": "yes"}
+        node["metadata"] = {**node["metadata"], "labels": labels}
         node_feed.push("MODIFIED", node)
         snap = ctx.sync()
         updated = [n for n in snap.all_nodes if n["metadata"]["uid"] == node["metadata"]["uid"]]

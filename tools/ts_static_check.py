@@ -558,7 +558,8 @@ def _extract_modules(result: ParseResult) -> ModuleInfo:
                         info.exports.add(toks[k][1])
                 elif nvalue == "default":
                     info.exports.add("default")
-        if kind == "word" and value in ("function", "class", "const", "let", "var", "interface", "enum"):
+        declares = ("function", "class", "const", "let", "var", "interface", "enum")
+        if kind == "word" and value in declares:
             j = i + 1
             if j < len(toks) and toks[j][0] == "word":
                 info.defined.add(toks[j][1])
