@@ -64,6 +64,33 @@ describe('degraded fixture', () => {
     // a size-4 axis → at least one dashed wrap link.
     const dashed = container.querySelectorAll('line[stroke-dasharray]');
     expect(dashed.length).toBeGreaterThan(0);
+    // ICI/DCN framing + per-axis link summary, mirroring the Python
+    // page's wording.
+    expect(screen.getByText(/one ICI domain/)).toBeTruthy();
+    expect(screen.getByText(/^ICI: axis 0: \d+ links/)).toBeTruthy();
+  });
+
+  it('orders slice cards unhealthy-first', async () => {
+    // Merge a healthy v5e slice with the degraded v5p slice: the card
+    // an operator opens the page for must come first regardless of id
+    // order (`pages/topology_page.py:254-260` parity).
+    const healthy = loadFixture('v5e4').fleet;
+    const degraded = loadFixture('v5p32-degraded').fleet;
+    setMockCluster({
+      nodes: [...healthy.nodes, ...degraded.nodes],
+      pods: [...healthy.pods, ...degraded.pods],
+    });
+    mount();
+    await screen.findByText('Slice Summary');
+    // Card titles only — 'Slice Summary' also starts with 'Slice ', so
+    // match the 'Slice <pool-id>' shape of card headings.
+    const cards = screen
+      .getAllByText(/^Slice [a-z0-9]/)
+      .map(el => el.textContent ?? '')
+      .filter(t => t !== 'Slice Summary');
+    expect(cards.length).toBe(2);
+    expect(cards[0]).toContain('v5p'); // degraded slice leads
+    expect(cards[1]).toContain('v5e');
   });
 });
 

@@ -117,6 +117,26 @@ function MeshSvg({
   );
 }
 
+/** Slice-card cap, unhealthy-first — `pages/topology_page.py:209`. */
+const SLICE_CARDS_CAP = 64;
+
+/** 'axis 0: 12 links (torus), axis 1: …' — same wording as the Python
+ * page (`pages/topology_page.py:148-151`). */
+function linkSummary(layout: MeshLayout): string {
+  const axisCounts = new Map<number, number>();
+  const wrapAxes = new Set<number>();
+  // Links are [a, b, axis, wrap] tuples (the shared-fixture wire
+  // format MeshSvg destructures the same way).
+  for (const [, , axis, wrap] of layout.links) {
+    axisCounts.set(axis, (axisCounts.get(axis) ?? 0) + 1);
+    if (wrap) wrapAxes.add(axis);
+  }
+  return [...axisCounts.entries()]
+    .sort(([a], [b]) => a - b)
+    .map(([axis, count]) => `axis ${axis}: ${count} links${wrapAxes.has(axis) ? ' (torus)' : ''}`)
+    .join(', ');
+}
+
 function SliceCard({
   slice,
   utilization,
@@ -125,6 +145,7 @@ function SliceCard({
   utilization: Map<string, number>;
 }) {
   const layout = buildMeshLayout(slice);
+  const links = linkSummary(layout);
   return (
     <SectionBox title={`Slice ${slice.slice_id}`}>
       <NameValueTable
@@ -140,6 +161,9 @@ function SliceCard({
         ]}
       />
       <MeshSvg layout={layout} slice={slice} utilization={utilization} />
+      <p className="hl-mesh-links" style={{ fontSize: '13px' }}>
+        {links ? `ICI: ${links}` : 'ICI topology unknown'}
+      </p>
       <SimpleTable
         columns={[
           { label: 'Worker', getter: (w: any) => w.worker_id },
@@ -180,6 +204,17 @@ export default function TopologyPage() {
     slices.flatMap(s => s.workers.map(w => w.node_name))
   );
 
+  // Unhealthy slices first (the ones an operator opens the page for),
+  // then by id — same ordering + cap as the Python page
+  // (`pages/topology_page.py:254-266`).
+  const orderedSlices = React.useMemo(() => {
+    const rank: Record<string, number> = { error: 0, warning: 1, success: 2 };
+    return [...slices].sort((a, b) => {
+      const d = (rank[a.health] ?? 3) - (rank[b.health] ?? 3);
+      return d !== 0 ? d : a.slice_id < b.slice_id ? -1 : 1;
+    });
+  }, [slices]);
+
   if (loading) {
     return <Loader title="Loading TPU topology" />;
   }
@@ -203,6 +238,11 @@ export default function TopologyPage() {
             { name: 'Total chips', value: sliceSummary.total_chips },
           ]}
         />
+        <p className="hl-hint" style={{ fontSize: '13px' }}>
+          Each slice is one ICI domain — chips inside it talk over the high-bandwidth
+          interconnect drawn below; traffic BETWEEN slices rides the datacenter network (DCN).
+          Schedule collective-heavy workloads within a slice.
+        </p>
       </SectionBox>
       {utilization.size > 0 && (
         <SectionBox title="Live utilization">
@@ -212,9 +252,14 @@ export default function TopologyPage() {
           </p>
         </SectionBox>
       )}
-      {slices.map(s => (
+      {orderedSlices.slice(0, SLICE_CARDS_CAP).map(s => (
         <SliceCard key={s.slice_id} slice={s} utilization={utilization} />
       ))}
+      {orderedSlices.length > SLICE_CARDS_CAP && (
+        <p className="hl-hint">
+          Showing {SLICE_CARDS_CAP} of {orderedSlices.length} slices (unhealthy first).
+        </p>
+      )}
       {slices.length === 0 && (
         <SectionBox title="No slices">
           <p>No TPU slices found — no nodes carry the GKE TPU labels.</p>
