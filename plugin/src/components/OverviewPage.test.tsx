@@ -91,6 +91,16 @@ describe('loaded on the mixed fixture', () => {
       expect(screen.getByText(new RegExp(name))).toBeTruthy();
     }
   });
+
+  it('tables the plugin daemon pods like the Python overview', async () => {
+    const { fleet, expected } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount();
+    const section = (await screen.findByText('Plugin Pods')).closest('section')!;
+    for (const name of expected.plugin_pod_names) {
+      expect(section.textContent).toContain(name);
+    }
+  });
 });
 
 describe('list error', () => {

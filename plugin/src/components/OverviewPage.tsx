@@ -27,6 +27,7 @@ import {
   podNamespace,
   podNodeName,
   podPhase,
+  podRestarts,
 } from '../api/fleet';
 import { useTpuContext } from '../api/TpuDataContext';
 import { PageHeader, phaseStatus } from './common';
@@ -88,6 +89,24 @@ export default function OverviewPage() {
           ]}
         />
       </SectionBox>
+      {pluginPods.length > 0 && (
+        <SectionBox title="Plugin Pods">
+          <SimpleTable
+            columns={[
+              { label: 'Pod', getter: (p: any) => `${podNamespace(p)}/${podName(p)}` },
+              { label: 'Node', getter: (p: any) => podNodeName(p) ?? '—' },
+              {
+                label: 'Phase',
+                getter: (p: any) => (
+                  <StatusLabel status={phaseStatus(podPhase(p))}>{podPhase(p)}</StatusLabel>
+                ),
+              },
+              { label: 'Restarts', getter: (p: any) => podRestarts(p) },
+            ]}
+            data={pluginPods}
+          />
+        </SectionBox>
+      )}
       <SectionBox title="TPU Nodes">
         {stats.nodes_total > 0 && genCounts.length > 0 && (
           <div style={{ marginBottom: '12px' }}>

@@ -39,7 +39,7 @@ describe('loading and empty states', () => {
   it('renders the empty message when nothing requests chips', async () => {
     setMockCluster({ nodes: [], pods: [] });
     mount();
-    await screen.findByText('Phases');
+    await screen.findByText('TPU Workload Summary');
     expect(screen.getByText('No pods request TPU chips')).toBeTruthy();
   });
 });
@@ -49,7 +49,7 @@ describe('loaded on v5p32', () => {
     const { fleet, expected } = loadFixture('v5p32');
     setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
     mount();
-    await screen.findByText('Phases');
+    await screen.findByText('TPU Workload Summary');
     for (const name of expected.tpu_pod_names) {
       expect(screen.getByText(name)).toBeTruthy();
     }
@@ -75,7 +75,7 @@ describe('loaded on v5p32', () => {
     };
     setMockCluster({ nodes: fleet.nodes, pods: [...fleet.pods, pod] });
     mount();
-    await screen.findByText('Phases');
+    await screen.findByText('TPU Workload Summary');
     const row = screen.getByText('two-stage-train').closest('tr')!;
     // Chip-bearing containers get a line each; the chipless sidecar none.
     expect(row.textContent).toContain('trainer');
@@ -114,7 +114,7 @@ describe('pending attention table', () => {
     const { fleet } = loadFixture('v5p32');
     setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
     mount();
-    await screen.findByText('Phases');
+    await screen.findByText('TPU Workload Summary');
     expect(screen.queryByText('Attention: Pending TPU Pods')).toBeNull();
   });
 });
@@ -133,7 +133,7 @@ describe('refresh', () => {
     const { fleet } = loadFixture('v5p32');
     setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
     mount();
-    await screen.findByText('Phases');
+    await screen.findByText('TPU Workload Summary');
     const before = requestLog.length;
     fireEvent.click(screen.getByRole('button', { name: /Refresh TPU Workloads/ }));
     await vi.waitFor(() => expect(requestLog.length).toBeGreaterThan(before));

@@ -15,6 +15,7 @@ import {
 import React from 'react';
 import {
   containerChipBreakdown,
+  formatChipCount,
   getPodChipRequest,
   KubePod,
   podName,
@@ -62,11 +63,15 @@ export default function PodsPage() {
           <StatusLabel status="error">{error}</StatusLabel>
         </SectionBox>
       )}
-      <SectionBox title="Phases">
+      <SectionBox title="TPU Workload Summary">
         <NameValueTable
-          rows={Object.entries(stats.phase_counts)
-            .filter(([phase, count]) => count > 0 || phase !== 'Other')
-            .map(([phase, count]) => ({ name: phase, value: count }))}
+          rows={[
+            { name: 'Total pods', value: tpuPods.length },
+            ...Object.entries(stats.phase_counts)
+              .filter(([phase, count]) => count > 0 || phase !== 'Other')
+              .map(([phase, count]) => ({ name: phase, value: count })),
+            { name: 'Chips in use (Running)', value: formatChipCount(stats.in_use) },
+          ]}
         />
       </SectionBox>
       {pending.length > 0 && (

@@ -238,11 +238,13 @@ export default function TopologyPage() {
             { name: 'Total chips', value: sliceSummary.total_chips },
           ]}
         />
-        <p className="hl-hint" style={{ fontSize: '13px' }}>
-          Each slice is one ICI domain — chips inside it talk over the high-bandwidth
-          interconnect drawn below; traffic BETWEEN slices rides the datacenter network (DCN).
-          Schedule collective-heavy workloads within a slice.
-        </p>
+        {slices.length > 0 && (
+          <p className="hl-hint" style={{ fontSize: '13px' }}>
+            Each slice is one ICI domain — chips inside it talk over the high-bandwidth
+            interconnect drawn below; traffic BETWEEN slices rides the datacenter network
+            (DCN). Schedule collective-heavy workloads within a slice.
+          </p>
+        )}
       </SectionBox>
       {utilization.size > 0 && (
         <SectionBox title="Live utilization">
