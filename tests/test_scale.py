@@ -117,3 +117,66 @@ class TestThousandNodeFleet:
         if not_ready:
             _, _, body = app.handle("/tpu/nodes")
             assert not_ready[0] in body
+
+
+class TestIntelFleetScale:
+    """The Intel provider's pages get the same fleet-scale guards as
+    TPU: card capping, table paging, and a paint budget — on a 600-node
+    Arc fleet built from the canonical per-object builders (only a
+    fleet-LEVEL Intel generator is missing from fixtures.py)."""
+
+    @staticmethod
+    def _arc_fleet(n_nodes: int) -> dict:
+        nodes = [
+            fx.make_intel_node(f"arc-{i:04d}", gpus=2, ready=i % 97 != 0)
+            for i in range(n_nodes)
+        ]
+        pods = [
+            fx.make_intel_pod(
+                f"transcode-{i:04d}",
+                namespace="media",
+                node=f"arc-{i:04d}",
+                gpus=1,
+            )
+            for i in range(0, n_nodes, 3)
+        ]
+        return {"nodes": nodes, "pods": pods, "daemonsets": []}
+
+    def test_intel_pages_paint_under_budget_with_caps(self):
+        fleet = self._arc_fleet(600)
+        app = DashboardApp(fx.fleet_transport(fleet), min_sync_interval_s=0.0)
+        app.handle("/intel")  # warm
+        t0 = time.perf_counter()
+        for path in ("/intel", "/intel/nodes", "/intel/pods"):
+            status, _, body = app.handle(path)
+            assert status == 200 and len(body) > 1000
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 2.0, f"Intel 3-page paint took {elapsed:.2f}s at 600 nodes"
+
+        status, _, body = app.handle("/intel/nodes")
+        text = body.decode() if isinstance(body, (bytes, bytearray)) else body
+        # Detail cards capped not-ready-first; table paged.
+        assert "of 600 node detail cards" in text
+        # Every NotReady node (i % 97 == 0 → 7 of 600) keeps a CARD, not
+        # just a table row: two occurrences each (row + card title) —
+        # a name-ordered cap regression would drop the card while the
+        # independently-ordered table row kept the name present once.
+        for i in range(0, 600, 97):
+            name = f"arc-{i:04d}"
+            assert text.count(name) >= 2, f"{name} lost its detail card"
+        assert "Intel GPU nodes" in text
+
+    def test_intel_nodes_filter_reaches_any_node(self):
+        fleet = self._arc_fleet(600)
+        app = DashboardApp(fx.fleet_transport(fleet), min_sync_interval_s=0.0)
+        # The last node must NOT appear on page 1 of the unfiltered,
+        # capped table (not-ready-first then name ⇒ arc-0599 falls past
+        # the 512-row cap)…
+        status, _, body = app.handle("/intel/nodes")
+        text = body.decode() if isinstance(body, (bytes, bytearray)) else body
+        assert "arc-0599" not in text
+        # …so the ?q= filter is the only way to reach it — and must.
+        status, _, body = app.handle("/intel/nodes?q=arc-0599")
+        text = body.decode() if isinstance(body, (bytes, bytearray)) else body
+        assert status == 200
+        assert "arc-0599" in text
