@@ -252,6 +252,7 @@ def intel_device_plugins_page(snap: ClusterSnapshot, *, now: float) -> Element:
                         ),
                         ("Desired", obj.parse_int(s.get("desiredNumberScheduled"))),
                         ("Ready", obj.parse_int(s.get("numberReady"))),
+                        ("Unavailable", obj.parse_int(s.get("numberUnavailable"))),
                         ("Node selector", selector_text),
                         ("Age", age_cell(plugin, now)),
                     ]

@@ -54,6 +54,7 @@ function PluginCard({ plugin }: { plugin: GpuDevicePlugin }) {
           { name: 'Resource manager', value: spec.resourceManager ? 'yes' : 'no' },
           { name: 'Desired', value: parseIntLenient(status.desiredNumberScheduled) },
           { name: 'Ready', value: parseIntLenient(status.numberReady) },
+          { name: 'Unavailable', value: parseIntLenient(status.numberUnavailable) },
           { name: 'Node selector', value: nodeSelectorText(plugin) },
         ]}
       />
