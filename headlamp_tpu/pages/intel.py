@@ -221,6 +221,8 @@ def intel_device_plugins_page(snap: ClusterSnapshot, *, now: float) -> Element:
     for plugin in state.workloads:
         spec = obj.spec(plugin)
         s = obj.status(plugin)
+        desired = obj.parse_int(s.get("desiredNumberScheduled"))
+        ready = obj.parse_int(s.get("numberReady"))
         selector = spec.get("nodeSelector")
         selector_text = (
             ", ".join(f"{k}={v}" for k, v in sorted(selector.items()))
@@ -250,9 +252,11 @@ def intel_device_plugins_page(snap: ClusterSnapshot, *, now: float) -> Element:
                             "Resource manager",
                             "yes" if spec.get("resourceManager") else "no",
                         ),
-                        ("Desired", obj.parse_int(s.get("desiredNumberScheduled"))),
-                        ("Ready", obj.parse_int(s.get("numberReady"))),
-                        ("Unavailable", obj.parse_int(s.get("numberUnavailable"))),
+                        ("Desired", desired),
+                        ("Ready", ready),
+                        # The CRD status carries no numberUnavailable
+                        # (a DaemonSet-only field) — derive it.
+                        ("Unavailable", max(0, desired - ready)),
                         ("Node selector", selector_text),
                         ("Age", age_cell(plugin, now)),
                     ]

@@ -83,6 +83,10 @@ describe('CRD present', () => {
     expect(screen.getByText('intel/intel-gpu-plugin:0.30.0')).toBeTruthy();
     expect(screen.getByText('balanced')).toBeTruthy();
     expect(screen.getByText('1/2 ready')).toBeTruthy();
+    // Unavailable is DERIVED (desired - ready): the CRD status has no
+    // numberUnavailable field, and a degraded rollout must not show 0.
+    const unavailable = screen.getByText('Unavailable').closest('div')!;
+    expect(unavailable.textContent).toContain('1');
     expect(screen.getByText(/intel.feature.node.kubernetes.io\/gpu=true/)).toBeTruthy();
   });
 });

@@ -35,6 +35,8 @@ function nodeSelectorText(plugin: GpuDevicePlugin): string {
 function PluginCard({ plugin }: { plugin: GpuDevicePlugin }) {
   const spec = plugin?.spec ?? {};
   const status = plugin?.status ?? {};
+  const desired = parseIntLenient(status.desiredNumberScheduled);
+  const ready = parseIntLenient(status.numberReady);
   return (
     <SectionBox title={`GpuDevicePlugin: ${String(plugin?.metadata?.name ?? '')}`}>
       <NameValueTable
@@ -52,9 +54,11 @@ function PluginCard({ plugin }: { plugin: GpuDevicePlugin }) {
           { name: 'Allocation policy', value: String(spec.preferredAllocationPolicy ?? 'none') },
           { name: 'Monitoring', value: spec.enableMonitoring ? 'yes' : 'no' },
           { name: 'Resource manager', value: spec.resourceManager ? 'yes' : 'no' },
-          { name: 'Desired', value: parseIntLenient(status.desiredNumberScheduled) },
-          { name: 'Ready', value: parseIntLenient(status.numberReady) },
-          { name: 'Unavailable', value: parseIntLenient(status.numberUnavailable) },
+          { name: 'Desired', value: desired },
+          { name: 'Ready', value: ready },
+          // The CRD status carries no numberUnavailable (a
+          // DaemonSet-only field) — derive it.
+          { name: 'Unavailable', value: Math.max(0, desired - ready) },
           { name: 'Node selector', value: nodeSelectorText(plugin) },
         ]}
       />

@@ -147,6 +147,19 @@ class TestIntelPages:
         assert "Shared devices 1" in text
         assert "Allocation policy balanced" in text
 
+    def test_device_plugins_degraded_card_derives_unavailable(self):
+        # The CRD status has no numberUnavailable field (DaemonSet-only)
+        # — the card must DERIVE desired - ready, never show 0 on a
+        # degraded rollout.
+        fleet = dict(fx.fleet_mixed())
+        fleet["gpudeviceplugins"] = [fx.make_intel_crd(desired=4, ready=1)]
+        snap = AcceleratorDataContext(fx.fleet_transport(fleet)).sync()
+        text = text_content(intel_device_plugins_page(snap, now=NOW))
+        assert "Desired 4" in text
+        assert "Ready 1" in text
+        assert "Unavailable 3" in text
+        assert "1/4 ready" in text
+
     def test_nodes_page(self):
         el = intel_nodes_page(mixed_snapshot(), now=NOW)
         text = text_content(el)
