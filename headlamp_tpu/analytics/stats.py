@@ -16,6 +16,7 @@ per_node_in_use, max_node_util_pct, hot_nodes.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any
 
@@ -137,35 +138,62 @@ class _Calibration:
     """Rollup timings, re-probed at most once per ``CALIBRATION_TTL_S``:
     one warm-up + timed XLA probe and a timed Python run at scale, then
     every later at-scale request inside the window picks the measured
-    winner. Plain attribute writes (GIL-atomic); worst case under a
-    race is one redundant probe.
+    winner. Data fields are plain attribute writes (GIL-atomic); probe
+    ENTRY is guarded by a non-blocking lock (``try_begin_probe``) so
+    that under ThreadingHTTPServer only ONE request pays the ~600 ms+
+    probe per window — every concurrent at-scale request that loses the
+    race serves the stale measured winner (or, on a first-ever
+    calibration with no measurement, the Python fallback) instead of
+    stacking redundant probes. This matters at TTL expiry, where many
+    in-flight requests can observe ``expired() == True`` in the same
+    instant.
 
     Failure memoization: a host where jax imports but the backend is
     persistently broken would otherwise re-enter the probe (and re-pay
     the failed compile/dispatch) on EVERY at-scale request. After
     ``CALIBRATE_BROKEN_AFTER`` consecutive failures the last reason is
     pinned, ``chosen_backend`` answers "python" without touching the
-    device, and /healthz surfaces the reason. ``clear_broken()`` (wired
-    to the operator's /refresh lever) unpins it, forcing a fresh probe;
-    a pinned broken state never expires by TTL (retrying a dead backend
-    on a schedule is how the repeated-failure cost comes back)."""
+    device, and /healthz surfaces the reason. The operator lever is
+    ``reset()`` (wired to ``/refresh?recalibrate=1`` via the server's
+    ``_force_recalibration``): it calls :meth:`clear_broken` to unpin
+    the memoized failure AND drops the measured timings, forcing a
+    fresh probe on the next at-scale request. A pinned broken state
+    never expires by TTL (retrying a dead backend on a schedule is how
+    the repeated-failure cost comes back)."""
 
     def __init__(self) -> None:
+        # Created once per instance and deliberately NOT recreated by
+        # reset(): a thread mid-probe must release the same lock it
+        # acquired even if an operator resets underneath it.
+        self._probe_lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        """Operator recovery lever (``/refresh?recalibrate=1``): drop
+        measured timings and — via :meth:`clear_broken` — any pinned
+        broken-backend state, so the next at-scale request re-probes."""
         self.xla_ms: float | None = None
         self.python_ms_per_node: float | None = None
         self.calibrated_at: float | None = None
-        self.consecutive_failures = 0
-        self.broken_reason: str | None = None
-
-    def reset(self) -> None:
-        self.__init__()
+        self.clear_broken()
 
     def clear_broken(self) -> None:
         """Unpin a memoized broken backend (and its failure streak) so
         the next at-scale request re-probes. Measured timings survive —
-        clearing them belongs to the TTL, not the routine refresh."""
+        clearing them belongs to the TTL (or the full :meth:`reset`),
+        not to this narrower unpin."""
         self.consecutive_failures = 0
         self.broken_reason = None
+
+    def try_begin_probe(self) -> bool:
+        """Claim the single probe slot (non-blocking). The winner must
+        call :meth:`end_probe` when done; losers serve the stale
+        measured winner (TTL re-probe) or the Python fallback (first
+        calibration) for this request and re-check on their next."""
+        return self._probe_lock.acquire(blocking=False)
+
+    def end_probe(self) -> None:
+        self._probe_lock.release()
 
     def expired(self, now: float) -> bool:
         return (
@@ -240,15 +268,45 @@ def fleet_stats(view: FleetView, *, backend: str | None = None) -> dict[str, Any
     try:
         choice = chosen_backend(len(view.nodes))
         if choice == "calibrating":
-            stats = _calibrate(view)
-            calibration.record_success()
-            return stats
+            if calibration.try_begin_probe():
+                try:
+                    # Double-check under the lock: a probe that finished
+                    # between our chosen_backend read and the acquire
+                    # has already recorded fresh timings — re-probing
+                    # would break the one-probe-per-window guarantee.
+                    if chosen_backend(len(view.nodes)) == "calibrating":
+                        stats = _calibrate(view)
+                        calibration.record_success()
+                        return stats
+                finally:
+                    calibration.end_probe()
+                # Fresh timings exist (someone else probed): fall
+                # through to dispatch on the re-read choice below.
+                choice = chosen_backend(len(view.nodes))
+            else:
+                # Another request is mid-probe (first calibration or a
+                # TTL-expiry re-probe under concurrent load). Never
+                # stack a redundant ~600 ms+ probe; instead serve the
+                # STALE measured winner if one exists (TTL re-probe —
+                # the old measurement is seconds past its window, not
+                # wrong), and only on a first-ever calibration (no
+                # measurement at all) fall through to the Python
+                # fallback below.
+                if calibration.xla_ms is not None:
+                    predicted = calibration.predicted_python_ms(len(view.nodes))
+                    if predicted is None or predicted >= calibration.xla_ms:
+                        stats = _xla_stats(view)
+                        calibration.record_success()
+                        return stats
+                choice = "python"
         if choice == "xla":
             stats = _xla_stats(view)
             calibration.record_success()
             return stats
     except Exception as exc:  # noqa: BLE001 — degraded, never broken
         calibration.record_failure(f"{type(exc).__name__}: {exc}"[:200])
+    # Outside the try: a Python-path error must propagate, not be
+    # memoized as a broken XLA backend by record_failure.
     return python_fleet_stats(view)
 
 
@@ -275,10 +333,18 @@ def _calibrate(view: FleetView) -> dict[str, Any]:
         return statistics.median(samples)
 
     stats = _xla_stats(view)  # warm-up: compile for this fleet-shape bucket
-    calibration.xla_ms = timed(lambda: _xla_stats(view))
+    xla_ms = timed(lambda: _xla_stats(view))
     python_ms = timed(lambda: python_fleet_stats(view))
+    # Publish only after BOTH passes, with xla_ms LAST: mid-probe
+    # losers gate on `xla_ms is not None`, so ordering the writes this
+    # way means no request can ever observe a half-published
+    # calibration (xla_ms set, python_ms_per_node still None) — which
+    # would both misroute first-calibration losers onto the XLA path
+    # and let their dispatches contend with (and inflate) the Python
+    # timing pass above.
     calibration.python_ms_per_node = python_ms / max(1, len(view.nodes))
     calibration.calibrated_at = time.monotonic()
+    calibration.xla_ms = xla_ms
     return stats
 
 

@@ -527,7 +527,10 @@ def intel_metrics_page(metrics: IntelMetricsSnapshot | None) -> Element:
         return h("div", {"class_": "hl-page hl-intel-metrics"}, children)
 
     power_samples = [c.power_watts for c in metrics.chips if c.power_watts is not None]
-    total_tdp = sum(c.tdp_watts or 0 for c in metrics.chips)
+    # Same missing-vs-zero rule as Total power: '—' only when NO chip
+    # carries a TDP sample; a fleet of present-but-zero samples sums to
+    # a real 'Total TDP 0.0 W'.
+    tdp_samples = [c.tdp_watts for c in metrics.chips if c.tdp_watts is not None]
     children.append(
         SectionBox(
             "Power Summary",
@@ -541,7 +544,10 @@ def intel_metrics_page(metrics: IntelMetricsSnapshot | None) -> Element:
                         "Total power",
                         format_watts(sum(power_samples)) if power_samples else "—",
                     ),
-                    ("Total TDP", format_watts(total_tdp) if total_tdp else "—"),
+                    (
+                        "Total TDP",
+                        format_watts(sum(tdp_samples)) if tdp_samples else "—",
+                    ),
                 ]
             ),
             h(
@@ -554,13 +560,17 @@ def intel_metrics_page(metrics: IntelMetricsSnapshot | None) -> Element:
     )
     for c in metrics.chips:
         rows: list[tuple[str, Any]] = [("Power", format_watts(c.power_watts))]
-        if c.tdp_watts:
+        # None means the sample is missing; 0 is a real reading — a
+        # present-but-zero node_hwmon_power_max_watt still gets its TDP
+        # row, and the scrape-history hint is reserved for a genuinely
+        # absent power rate (mirrors IntelMetricsPage.tsx ChipPowerCard).
+        if c.tdp_watts is not None:
             rows.append(("TDP", format_watts(c.tdp_watts)))
-            if c.power_watts is not None:
+            if c.power_watts is not None and c.tdp_watts > 0:
                 rows.append(
                     ("Of TDP", UtilizationBar(round(c.power_watts, 1), round(c.tdp_watts, 1), unit="W"))
                 )
-        else:
+        if c.power_watts is None:
             rows.append(
                 ("Hint", "needs ≥5m of scrape history for rate() to produce data")
             )

@@ -201,7 +201,11 @@ export function countPodPhases(pods: KubePod[]): Record<string, number> {
   };
   for (const p of pods) {
     const phase = podPhase(p);
-    counts[phase in counts ? phase : 'Other'] += 1;
+    // Own-key membership only: `phase in counts` would walk the
+    // prototype chain, so a pod whose status.phase is e.g. 'toString'
+    // would corrupt the histogram and diverge from the Python mirror
+    // (objects.py count_pod_phases uses dict membership).
+    counts[Object.prototype.hasOwnProperty.call(counts, phase) ? phase : 'Other'] += 1;
   }
   return counts;
 }

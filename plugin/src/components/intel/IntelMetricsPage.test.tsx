@@ -91,6 +91,26 @@ describe('reachable with chips', () => {
     expect(container.querySelector('.hl-utilbar-ok')).toBeTruthy();
   });
 
+  it('treats a present-but-zero TDP as a reading, not missing history', async () => {
+    // ADVICE r4: tdp_watts === 0 is a real node_hwmon_power_max_watt
+    // sample — show 'TDP 0.0 W', skip the zero-capacity meter, and do
+    // NOT show the scrape-history hint (power has samples).
+    setMockApiHandler(
+      promHandler({
+        chips: vector([{ labels: { chip: 'platform_i915_0', node: 'arc-node-1' }, value: 1 }]),
+        power: vector([{ labels: { chip: 'platform_i915_0', node: 'arc-node-1' }, value: 8.5 }]),
+        tdp: vector([{ labels: { chip: 'platform_i915_0', node: 'arc-node-1' }, value: 0 }]),
+      })
+    );
+    const { container } = render(<IntelMetricsPage />);
+    await screen.findByText('Power Summary');
+    const card = screen.getByText('arc-node-1 · platform_i915_0').closest('section')!;
+    expect(card.textContent).toContain('TDP');
+    expect(card.textContent).toContain('0.0 W');
+    expect(screen.queryByText(/needs ≥5m of scrape history/)).toBeNull();
+    expect(container.querySelector('.hl-utilbar')).toBeNull(); // no 0-capacity meter
+  });
+
   it('hints instead of asserting zero when power has no samples yet', async () => {
     setMockApiHandler(
       promHandler({

@@ -53,9 +53,13 @@ function ChipPowerCard({ chip }: { chip: GpuChipMetrics }) {
   const rows: Array<{ name: string; value: React.ReactNode }> = [
     { name: 'Power', value: formatWatts(chip.power_watts) },
   ];
-  if (chip.tdp_watts) {
+  // null means the sample is missing; 0 is a real (present) reading,
+  // so the gates below distinguish the two — a present-but-zero
+  // node_hwmon_power_max_watt still gets its TDP row, and the
+  // scrape-history hint is reserved for a genuinely absent power rate.
+  if (chip.tdp_watts !== null) {
     rows.push({ name: 'TDP', value: formatWatts(chip.tdp_watts) });
-    if (chip.power_watts !== null) {
+    if (chip.power_watts !== null && chip.tdp_watts > 0) {
       rows.push({
         name: 'Of TDP',
         value: (
@@ -67,7 +71,8 @@ function ChipPowerCard({ chip }: { chip: GpuChipMetrics }) {
         ),
       });
     }
-  } else {
+  }
+  if (chip.power_watts === null) {
     rows.push({ name: 'Hint', value: 'needs ≥5m of scrape history for rate() to produce data' });
   }
   return (
@@ -137,7 +142,9 @@ export default function IntelMetricsPage() {
   const powerSamples = snapshot.chips
     .map(c => c.power_watts)
     .filter((v): v is number => v !== null);
-  const totalTdp = snapshot.chips.reduce((acc, c) => acc + (c.tdp_watts ?? 0), 0);
+  // Same missing-vs-zero rule as Total power: '—' only when NO chip
+  // carries a TDP sample; present-but-zero samples sum to a real 0.0 W.
+  const tdpSamples = snapshot.chips.map(c => c.tdp_watts).filter((v): v is number => v !== null);
 
   return (
     <>
@@ -156,7 +163,10 @@ export default function IntelMetricsPage() {
                 ? formatWatts(powerSamples.reduce((a, b) => a + b, 0))
                 : '—',
             },
-            { name: 'Total TDP', value: totalTdp ? formatWatts(totalTdp) : '—' },
+            {
+              name: 'Total TDP',
+              value: tdpSamples.length ? formatWatts(tdpSamples.reduce((a, b) => a + b, 0)) : '—',
+            },
           ]}
         />
         <p className="hl-hint">

@@ -396,6 +396,146 @@ class TestServingPathStats:
             st.python_fleet_stats = original
             st.calibration.reset()
 
+    def test_concurrent_requests_never_stack_probes(self):
+        # ADVICE r4: at TTL expiry every in-flight at-scale request can
+        # observe expired()==True in the same instant; only the one that
+        # wins try_begin_probe may pay the ~600ms probe — the rest must
+        # serve the Python fallback for that request.
+        from headlamp_tpu.analytics import stats as st
+
+        large = tpu_view(fx.fleet_large(1024))
+        st.calibration.reset()
+        assert st.calibration.try_begin_probe()  # a probe is in flight
+        try:
+            probes = []
+            original = st._calibrate
+            st._calibrate = lambda view: probes.append(1)
+            try:
+                served = st.fleet_stats(large)  # loses the race
+                assert probes == []  # no second probe entered
+                assert served["nodes_total"] == len(large.nodes)
+                assert st.calibration.xla_ms is None  # fallback, not XLA
+            finally:
+                st._calibrate = original
+        finally:
+            st.calibration.end_probe()
+            st.calibration.reset()
+
+    def test_probe_storm_pays_one_probe(self):
+        # Same property under real threads: N concurrent at-scale
+        # requests while the probe is slow → exactly one _calibrate
+        # entry, and every request still gets a full stats dict.
+        import threading
+        import time as time_mod
+
+        from headlamp_tpu.analytics import stats as st
+
+        large = tpu_view(fx.fleet_large(1024))
+        st.calibration.reset()
+        probes = []
+        original = st._calibrate
+
+        def slow_probe(view):
+            probes.append(1)
+            time_mod.sleep(0.2)  # long enough for every thread to race
+            return original(view)
+
+        st._calibrate = slow_probe
+        results: list[dict] = []
+        try:
+            threads = [
+                threading.Thread(target=lambda: results.append(st.fleet_stats(large)))
+                for _ in range(6)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(probes) == 1
+            assert len(results) == 6
+            assert all(r["nodes_total"] == len(large.nodes) for r in results)
+        finally:
+            st._calibrate = original
+            st.calibration.reset()
+
+    def test_probe_loser_serves_stale_winner_on_ttl_reprobe(self):
+        # A TTL re-probe invalidates the window, not the measurement:
+        # while one request re-probes, losers keep serving the backend
+        # the LAST calibration proved faster instead of downgrading to
+        # the slower Python pass for the whole probe window.
+        import time as time_mod
+
+        from headlamp_tpu.analytics import stats as st
+
+        large = tpu_view(fx.fleet_large(1024))
+        st.calibration.reset()
+        st.calibration.xla_ms = 0.5  # XLA had won the last calibration
+        st.calibration.python_ms_per_node = 1.0
+        st.calibration.calibrated_at = time_mod.monotonic() - (st.CALIBRATION_TTL_S + 1)
+        assert st.chosen_backend(len(large.nodes)) == "calibrating"
+        assert st.calibration.try_begin_probe()  # a re-probe is in flight
+        try:
+            xla_calls = []
+            original = st._xla_stats
+
+            def spying(view):
+                xla_calls.append(1)
+                return original(view)
+
+            st._xla_stats = spying
+            try:
+                served = st.fleet_stats(large)  # loses the probe race
+                assert xla_calls == [1]  # stale winner served, not python
+                assert served["nodes_total"] == len(large.nodes)
+            finally:
+                st._xla_stats = original
+        finally:
+            st.calibration.end_probe()
+            st.calibration.reset()
+
+    def test_loser_python_error_is_not_memoized_as_broken_backend(self):
+        # A Python-path failure while another request holds the probe
+        # lock must propagate — not feed record_failure, which would
+        # eventually pin a Python-side data error as a broken XLA
+        # backend on /healthz.
+        from headlamp_tpu.analytics import stats as st
+
+        large = tpu_view(fx.fleet_large(1024))
+        st.calibration.reset()
+        assert st.calibration.try_begin_probe()
+        try:
+            original = st.python_fleet_stats
+
+            def boom(view):
+                raise RuntimeError("python path data error")
+
+            st.python_fleet_stats = boom
+            try:
+                with pytest.raises(RuntimeError, match="python path data error"):
+                    st.fleet_stats(large)
+                assert st.calibration.consecutive_failures == 0
+                assert st.calibration.broken_reason is None
+            finally:
+                st.python_fleet_stats = original
+        finally:
+            st.calibration.end_probe()
+            st.calibration.reset()
+
+    def test_reset_unpins_broken_state(self):
+        # The operator lever (/refresh?recalibrate=1 → reset) goes
+        # through clear_broken: both the pinned reason and the failure
+        # streak are dropped along with the timings.
+        from headlamp_tpu.analytics import stats as st
+
+        st.calibration.reset()
+        st.calibration.broken_reason = "CompileError: boom"
+        st.calibration.consecutive_failures = 3
+        st.calibration.xla_ms = 12.0
+        st.calibration.reset()
+        assert st.calibration.broken_reason is None
+        assert st.calibration.consecutive_failures == 0
+        assert st.calibration.xla_ms is None
+
     def test_calibration_probe_runs_once(self):
         from headlamp_tpu.analytics import stats as st
 

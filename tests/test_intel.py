@@ -199,6 +199,29 @@ class TestIntelPages:
         assert "needs ≥5m of scrape history" in text
         assert "hl-utilbar" in render_html(el)
 
+    def test_metrics_page_zero_tdp_is_a_reading_not_a_gap(self):
+        # ADVICE r4: a present-but-zero node_hwmon_power_max_watt is a
+        # real sample — the card must show 'TDP 0.0 W', must not draw a
+        # zero-capacity meter, and must not claim the scrape history is
+        # too short (that hint is reserved for a missing power rate).
+        snap = IntelMetricsSnapshot(
+            namespace="monitoring",
+            service="prometheus-k8s:9090",
+            chips=[
+                GpuChipMetrics(node="arc-node-1", chip="card0", power_watts=8.0, tdp_watts=0.0),
+            ],
+            fetch_ms=10.0,
+        )
+        el = intel_metrics_page(snap)
+        text = text_content(el)
+        html = render_html(el)
+        assert "0.0 W" in text  # the TDP reading renders
+        assert "Total TDP" in text  # summary also treats 0 as a sample
+        assert "needs ≥5m of scrape history" not in text
+        assert "hl-chip-card" in html
+        # No zero-capacity Of-TDP meter may render anywhere on the page.
+        assert "hl-utilbar" not in html
+
     def test_metrics_page_unreachable_lists_services(self):
         text = text_content(intel_metrics_page(None))
         assert "Prometheus not reachable" in text
