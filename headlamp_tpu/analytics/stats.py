@@ -172,10 +172,72 @@ class _Calibration:
         """Operator recovery lever (``/refresh?recalibrate=1``): drop
         measured timings and — via :meth:`clear_broken` — any pinned
         broken-backend state, so the next at-scale request re-probes."""
-        self.xla_ms: float | None = None
-        self.python_ms_per_node: float | None = None
-        self.calibrated_at: float | None = None
+        #: (xla_ms, python_ms_per_node, calibrated_at) — ONE reference,
+        #: swapped atomically by :meth:`publish`, so no concurrent
+        #: reader can ever observe a mixed old/new calibration (e.g. a
+        #: re-probe's fresh python timing against the previous window's
+        #: xla timing). The three public names are properties over it.
+        self._measured: tuple[float | None, float | None, float | None] | None = None
         self.clear_broken()
+
+    def publish(
+        self,
+        *,
+        xla_ms: float,
+        python_ms_per_node: float,
+        calibrated_at: float,
+    ) -> None:
+        """Publish a complete measurement in one atomic swap."""
+        self._measured = (xla_ms, python_ms_per_node, calibrated_at)
+
+    @property
+    def xla_ms(self) -> float | None:
+        m = self._measured
+        return m[0] if m else None
+
+    @xla_ms.setter
+    def xla_ms(self, v: float | None) -> None:
+        # Tests/benches pin fields one at a time; each write rebuilds
+        # the tuple so concurrent readers still see one reference.
+        m = self._measured or (None, None, None)
+        self._measured = (v, m[1], m[2])
+
+    @property
+    def python_ms_per_node(self) -> float | None:
+        m = self._measured
+        return m[1] if m else None
+
+    @python_ms_per_node.setter
+    def python_ms_per_node(self, v: float | None) -> None:
+        m = self._measured or (None, None, None)
+        self._measured = (m[0], v, m[2])
+
+    @property
+    def calibrated_at(self) -> float | None:
+        m = self._measured
+        return m[2] if m else None
+
+    @calibrated_at.setter
+    def calibrated_at(self, v: float | None) -> None:
+        m = self._measured or (None, None, None)
+        self._measured = (m[0], m[1], v)
+
+    def measured_winner(self, n_nodes: int) -> str | None:
+        """The backend the last PUBLISHED measurement picks for an
+        ``n_nodes`` fleet — "xla" or "python" — or ``None`` when no
+        measurement exists. Reads the tuple once, so the comparison is
+        always against one coherent calibration. Deliberately ignores
+        the TTL: callers decide whether staleness matters (a mid-probe
+        loser serves the stale winner; :func:`chosen_backend` re-probes
+        instead)."""
+        m = self._measured
+        if m is None or m[0] is None:
+            return None
+        xla_ms, per_node, _ = m
+        predicted = per_node * n_nodes if per_node is not None else None
+        if predicted is not None and predicted < xla_ms:
+            return "python"
+        return "xla"
 
     def clear_broken(self) -> None:
         """Unpin a memoized broken backend (and its failure streak) so
@@ -209,11 +271,6 @@ class _Calibration:
     def record_success(self) -> None:
         self.consecutive_failures = 0
 
-    def predicted_python_ms(self, n_nodes: int) -> float | None:
-        if self.python_ms_per_node is None:
-            return None
-        return self.python_ms_per_node * n_nodes
-
 
 calibration = _Calibration()
 
@@ -227,12 +284,10 @@ def chosen_backend(n_nodes: int) -> str:
         return "python"
     if calibration.broken_reason is not None:
         return "python"
-    if calibration.xla_ms is None or calibration.expired(time.monotonic()):
+    winner = calibration.measured_winner(n_nodes)
+    if winner is None or calibration.expired(time.monotonic()):
         return "calibrating"
-    predicted = calibration.predicted_python_ms(n_nodes)
-    if predicted is not None and predicted < calibration.xla_ms:
-        return "python"
-    return "xla"
+    return winner
 
 
 def fleet_stats(view: FleetView, *, backend: str | None = None) -> dict[str, Any]:
@@ -289,15 +344,13 @@ def fleet_stats(view: FleetView, *, backend: str | None = None) -> dict[str, Any
                 # stack a redundant ~600 ms+ probe; instead serve the
                 # STALE measured winner if one exists (TTL re-probe —
                 # the old measurement is seconds past its window, not
-                # wrong), and only on a first-ever calibration (no
-                # measurement at all) fall through to the Python
-                # fallback below.
-                if calibration.xla_ms is not None:
-                    predicted = calibration.predicted_python_ms(len(view.nodes))
-                    if predicted is None or predicted >= calibration.xla_ms:
-                        stats = _xla_stats(view)
-                        calibration.record_success()
-                        return stats
+                # wrong; same policy code as chosen_backend), and only
+                # on a first-ever calibration (no measurement at all)
+                # fall through to the Python fallback below.
+                if calibration.measured_winner(len(view.nodes)) == "xla":
+                    stats = _xla_stats(view)
+                    calibration.record_success()
+                    return stats
                 choice = "python"
         if choice == "xla":
             stats = _xla_stats(view)
@@ -335,16 +388,17 @@ def _calibrate(view: FleetView) -> dict[str, Any]:
     stats = _xla_stats(view)  # warm-up: compile for this fleet-shape bucket
     xla_ms = timed(lambda: _xla_stats(view))
     python_ms = timed(lambda: python_fleet_stats(view))
-    # Publish only after BOTH passes, with xla_ms LAST: mid-probe
-    # losers gate on `xla_ms is not None`, so ordering the writes this
-    # way means no request can ever observe a half-published
-    # calibration (xla_ms set, python_ms_per_node still None) — which
-    # would both misroute first-calibration losers onto the XLA path
-    # and let their dispatches contend with (and inflate) the Python
-    # timing pass above.
-    calibration.python_ms_per_node = python_ms / max(1, len(view.nodes))
-    calibration.calibrated_at = time.monotonic()
-    calibration.xla_ms = xla_ms
+    # One atomic publish after BOTH passes: no concurrent reader can
+    # observe a half-published calibration (which would misroute
+    # first-calibration losers onto the XLA path and let their
+    # dispatches contend with — and inflate — the Python timing pass
+    # above) or, on a re-probe, a mix of new python and old xla
+    # timings.
+    calibration.publish(
+        xla_ms=xla_ms,
+        python_ms_per_node=python_ms / max(1, len(view.nodes)),
+        calibrated_at=time.monotonic(),
+    )
     return stats
 
 
