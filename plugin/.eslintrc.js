@@ -1,0 +1,23 @@
+// Lint tier for the loadable plugin, mirroring the reference's gate
+// (reference .eslintrc.js + package.json:20-23): the shared Headlamp
+// plugin config plus an explicit react-hooks escalation.
+//
+// Why react-hooks is pinned to 'error' here rather than inherited:
+// both data contexts (TpuDataContext, IntelDataContext) and six pages
+// lean on useEffect/useMemo dependency arrays for their cancellation
+// and refresh semantics — a wrong deps array is a real correctness
+// bug (stale snapshot served after refresh), not a style issue, and
+// it is exactly the class the in-repo static gate
+// (tools/ts_static_check.py) documents as out of scope.
+module.exports = {
+  root: true,
+  extends: ['@headlamp-k8s/eslint-config'],
+  rules: {
+    // Prettier owns layout; the shared config's indent rule fights
+    // Prettier's JSX ternary formatting (same exclusion the
+    // reference makes).
+    indent: 'off',
+    'react-hooks/rules-of-hooks': 'error',
+    'react-hooks/exhaustive-deps': 'error',
+  },
+};

@@ -1,0 +1,17 @@
+// Format tier for the loadable plugin (reference package.json:22-23
+// gates `prettier --check src/` pre-merge). The reference requires the
+// shared @headlamp-k8s prettier config; here the options are written
+// out explicitly so the style contract is visible in-repo and the
+// local mechanical checks (tools/ts_static_check.py style pass) can
+// mirror the enforceable subset without a JS runtime.
+module.exports = {
+  printWidth: 100,
+  tabWidth: 2,
+  semi: true,
+  singleQuote: true,
+  jsxSingleQuote: false,
+  trailingComma: 'es5',
+  bracketSpacing: true,
+  arrowParens: 'avoid',
+  endOfLine: 'lf',
+};

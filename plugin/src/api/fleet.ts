@@ -116,7 +116,10 @@ export function podNodeName(pod: KubePod): string | null {
   return n ? String(n) : null;
 }
 
-function containerList(pod: KubePod, key: 'containers' | 'initContainers'): Array<Record<string, any>> {
+function containerList(
+  pod: KubePod,
+  key: 'containers' | 'initContainers'
+): Array<Record<string, any>> {
   const items = asRecord(pod?.spec)[key];
   if (!Array.isArray(items)) return [];
   return items.filter(c => c && typeof c === 'object');
@@ -155,7 +158,10 @@ export function getPodChipRequest(pod: KubePod): number {
     return parseIntLenient(req !== undefined ? req : containerLimits(c)[TPU_RESOURCE]);
   };
   const mainSum = containerList(pod, 'containers').reduce((acc, c) => acc + chipReq(c), 0);
-  const initMax = containerList(pod, 'initContainers').reduce((acc, c) => Math.max(acc, chipReq(c)), 0);
+  const initMax = containerList(pod, 'initContainers').reduce(
+    (acc, c) => Math.max(acc, chipReq(c)),
+    0
+  );
   return Math.max(mainSum, initMax);
 }
 

@@ -49,7 +49,10 @@ function allocatableOf(node: KubeNode): Record<string, any> {
   return a && typeof a === 'object' ? a : {};
 }
 
-function containersOf(pod: KubePod, key: 'containers' | 'initContainers'): Array<Record<string, any>> {
+function containersOf(
+  pod: KubePod,
+  key: 'containers' | 'initContainers'
+): Array<Record<string, any>> {
   const items = pod?.spec?.[key];
   return Array.isArray(items) ? items.filter(c => c && typeof c === 'object') : [];
 }

@@ -141,7 +141,10 @@ function stripPort(instance: string): string {
   return instance.includes(':') ? instance.slice(0, instance.lastIndexOf(':')) : instance;
 }
 
-export function nodeOf(labels: Record<string, string>, instanceMap: Record<string, string>): string {
+export function nodeOf(
+  labels: Record<string, string>,
+  instanceMap: Record<string, string>
+): string {
   for (const key of NODE_LABELS) {
     if (labels[key]) return String(labels[key]);
   }

@@ -115,8 +115,10 @@ export default function DevicePluginsPage() {
         <SectionBox title={sourceAvailable ? 'Not installed' : 'DaemonSet not readable'}>
           <p>
             {sourceAvailable
-              ? 'No TPU device-plugin DaemonSet found. On GKE, TPU node pools deploy it automatically; elsewhere install the tpu-device-plugin DaemonSet.'
-              : 'DaemonSet lists could not be read (RBAC may forbid them) — the plugin may still be installed; daemon pods below are discovered independently.'}
+              ? 'No TPU device-plugin DaemonSet found. On GKE, TPU node pools deploy it ' +
+                'automatically; elsewhere install the tpu-device-plugin DaemonSet.'
+              : 'DaemonSet lists could not be read (RBAC may forbid them) — the plugin may ' +
+                'still be installed; daemon pods below are discovered independently.'}
           </p>
         </SectionBox>
       )}

@@ -133,8 +133,9 @@ export default function MetricsPage() {
           }))}
         />
         <p>
-          TPU series come from the GKE tpu-device-plugin or a libtpu exporter; names vary by
-          exporter version, so each metric resolves through a fallback chain. Scrape→join took{' '}
+          TPU series come from the GKE tpu-device-plugin or a libtpu exporter; names vary
+          by exporter version, so each metric resolves through a fallback chain. Scrape→join
+          took{' '}
           {snapshot.fetchMs} ms via {snapshot.namespace}/{snapshot.service}.
         </p>
       </SectionBox>
@@ -152,7 +153,12 @@ export default function MetricsPage() {
                   ]
                 : []),
               ...(hbmUsed.length
-                ? [{ name: 'Total HBM used', value: formatBytes(hbmUsed.reduce((a, b) => a + b, 0)) }]
+                ? [
+                    {
+                      name: 'Total HBM used',
+                      value: formatBytes(hbmUsed.reduce((a, b) => a + b, 0)),
+                    },
+                  ]
                 : []),
               ...(hbmTotal.length
                 ? [

@@ -66,7 +66,11 @@ export default function NodeDetailSection({ resource }: { resource: { jsonData?:
                   name: 'Slice health',
                   value: (
                     <StatusLabel status={slice.health}>
-                      {slice.health === 'success' ? 'Healthy' : slice.health === 'warning' ? 'Degraded' : 'Incomplete'}
+                      {slice.health === 'success'
+                        ? 'Healthy'
+                        : slice.health === 'warning'
+                          ? 'Degraded'
+                          : 'Incomplete'}
                     </StatusLabel>
                   ),
                 },

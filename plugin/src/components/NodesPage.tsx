@@ -102,7 +102,9 @@ export default function NodesPage() {
             { name: 'Chips in use', value: `${stats.in_use}/${stats.capacity}` },
             {
               name: 'Fleet allocation',
-              value: <UtilizationBar used={stats.in_use} capacity={stats.allocatable} unit="chips" />,
+              value: (
+                <UtilizationBar used={stats.in_use} capacity={stats.allocatable} unit="chips" />
+              ),
             },
           ]}
         />
@@ -112,7 +114,10 @@ export default function NodesPage() {
           columns={[
             { label: 'Node', getter: (n: KubeNode) => nodeName(n) },
             { label: 'Ready', getter: readyLabel },
-            { label: 'Generation', getter: (n: KubeNode) => formatGeneration(getNodeGeneration(n)) },
+            {
+              label: 'Generation',
+              getter: (n: KubeNode) => formatGeneration(getNodeGeneration(n)),
+            },
             { label: 'Topology', getter: (n: KubeNode) => getNodeTopology(n) ?? '—' },
             { label: 'Node pool', getter: (n: KubeNode) => getNodePool(n) ?? '—' },
             {

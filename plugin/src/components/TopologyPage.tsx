@@ -29,7 +29,16 @@ import {
   sliceTotalChips,
 } from '../api/topology';
 
-const WORKER_PALETTE = ['#1f77b4', '#ff7f0e', '#2ca02c', '#d62728', '#9467bd', '#8c564b', '#e377c2', '#7f7f7f'];
+const WORKER_PALETTE = [
+  '#1f77b4',
+  '#ff7f0e',
+  '#2ca02c',
+  '#d62728',
+  '#9467bd',
+  '#8c564b',
+  '#e377c2',
+  '#7f7f7f',
+];
 /** Heat-band fills matching the dashboard server's hl-heat-0..4. */
 const HEAT_PALETTE = ['#e8f0fe', '#aecbfa', '#fde293', '#f6ae6b', '#ee675c'];
 
@@ -109,7 +118,9 @@ function MeshSvg({
             stroke={util !== undefined ? workerColor : 'none'}
             strokeWidth={util !== undefined ? 2 : 0}
           >
-            <title>{`chip ${chipIndex} · worker ${workerId} · (${coord.join(', ')})${utilText}`}</title>
+            <title>
+              {`chip ${chipIndex} · worker ${workerId} · (${coord.join(', ')})${utilText}`}
+            </title>
           </circle>
         );
       })}

@@ -71,7 +71,9 @@ export function UtilizationBar({
           width: '72px',
           height: '7px',
           borderRadius: '3.5px',
-          background: `linear-gradient(to right, ${METER_COLORS[level]} ${pct.toFixed(1)}%, #e0e0e0 ${pct.toFixed(1)}%)`,
+          background:
+            `linear-gradient(to right, ${METER_COLORS[level]} ${pct.toFixed(1)}%, ` +
+            `#e0e0e0 ${pct.toFixed(1)}%)`,
         }}
       />
       <span className="hl-utilbar-label" style={{ fontSize: '12px' }}>

@@ -14,10 +14,30 @@ catches the failure classes a broken edit actually produces:
   * unknown props passed to the Headlamp CommonComponents the suite
     mocks (the mock kit is the contract both sides must agree on),
   * relative imports that resolve to no file,
-  * named imports that the target module does not export.
+  * named imports that the target module does not export,
+  * value-position identifiers with no binding anywhere in the file
+    (imports, const/let/var incl. destructuring, function/arrow/catch
+    params, method shorthand, type names, generics) — the
+    typo'd-variable class, including ternary branches,
+  * imports never referenced again (unused-import),
+  * the mechanically-checkable prettier subset (printWidth 100, no
+    tabs, no trailing whitespace, LF endings, final newline) — with
+    string/template content lines exempt, since prettier never
+    rewraps those (local-fail must imply CI-fail).
 
-What it cannot do — type checking, prop types beyond names, runtime
-behavior — stays CI's job; `plugin/VERIFIED.md` states the split.
+Known identifier-check skips besides lexical scoping (all chosen so
+correct code can never be flagged): words directly before a
+non-ternary `:` (object keys, annotated bindings, computed keys) and
+directly after `as`/`satisfies` (type casts) are not use-checked, and
+`class`/`interface`/`enum` BODIES are skipped wholesale (this tree is
+purely functional React; method-definition syntax would read as calls
+of undefined names — a class-based component loses identifier
+coverage inside its body, and tsc keeps it).
+
+What it cannot do — type checking, prop types beyond names, lexical
+scoping (the identifier table is file-wide by design: it can accept
+what tsc rejects, never the reverse), runtime behavior — stays CI's
+job; `plugin/VERIFIED.md` states the split.
 
 Grammar notes: `<` opens JSX only when the previous significant token
 cannot end an expression (so `a < b`, `useState<KubePod[]>`, and
@@ -95,6 +115,14 @@ class ParseResult:
     tokens: list[tuple[str, str, int]] = field(default_factory=list)  # (kind, value, line)
     jsx_tags: list[JsxTag] = field(default_factory=list)
     errors: list[Diagnostic] = field(default_factory=list)
+    #: lines wholly outside prettier's reach — multi-line string and
+    #: template spans plus comment lines (prettier preserves both
+    #: verbatim) — the style pass must not judge them at all.
+    protected_lines: set[int] = field(default_factory=set)
+    #: line -> total chars of SINGLE-line string contents on it: the
+    #: style width check subtracts these (prettier can rewrap the code
+    #: around a string but never the string itself).
+    string_chars: dict[int, int] = field(default_factory=dict)
 
 
 class _Parser:
@@ -140,6 +168,7 @@ class _Parser:
             if c in " \t\r\n":
                 self.advance()
             elif c == "/" and self.peek(1) == "/":
+                self.result.protected_lines.add(self.line)
                 while self.pos < self.n and self.peek() != "\n":
                     self.advance()
             elif c == "/" and self.peek(1) == "*":
@@ -151,6 +180,7 @@ class _Parser:
                     self.error("unterminated block comment", start)
                     return
                 self.advance(2)
+                self.result.protected_lines.update(range(start, self.line + 1))
             else:
                 return
 
@@ -171,9 +201,13 @@ class _Parser:
                 return
             elif c == quote:
                 # Emit the CONTENT (module specifiers need it downstream).
-                self.result.tokens.append(
-                    ("string", self.src[body_start : self.pos], start)
-                )
+                content = self.src[body_start : self.pos]
+                self.result.tokens.append(("string", content, start))
+                if self.line == start:
+                    chars = self.result.string_chars
+                    chars[start] = chars.get(start, 0) + len(content)
+                else:  # multi-line (JSX attr): fully out of prettier's reach
+                    self.result.protected_lines.update(range(start, self.line + 1))
                 self.prev = "string"
                 self.advance()
                 return
@@ -191,6 +225,7 @@ class _Parser:
             elif c == "`":
                 self.advance()
                 self.emit("string", "`", start)
+                self.result.protected_lines.update(range(start, self.line + 1))
                 return
             elif c == "$" and self.peek(1) == "{":
                 self.advance(2)
@@ -442,6 +477,9 @@ class ModuleInfo:
     exports: set[str] = field(default_factory=set)
     #: names visible at module scope (imports + declarations)
     defined: set[str] = field(default_factory=set)
+    #: local aliases bound by import statements, with the line they
+    #: were bound on — the unused-import check's input.
+    imported_locals: list[tuple[str, int]] = field(default_factory=list)
 
 
 def _brace_entries(
@@ -520,6 +558,7 @@ def _extract_modules(result: ParseResult) -> ModuleInfo:
                 module = toks[j][1]
                 for original, local, line in pending:
                     info.defined.add(local)
+                    info.imported_locals.append((local, line))
                     if original != "*":
                         record_import(module, original, line)
                 i = j + 1
@@ -565,6 +604,553 @@ def _extract_modules(result: ParseResult) -> ModuleInfo:
                 info.defined.add(toks[j][1])
         i += 1
     return info
+
+
+# ---------------------------------------------------------------------------
+# Identifier resolution (VERDICT r4 next-step #3)
+# ---------------------------------------------------------------------------
+#
+# A typo'd identifier inside a JSX expression or effect body was the
+# gate's largest admitted blind spot: component names resolved, plain
+# variables did not. This layer collects every binding a file creates
+# (imports, const/let/var incl. destructuring, function names and
+# params, arrow params — incl. annotated and type-predicate returns —
+# catch params, type/interface/enum/class names, generic type params)
+# into one file-wide table, then checks every value-position word
+# against it. File-wide rather than per-scope on purpose: TS block
+# scoping would reject some code this accepts (use before a sibling
+# scope's binding), but acceptance can never FLAG correct code — the
+# gate stays zero-false-positive, which a half-right scope tree built
+# on a flat token stream could not guarantee. tsc in CI remains the
+# authority on scoping.
+
+_TS_KEYWORDS = frozenset(
+    """
+    abstract any as asserts async await bigint boolean break case catch
+    class const continue debugger declare default delete do else enum
+    export extends false finally for from function get if implements
+    import in infer instanceof interface is keyof let namespace never
+    new null number object of out override private protected public
+    readonly require return satisfies set static string super switch
+    symbol this throw true try type typeof undefined unique unknown var
+    void while with yield
+    """.split()
+)
+
+#: Ambient names tsc accepts without an import in this project's tsx
+#: code: JS builtins, the DOM/test surface the suites touch, and TS
+#: utility types. Deliberately closed — a name missing here that tsc
+#: would accept produces a diagnostic, which is the correct failure
+#: direction for an allowlist (loud, immediately fixable here).
+_AMBIENT = frozenset(
+    """
+    Array ArrayLike Awaited Boolean ConsoleMemory DOMParser Date Error
+    EvalError Exclude Extract Function Infinity Intl Iterable
+    IterableIterator Iterator JSON JSX Map Math NaN NonNullable Number
+    Object Omit Parameters Partial Pick Promise PromiseLike Proxy
+    RangeError Readonly Record Reflect RegExp Required ReturnType Set
+    String Symbol SyntaxError TypeError URIError URL URLSearchParams
+    WeakMap WeakSet arguments atob btoa clearInterval clearTimeout
+    console decodeURIComponent document encodeURIComponent fetch
+    globalThis isFinite isNaN localStorage navigator parseFloat
+    parseInt performance queueMicrotask requestAnimationFrame
+    setInterval setTimeout structuredClone window
+    AbortController AbortSignal Element Event HTMLElement Headers Node
+    Response TextDecoder TextEncoder __dirname __filename process
+    """.split()
+)
+
+_OPEN = {"(": ")", "[": "]", "{": "}"}
+_CLOSE = {")": "(", "]": "[", "}": "{"}
+
+
+class _IdentifierPass:
+    """File-wide binding collection + value-position use check over the
+    lexed token stream (comments and string bodies already out of band,
+    JSX tag/attr names never tokenized — only real code reaches this)."""
+
+    def __init__(self, result: ParseResult, info: ModuleInfo) -> None:
+        self.toks = [t for t in result.tokens if t[0] != "comment"]
+        self.result = result
+        self.info = info
+        self.declared: set[str] = set(info.defined)
+        self.match = self._match_brackets()
+        self.skip = [False] * len(self.toks)  # type zones (no value refs)
+        #: import/export-statement tokens: excluded from BOTH the use
+        #: check and the unused-import usage count (the alias's own
+        #: appearance in its import statement is not a use) — unlike
+        #: type zones, whose tokens DO count as uses (a type-only
+        #: import is a real use, exactly as tsc sees it).
+        self.in_import = [False] * len(self.toks)
+        self.ternary_colons = self._find_ternary_colons()
+
+    def _find_ternary_colons(self) -> set[int]:
+        """Token indices of `:` that close a ternary `?` — those are
+        NOT object keys, so the word before them must be use-checked
+        (`cond ? typoVar : x` was the gate's admitted ternary hole).
+        `x?: T` optional markers and `?.`/`??` (distinct tokens) never
+        open a ternary."""
+        pending: list[int] = []  # bracket depth of each open ternary '?'
+        out: set[int] = set()
+        depth = 0
+        for i, (kind, value, _ln) in enumerate(self.toks):
+            if kind != "punct":
+                continue
+            if value in _OPEN:
+                depth += 1
+            elif value in _CLOSE:
+                depth -= 1
+                while pending and pending[-1] > depth:
+                    pending.pop()
+            elif value == "?":
+                if not (self._punct_at(i + 1, ":") or self._punct_at(i + 1, ")")):
+                    pending.append(depth)
+            elif value == ":" and pending and pending[-1] == depth:
+                pending.pop()
+                out.add(i)
+        return out
+
+    # -- structure ----------------------------------------------------------
+
+    def _match_brackets(self) -> dict[int, int]:
+        """open-index -> close-index over punct tokens only (string
+        CONTENT tokens may hold bracket characters; they don't nest)."""
+        match: dict[int, int] = {}
+        stack: list[int] = []
+        for i, (kind, value, _ln) in enumerate(self.toks):
+            if kind != "punct":
+                continue
+            if value in _OPEN:
+                stack.append(i)
+            elif value in _CLOSE and stack:
+                match[stack.pop()] = i
+        return match
+
+    def _mark(self, start: int, end: int) -> None:
+        for i in range(max(start, 0), min(end + 1, len(self.toks))):
+            self.skip[i] = True
+
+    def _punct_at(self, i: int, value: str) -> bool:
+        return 0 <= i < len(self.toks) and self.toks[i][0] == "punct" and self.toks[i][1] == value
+
+    def _word_at(self, i: int) -> str | None:
+        if 0 <= i < len(self.toks) and self.toks[i][0] == "word":
+            return self.toks[i][1]
+        return None
+
+    # -- binding collection -------------------------------------------------
+
+    def _bind_pattern(self, start: int, end: int) -> None:
+        """Bind a destructuring pattern's targets in toks[start:end+1]
+        (the brace/bracket group INCLUDING its delimiters). `{a, b: c,
+        ...rest}` binds a, c, rest; `[x, , y]` binds x, y; nesting
+        recurses; `= default` right-hand sides are skipped."""
+        is_object = self._punct_at(start, "{")
+        i = start + 1
+        expect_binding = True
+        while i < end:
+            kind, value, _ln = self.toks[i]
+            if kind == "punct" and value in _OPEN:
+                close = self.match.get(i, end)
+                if expect_binding:
+                    self._bind_pattern(i, close)
+                    expect_binding = False
+                i = close + 1
+                continue
+            if kind == "punct" and value == ",":
+                expect_binding = True
+            elif kind == "punct" and value == ":" and is_object:
+                # `{key: target}` — the target (next) binds, not the key.
+                expect_binding = True
+                nxt = self._word_at(i + 1)
+                if nxt is not None:
+                    self.declared.add(nxt)
+                    expect_binding = False
+                    i += 1
+            elif kind == "punct" and value == "=":
+                # Default value: expression until the next depth-0 comma.
+                depth = 0
+                i += 1
+                while i < end:
+                    k2, v2, _l2 = self.toks[i]
+                    if k2 == "punct" and v2 in _OPEN:
+                        depth += 1
+                    elif k2 == "punct" and v2 in _CLOSE:
+                        depth -= 1
+                    elif k2 == "punct" and v2 == "," and depth == 0:
+                        break
+                    i += 1
+                continue
+            elif kind == "word" and expect_binding and value not in _TS_KEYWORDS:
+                if is_object and self._punct_at(i + 1, ":"):
+                    pass  # source key; the ':' branch binds the target
+                else:
+                    self.declared.add(value)
+                    expect_binding = False
+            i += 1
+
+    def _bind_params(self, open_paren: int) -> None:
+        """Bind every parameter in the (…) group opening at `open_paren`:
+        plain, annotated (`x: T`), optional (`x?`), defaulted (`x = d`),
+        rest (`...xs`), and destructured (incl. renames)."""
+        close = self.match.get(open_paren)
+        if close is None:
+            return
+        i = open_paren + 1
+        at_chunk_start = True
+        depth = 0
+        while i < close:
+            kind, value, _ln = self.toks[i]
+            if kind == "punct" and value in _OPEN:
+                if at_chunk_start and value in "{[":
+                    group_close = self.match.get(i, close)
+                    self._bind_pattern(i, group_close)
+                    at_chunk_start = False
+                    i = group_close + 1
+                    continue
+                depth += 1
+            elif kind == "punct" and value in _CLOSE:
+                depth -= 1
+            elif kind == "punct" and value == "," and depth == 0:
+                at_chunk_start = True
+            elif kind == "punct" and value == "...":
+                pass  # rest: the following word is still the binding
+            elif kind == "word" and at_chunk_start and value not in _TS_KEYWORDS:
+                self.declared.add(value)
+                at_chunk_start = False
+            elif at_chunk_start:
+                at_chunk_start = False
+            i += 1
+
+    def _annotation_terminator(self, i: int) -> str | None:
+        """From the token after `):`, scan the (possible) return-type
+        annotation and report what ends it at depth 0: `'=>'` for an
+        arrow (covering `(u: string): unknown =>` and the type
+        predicate `(r): r is { … } =>`), `'{'` for a body (function
+        declaration or object-method shorthand), None otherwise."""
+        depth = 0
+        #: a `{` right after one of these continues the TYPE (object
+        #: type in `is { … }`, `: { … }`, unions) — only a `{` after a
+        #: completed type (word, `>`, `]`, `}`) starts the body.
+        type_continues_after = {":", "|", "&", "is", "=>", "keyof", "readonly", "("}
+        prev_value = ":"
+        while i < len(self.toks):
+            kind, value, _ln = self.toks[i]
+            if kind == "punct" or kind == "word":
+                if (
+                    depth == 0
+                    and kind == "punct"
+                    and (value == "=>" or (value == "{" and prev_value not in type_continues_after))
+                ):
+                    return value
+                if value in _OPEN or value == "<":
+                    depth += 1
+                elif value in _CLOSE or value == ">":
+                    if depth == 0:
+                        return None
+                    depth -= 1
+                elif depth == 0 and value in (";", ",", "="):
+                    return None
+                prev_value = value
+            i += 1
+        return None
+
+    def collect_bindings(self) -> None:
+        toks = self.toks
+        i = 0
+        while i < len(toks):
+            kind, value, _ln = toks[i]
+            if kind != "word":
+                # Arrow params: `(…) =>`, `(…): Type =>`, or `x =>`.
+                if kind == "punct" and value == "(":
+                    close = self.match.get(i)
+                    if close is not None:
+                        after = close + 1
+                        if self._punct_at(after, "=>") or (
+                            self._punct_at(after, ":")
+                            and self._annotation_terminator(after + 1) == "=>"
+                        ):
+                            self._bind_params(i)
+                elif kind == "punct" and value == "=>":
+                    # `x =>` binds x — including `key: x =>` object
+                    # properties, but NOT `(…): RetType =>` where the
+                    # word is a return-type name (`:` preceded by `)`).
+                    word = self._word_at(i - 1)
+                    if word and not (
+                        self._punct_at(i - 2, ":") and self._punct_at(i - 3, ")")
+                    ):
+                        self.declared.add(word)
+                i += 1
+                continue
+            if value in ("const", "let", "var"):
+                i = self._collect_declarators(i + 1)
+                continue
+            if value == "function":
+                j = i + 1
+                name = self._word_at(j)
+                if name:
+                    self.declared.add(name)
+                    j += 1
+                if self._punct_at(j, "<"):
+                    # Generic type params: every word inside declares.
+                    depth = 1
+                    j += 1
+                    while j < len(toks) and depth:
+                        k2, v2, _l2 = toks[j]
+                        if k2 == "punct" and v2 == "<":
+                            depth += 1
+                        elif k2 == "punct" and v2 == ">":
+                            depth -= 1
+                        elif k2 == "word" and v2 not in _TS_KEYWORDS:
+                            self.declared.add(v2)
+                        j += 1
+                if self._punct_at(j, "("):
+                    self._bind_params(j)
+                i = j + 1
+                continue
+            if value == "catch":
+                if self._punct_at(i + 1, "("):
+                    self._bind_params(i + 1)
+                i += 1
+                continue
+            if value in ("interface", "enum", "class"):
+                # Name declares; the body is type/definition territory
+                # the value-position check must not wander into.
+                name = self._word_at(i + 1)
+                if name:
+                    self.declared.add(name)
+                j = i + 1
+                while j < len(toks) and not self._punct_at(j, "{"):
+                    j += 1
+                if j < len(toks):
+                    self._mark(j, self.match.get(j, len(toks) - 1))
+                    i = j + 1
+                    continue
+                i += 1
+                continue
+            if value == "type":
+                # Type alias: `type Name = …;` — name declares, the
+                # right-hand side is a type expression (skip zone).
+                name = self._word_at(i + 1)
+                if name and self._punct_at(i + 2, "="):
+                    self.declared.add(name)
+                    j = i + 3
+                    depth = 0
+                    while j < len(toks):
+                        k2, v2, _l2 = toks[j]
+                        if k2 == "punct" and v2 in _OPEN:
+                            depth += 1
+                        elif k2 == "punct" and v2 in _CLOSE:
+                            depth -= 1
+                        elif k2 == "punct" and v2 == ";" and depth == 0:
+                            break
+                        j += 1
+                    self._mark(i + 2, j)
+                    i = j + 1
+                    continue
+            if value in ("import", "export"):
+                i = self._mark_import_export(i)
+                continue
+            # Object-literal method shorthand / accessors: `name(…) {`
+            # (or `: T {`) after `{`, `,`, or get/set/async — the name
+            # is a definition, not a call; its params bind.
+            if value not in _TS_KEYWORDS and self._punct_at(i + 1, "("):
+                prev = self.toks[i - 1] if i > 0 else ("", "", 0)
+                before = prev
+                if prev[0] == "word" and prev[1] in ("get", "set", "async"):
+                    before = self.toks[i - 2] if i > 1 else ("", "", 0)
+                if before[0] == "punct" and before[1] in ("{", ","):
+                    close = self.match.get(i + 1)
+                    if close is not None:
+                        after = close + 1
+                        is_body = self._punct_at(after, "{") or (
+                            self._punct_at(after, ":")
+                            and self._annotation_terminator(after + 1) == "{"
+                        )
+                        if is_body:
+                            self.skip[i] = True  # definition, not a use
+                            self._bind_params(i + 1)
+            i += 1
+
+    def _collect_declarators(self, i: int) -> int:
+        """Bind `const`/`let`/`var` declarator targets starting at the
+        first pattern token. Returns the index just AFTER the first
+        pattern — NOT after the statement — so the main loop re-scans
+        initializer expressions for the constructs nested inside them
+        (arrow params, function expressions, further declarations).
+        Later declarators (`, b = 2`) are bound by a non-consuming
+        look-ahead that splits on depth-0 commas."""
+        toks = self.toks
+
+        def bind_one(j: int) -> int:
+            """Bind the pattern at j; return index just past it."""
+            if j < len(toks):
+                kind, value, _ln = toks[j]
+                if kind == "punct" and value in "{[":
+                    close = self.match.get(j, j)
+                    self._bind_pattern(j, close)
+                    return close + 1
+                if kind == "word" and value not in _TS_KEYWORDS:
+                    self.declared.add(value)
+                    return j + 1
+            return j
+
+        resume = bind_one(i)
+        # Look ahead (without consuming) for `, nextPattern` declarators.
+        j = resume
+        depth = 0
+        while j < len(toks):
+            kind, value, _ln = toks[j]
+            if kind == "punct" and value in _OPEN:
+                depth += 1
+            elif kind == "punct" and value in _CLOSE:
+                if depth == 0:
+                    break
+                depth -= 1
+            elif depth == 0 and kind == "punct" and value == ",":
+                j = bind_one(j + 1)
+                continue
+            elif depth == 0 and (
+                (kind == "punct" and value == ";") or (kind == "word" and value in ("of", "in"))
+            ):
+                break
+            j += 1
+        return resume
+
+    def _mark_import_export(self, i: int) -> int:
+        """Exclude an import/`export {…} [from …]` statement's tokens
+        from the use check (its words are bindings and source-module
+        names, not value references); returns the index after it."""
+        toks = self.toks
+        start = i
+        if toks[i][1] == "import":
+            j = i + 1
+            while j < len(toks) and toks[j][0] != "string":
+                j += 1
+            self._mark_import_range(start, j)
+            return j + 1
+        j = i + 1
+        if self._punct_at(j, "{"):
+            close = self.match.get(j, j)
+            if j < len(toks) - 1 and self._word_at(close + 1) == "from":
+                # `export { a } from './m'` — source-module names, not
+                # local references; exclude like an import statement.
+                self._mark_import_range(start, close + 2)
+                return close + 3
+            # Bare `export { a, b };` re-exports LOCAL bindings: the
+            # braced names are value uses (they also count for the
+            # unused-import check — tsc agrees a re-export is a use).
+            return close + 1
+        return i + 1
+
+    def _mark_import_range(self, start: int, end: int) -> None:
+        for i in range(max(start, 0), min(end + 1, len(self.toks))):
+            self.in_import[i] = True
+
+    # -- the check ----------------------------------------------------------
+
+    def check(self) -> list[Diagnostic]:
+        self.collect_bindings()
+        diagnostics: list[Diagnostic] = []
+        toks = self.toks
+        for i, (kind, value, line) in enumerate(toks):
+            if kind != "word" or self.skip[i] or self.in_import[i]:
+                continue
+            if value in _TS_KEYWORDS or value in _AMBIENT or value in self.declared:
+                continue
+            prev_kind, prev_value, _pl = toks[i - 1] if i > 0 else ("", "", 0)
+            if prev_kind == "punct" and prev_value in (".", "?."):
+                continue  # property access
+            if prev_kind == "word" and prev_value in ("as", "satisfies"):
+                continue  # type cast position
+            if self._punct_at(i + 1, ":") and (i + 1) not in self.ternary_colons:
+                continue  # object key / annotated binding / label
+            if self._punct_at(i + 1, "?") and self._punct_at(i + 2, ":"):
+                continue  # optional property in an inline type (`x?: T`)
+            diagnostics.append(
+                Diagnostic(
+                    self.result.path,
+                    line,
+                    f"'{value}' is not defined (no import, declaration, "
+                    "parameter, or known global)",
+                )
+            )
+        diagnostics.extend(self._check_unused_imports())
+        return diagnostics
+
+    def _check_unused_imports(self) -> list[Diagnostic]:
+        uses: dict[str, int] = {}
+        for i, (kind, value, _ln) in enumerate(self.toks):
+            if kind == "word" and not self.in_import[i]:
+                uses[value] = uses.get(value, 0) + 1
+        for tag in self.result.jsx_tags:
+            head = tag.name.split(".")[0]
+            if head:
+                uses[head] = uses.get(head, 0) + 1
+        out: list[Diagnostic] = []
+        for local, line in self.info.imported_locals:
+            # `import React` stays: the classic JSX transform needs it
+            # in scope even when no expression mentions it.
+            if local != "React" and uses.get(local, 0) == 0:
+                out.append(
+                    Diagnostic(self.result.path, line, f"imported '{local}' is never used")
+                )
+        return out
+
+
+def check_identifiers(result: ParseResult, info: ModuleInfo) -> list[Diagnostic]:
+    return _IdentifierPass(result, info).check()
+
+
+# ---------------------------------------------------------------------------
+# Style (the mechanically-checkable prettier subset)
+# ---------------------------------------------------------------------------
+#
+# `prettier --check` itself only runs in CI (plugin/VERIFIED.md); these
+# are the .prettierrc.js invariants a Python process CAN verify, so a
+# style drift that would fail CI's format gate fails pytest first.
+
+STYLE_MAX_WIDTH = 100
+
+
+def check_style(
+    path: str,
+    src: str,
+    protected_lines: set[int] | None = None,
+    string_chars: dict[int, int] | None = None,
+) -> list[Diagnostic]:
+    """The rule set only ever flags what `prettier --check` would also
+    reject (local-fail ⇒ CI-fail); it is NOT the converse — prettier
+    sees more than a per-line scan can. `protected_lines` (1-based)
+    are wholly outside prettier's reach (comments, multi-line
+    string/template content) and exempt; `string_chars` discounts
+    single-line string contents from the width measure, since prettier
+    rewraps the code around a string but never the string itself."""
+    protected = protected_lines or set()
+    chars = string_chars or {}
+    diagnostics: list[Diagnostic] = []
+    if src and not src.endswith("\n"):
+        diagnostics.append(Diagnostic(path, src.count("\n") + 1, "missing final newline"))
+    for lineno, raw in enumerate(src.split("\n"), start=1):
+        if lineno in protected:
+            continue
+        if "\r" in raw:
+            diagnostics.append(Diagnostic(path, lineno, "carriage return (endOfLine: 'lf')"))
+        text = raw.rstrip("\r")
+        if "\t" in text and lineno not in chars:
+            # A tab on a string-bearing line could be string content;
+            # elsewhere it is indentation prettier would rewrite.
+            diagnostics.append(Diagnostic(path, lineno, "tab character (tabWidth: 2, spaces)"))
+        if text != text.rstrip(" \t"):
+            # End-of-line whitespace sits OUTSIDE any single-line
+            # string on the line (the closing quote precedes it).
+            diagnostics.append(Diagnostic(path, lineno, "trailing whitespace"))
+        if len(text) - chars.get(lineno, 0) > STYLE_MAX_WIDTH:
+            diagnostics.append(
+                Diagnostic(path, lineno, f"line exceeds printWidth {STYLE_MAX_WIDTH} "
+                           f"({len(text)} chars incl. strings)")
+            )
+    return diagnostics
 
 
 # ---------------------------------------------------------------------------
@@ -648,7 +1234,9 @@ def check_tree(root: str) -> list[Diagnostic]:
         for filename in sorted(filenames):
             if filename.endswith((".ts", ".tsx", ".mts")):
                 path = os.path.join(dirpath, filename)
-                with open(path, "r", encoding="utf-8") as f:
+                # newline='' keeps \r visible — universal-newline mode
+                # would silently hide CRLF from the style pass.
+                with open(path, "r", encoding="utf-8", newline="") as f:
                     sources[path] = f.read()
 
     diagnostics: list[Diagnostic] = []
@@ -662,7 +1250,16 @@ def check_tree(root: str) -> list[Diagnostic]:
         result = parse_source(path, src)
         parsed[path] = result
         diagnostics.extend(result.errors)
+        diagnostics.extend(
+            check_style(path, src, result.protected_lines, result.string_chars)
+        )
         modules[path] = _extract_modules(result)
+
+    # Identifier resolution + unused imports (only on files whose
+    # token stream is trustworthy — a parse error already failed them).
+    for path, result in parsed.items():
+        if not result.errors:
+            diagnostics.extend(check_identifiers(result, modules[path]))
 
     # Import graph: resolution + named-import existence (token-derived,
     # so imports quoted inside comments or strings never count).
