@@ -1,0 +1,175 @@
+"""ArtifactHub catalog metadata (artifacthub-pkg.yml) and the release
+stamping loop (tools/release_catalog.py).
+
+The reference ships a catalog entry whose install block points at a
+real, checksummed archive (`/root/reference/artifacthub-pkg.yml`,
+annotations `headlamp/plugin/archive-url` / `archive-checksum`). The
+dev image cannot package the plugin (no npm — plugin/VERIFIED.md), so
+the archive is produced by the tag-triggered release workflow; what
+CAN be verified here, and is:
+
+  * the committed catalog file parses and carries the reference's
+    field set,
+  * screenshots it advertises exist in-repo,
+  * the stamping tool turns it into the reference's released shape
+    with zero manual steps, idempotently, and refuses bad input.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+import pytest
+import yaml
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from release_catalog import CHECKSUM_KEY, URL_KEY, stamp  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CATALOG = os.path.join(REPO, "artifacthub-pkg.yml")
+
+#: Top-level fields the reference's catalog entry carries — ours must
+#: not be missing any (`/root/reference/artifacthub-pkg.yml`).
+REFERENCE_FIELDS = {
+    "version",
+    "name",
+    "displayName",
+    "description",
+    "createdAt",
+    "license",
+    "category",
+    "homeURL",
+    "appVersion",
+    "install",
+    "keywords",
+    "maintainers",
+    "provider",
+    "links",
+    "changes",
+    "screenshots",
+    "annotations",
+}
+
+DIGEST = "e" * 64
+URL = (
+    "https://example.invalid/headlamp-tpu/releases/download/v0.3.0/"
+    "headlamp-tpu-plugin-0.3.0.tar.gz"
+)
+
+
+def catalog_text() -> str:
+    with open(CATALOG, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def test_catalog_parses_and_has_reference_fields():
+    doc = yaml.safe_load(catalog_text())
+    missing = REFERENCE_FIELDS - set(doc)
+    assert not missing, f"catalog lacks reference fields: {sorted(missing)}"
+    assert doc["license"] == "Apache-2.0"
+    assert re.fullmatch(r"\d+\.\d+\.\d+", str(doc["version"]))
+    assert doc["keywords"], "keywords must be non-empty"
+    assert doc["annotations"]["headlamp/plugin/version-compat"] == ">=0.20.0"
+    # The reference's distro-compat annotation, same shape.
+    assert doc["annotations"]["headlamp/plugin/distro-compat"] == "in-cluster,web,app"
+
+
+def test_catalog_screenshots_exist_in_repo():
+    doc = yaml.safe_load(catalog_text())
+    for shot in doc["screenshots"]:
+        filename = shot["url"].rsplit("/", 1)[1]
+        path = os.path.join(REPO, "docs", "screenshots", filename)
+        assert os.path.isfile(path), f"advertised screenshot missing: {filename}"
+
+
+def test_catalog_changes_have_reference_shape():
+    doc = yaml.safe_load(catalog_text())
+    kinds = {"added", "changed", "deprecated", "removed", "fixed", "security"}
+    for change in doc["changes"]:
+        assert change["kind"] in kinds
+        assert change["description"].strip()
+
+
+def test_catalog_is_honestly_unstamped_or_fully_stamped():
+    # Before the first release: no archive annotations, and the file
+    # says why rather than listing a URL that does not exist. After
+    # the release workflow stamps and commits back to main, this same
+    # test keeps CI green by checking the released shape instead.
+    text = catalog_text()
+    doc = yaml.safe_load(text)
+    stamped = URL_KEY in doc["annotations"] or CHECKSUM_KEY in doc["annotations"]
+    if stamped:
+        assert re.fullmatch(r"sha256:[0-9a-f]{64}", doc["annotations"][CHECKSUM_KEY])
+        assert doc["annotations"][URL_KEY].endswith(".tar.gz")
+        assert "No archive URL/checksum is listed yet" not in text
+    else:
+        assert "No archive URL/checksum is listed yet" in text
+
+
+def test_stamp_produces_reference_released_shape():
+    stamped = stamp(catalog_text(), "0.3.0", URL, DIGEST)
+    doc = yaml.safe_load(stamped)
+    assert str(doc["version"]) == "0.3.0"
+    # appVersion tracks the plugin version here (unlike the reference,
+    # whose appVersion names the Intel operator's version).
+    assert str(doc["appVersion"]) == "0.3.0"
+    assert doc["annotations"][URL_KEY] == URL
+    # Reference checksum shape: `sha256:<64 hex>` (its :103).
+    assert re.fullmatch(r"sha256:[0-9a-f]{64}", doc["annotations"][CHECKSUM_KEY])
+    # Other fields survive the line edit untouched.
+    assert set(doc) >= REFERENCE_FIELDS
+    assert doc["annotations"]["headlamp/plugin/version-compat"] == ">=0.20.0"
+    # The placeholder explanation is gone — it described the absence.
+    assert "No archive URL/checksum is listed yet" not in stamped
+
+
+def test_stamp_is_idempotent_and_updatable():
+    once = stamp(catalog_text(), "0.3.0", URL, DIGEST)
+    assert stamp(once, "0.3.0", URL, DIGEST) == once
+    # A later release replaces in place (no duplicate keys).
+    digest2 = "a" * 64
+    twice = stamp(once, "0.4.0", URL.replace("0.3.0", "0.4.0"), digest2)
+    doc = yaml.safe_load(twice)
+    assert str(doc["version"]) == "0.4.0"
+    assert doc["annotations"][CHECKSUM_KEY] == f"sha256:{digest2}"
+    assert twice.count(CHECKSUM_KEY) == 1
+
+
+def test_stamp_rejects_bad_digest_and_version():
+    with pytest.raises(ValueError):
+        stamp(catalog_text(), "0.3.0", URL, "nothex")
+    with pytest.raises(ValueError):
+        stamp(catalog_text(), "0.3.0", URL, "E" * 64)  # uppercase ≠ sha256sum output
+    with pytest.raises(ValueError):
+        stamp(catalog_text(), "not-a-version", URL, DIGEST)
+
+
+def test_stamp_requires_annotations_block():
+    with pytest.raises(ValueError):
+        stamp("version: 1.0.0\nname: x\n", "1.0.0", URL, DIGEST)
+
+
+def test_release_workflow_wires_the_loop():
+    # The workflow must call the stamping tool and commit the catalog
+    # and lockfile back — the zero-manual-steps contract.
+    path = os.path.join(REPO, ".github", "workflows", "release.yaml")
+    with open(path, "r", encoding="utf-8") as f:
+        workflow = f.read()
+    assert "tools/release_catalog.py" in workflow
+    assert "artifacthub-pkg.yml" in workflow
+    assert "package-lock.json" in workflow
+    assert "sha256sum" in workflow
+    # Provenance + race hygiene: build from the tagged commit (no
+    # `ref: main` checkout), rebase before the metadata push, and
+    # never guess the archive name with `ls`.
+    assert "ref: main" not in workflow
+    assert "git pull --rebase origin main" in workflow
+    assert "$(ls" not in workflow
+    assert "--clobber" in workflow
+    doc = yaml.safe_load(workflow)
+    # `on:` parses to the boolean-ish key True in YAML 1.1.
+    triggers = doc.get("on") or doc.get(True)
+    assert triggers["push"]["tags"] == ["v*"]

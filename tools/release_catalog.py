@@ -1,0 +1,124 @@
+"""Stamp a released archive into the ArtifactHub catalog file.
+
+The reference's catalog entry points at a real downloadable archive
+with a sha256 (`/root/reference/artifacthub-pkg.yml:102-103`):
+
+    headlamp/plugin/archive-url: "https://…/intel-gpu-1.1.0.tar.gz"
+    headlamp/plugin/archive-checksum: sha256:e212381f…
+
+This tool closes the same loop for the TPU plugin: the release
+workflow (`.github/workflows/release.yaml`) packages the plugin,
+computes the checksum, and calls this to rewrite
+`artifacthub-pkg.yml` — zero manual steps between `git tag` and a
+catalog-ready file. It is stdlib-only (the release runner needs no
+extra deps) and edits by line so the file's comments survive; the
+placeholder comment explaining why no archive is listed is removed
+the moment a real one is stamped.
+
+Usage:
+    python tools/release_catalog.py --version 0.2.0 \
+        --archive-url https://…/headlamp-tpu-plugin-0.2.0.tar.gz \
+        --sha256 <64-hex> [--path artifacthub-pkg.yml]
+
+Idempotent: re-running with the same arguments yields the same file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+#: Annotation keys in the reference's shape.
+URL_KEY = "headlamp/plugin/archive-url"
+CHECKSUM_KEY = "headlamp/plugin/archive-checksum"
+
+#: The placeholder comment block (see artifacthub-pkg.yml) is removed
+#: when a real archive is stamped — it explains the ABSENCE of one.
+PLACEHOLDER_MARKER = "No archive URL/checksum is listed yet"
+
+
+def stamp(text: str, version: str, archive_url: str, sha256: str) -> str:
+    """Return `text` with version + archive annotations updated."""
+    if not re.fullmatch(r"[0-9a-f]{64}", sha256):
+        raise ValueError(f"not a sha256 hex digest: {sha256!r}")
+    if not re.fullmatch(r"\d+\.\d+\.\d+([.-].+)?", version):
+        raise ValueError(f"not a semantic version: {version!r}")
+
+    lines = text.split("\n")
+
+    # Drop the contiguous comment block containing the placeholder.
+    if any(PLACEHOLDER_MARKER in line for line in lines):
+        marker_at = next(i for i, line in enumerate(lines) if PLACEHOLDER_MARKER in line)
+        lo = marker_at
+        while lo > 0 and lines[lo - 1].lstrip().startswith("#"):
+            lo -= 1
+        hi = marker_at
+        while hi + 1 < len(lines) and lines[hi + 1].lstrip().startswith("#"):
+            hi += 1
+        del lines[lo : hi + 1]
+
+    checksum_value = f"sha256:{sha256}"
+    replaced = {URL_KEY: False, CHECKSUM_KEY: False, "version": False}
+    out: list[str] = []
+    for line in lines:
+        stripped = line.lstrip()
+        indent = line[: len(line) - len(stripped)]
+        if stripped.startswith(f"{URL_KEY}:"):
+            out.append(f'{indent}{URL_KEY}: "{archive_url}"')
+            replaced[URL_KEY] = True
+        elif stripped.startswith(f"{CHECKSUM_KEY}:"):
+            out.append(f"{indent}{CHECKSUM_KEY}: {checksum_value}")
+            replaced[CHECKSUM_KEY] = True
+        elif line.startswith("version:") and not replaced["version"]:
+            out.append(f"version: {version}")
+            replaced["version"] = True
+        elif line.startswith("appVersion:"):
+            # Unlike the reference (whose appVersion tracks the Intel
+            # operator), this project's appVersion IS the plugin
+            # version — keep them in lockstep.
+            out.append(f'appVersion: "{version}"')
+        else:
+            out.append(line)
+
+    if not (replaced[URL_KEY] and replaced[CHECKSUM_KEY]):
+        # Insert right under the top-level `annotations:` key.
+        for i, line in enumerate(out):
+            if line.startswith("annotations:"):
+                insert_at = i + 1
+                if not replaced[CHECKSUM_KEY]:
+                    out.insert(insert_at, f"  {CHECKSUM_KEY}: {checksum_value}")
+                if not replaced[URL_KEY]:
+                    out.insert(insert_at, f'  {URL_KEY}: "{archive_url}"')
+                break
+        else:
+            raise ValueError("no top-level 'annotations:' key in catalog file")
+
+    if not replaced["version"]:
+        raise ValueError("no top-level 'version:' key in catalog file")
+    return "\n".join(out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    parser.add_argument("--version", required=True)
+    parser.add_argument("--archive-url", required=True)
+    parser.add_argument("--sha256", required=True, help="64-char hex digest (no prefix)")
+    parser.add_argument(
+        "--path",
+        default=os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                             "artifacthub-pkg.yml"),
+    )
+    args = parser.parse_args(argv)
+    with open(args.path, "r", encoding="utf-8") as f:
+        text = f.read()
+    stamped = stamp(text, args.version, args.archive_url, args.sha256)
+    with open(args.path, "w", encoding="utf-8") as f:
+        f.write(stamped)
+    print(f"stamped {args.path}: v{args.version}, {CHECKSUM_KEY}: sha256:{args.sha256[:12]}…")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
