@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any
+from typing import Any, Callable
 
 from ..domain import objects, tpu
 from ..domain.accelerator import FleetView
@@ -377,7 +377,7 @@ def _calibrate(view: FleetView) -> dict[str, Any]:
     view."""
     import statistics
 
-    def timed(fn) -> float:
+    def timed(fn: Callable[[], Any]) -> float:
         samples = []
         for _ in range(3):
             t0 = time.perf_counter()

@@ -16,9 +16,11 @@ import argparse
 import sys
 import time
 
+from typing import Callable
+
 from .context.accelerator_context import AcceleratorDataContext
 from .registration import register_plugin
-from .transport.api_proxy import KubeTransport
+from .transport.api_proxy import KubeTransport, Transport
 from .ui import render_text
 
 #: CLI page name -> route path.
@@ -38,7 +40,9 @@ PAGES = {
 }
 
 
-def render_page(page: str, transport, *, clock=time.time) -> str:
+def render_page(
+    page: str, transport: Transport, *, clock: Callable[[], float] = time.time
+) -> str:
     """Render one page to text against a transport (exposed for tests)."""
     registry = register_plugin()
     route = registry.route_for(PAGES[page])

@@ -163,7 +163,7 @@ class AcceleratorDataContext:
         page_limit: int | None = None,
         pod_field_selector: str | None = None,
         watch: bool = False,
-    ):
+    ) -> None:
         self._transport = transport
         self._providers = providers
         self._sources = dict(sources if sources is not None else default_sources())

@@ -15,8 +15,12 @@ snapshots can be shared with the TS vitest suites (fixtures/*.json).
 
 from __future__ import annotations
 
+import copy
 import random
-from typing import Any
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:  # runtime import stays local to fleet_transport
+    from ..transport.api_proxy import MockTransport
 
 from ..domain.constants import (
     GKE_NODEPOOL_LABEL,
@@ -323,7 +327,7 @@ def make_intel_crd(
     }
 
 
-def fleet_transport(fleet: dict[str, Any]):
+def fleet_transport(fleet: dict[str, Any]) -> "MockTransport":
     """MockTransport serving a fixture fleet on the same URL surface the
     context fetches (single definition — the server demo mode and
     bench.py must wire identical routes, or a drifted daemonset path
@@ -398,6 +402,25 @@ def fleet_v5p32() -> dict[str, Any]:
         "pods": pods + plugins,
         "daemonsets": [make_plugin_daemonset(desired=4)],
     }
+
+
+def fleet_v5p32_degraded() -> dict[str, Any]:
+    """The v5p-32 slice after a host drop: worker 3 gone entirely and
+    worker 2 NotReady — the degraded-fleet shape every surface must
+    classify the same way (slice health 'error': an incomplete
+    multi-host slice outranks mere unreadiness, topology/slices.py).
+    Exported as the `v5p32-degraded` shared fixture and driven by
+    dryrun_multichip stage 6."""
+    fleet = copy.deepcopy(fleet_v5p32())
+    fleet["nodes"] = [
+        n for n in fleet["nodes"] if n["metadata"]["name"] != "gke-v5p-pool-w3"
+    ]
+    for n in fleet["nodes"]:
+        if n["metadata"]["name"] == "gke-v5p-pool-w2":
+            for c in n.get("status", {}).get("conditions", []):
+                if c.get("type") == "Ready":
+                    c["status"] = "False"
+    return fleet
 
 
 def fleet_mixed() -> dict[str, Any]:

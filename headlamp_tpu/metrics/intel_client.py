@@ -15,7 +15,7 @@ from __future__ import annotations
 import concurrent.futures
 import time
 from dataclasses import dataclass, field
-from typing import Callable
+from typing import Any, Callable
 
 from ..transport.api_proxy import ApiError, Transport
 from .client import (
@@ -105,7 +105,7 @@ def fetch_intel_gpu_metrics(
         return None
     namespace, service = found
 
-    def run_query(promql: str):
+    def run_query(promql: str) -> list[Any]:
         try:
             data = transport.request(
                 _proxy_query_path(namespace, service, promql), timeout_s

@@ -81,7 +81,9 @@ def make_train_step(
     optimizer = optax.adam(cfg.learning_rate)
 
     @jax.jit
-    def train_step(params: Params, opt_state: Any, x: jax.Array, y: jax.Array):
+    def train_step(
+        params: Params, opt_state: Any, x: jax.Array, y: jax.Array
+    ) -> tuple[Params, Any, jax.Array]:
         loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -222,7 +224,9 @@ def _fit_program(
     optimizer = optax.adam(cfg.learning_rate)
     opt_state = optimizer.init(params)
 
-    def body(carry, _):
+    def body(
+        carry: tuple[Params, Any], _: None
+    ) -> tuple[tuple[Params, Any], jax.Array]:
         p, s = carry
         loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
         updates, s = optimizer.update(grads, s, p)
@@ -245,7 +249,7 @@ def _fit_forecast_program(
     steps: int,
     inference: str,
     batch_p: int,
-):
+) -> tuple[jax.Array, jax.Array]:
     """The WHOLE forecast — windowing → fit scan → inference (Pallas
     kernel or XLA forward, chosen statically) — as ONE XLA program and
     therefore ONE device dispatch. The split fit/infer path costs two

@@ -24,6 +24,7 @@ the XLA path, which autodiff already handles.
 from __future__ import annotations
 
 import functools
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -39,11 +40,20 @@ _BLOCK_B = 128
 _LANES = 128
 
 
-def _forward_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref, b3_ref, out_ref):
+def _forward_kernel(
+    x_ref: Any,
+    w1_ref: Any,
+    b1_ref: Any,
+    w2_ref: Any,
+    b2_ref: Any,
+    w3_ref: Any,
+    b3_ref: Any,
+    out_ref: Any,
+) -> None:
     """One batch tile: y = sigmoid(gelu(gelu(x@w1+b1)@w2+b2)@w3+b3),
     entirely in VMEM."""
 
-    def dense(h, w_ref, b_ref):
+    def dense(h: jax.Array, w_ref: Any, b_ref: Any) -> jax.Array:
         y = jax.lax.dot_general(
             h.astype(jnp.bfloat16),
             w_ref[:].astype(jnp.bfloat16),
@@ -65,7 +75,7 @@ def _pad2(a: jax.Array, rows: int, cols: int) -> jax.Array:
 
 def forecast_forward_padded(
     params: Params, x: jax.Array, *, batch_p: int, horizon: int, interpret: bool
-):
+) -> jax.Array:
     """Trace-time body: padding → kernel → un-pad. Call it inside an
     enclosing jit — the fused fit+infer program does — or through the
     jitted :func:`_pallas_program` wrapper for standalone inference."""
@@ -85,7 +95,7 @@ def forecast_forward_padded(
 @functools.partial(jax.jit, static_argnames=("batch_p", "horizon", "interpret"))
 def _pallas_program(
     params: Params, x: jax.Array, *, batch_p: int, horizon: int, interpret: bool
-):
+) -> jax.Array:
     """Padding → kernel → un-pad as ONE jitted program: each un-jitted
     jnp.pad is its own device dispatch, and over a tunneled/remote TPU
     those seven round-trips cost more than the kernel itself."""
@@ -109,7 +119,17 @@ def check_single_tile(window: int, hidden: int, horizon: int) -> None:
         )
 
 
-def _padded_forward(x_p, w1_p, b1_p, w2_p, b2_p, w3_p, b3_p, *, interpret: bool):
+def _padded_forward(
+    x_p: jax.Array,
+    w1_p: jax.Array,
+    b1_p: jax.Array,
+    w2_p: jax.Array,
+    b2_p: jax.Array,
+    w3_p: jax.Array,
+    b3_p: jax.Array,
+    *,
+    interpret: bool,
+) -> jax.Array:
     n_blocks = x_p.shape[0] // _BLOCK_B
     weight_spec = pl.BlockSpec(
         (_LANES, _LANES), lambda i: (0, 0), memory_space=pltpu.VMEM

@@ -9,6 +9,7 @@ module owns the jax calls.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Any, Callable
 
 from ..metrics.client import UtilizationHistory
 from .forecast import ForecastConfig, fit_and_forecast_with_dispatch
@@ -52,7 +53,9 @@ class ForecastView:
 SATURATION_PCT = 90.0
 
 
-def compute_forecast(transport, metrics, *, clock=None) -> ForecastView | None:
+def compute_forecast(
+    transport: Any, metrics: Any, *, clock: Callable[[], float] | None = None
+) -> ForecastView | None:
     """Shared metrics-route glue for every host (HTTP server, CLI):
     fetch history for the snapshot's Prometheus and fit, degrading to
     None on missing extras, unusable jax backends, or thin history —

@@ -86,7 +86,17 @@ def _rollup_with_reducer(
     pod_cols = [_pad_to_multiple(c, n_hosts) for c in pod_cols]
     n_nodes_pad = int(node_cols[0].shape[0])
 
-    def rollup_body(cap, alloc, ready, gen, nvalid, req, phase, nidx, pvalid):
+    def rollup_body(
+        cap: jax.Array,
+        alloc: jax.Array,
+        ready: jax.Array,
+        gen: jax.Array,
+        nvalid: jax.Array,
+        req: jax.Array,
+        phase: jax.Array,
+        nidx: jax.Array,
+        pvalid: jax.Array,
+    ) -> dict[str, jax.Array]:
         # One shared reduction body with the single-device rollup
         # (fleet_jax.local_aggregates) — pod_node_idx already indexes
         # the GLOBAL node space, so each shard's segment-sum lands in
@@ -136,7 +146,9 @@ def seq_mesh(n_devices: int | None = None) -> Mesh:
     return _mesh_1d("seq", n_devices)
 
 
-def shard_map_unchecked(fn, *, mesh, in_specs, out_specs):
+def shard_map_unchecked(
+    fn: Any, *, mesh: Any, in_specs: Any, out_specs: Any
+) -> Any:
     """shard_map with the static replication check off: ppermute-ring
     outputs ARE replicated in value, but the checker can't infer it
     (only psum-style collectives register as replicating). Kwarg name
@@ -167,7 +179,7 @@ def ring_allreduce(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
     per hop is exactly one shard's original contribution."""
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
-    def body(_, carry):
+    def body(_: jax.Array, carry: tuple[jax.Array, jax.Array]) -> tuple[jax.Array, jax.Array]:
         acc, buf = carry
         buf = jax.lax.ppermute(buf, axis_name, perm)
         return acc + buf, buf
@@ -212,7 +224,7 @@ def alltoall_generation_histogram(fleet: FleetArrays, mesh: Mesh) -> "np.ndarray
     gen = _pad_to_multiple(jnp.asarray(fleet.node_generation), n_hosts)
     valid = _pad_to_multiple(jnp.asarray(fleet.node_valid), n_hosts)
 
-    def shard_fn(gen_block, valid_block):
+    def shard_fn(gen_block: jax.Array, valid_block: jax.Array) -> jax.Array:
         # Local partial histogram over the FULL bucket space — the same
         # segment_sum idiom fleet_jax uses (O(rows), no [rows, vocab]
         # one-hot materialization).
@@ -279,7 +291,7 @@ def sharded_make_windows(
         in_specs=(P(None, "seq"),),
         out_specs=(P(None, "seq", None), P(None, "seq", None), P("seq")),
     )
-    def windows_shard(block):
+    def windows_shard(block: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
         # block: [n_series, local_t]
         head = block[:, :halo]
         halo_block = jax.lax.ppermute(head, "seq", perm)

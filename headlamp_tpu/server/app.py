@@ -30,7 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 from urllib.parse import parse_qs, urlparse
 
-from ..context.accelerator_context import AcceleratorDataContext
+from ..context.accelerator_context import AcceleratorDataContext, ClusterSnapshot
 from ..metrics.client import fetch_tpu_metrics
 from ..pages.native import native_node_page, native_pod_page
 from ..registration import Registry, register_plugin
@@ -117,7 +117,7 @@ class DashboardApp:
         min_sync_interval_s: float = 5.0,
         clock: Any = time.time,
         pod_field_selector: str | None = None,
-    ):
+    ) -> None:
         self._ctx = AcceleratorDataContext(
             transport, pod_field_selector=pod_field_selector, clock=clock
         )
@@ -266,7 +266,7 @@ class DashboardApp:
     def _background_live(self) -> bool:
         return self._background_stop is not None and not self._background_stop.is_set()
 
-    def _synced_snapshot(self):
+    def _synced_snapshot(self) -> ClusterSnapshot:
         # With background sync live, page views read the atomically
         # published snapshot WITHOUT taking the sync lock: the loop
         # holds self._lock across each tick, and with watch enabled a

@@ -33,7 +33,7 @@ DEFAULT_TIMEOUT_S = 2.0
 class ApiError(Exception):
     """A request failed (HTTP error, bad JSON, connection refused)."""
 
-    def __init__(self, path: str, message: str, status: int | None = None):
+    def __init__(self, path: str, message: str, status: int | None = None) -> None:
         super().__init__(f"{path}: {message}")
         self.path = path
         self.status = status
@@ -42,7 +42,7 @@ class ApiError(Exception):
 class RequestTimeout(ApiError):
     """The request exceeded its wall-clock budget."""
 
-    def __init__(self, path: str, timeout_s: float):
+    def __init__(self, path: str, timeout_s: float) -> None:
         super().__init__(path, f"timed out after {timeout_s:g}s")
         self.timeout_s = timeout_s
 
@@ -120,7 +120,7 @@ class KubeTransport:
         bearer_token: str | None = None,
         ca_cert: str | None = None,
         insecure_skip_verify: bool = False,
-    ):
+    ) -> None:
         self.base_url = base_url.rstrip("/")
         self._headers: dict[str, str] = {"Accept": "application/json"}
         if bearer_token:
@@ -224,7 +224,7 @@ class WatchFeed:
     against it sees exactly the list+watch protocol contract (including
     410 Gone after :meth:`compact`)."""
 
-    def __init__(self, items: list[Any], resource_version: int = 1000):
+    def __init__(self, items: list[Any], resource_version: int = 1000) -> None:
         self._items: dict[str, Any] = {}
         for item in items:
             self._items[self._uid(item)] = item
@@ -325,7 +325,7 @@ class MockTransport:
     #: labelSelector — must be routed explicitly).
     _LIST_PARAMS = frozenset({"limit", "continue", "fieldSelector", "resourceVersion"})
 
-    def __init__(self, routes: Mapping[str, Any] | None = None):
+    def __init__(self, routes: Mapping[str, Any] | None = None) -> None:
         self.routes: dict[str, Any] = dict(routes or {})
         self._prefix_routes: list[tuple[str, Any]] = []
         self._list_routes: dict[str, Any] = {}

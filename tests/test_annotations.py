@@ -1,0 +1,62 @@
+"""Annotation coverage over the whole package (the local half of the
+widened mypy gate, VERDICT r4 #6).
+
+CI runs `mypy headlamp_tpu/` on the package root
+(.github/workflows/ci.yaml) the way the reference runs tsc over all
+of src/ — but mypy, like every other checker with no wheel in this
+image, cannot execute here (no egress to install it; the pattern is
+documented in plugin/VERIFIED.md for tsc). What CAN run locally, and
+does on every pytest, is the part of the gate that regresses most
+easily: every function in every module stays fully annotated —
+parameters and return type — so mypy's whole-package run never
+degrades back into the two-directory island it used to be. A new
+unannotated def anywhere in headlamp_tpu/ fails this test before it
+reaches CI.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "headlamp_tpu")
+
+
+def iter_functions() -> list[tuple[str, ast.FunctionDef | ast.AsyncFunctionDef]]:
+    out: list[tuple[str, ast.FunctionDef | ast.AsyncFunctionDef]] = []
+    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
+        for filename in sorted(filenames):
+            if not filename.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, filename)
+            with open(path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.append((os.path.relpath(path, REPO), node))
+    return out
+
+
+def test_every_function_is_fully_annotated():
+    offenders: list[str] = []
+    for path, node in iter_functions():
+        args = [
+            a
+            for a in (*node.args.posonlyargs, *node.args.args, *node.args.kwonlyargs)
+            if a.arg not in ("self", "cls")
+        ]
+        unannotated = [a.arg for a in args if a.annotation is None]
+        if node.returns is None or unannotated:
+            what = []
+            if node.returns is None:
+                what.append("return")
+            what.extend(unannotated)
+            offenders.append(f"{path}:{node.lineno} {node.name}({', '.join(what)})")
+    assert not offenders, "unannotated defs (mypy gate coverage):\n" + "\n".join(offenders)
+
+
+def test_package_has_substantial_surface():
+    # Guard the walker itself: if the package moved, an empty walk
+    # would vacuously pass the test above.
+    assert len(iter_functions()) > 300

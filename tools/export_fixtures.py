@@ -13,7 +13,6 @@ the Python engine. Regenerate after topology changes:
 
 from __future__ import annotations
 
-import copy
 import json
 import os
 import sys
@@ -32,27 +31,11 @@ OUT_DIR = os.path.join(
 )
 
 
-def degraded_v5p32() -> dict:
-    """v5p-32 slice with worker 3 missing and worker 2 NotReady —
-    exercises the incomplete/degraded health paths both engines must
-    agree on."""
-    fleet = copy.deepcopy(fx.fleet_v5p32())
-    fleet["nodes"] = [
-        n for n in fleet["nodes"] if n["metadata"]["name"] != "gke-v5p-pool-w3"
-    ]
-    for n in fleet["nodes"]:
-        if n["metadata"]["name"] == "gke-v5p-pool-w2":
-            for c in n.get("status", {}).get("conditions", []):
-                if c.get("type") == "Ready":
-                    c["status"] = "False"
-    return fleet
-
-
 FLEETS = {
     "v5e4": fx.fleet_v5e4,
     "v5p32": fx.fleet_v5p32,
     "mixed": fx.fleet_mixed,
-    "v5p32-degraded": degraded_v5p32,
+    "v5p32-degraded": fx.fleet_v5p32_degraded,
     # Scale diversity for the TS parity replay: many slices, mixed
     # generations, plain nodes, and enough pods to exercise utilization
     # rounding and per-node attribution beyond the toy fleets.
