@@ -75,3 +75,47 @@ describe('both providers over one mixed cluster', () => {
     );
   });
 });
+
+describe('workloadAvailable vs pluginInstalled (Intel degradation axes)', () => {
+  // Two independent facts the pages must not conflate: "the CRD list
+  // is readable" (workloadAvailable) and "anything Intel is present"
+  // (pluginInstalled) — the reference collapses these; the rebuild
+  // keeps them apart so RBAC-denied CRDs don't read as not-installed.
+  function Probe() {
+    const intel = useIntelContext();
+    if (intel.loading) return <div data-testid="loader" />;
+    return (
+      <div>
+        <span data-testid="workload">{String(intel.workloadAvailable)}</span>
+        <span data-testid="installed">{String(intel.pluginInstalled)}</span>
+      </div>
+    );
+  }
+
+  it('unreadable CRD list: workload unavailable, yet installed via nodes', async () => {
+    const { fleet } = loadFixture('mixed');
+    // Default mock ApiProxy throws for the CRD path → unreadable; the
+    // fixture's GPU nodes still prove an installation.
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    render(
+      <IntelDataProvider>
+        <Probe />
+      </IntelDataProvider>
+    );
+    const workload = await screen.findByTestId('workload');
+    expect(workload.textContent).toBe('false');
+    expect(screen.getByTestId('installed').textContent).toBe('true');
+  });
+
+  it('empty cluster: neither axis claims presence', async () => {
+    setMockCluster({ nodes: [], pods: [] });
+    render(
+      <IntelDataProvider>
+        <Probe />
+      </IntelDataProvider>
+    );
+    const workload = await screen.findByTestId('workload');
+    expect(workload.textContent).toBe('false');
+    expect(screen.getByTestId('installed').textContent).toBe('false');
+  });
+});

@@ -1,0 +1,258 @@
+/**
+ * Hostile/malformed-input totality for the TPU domain mirror: every
+ * exported helper must be TOTAL — never throw, always land on its
+ * documented fallback — for the garbage a cluster can actually serve.
+ * The Python engine pins the same contract in its own suite
+ * (tests/test_domain_tpu.py); the shared fixtures tie the two mirrors
+ * together on well-formed fleets, and this file covers the ill-formed
+ * rest.
+ */
+
+import { describe, expect, it } from 'vitest';
+
+import {
+  containerChipBreakdown,
+  countPodPhases,
+  daemonsetStatusText,
+  daemonsetStatusToStatus,
+  dedupByUid,
+  filterTpuPluginPods,
+  filterTpuRequestingPods,
+  fleetStats,
+  formatAge,
+  formatChipCount,
+  formatGeneration,
+  getNodeChipAllocatable,
+  getPodChipRequest,
+  isTpuPluginPod,
+  isTpuRequestingPod,
+  podNodeName,
+  podPhase,
+  podRestarts,
+  rawObjectOf,
+  roundHalfEven,
+  waitingReason,
+} from './fleet';
+
+const GARBAGE: any[] = [
+  null,
+  undefined,
+  42,
+  'a-string',
+  true,
+  [],
+  {},
+  { metadata: 'oops' },
+  { metadata: { name: 7, labels: 'not-a-map', uid: 9 } },
+  { spec: 'none', status: [] },
+  { spec: { containers: 'many', nodeName: { a: 1 } } },
+  { spec: { containers: [null, 3, { resources: 'none' }, { resources: { requests: [] } }] } },
+  { status: { phase: '', containerStatuses: 'x', conditions: {} } },
+];
+
+describe('totality over garbage pods', () => {
+  it('string helpers return strings, never throw', () => {
+    for (const g of GARBAGE) {
+      expect(typeof podPhase(g)).toBe('string');
+      const node = podNodeName(g);
+      expect(node === null || typeof node === 'string').toBe(true);
+      expect(typeof waitingReason(g)).toBe('string');
+    }
+  });
+
+  it('numeric helpers return finite integers ≥ 0', () => {
+    for (const g of GARBAGE) {
+      for (const value of [getPodChipRequest(g), podRestarts(g), getNodeChipAllocatable(g)]) {
+        expect(Number.isInteger(value)).toBe(true);
+        expect(value).toBeGreaterThanOrEqual(0);
+      }
+    }
+  });
+
+  it('detection and breakdown fall back to negative/empty', () => {
+    for (const g of GARBAGE) {
+      expect(isTpuRequestingPod(g)).toBe(false);
+      expect(isTpuPluginPod(g)).toBe(false);
+      expect(containerChipBreakdown(g)).toEqual([]);
+    }
+    expect(filterTpuRequestingPods(GARBAGE)).toEqual([]);
+    expect(filterTpuPluginPods(GARBAGE)).toEqual([]);
+  });
+
+  it('missing/empty phase is Unknown, never the empty string', () => {
+    expect(podPhase(null as any)).toBe('Unknown');
+    expect(podPhase({})).toBe('Unknown');
+    expect(podPhase({ status: { phase: '' } })).toBe('Unknown');
+  });
+});
+
+describe('countPodPhases', () => {
+  it('routes prototype-chain phase names to Other, not NaN buckets', () => {
+    const hostile = [
+      { status: { phase: 'toString' } },
+      { status: { phase: 'constructor' } },
+      { status: { phase: 'hasOwnProperty' } },
+      { status: { phase: 'Running' } },
+    ];
+    const counts = countPodPhases(hostile as any);
+    expect(counts.Other).toBe(3);
+    expect(counts.Running).toBe(1);
+    for (const v of Object.values(counts)) expect(Number.isInteger(v)).toBe(true);
+  });
+
+  it('buckets every garbage pod somewhere (histogram is conservative)', () => {
+    const counts = countPodPhases(GARBAGE);
+    const total = Object.values(counts).reduce((a, b) => a + b, 0);
+    expect(total).toBe(GARBAGE.length);
+  });
+});
+
+describe('dedupByUid', () => {
+  it('drops missing and duplicate uids, preserves first-seen order', () => {
+    const a = { metadata: { name: 'a', uid: 'u1' } };
+    const b = { metadata: { name: 'b', uid: 'u2' } };
+    const aAgain = { metadata: { name: 'a-again', uid: 'u1' } };
+    const noUid = { metadata: { name: 'ghost' } };
+    expect(dedupByUid([a, noUid, b, aAgain])).toEqual([a, b]);
+  });
+});
+
+describe('fleetStats on garbage', () => {
+  it('aggregates to zeros with aligned per-node rows and no NaN', () => {
+    const stats = fleetStats(GARBAGE, GARBAGE);
+    expect(stats.capacity).toBe(0);
+    expect(stats.allocatable).toBe(0);
+    expect(stats.in_use).toBe(0);
+    expect(stats.utilization_pct).toBe(0);
+    expect(stats.max_node_util_pct).toBe(0);
+    expect(stats.hot_nodes).toBe(0);
+    expect(stats.nodes_total).toBe(GARBAGE.length);
+    expect(stats.per_node_in_use).toHaveLength(GARBAGE.length);
+    for (const v of stats.per_node_in_use) expect(v).toBe(0);
+    for (const v of Object.values(stats)) {
+      if (typeof v === 'number') expect(Number.isFinite(v)).toBe(true);
+    }
+  });
+});
+
+describe('roundHalfEven (Python round parity)', () => {
+  it('rounds .5 ties to the even neighbor', () => {
+    expect(roundHalfEven(0.5)).toBe(0);
+    expect(roundHalfEven(1.5)).toBe(2);
+    expect(roundHalfEven(2.5)).toBe(2);
+    expect(roundHalfEven(3.5)).toBe(4);
+    expect(roundHalfEven(-0.5)).toBe(0);
+  });
+
+  it('rounds non-ties normally', () => {
+    expect(roundHalfEven(2.4)).toBe(2);
+    expect(roundHalfEven(2.6)).toBe(3);
+    expect(roundHalfEven(7)).toBe(7);
+  });
+});
+
+describe('rawObjectOf', () => {
+  it('unwraps KubeObject wrappers and passes raw manifests through', () => {
+    const manifest = { metadata: { name: 'n' } };
+    expect(rawObjectOf({ jsonData: manifest })).toBe(manifest);
+    expect(rawObjectOf(manifest)).toBe(manifest);
+  });
+});
+
+describe('effective chip accounting', () => {
+  it('init containers overlap (max), main containers add (sum)', () => {
+    const pod = {
+      spec: {
+        containers: [
+          { name: 'a', resources: { requests: { 'google.com/tpu': '2' } } },
+          { name: 'b', resources: { limits: { 'google.com/tpu': '2' } } },
+        ],
+        initContainers: [
+          { name: 'warm', resources: { requests: { 'google.com/tpu': '8' } } },
+        ],
+      },
+    };
+    // max(sum(main)=4, max(init)=8) — the reference sums both
+    // (k8s.ts:289-301), which overcounts; the Python engine and this
+    // mirror agree on overlap semantics.
+    expect(getPodChipRequest(pod)).toBe(8);
+    const rows = containerChipBreakdown(pod);
+    expect(rows.map(r => [r.name, r.req, r.lim, r.init])).toEqual([
+      ['a', 2, 0, false],
+      ['b', 0, 2, false],
+      ['warm', 8, 0, true],
+    ]);
+  });
+});
+
+describe('waitingReason fallback chain', () => {
+  it('prefers the first container waiting reason', () => {
+    const pod = {
+      status: {
+        containerStatuses: [
+          { state: { running: {} } },
+          { state: { waiting: { reason: 'ImagePullBackOff' } } },
+        ],
+      },
+    };
+    expect(waitingReason(pod)).toBe('ImagePullBackOff');
+  });
+
+  it('falls back to the PodScheduled condition for unscheduled pods', () => {
+    const pod = {
+      status: {
+        containerStatuses: [],
+        conditions: [{ type: 'PodScheduled', status: 'False', reason: 'Unschedulable' }],
+      },
+    };
+    expect(waitingReason(pod)).toBe('Unschedulable');
+  });
+
+  it('returns empty when nothing explains the wait', () => {
+    expect(waitingReason({ status: {} })).toBe('');
+  });
+});
+
+describe('daemonset status', () => {
+  it('maps rollout shapes to severities and text', () => {
+    const healthy = { status: { desiredNumberScheduled: 2, numberReady: 2 } };
+    const rolling = {
+      status: { desiredNumberScheduled: 2, numberReady: 1, numberUnavailable: 1 },
+    };
+    const broken = { status: { desiredNumberScheduled: 2, numberReady: 0 } };
+    const unscheduled = { status: { desiredNumberScheduled: 0 } };
+    expect(daemonsetStatusToStatus(healthy)).toBe('success');
+    expect(daemonsetStatusToStatus(rolling)).toBe('warning');
+    expect(daemonsetStatusToStatus(broken)).toBe('error');
+    expect(daemonsetStatusToStatus(unscheduled)).toBe('warning');
+    expect(daemonsetStatusText(healthy)).toBe('2/2 ready');
+    expect(daemonsetStatusText(unscheduled)).toBe('No nodes scheduled');
+    expect(daemonsetStatusToStatus({} as any)).toBe('warning');
+  });
+});
+
+describe('formatters', () => {
+  it('formatGeneration displays unknown future generations verbatim', () => {
+    expect(formatGeneration('v5e')).toBe('TPU v5e');
+    expect(formatGeneration('v9')).toBe('TPU v9');
+    expect(formatGeneration('unknown')).toBe('TPU (unknown gen)');
+    expect(formatGeneration('')).toBe('TPU (unknown gen)');
+  });
+
+  it('formatChipCount pluralizes', () => {
+    expect(formatChipCount(1)).toBe('1 chip');
+    expect(formatChipCount(4)).toBe('4 chips');
+    expect(formatChipCount(0)).toBe('0 chips');
+  });
+
+  it('formatAge buckets s/m/h/d and never goes negative', () => {
+    const now = Date.parse('2026-07-30T12:00:00Z');
+    expect(formatAge('2026-07-30T11:59:30Z', now)).toBe('30s');
+    expect(formatAge('2026-07-30T11:58:00Z', now)).toBe('2m');
+    expect(formatAge('2026-07-30T09:00:00Z', now)).toBe('3h');
+    expect(formatAge('2026-07-28T12:00:00Z', now)).toBe('2d');
+    expect(formatAge('2026-07-30T13:00:00Z', now)).toBe('0s'); // future skew
+    expect(formatAge('not-a-date', now)).toBe('unknown');
+    expect(formatAge(null, now)).toBe('unknown');
+  });
+});

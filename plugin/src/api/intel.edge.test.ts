@@ -1,0 +1,181 @@
+/**
+ * Hostile/malformed-input totality + branch edges for the Intel GPU
+ * domain mirror — the same contract the Python suite pins for
+ * `headlamp_tpu/domain/intel.py`, and the detection/accounting rules
+ * the reference's k8s.ts defines (its :125-152 node rule, :250-264 pod
+ * rule), on inputs the fixture replay cannot reach.
+ */
+
+import { describe, expect, it } from 'vitest';
+
+import {
+  filterGpuRequestingPods,
+  filterIntelGpuNodes,
+  filterIntelPluginPods,
+  formatGpuResourceName,
+  formatGpuType,
+  getNodeGpuCount,
+  getNodeGpuType,
+  getPodDeviceRequest,
+  getPodGpuRequests,
+  intelAllocationSummary,
+  isGpuRequestingPod,
+  isIntelGpuNode,
+  pluginStatusText,
+  pluginStatusToStatus,
+} from './intel';
+
+const GARBAGE: any[] = [
+  null,
+  undefined,
+  0,
+  'node',
+  [],
+  {},
+  { metadata: { labels: 'not-a-map' } },
+  { status: { capacity: 7 } },
+  { spec: { containers: [{ resources: { requests: 'none' } }] } },
+];
+
+describe('totality over garbage', () => {
+  it('detection and counting never throw, land on negative/zero', () => {
+    for (const g of GARBAGE) {
+      expect(isIntelGpuNode(g)).toBe(false);
+      expect(isGpuRequestingPod(g)).toBe(false);
+      expect(getNodeGpuCount(g)).toBe(0);
+      expect(getNodeGpuType(g)).toBe('unknown');
+      expect(getPodGpuRequests(g)).toEqual({});
+      expect(getPodDeviceRequest(g)).toBe(0);
+    }
+    expect(filterIntelGpuNodes(GARBAGE)).toEqual([]);
+    expect(filterGpuRequestingPods(GARBAGE)).toEqual([]);
+    expect(filterIntelPluginPods(GARBAGE)).toEqual([]);
+  });
+
+  it('allocation over garbage is all-zero with no NaN', () => {
+    const alloc = intelAllocationSummary(GARBAGE, GARBAGE);
+    expect(alloc).toEqual({
+      capacity: 0,
+      allocatable: 0,
+      in_use: 0,
+      free: 0,
+      utilization_pct: 0,
+    });
+  });
+});
+
+describe('node detection rule (label OR capacity prefix)', () => {
+  it('accepts the NFD label, either role label, or a gpu.intel.com resource', () => {
+    expect(
+      isIntelGpuNode({
+        metadata: { labels: { 'intel.feature.node.kubernetes.io/gpu': 'true' } },
+      })
+    ).toBe(true);
+    expect(
+      isIntelGpuNode({ metadata: { labels: { 'node-role.kubernetes.io/igpu': 'true' } } })
+    ).toBe(true);
+    expect(
+      isIntelGpuNode({ status: { capacity: { 'gpu.intel.com/xe': '1' } } })
+    ).toBe(true);
+    // The label value must be exactly 'true' — a labeled-but-false
+    // node is not a GPU node.
+    expect(
+      isIntelGpuNode({
+        metadata: { labels: { 'intel.feature.node.kubernetes.io/gpu': 'false' } },
+      })
+    ).toBe(false);
+  });
+
+  it('counts i915 + xe devices, ignores millicores and memory', () => {
+    const node = {
+      status: {
+        capacity: {
+          'gpu.intel.com/i915': '2',
+          'gpu.intel.com/xe': '1',
+          'gpu.intel.com/millicores': '2000',
+          'gpu.intel.com/memory.max': '8000000000',
+        },
+      },
+    };
+    expect(getNodeGpuCount(node)).toBe(3);
+  });
+});
+
+describe('pod accounting (init containers overlap, not add)', () => {
+  it('takes max(sum(main), max(init)) per resource', () => {
+    const pod = {
+      spec: {
+        containers: [
+          { resources: { requests: { 'gpu.intel.com/i915': '1' } } },
+          { resources: { requests: { 'gpu.intel.com/i915': '1' } } },
+        ],
+        initContainers: [{ resources: { requests: { 'gpu.intel.com/i915': '3' } } }],
+      },
+    };
+    expect(getPodGpuRequests(pod)).toEqual({ 'gpu.intel.com/i915': 3 });
+    expect(getPodDeviceRequest(pod)).toBe(3);
+  });
+
+  it('detects limit-only pods (requests∪limits, reference k8s.ts:250-264)', () => {
+    const pod = {
+      spec: { containers: [{ resources: { limits: { 'gpu.intel.com/i915': '1' } } }] },
+    };
+    expect(isGpuRequestingPod(pod)).toBe(true);
+  });
+});
+
+describe('CRD rollout status', () => {
+  it('maps rollout counters to severity and text', () => {
+    expect(pluginStatusToStatus({ status: { desiredNumberScheduled: 2, numberReady: 2 } })).toBe(
+      'success'
+    );
+    expect(pluginStatusToStatus({ status: { desiredNumberScheduled: 2, numberReady: 1 } })).toBe(
+      'error'
+    );
+    expect(pluginStatusToStatus({ status: { desiredNumberScheduled: 0 } })).toBe('warning');
+    expect(pluginStatusToStatus({} as any)).toBe('warning');
+    expect(pluginStatusText({ status: { desiredNumberScheduled: 2, numberReady: 1 } })).toBe(
+      '1/2 ready'
+    );
+    expect(pluginStatusText({} as any)).toBe('No nodes scheduled');
+  });
+});
+
+describe('formatters', () => {
+  it('pretty-prints known resources, wraps unknown suffixes, passes foreign keys', () => {
+    expect(formatGpuResourceName('gpu.intel.com/i915')).toBe('GPU (i915)');
+    expect(formatGpuResourceName('gpu.intel.com/memory.max')).toBe('GPU memory');
+    expect(formatGpuResourceName('gpu.intel.com/new-thing')).toBe('GPU (new-thing)');
+    expect(formatGpuResourceName('google.com/tpu')).toBe('google.com/tpu');
+  });
+
+  it('formats GPU types with an Intel fallback', () => {
+    expect(formatGpuType('discrete')).toBe('Discrete GPU');
+    expect(formatGpuType('integrated')).toBe('Integrated GPU');
+    expect(formatGpuType('unknown')).toBe('Intel GPU');
+  });
+});
+
+describe('allocation summary semantics', () => {
+  it('counts only Running pods and leaves over-commit unclamped', () => {
+    const node = {
+      status: {
+        capacity: { 'gpu.intel.com/i915': '2' },
+        allocatable: { 'gpu.intel.com/i915': '2' },
+      },
+    };
+    const running = {
+      spec: { containers: [{ resources: { requests: { 'gpu.intel.com/i915': '3' } } }] },
+      status: { phase: 'Running' },
+    };
+    const pending = {
+      spec: { containers: [{ resources: { requests: { 'gpu.intel.com/i915': '1' } } }] },
+      status: { phase: 'Pending' },
+    };
+    const alloc = intelAllocationSummary([node], [running, pending]);
+    expect(alloc.capacity).toBe(2);
+    expect(alloc.in_use).toBe(3); // pending excluded, Running counted
+    expect(alloc.free).toBe(-1); // unclamped, same as objects.allocation_summary
+    expect(alloc.utilization_pct).toBe(150);
+  });
+});

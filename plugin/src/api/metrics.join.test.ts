@@ -1,0 +1,97 @@
+/**
+ * The Prometheus sample→node join and the response-shape guards: the
+ * pieces every scrape funnels through before a chip card or heat tint
+ * can render. Mirrors `headlamp_tpu/metrics/client.py`'s `_node_of` /
+ * instance-map semantics (the two providers share one join so they
+ * fail identically); totality on hostile response bodies matches the
+ * Python client's own malformed-response tests.
+ */
+
+import { describe, expect, it } from 'vitest';
+
+import {
+  buildInstanceMap,
+  nodeOf,
+  normalizeFraction,
+  sampleLabels,
+  sampleValue,
+  vectorResult,
+} from './metrics';
+
+describe('vectorResult (response-shape guard)', () => {
+  it('accepts only a success vector payload', () => {
+    const good = {
+      status: 'success',
+      data: { resultType: 'vector', result: [{ metric: { node: 'a' }, value: [0, '1'] }] },
+    };
+    expect(vectorResult(good)).toHaveLength(1);
+  });
+
+  it('rejects errors, scalars, and junk without throwing', () => {
+    expect(vectorResult(null)).toEqual([]);
+    expect(vectorResult('Forbidden')).toEqual([]);
+    expect(vectorResult({ status: 'error' })).toEqual([]);
+    expect(
+      vectorResult({ status: 'success', data: { resultType: 'scalar', result: [0, '1'] } })
+    ).toEqual([]);
+    expect(
+      vectorResult({ status: 'success', data: { resultType: 'vector', result: 'x' } })
+    ).toEqual([]);
+    // Junk entries inside an otherwise-valid vector are dropped.
+    expect(
+      vectorResult({
+        status: 'success',
+        data: { resultType: 'vector', result: [null, 3, { metric: {} }] },
+      })
+    ).toHaveLength(1);
+  });
+});
+
+describe('sampleValue / sampleLabels totality', () => {
+  it('parses well-formed values and nulls the rest', () => {
+    expect(sampleValue({ value: [0, '0.75'] })).toBe(0.75);
+    expect(sampleValue({ value: [0, 'NaN-ish'] })).toBeNull();
+    expect(sampleValue({ value: ['lonely'] as any })).toBeNull();
+    expect(sampleValue({})).toBeNull();
+    expect(sampleLabels({})).toEqual({});
+    expect(sampleLabels({ metric: { node: 'n' } })).toEqual({ node: 'n' });
+  });
+});
+
+describe('nodeOf join chain', () => {
+  const instanceMap = { '10.0.0.7:9100': 'gke-w0', '10.0.0.7': 'gke-w0' };
+
+  it('prefers explicit node labels over the instance map', () => {
+    expect(nodeOf({ node: 'direct', instance: '10.0.0.7:9100' }, instanceMap)).toBe('direct');
+    expect(nodeOf({ kubernetes_node: 'k8s-node' }, instanceMap)).toBe('k8s-node');
+  });
+
+  it('falls back to the instance map, then to the stripped host', () => {
+    expect(nodeOf({ instance: '10.0.0.7:9100' }, instanceMap)).toBe('gke-w0');
+    // Port-less lookup hits the stripped entry the map also carries.
+    expect(nodeOf({ instance: '10.0.0.7' }, instanceMap)).toBe('gke-w0');
+    // Unknown instance: the bare host is better than nothing.
+    expect(nodeOf({ instance: '10.9.9.9:9100' }, instanceMap)).toBe('10.9.9.9');
+    expect(nodeOf({}, instanceMap)).toBe('unknown');
+  });
+});
+
+describe('buildInstanceMap', () => {
+  it('maps both the ported and port-stripped instance forms', () => {
+    const map = buildInstanceMap([
+      { metric: { instance: '10.0.0.7:9100', nodename: 'gke-w0' } },
+      { metric: { instance: 'bad-sample-no-nodename' } },
+      {},
+    ]);
+    expect(map).toEqual({ '10.0.0.7:9100': 'gke-w0', '10.0.0.7': 'gke-w0' });
+  });
+});
+
+describe('normalizeFraction (the ONE scale authority)', () => {
+  it('passes 0-1 fractions through and divides 0-100 exporters down', () => {
+    expect(normalizeFraction(0.8)).toBe(0.8);
+    expect(normalizeFraction(1.2)).toBe(1.2); // within FRACTION_MAX slack
+    expect(normalizeFraction(80)).toBe(0.8);
+    expect(normalizeFraction(100)).toBe(1);
+  });
+});

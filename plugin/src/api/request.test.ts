@@ -7,7 +7,7 @@
  */
 
 import { afterEach, beforeEach, describe, expect, it, vi } from 'vitest';
-import { raceDeadline, REQUEST_TIMEOUT_MS } from './request';
+import { isKubeList, raceDeadline, REQUEST_TIMEOUT_MS } from './request';
 
 describe('raceDeadline', () => {
   beforeEach(() => {
@@ -54,5 +54,24 @@ describe('raceDeadline', () => {
     // The losing deadline timer must not linger: a page polling every
     // few seconds would otherwise strand a queue of live 2 s timers.
     expect(vi.getTimerCount()).toBe(0);
+  });
+});
+
+describe('isKubeList', () => {
+  it('accepts anything carrying an items array', () => {
+    expect(isKubeList({ items: [] })).toBe(true);
+    expect(isKubeList({ items: [1, 2], metadata: {} })).toBe(true);
+  });
+
+  it('rejects the shapes an apiserver error path actually produces', () => {
+    // Status objects, HTML error bodies parsed to strings, nulls —
+    // every CRD fallback branch funnels through this guard.
+    expect(isKubeList(null)).toBe(false);
+    expect(isKubeList(undefined)).toBe(false);
+    expect(isKubeList('Forbidden')).toBe(false);
+    expect(isKubeList({ kind: 'Status', code: 403 })).toBe(false);
+    expect(isKubeList({ items: 'not-an-array' })).toBe(false);
+    expect(isKubeList({ items: {} })).toBe(false);
+    expect(isKubeList([])).toBe(false);
   });
 });

@@ -1,0 +1,165 @@
+/**
+ * Degenerate and hostile shapes for the slice/mesh engine: pool-less
+ * nodes, single-host pools, unparseable topology strings, and the
+ * worker-id edge cases — the branches the fixture replay (well-formed
+ * fleets only) cannot reach. Mirrors the Python engine's own edge
+ * suite over `headlamp_tpu/topology/slices.py`.
+ */
+
+import { describe, expect, it } from 'vitest';
+
+import {
+  buildMeshLayout,
+  getNodeWorkerId,
+  getTpuGeneration,
+  groupSlices,
+  naturalCompare,
+  parseIntLenient,
+  parseTopology,
+  sliceHealth,
+  sliceMissingWorkerIds,
+  summarizeSlices,
+  topologyChipCount,
+} from './topology';
+
+const ACCEL = 'cloud.google.com/gke-tpu-accelerator';
+const TOPO = 'cloud.google.com/gke-tpu-topology';
+const POOL = 'cloud.google.com/gke-nodepool';
+const WORKER = 'cloud.google.com/gke-tpu-worker-id';
+
+function tpuNode(
+  name: string,
+  labels: Record<string, string>,
+  chips = 4,
+  ready = true
+): Record<string, any> {
+  return {
+    metadata: { name, labels: { [ACCEL]: 'tpu-v5p-slice', ...labels } },
+    status: {
+      capacity: { 'google.com/tpu': String(chips) },
+      allocatable: { 'google.com/tpu': String(chips) },
+      conditions: [{ type: 'Ready', status: ready ? 'True' : 'False' }],
+    },
+  };
+}
+
+describe('parseIntLenient (objects.parse_int parity)', () => {
+  it('parses signed prefixes, truncates numbers, zeroes garbage', () => {
+    expect(parseIntLenient('8')).toBe(8);
+    expect(parseIntLenient(' +3 ')).toBe(3);
+    expect(parseIntLenient('-2')).toBe(-2);
+    expect(parseIntLenient('12abc')).toBe(12);
+    expect(parseIntLenient(7.9)).toBe(7);
+    expect(parseIntLenient(true)).toBe(1);
+    expect(parseIntLenient(false)).toBe(0);
+    expect(parseIntLenient('x')).toBe(0);
+    expect(parseIntLenient(null)).toBe(0);
+    expect(parseIntLenient([])).toBe(0);
+    expect(parseIntLenient({})).toBe(0);
+  });
+});
+
+describe('parseTopology', () => {
+  it('accepts NxM…, rejects zero dims and junk', () => {
+    expect(parseTopology('2x2x4')).toEqual([2, 2, 4]);
+    expect(parseTopology(' 4 ')).toEqual([4]);
+    expect(parseTopology('0x4')).toEqual([]);
+    expect(parseTopology('2x-1')).toEqual([]);
+    expect(parseTopology('x')).toEqual([]);
+    expect(parseTopology('')).toEqual([]);
+    expect(parseTopology(null)).toEqual([]);
+    expect(parseTopology(undefined)).toEqual([]);
+  });
+
+  it('chip count multiplies dims, empty is zero', () => {
+    expect(topologyChipCount([2, 2, 4])).toBe(16);
+    expect(topologyChipCount([4])).toBe(4);
+    expect(topologyChipCount([])).toBe(0);
+  });
+});
+
+describe('getTpuGeneration', () => {
+  it('maps known accelerators, guesses tpu-v prefixes, else unknown', () => {
+    expect(getTpuGeneration('tpu-v5p-slice')).toBe('v5p');
+    expect(getTpuGeneration('tpu-v5-lite-podslice')).toBe('v5e');
+    expect(getTpuGeneration('tpu-v7x-mega')).toBe('v7x');
+    expect(getTpuGeneration('gpu-h100')).toBe('unknown');
+    expect(getTpuGeneration(null)).toBe('unknown');
+  });
+});
+
+describe('getNodeWorkerId', () => {
+  it('distinguishes a real 0 from an unparseable label', () => {
+    expect(getNodeWorkerId(tpuNode('n', { [WORKER]: '0' }))).toBe(0);
+    expect(getNodeWorkerId(tpuNode('n', { [WORKER]: '3' }))).toBe(3);
+    expect(getNodeWorkerId(tpuNode('n', { [WORKER]: 'x' }))).toBeNull();
+    expect(getNodeWorkerId(tpuNode('n', { [WORKER]: '' }))).toBeNull();
+    expect(getNodeWorkerId(tpuNode('n', {}))).toBeNull();
+  });
+});
+
+describe('naturalCompare', () => {
+  it('orders embedded numbers numerically', () => {
+    expect(naturalCompare('w2', 'w10')).toBeLessThan(0);
+    expect(naturalCompare('w10', 'w2')).toBeGreaterThan(0);
+    expect(naturalCompare('a2b', 'a10b')).toBeLessThan(0);
+    expect(naturalCompare('same', 'same')).toBe(0);
+  });
+});
+
+describe('groupSlices on degenerate shapes', () => {
+  it('pool-less TPU nodes each form their own degenerate slice', () => {
+    const slices = groupSlices([
+      tpuNode('loner-b', { [TOPO]: '2x2' }),
+      tpuNode('loner-a', { [TOPO]: '2x2' }),
+      { metadata: { name: 'plain' } }, // non-TPU: ignored
+    ]);
+    expect(slices).toHaveLength(2);
+    expect(slices.map(s => s.slice_id)).toEqual(['node/loner-b', 'node/loner-a']);
+    for (const s of slices) expect(s.workers).toHaveLength(1);
+  });
+
+  it('a single-host pool holds one slice PER node, not one merged slice', () => {
+    // An autoscaled v5e-4 pool: topology 2x2 fits on one host, so two
+    // nodes are two independent slices — merging would undercount
+    // chips and misreport health (slices.py's pool rule).
+    const v5e = { [ACCEL]: 'tpu-v5-lite-podslice' };
+    const slices = groupSlices([
+      tpuNode('pool-w10', { ...v5e, [POOL]: 'v5e-pool', [TOPO]: '2x2' }),
+      tpuNode('pool-w2', { ...v5e, [POOL]: 'v5e-pool', [TOPO]: '2x2' }),
+    ]);
+    expect(slices).toHaveLength(2);
+    // Natural order: w2 before w10.
+    expect(slices.map(s => s.slice_id)).toEqual([
+      'v5e-pool/pool-w2',
+      'v5e-pool/pool-w10',
+    ]);
+    const summary = summarizeSlices(slices);
+    expect(summary.multi_host).toBe(0);
+    expect(summary.total_chips).toBe(8);
+  });
+
+  it('an unparseable topology label degrades to observed workers', () => {
+    const slices = groupSlices([
+      tpuNode('w0', { [POOL]: 'weird-pool', [TOPO]: 'banana', [WORKER]: '0' }),
+    ]);
+    expect(slices).toHaveLength(1);
+    expect(slices[0].dims).toEqual([]);
+    // No dims → expected hosts = observed workers → nothing missing.
+    expect(sliceMissingWorkerIds(slices[0])).toEqual([]);
+    expect(sliceHealth(slices[0])).toBe('success');
+    const layout = buildMeshLayout(slices[0]);
+    // Degenerate mesh still renders: one cell per observed chip.
+    expect(layout.cells.length).toBeGreaterThan(0);
+  });
+
+  it('a not-ready single-host slice is degraded, never incomplete', () => {
+    const slices = groupSlices([
+      tpuNode('sick', { [POOL]: 'p', [TOPO]: '2x2' }, 4, false),
+    ]);
+    expect(sliceHealth(slices[0])).toBe('warning');
+    const summary = summarizeSlices(slices);
+    expect(summary.degraded).toBe(1);
+    expect(summary.incomplete).toBe(0);
+  });
+});

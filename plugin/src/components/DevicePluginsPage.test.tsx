@@ -54,6 +54,14 @@ afterEach(() => {
   resetRequestLog();
 });
 
+describe('loading state', () => {
+  it('shows the loader while lists are pending', () => {
+    setMockCluster({ nodes: null, pods: null });
+    mount();
+    expect(screen.getByTestId('loader')).toBeTruthy();
+  });
+});
+
 describe('unreadable DaemonSet lists', () => {
   it('reports not-readable, never claims not-installed', async () => {
     const { fleet, expected } = loadFixture('mixed');

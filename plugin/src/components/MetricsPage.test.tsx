@@ -48,6 +48,14 @@ afterEach(async () => {
   resetMetricsCache();
 });
 
+describe('loading state', () => {
+  it('shows the scrape loader while the discovery chain is in flight', () => {
+    setMockCluster({ nodes: [], pods: [] });
+    render(<MetricsPage />);
+    expect(screen.getByTestId('loader')).toBeTruthy();
+  });
+});
+
 describe('unreachable Prometheus', () => {
   it('renders the guided install box, never crashes', async () => {
     // The mock ApiProxy throws for every non-/pods URL, so the whole

@@ -80,3 +80,48 @@ describe('PodDetailSection', () => {
     expect(container.querySelector('section')).toBeNull();
   });
 });
+
+describe('raw (unwrapped) inputs', () => {
+  // Headlamp hands detail sections KubeObject wrappers, but the
+  // contract accepts raw manifests too (`rawObjectOf`; the reference
+  // tests both shapes, NodeDetailSection.test.tsx:84-95) — a Headlamp
+  // version that stops wrapping must not blank the sections.
+  it('NodeDetailSection accepts a raw node object', async () => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mount(<NodeDetailSection resource={fleet.nodes[0] as any} />);
+    expect(await screen.findByText('Cloud TPU')).toBeTruthy();
+  });
+
+  it('NodeDetailSection renders nothing for a raw plain node', () => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    const { container } = mount(
+      <NodeDetailSection resource={{ metadata: { name: 'plain' } } as any} />
+    );
+    expect(container.querySelector('section')).toBeNull();
+  });
+
+  it('PodDetailSection accepts a raw pod object', () => {
+    const { fleet } = loadFixture('v5p32');
+    const tpuPod = fleet.pods.find((p: any) => JSON.stringify(p).includes('google.com/tpu'));
+    render(<PodDetailSection resource={tpuPod as any} />);
+    expect(screen.getByText('TPU Resources')).toBeTruthy();
+  });
+
+  it('PodDetailSection renders nothing for a raw plain pod', () => {
+    const { container } = render(
+      <PodDetailSection resource={{ metadata: { name: 'web' } } as any} />
+    );
+    expect(container.querySelector('section')).toBeNull();
+  });
+
+  it('both render nothing for an empty wrapper', () => {
+    const { fleet } = loadFixture('v5p32');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    const node = mount(<NodeDetailSection resource={{} as any} />);
+    expect(node.container.querySelector('section')).toBeNull();
+    const pod = render(<PodDetailSection resource={{} as any} />);
+    expect(pod.container.querySelector('section')).toBeNull();
+  });
+});

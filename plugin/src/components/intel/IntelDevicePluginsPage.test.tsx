@@ -48,6 +48,14 @@ afterEach(() => {
   resetRequestLog();
 });
 
+describe('loading state', () => {
+  it('shows the loader while lists are pending', () => {
+    setMockCluster({ nodes: null, pods: null });
+    mount();
+    expect(screen.getByTestId('loader')).toBeTruthy();
+  });
+});
+
 describe('CRD unreadable', () => {
   it('renders the CRD notice, keeps the pod table', async () => {
     const { fleet } = loadFixture('mixed');

@@ -51,6 +51,14 @@ afterEach(() => {
   resetRequestLog();
 });
 
+describe('loading state', () => {
+  it('shows the scrape loader while the discovery chain is in flight', () => {
+    setMockCluster({ nodes: [], pods: [] });
+    render(<IntelMetricsPage />);
+    expect(screen.getByTestId('loader')).toBeTruthy();
+  });
+});
+
 describe('unreachable Prometheus', () => {
   it('renders the availability matrix and the probe list', async () => {
     setMockCluster({ nodes: [], pods: [] });

@@ -28,6 +28,40 @@ afterEach(() => {
   resetRequestLog();
 });
 
+describe('raw (unwrapped) inputs', () => {
+  // Same contract as the TPU sections (reference
+  // NodeDetailSection.test.tsx:84-95): raw manifests work without the
+  // KubeObject wrapper.
+  it('IntelNodeDetailSection accepts a raw GPU node', async () => {
+    const { fleet, expected } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    const want = expected.intel as any;
+    const gpuNode = fleet.nodes.find(
+      (n: any) => n?.metadata?.name === want.node_names[0]
+    );
+    mount(<IntelNodeDetailSection resource={gpuNode as any} />);
+    expect(await screen.findByText('Intel GPU')).toBeTruthy();
+  });
+
+  it('IntelPodDetailSection renders nothing for a raw plain pod', () => {
+    const { container } = render(
+      <IntelPodDetailSection resource={{ metadata: { name: 'web' } } as any} />
+    );
+    expect(container.querySelector('section')).toBeNull();
+  });
+
+  it('IntelNodeDetailSection shows Loading… while pod lists are pending', async () => {
+    const { fleet, expected } = loadFixture('mixed');
+    const want = expected.intel as any;
+    const gpuNode = fleet.nodes.find(
+      (n: any) => n?.metadata?.name === want.node_names[0]
+    );
+    setMockCluster({ nodes: fleet.nodes, pods: null });
+    mount(<IntelNodeDetailSection resource={{ jsonData: gpuNode } as any} />);
+    expect(await screen.findByText('Loading…')).toBeTruthy();
+  });
+});
+
 describe('IntelNodeDetailSection', () => {
   it('renders devices, utilization, and the pods list for a GPU node', async () => {
     const { fleet } = loadFixture('mixed');

@@ -43,7 +43,13 @@ def test_every_function_is_fully_annotated():
     for path, node in iter_functions():
         args = [
             a
-            for a in (*node.args.posonlyargs, *node.args.args, *node.args.kwonlyargs)
+            for a in (
+                *node.args.posonlyargs,
+                *node.args.args,
+                *node.args.kwonlyargs,
+                *([node.args.vararg] if node.args.vararg else []),
+                *([node.args.kwarg] if node.args.kwarg else []),
+            )
             if a.arg not in ("self", "cls")
         ]
         unannotated = [a.arg for a in args if a.annotation is None]
