@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import os
 import re
+import subprocess
 import sys
 
 import pytest
@@ -173,3 +174,41 @@ def test_release_workflow_wires_the_loop():
     # `on:` parses to the boolean-ish key True in YAML 1.1.
     triggers = doc.get("on") or doc.get(True)
     assert triggers["push"]["tags"] == ["v*"]
+
+
+def run_cli(target: object, sha256: str) -> "subprocess.CompletedProcess[str]":
+    """Invoke the stamping tool the way the release workflow does."""
+    tool = os.path.join(REPO, "tools", "release_catalog.py")
+    return subprocess.run(
+        [
+            sys.executable, tool,
+            "--version", "0.3.0",
+            "--archive-url", URL,
+            "--sha256", sha256,
+            "--path", str(target),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+
+
+def test_cli_stamps_a_file_in_place(tmp_path):
+    # The release workflow invokes the tool as a CLI; the arg wiring
+    # and in-place rewrite deserve one end-to-end pass.
+    target = tmp_path / "artifacthub-pkg.yml"
+    target.write_text(catalog_text())
+    proc = run_cli(target, DIGEST)
+    assert proc.returncode == 0, proc.stderr
+    doc = yaml.safe_load(target.read_text())
+    assert doc["annotations"][CHECKSUM_KEY] == f"sha256:{DIGEST}"
+    assert str(doc["version"]) == "0.3.0"
+
+
+def test_cli_rejects_a_bad_digest(tmp_path):
+    target = tmp_path / "artifacthub-pkg.yml"
+    target.write_text(catalog_text())
+    proc = run_cli(target, "nope")
+    assert proc.returncode != 0
+    # The file must be untouched on failure.
+    assert target.read_text() == catalog_text()
