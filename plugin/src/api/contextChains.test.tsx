@@ -1,0 +1,159 @@
+/**
+ * Imperative-track chain semantics for both providers — the TS mirror
+ * of the Python suite's `tests/test_context.py` chain cases, pinned
+ * against `accelerator_context.py:_fetch_plugin_pods`: BOTH labeled
+ * selectors always run and merge (split-label installs), the
+ * namespace-wide fallback runs only when no labeled selector produced
+ * a daemon pod, results dedup by UID across selectors, and only an
+ * all-paths failure surfaces as the one chain error.
+ */
+
+import { render, screen } from '@testing-library/react';
+import React from 'react';
+import { afterEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('../testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('../testing/mockCommonComponents')
+);
+
+import {
+  requestLog,
+  resetRequestLog,
+  setMockApiHandler,
+  setMockCluster,
+} from '../testing/mockHeadlampLib';
+import { IntelDataProvider, useIntelContext } from './IntelDataContext';
+import { TpuDataProvider, useTpuContext } from './TpuDataContext';
+
+const NAMESPACE_URL = '/api/v1/namespaces/kube-system/pods';
+
+function pluginPod(name: string, labelKey: string): Record<string, any> {
+  return {
+    metadata: {
+      name,
+      namespace: 'kube-system',
+      uid: `uid-${name}`,
+      labels: { [labelKey]: 'tpu-device-plugin' },
+    },
+    status: { phase: 'Running' },
+  };
+}
+
+function TpuProbe() {
+  const ctx = useTpuContext();
+  if (ctx.loading) return <div data-testid="loader" />;
+  return (
+    <div>
+      <span data-testid="plugin-pods">{ctx.pluginPods.map(p => p.metadata.name).join(',')}</span>
+      <span data-testid="error">{ctx.error ?? 'none'}</span>
+    </div>
+  );
+}
+
+function mountTpu() {
+  return render(
+    <TpuDataProvider>
+      <TpuProbe />
+    </TpuDataProvider>
+  );
+}
+
+afterEach(() => {
+  setMockApiHandler(null);
+  resetRequestLog();
+});
+
+describe('TPU plugin-pod selector chain', () => {
+  it('merges BOTH labeled selectors and skips the namespace fallback', async () => {
+    setMockCluster({ nodes: [], pods: [] });
+    const byK8sApp = pluginPod('dp-k8s-app', 'k8s-app');
+    const byApp = pluginPod('dp-app', 'app');
+    setMockApiHandler(url => {
+      if (url.includes('labelSelector=k8s-app')) return { items: [byK8sApp] };
+      if (url.includes('labelSelector=app')) return { items: [byApp] };
+      return undefined;
+    });
+    mountTpu();
+    const pods = await screen.findByTestId('plugin-pods');
+    // A split-label install: stopping after the first hit would hide
+    // half the DaemonSet (accelerator_context.py:420-458 merges).
+    expect(pods.textContent).toBe('dp-k8s-app,dp-app');
+    expect(requestLog.some(u => u === NAMESPACE_URL)).toBe(false);
+  });
+
+  it('falls back to the namespace listing only when labels found nothing', async () => {
+    setMockCluster({ nodes: [], pods: [] });
+    const unlabeledVariant = pluginPod('dp-ns', 'app.kubernetes.io/name');
+    setMockApiHandler(url => {
+      if (url.includes('labelSelector=')) return { items: [] };
+      if (url === NAMESPACE_URL) return { items: [unlabeledVariant] };
+      return undefined;
+    });
+    mountTpu();
+    const pods = await screen.findByTestId('plugin-pods');
+    expect(pods.textContent).toBe('dp-ns');
+    expect(requestLog.some(u => u === NAMESPACE_URL)).toBe(true);
+  });
+
+  it('dedups one pod answered by both selectors', async () => {
+    setMockCluster({ nodes: [], pods: [] });
+    const both = {
+      ...pluginPod('dp-both', 'k8s-app'),
+      metadata: {
+        name: 'dp-both',
+        namespace: 'kube-system',
+        uid: 'uid-shared',
+        labels: { 'k8s-app': 'tpu-device-plugin', app: 'tpu-device-plugin' },
+      },
+    };
+    setMockApiHandler(url => (url.includes('labelSelector=') ? { items: [both] } : undefined));
+    mountTpu();
+    const pods = await screen.findByTestId('plugin-pods');
+    expect(pods.textContent).toBe('dp-both');
+  });
+
+  it('reports ONE chain error only when every path failed', async () => {
+    setMockCluster({ nodes: [], pods: [] });
+    setMockApiHandler(() => {
+      throw new Error('RBAC: pods is forbidden');
+    });
+    mountTpu();
+    const error = await screen.findByTestId('error');
+    expect(error.textContent).toBe('failed to query device-plugin pods');
+  });
+
+  it('a 200-with-nothing somewhere along the chain is NOT an error', async () => {
+    setMockCluster({ nodes: [], pods: [] });
+    setMockApiHandler(url => {
+      if (url.includes('labelSelector=k8s-app')) return { items: [] };
+      throw new Error('other paths down');
+    });
+    mountTpu();
+    const error = await screen.findByTestId('error');
+    // A healthy cluster with no plugin installed answers empty — the
+    // banner is reserved for cannot-know (every path failing).
+    expect(error.textContent).toBe('none');
+  });
+});
+
+describe('Intel chain ordering', () => {
+  it('queries the CRD list before the pod selectors', async () => {
+    setMockCluster({ nodes: [], pods: [] });
+    setMockApiHandler(() => ({ items: [] }));
+    function Probe() {
+      const ctx = useIntelContext();
+      return ctx.loading ? <div data-testid="loader" /> : <div data-testid="done" />;
+    }
+    render(
+      <IntelDataProvider>
+        <Probe />
+      </IntelDataProvider>
+    );
+    await screen.findByTestId('done');
+    const crdIndex = requestLog.findIndex(u => u.includes('/gpudeviceplugins'));
+    const firstPodIndex = requestLog.findIndex(u => u.includes('labelSelector='));
+    expect(crdIndex).toBeGreaterThanOrEqual(0);
+    expect(firstPodIndex).toBeGreaterThan(crdIndex);
+  });
+});

@@ -21,12 +21,12 @@ import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
+from export_sdk_props import REFERENCE_SRC  # noqa: E402
 from ts_static_check import derive_component_props, parse_source  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURE = os.path.join(REPO, "fixtures", "sdk_prop_usage.json")
 MOCK_KIT = os.path.join(REPO, "plugin", "src", "testing", "mockCommonComponents.tsx")
-REFERENCE_SRC = "/root/reference/src"
 
 
 def load_fixture() -> dict[str, list[str]]:

@@ -62,16 +62,21 @@ def collect_reference_usage(root: str = REFERENCE_SRC) -> dict[str, list[str]]:
                 # evidence; the reference parses clean in practice.
                 continue
             info = _extract_modules(result)
-            common: set[str] = set()
-            for module, names in info.imports.items():
+            # JSX tags carry the LOCAL alias; the fixture records the
+            # SDK's canonical name — map local -> original so
+            # `import { SimpleTable as Table }` still contributes.
+            local_to_original: dict[str, str] = {}
+            for module, pairs in info.import_pairs.items():
                 if COMMON_COMPONENTS in module:
-                    common.update(name for name, _line in names)
-            if not common:
+                    for original, local, _line in pairs:
+                        local_to_original[local] = original
+            if not local_to_original:
                 continue
             for tag in result.jsx_tags:
                 head = tag.name.split(".")[0]
-                if head in common:
-                    props = usage.setdefault(head, set())
+                canonical = local_to_original.get(head)
+                if canonical is not None:
+                    props = usage.setdefault(canonical, set())
                     for attr in tag.attrs:
                         # Spreads carry no prop name; React built-ins
                         # (`key`…) are React's API, not the SDK's.

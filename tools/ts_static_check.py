@@ -480,6 +480,10 @@ class ModuleInfo:
     #: local aliases bound by import statements, with the line they
     #: were bound on — the unused-import check's input.
     imported_locals: list[tuple[str, int]] = field(default_factory=list)
+    #: module -> [(original, local, line)] — consumers that must match
+    #: JSX tags (which use the LOCAL alias) back to a source module's
+    #: canonical name (e.g. tools/export_sdk_props.py).
+    import_pairs: dict[str, list[tuple[str, str, int]]] = field(default_factory=dict)
 
 
 def _brace_entries(
@@ -559,6 +563,7 @@ def _extract_modules(result: ParseResult) -> ModuleInfo:
                 for original, local, line in pending:
                     info.defined.add(local)
                     info.imported_locals.append((local, line))
+                    info.import_pairs.setdefault(module, []).append((original, local, line))
                     if original != "*":
                         record_import(module, original, line)
                 i = j + 1
