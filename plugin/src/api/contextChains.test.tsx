@@ -137,6 +137,49 @@ describe('TPU plugin-pod selector chain', () => {
   });
 });
 
+describe('TPU pluginInstalled axes (no CRD exists; ADR-003)', () => {
+  function InstallProbe() {
+    const ctx = useTpuContext();
+    if (ctx.loading) return <div data-testid="loader" />;
+    return <span data-testid="installed">{String(ctx.pluginInstalled)}</span>;
+  }
+
+  function mountProbe() {
+    return render(
+      <TpuDataProvider>
+        <InstallProbe />
+      </TpuDataProvider>
+    );
+  }
+
+  it('chips advertised on a node prove an installation without daemon pods', async () => {
+    // A cluster where the daemon pods are RBAC-hidden but a node
+    // advertises google.com/tpu allocatable: only the device plugin
+    // can publish that resource, so installed = true.
+    const node = {
+      metadata: { name: 'gke-w0', labels: {} },
+      status: {
+        capacity: { 'google.com/tpu': '4' },
+        allocatable: { 'google.com/tpu': '4' },
+        conditions: [{ type: 'Ready', status: 'True' }],
+      },
+    };
+    setMockCluster({ nodes: [node], pods: [] });
+    setMockApiHandler(() => ({ items: [] }));
+    mountProbe();
+    const installed = await screen.findByTestId('installed');
+    expect(installed.textContent).toBe('true');
+  });
+
+  it('an empty cluster claims nothing', async () => {
+    setMockCluster({ nodes: [], pods: [] });
+    setMockApiHandler(() => ({ items: [] }));
+    mountProbe();
+    const installed = await screen.findByTestId('installed');
+    expect(installed.textContent).toBe('false');
+  });
+});
+
 describe('Intel chain ordering', () => {
   it('queries the CRD list before the pod selectors', async () => {
     setMockCluster({ nodes: [], pods: [] });

@@ -16,6 +16,7 @@ vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
 import { TpuDataProvider } from '../api/TpuDataContext';
 import { loadFixture } from '../testing/fixtures';
 import { setMockCluster } from '../testing/mockHeadlampLib';
+import { buildNodeTpuColumns } from './integrations/NodeColumns';
 import NodeDetailSection from './NodeDetailSection';
 import PodDetailSection from './PodDetailSection';
 
@@ -123,5 +124,21 @@ describe('raw (unwrapped) inputs', () => {
     expect(node.container.querySelector('section')).toBeNull();
     const pod = render(<PodDetailSection resource={{} as any} />);
     expect(pod.container.querySelector('section')).toBeNull();
+  });
+});
+
+describe('buildNodeTpuColumns', () => {
+  it('labels TPU nodes and dashes the rest (wrapped or raw)', () => {
+    const { fleet } = loadFixture('mixed');
+    const [genCol, chipsCol] = buildNodeTpuColumns();
+    const tpu = fleet.nodes.find((n: any) => n.metadata.name === 'gke-v5e16-pool-w0')!;
+    const arc = fleet.nodes.find((n: any) => n.metadata.name === 'arc-node-1')!;
+    expect(genCol.getValue({ jsonData: tpu })).toBe('TPU v5e');
+    expect(chipsCol.getValue({ jsonData: tpu })).toBe('4');
+    expect(genCol.getValue({ jsonData: arc })).toBe('—');
+    expect(chipsCol.getValue({ jsonData: arc })).toBe('—');
+    // Raw manifests work too — same rawObjectOf contract as the
+    // detail sections above.
+    expect(genCol.getValue(tpu as any)).toBe('TPU v5e');
   });
 });
