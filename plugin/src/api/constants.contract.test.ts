@@ -1,0 +1,148 @@
+/**
+ * Wire-contract constants: the exact strings the plugin puts on the
+ * wire (node labels, resource names, label selectors, PromQL). A typo
+ * here fails no type check and no render test — queries just silently
+ * return nothing against a real cluster — so the strings are pinned
+ * verbatim. The Intel values additionally pin parity with the
+ * reference's own constants (`/root/reference/src/api/k8s.ts:17-31`,
+ * `metrics.ts:101-116`): same exporters ⇒ same strings.
+ */
+
+import { describe, expect, it } from 'vitest';
+
+import {
+  TPU_GENERATION_DISPLAY,
+  TPU_PLUGIN_NAMESPACE,
+  TPU_PLUGIN_POD_LABELS,
+} from './fleet';
+import {
+  INTEL_GPU_I915_RESOURCE,
+  INTEL_GPU_NODE_LABEL,
+  INTEL_GPU_RESOURCE_PREFIX,
+  INTEL_GPU_XE_RESOURCE,
+  INTEL_PLUGIN_POD_LABELS,
+} from './intel';
+import { INTEL_METRIC_AVAILABILITY, INTEL_QUERIES } from './intelMetrics';
+import {
+  LOGICAL_METRIC_DESCRIPTIONS,
+  LOGICAL_METRICS,
+  NODE_MAP_QUERY,
+  PROMETHEUS_SERVICES,
+} from './metrics';
+import {
+  GKE_NODEPOOL_LABEL,
+  GKE_TPU_ACCELERATOR_LABEL,
+  GKE_TPU_TOPOLOGY_LABEL,
+  GKE_TPU_WORKER_ID_LABEL,
+  TPU_ACCELERATOR_GENERATIONS,
+  TPU_RESOURCE,
+} from './topology';
+
+describe('GKE TPU node contract', () => {
+  it('pins the extended resource and the four node labels', () => {
+    expect(TPU_RESOURCE).toBe('google.com/tpu');
+    expect(GKE_TPU_ACCELERATOR_LABEL).toBe('cloud.google.com/gke-tpu-accelerator');
+    expect(GKE_TPU_TOPOLOGY_LABEL).toBe('cloud.google.com/gke-tpu-topology');
+    expect(GKE_NODEPOOL_LABEL).toBe('cloud.google.com/gke-nodepool');
+    expect(GKE_TPU_WORKER_ID_LABEL).toBe('cloud.google.com/gke-tpu-worker-id');
+  });
+
+  it('maps every known accelerator type to a displayed generation', () => {
+    expect(TPU_ACCELERATOR_GENERATIONS).toEqual({
+      'tpu-v4-podslice': 'v4',
+      'tpu-v5-lite-podslice': 'v5e',
+      'tpu-v5-lite-device': 'v5e',
+      'tpu-v5p-slice': 'v5p',
+      'tpu-v6e-slice': 'v6e',
+    });
+    for (const gen of new Set(Object.values(TPU_ACCELERATOR_GENERATIONS))) {
+      expect(TPU_GENERATION_DISPLAY[gen], gen).toBeTruthy();
+    }
+  });
+
+  it('pins the daemon-pod selector labels and namespace', () => {
+    expect(TPU_PLUGIN_POD_LABELS).toEqual([
+      ['k8s-app', 'tpu-device-plugin'],
+      ['app', 'tpu-device-plugin'],
+      ['app.kubernetes.io/name', 'tpu-device-plugin'],
+    ]);
+    expect(TPU_PLUGIN_NAMESPACE).toBe('kube-system');
+  });
+});
+
+describe('Intel GPU contract (reference k8s.ts parity)', () => {
+  it('pins the resource names and detection labels', () => {
+    expect(INTEL_GPU_RESOURCE_PREFIX).toBe('gpu.intel.com/');
+    expect(INTEL_GPU_I915_RESOURCE).toBe('gpu.intel.com/i915');
+    expect(INTEL_GPU_XE_RESOURCE).toBe('gpu.intel.com/xe');
+    expect(INTEL_GPU_NODE_LABEL).toBe('intel.feature.node.kubernetes.io/gpu');
+  });
+
+  it('pins the three plugin-pod label variants (reference :271-282)', () => {
+    expect(INTEL_PLUGIN_POD_LABELS.map(([k]) => k).sort()).toEqual([
+      'app',
+      'app.kubernetes.io/name',
+      'component',
+    ]);
+    for (const [, v] of INTEL_PLUGIN_POD_LABELS) {
+      expect(v).toBe('intel-gpu-plugin');
+    }
+  });
+
+  it('pins the i915 PromQL set (reference metrics.ts:101-116)', () => {
+    expect(INTEL_QUERIES.chips).toBe('node_hwmon_chip_names{chip_name="i915"}');
+    expect(INTEL_QUERIES.power).toBe(
+      'rate(node_hwmon_energy_joule_total[5m]) ' +
+        '* on(chip,instance) group_left(chip_name) ' +
+        'node_hwmon_chip_names{chip_name="i915"}'
+    );
+    expect(INTEL_QUERIES.tdp).toBe(
+      'node_hwmon_power_max_watt ' +
+        '* on(chip,instance) group_left(chip_name) ' +
+        'node_hwmon_chip_names{chip_name="i915"}'
+    );
+    expect(INTEL_QUERIES.node_map).toBe('node_uname_info');
+  });
+
+  it('keeps the honesty matrix truthful about what i915 hwmon provides', () => {
+    const byRow = Object.fromEntries(
+      INTEL_METRIC_AVAILABILITY.map(([row, available]) => [row, available])
+    );
+    expect(byRow['Package power (W)']).toBe(true);
+    expect(byRow['TDP / power limit (W)']).toBe(true);
+    expect(byRow['GPU frequency']).toBe(false); // drm collector is AMD-only
+    expect(byRow['GPU utilization %']).toBe(false);
+  });
+});
+
+describe('TPU Prometheus contract', () => {
+  it('probes a superset of the reference service candidates', () => {
+    const names = PROMETHEUS_SERVICES.map(([ns, svc]) => `${ns}/${svc}`);
+    // The reference probes these three (its metrics.ts:61-65).
+    for (const required of [
+      'monitoring/kube-prometheus-stack-prometheus:9090',
+      'monitoring/prometheus-operated:9090',
+      'monitoring/prometheus:9090',
+    ]) {
+      expect(names).toContain(required);
+    }
+    // GKE managed-Prometheus frontend — the TPU-first addition.
+    expect(names).toContain('gmp-system/frontend:9090');
+  });
+
+  it('resolves every logical metric through a non-empty fallback chain', () => {
+    const logical = Object.keys(LOGICAL_METRICS).sort();
+    expect(logical).toEqual([
+      'duty_cycle',
+      'hbm_bytes_total',
+      'hbm_bytes_used',
+      'memory_bandwidth_utilization',
+      'tensorcore_utilization',
+    ]);
+    for (const [name, candidates] of Object.entries(LOGICAL_METRICS)) {
+      expect(candidates.length, name).toBeGreaterThan(0);
+      expect(LOGICAL_METRIC_DESCRIPTIONS[name], name).toBeTruthy();
+    }
+    expect(NODE_MAP_QUERY).toBe('node_uname_info');
+  });
+});
