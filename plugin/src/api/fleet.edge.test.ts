@@ -23,12 +23,17 @@ import {
   formatChipCount,
   formatGeneration,
   getNodeChipAllocatable,
+  getNodeGeneration,
   getPodChipRequest,
   isTpuPluginPod,
   isTpuRequestingPod,
+  podLabels,
+  podName,
+  podNamespace,
   podNodeName,
   podPhase,
   podRestarts,
+  podUid,
   rawObjectOf,
   roundHalfEven,
   waitingReason,
@@ -254,5 +259,31 @@ describe('formatters', () => {
     expect(formatAge('2026-07-30T13:00:00Z', now)).toBe('0s'); // future skew
     expect(formatAge('not-a-date', now)).toBe('unknown');
     expect(formatAge(null, now)).toBe('unknown');
+  });
+});
+
+describe('pod identity helpers', () => {
+  it('return strings for well-formed metadata and empty-string fallbacks', () => {
+    const pod = { metadata: { name: 'dp-0', namespace: 'kube-system', uid: 'u-1' } };
+    expect(podName(pod)).toBe('dp-0');
+    expect(podNamespace(pod)).toBe('kube-system');
+    expect(podUid(pod)).toBe('u-1');
+    expect(podLabels({ metadata: { labels: { a: 'b' } } })).toEqual({ a: 'b' });
+    for (const g of [null, {}, { metadata: 'x' }]) {
+      expect(podName(g as any)).toBe('');
+      expect(podNamespace(g as any)).toBe('');
+      expect(podUid(g as any)).toBe('');
+      expect(podLabels(g as any)).toEqual({});
+    }
+  });
+
+  it('getNodeGeneration composes accelerator label → generation', () => {
+    const node = {
+      metadata: {
+        labels: { 'cloud.google.com/gke-tpu-accelerator': 'tpu-v6e-slice' },
+      },
+    };
+    expect(getNodeGeneration(node)).toBe('v6e');
+    expect(getNodeGeneration({} as any)).toBe('unknown');
   });
 });

@@ -163,3 +163,38 @@ describe('groupSlices on degenerate shapes', () => {
     expect(summary.incomplete).toBe(0);
   });
 });
+
+describe('mesh geometry: torus wrap links', () => {
+  it('v5p (torus) gets dashed wrap links only on axes of size >= 4', () => {
+    const slices = groupSlices([
+      tpuNode('w0', { [POOL]: 'p', [TOPO]: '2x2x4', [WORKER]: '0' }),
+      tpuNode('w1', { [POOL]: 'p', [TOPO]: '2x2x4', [WORKER]: '1' }),
+      tpuNode('w2', { [POOL]: 'p', [TOPO]: '2x2x4', [WORKER]: '2' }),
+      tpuNode('w3', { [POOL]: 'p', [TOPO]: '2x2x4', [WORKER]: '3' }),
+    ]);
+    const layout = buildMeshLayout(slices[0]);
+    expect(layout.cells).toHaveLength(16);
+    const wraps = layout.links.filter(([, , , wrap]) => wrap === 1);
+    // Axes 0 and 1 have size 2 (a wrap would duplicate the direct
+    // link); only the size-4 axis closes the torus: one wrap per
+    // (x, y) position = 4.
+    expect(wraps).toHaveLength(4);
+    for (const [, , axis] of wraps) expect(axis).toBe(2);
+  });
+
+  it('v5e (no torus) never wraps regardless of axis size', () => {
+    const v5e = { [ACCEL]: 'tpu-v5-lite-podslice' };
+    const slices = groupSlices([
+      tpuNode('w0', { ...v5e, [POOL]: 'p', [TOPO]: '4x4', [WORKER]: '0' }, 4),
+      tpuNode('w1', { ...v5e, [POOL]: 'p', [TOPO]: '4x4', [WORKER]: '1' }, 4),
+      tpuNode('w2', { ...v5e, [POOL]: 'p', [TOPO]: '4x4', [WORKER]: '2' }, 4),
+      tpuNode('w3', { ...v5e, [POOL]: 'p', [TOPO]: '4x4', [WORKER]: '3' }, 4),
+    ]);
+    const layout = buildMeshLayout(slices[0]);
+    expect(layout.cells).toHaveLength(16);
+    expect(layout.links.filter(([, , , wrap]) => wrap === 1)).toHaveLength(0);
+    // Every chip still belongs to one of the 4 observed workers.
+    const workers = new Set(layout.cells.map(c => c[2]));
+    expect(workers).toEqual(new Set([0, 1, 2, 3]));
+  });
+});
