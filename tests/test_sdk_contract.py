@@ -78,3 +78,49 @@ def test_fixture_is_fresh_when_reference_is_present():
     from export_sdk_props import collect_reference_usage
 
     assert collect_reference_usage() == load_fixture()
+
+
+def test_collector_maps_aliased_imports_to_canonical_names(tmp_path):
+    # `import { SimpleTable as Table }` renders as <Table …> — the JSX
+    # tag carries the LOCAL alias, but the fixture must record the
+    # SDK's canonical name, or regeneration would silently drop the
+    # component's evidence.
+    (tmp_path / "Page.tsx").write_text(
+        "import React from 'react';\n"
+        "import { SimpleTable as Table } from "
+        "'@kinvolk/headlamp-plugin/lib/CommonComponents';\n"
+        "export default function P() {\n"
+        "  return <Table columns={[]} data={[]} />;\n"
+        "}\n"
+    )
+    from export_sdk_props import collect_reference_usage
+
+    usage = collect_reference_usage(str(tmp_path))
+    assert usage == {"SimpleTable": ["columns", "data"]}
+
+
+def test_collector_ignores_react_builtins_and_foreign_components(tmp_path):
+    (tmp_path / "Page.tsx").write_text(
+        "import React from 'react';\n"
+        "import { SectionBox } from '@kinvolk/headlamp-plugin/lib/CommonComponents';\n"
+        "import { Helper } from './helper';\n"
+        "export default function P() {\n"
+        "  return (\n"
+        "    <SectionBox key=\"k\" title=\"t\">\n"
+        "      <Helper mystery=\"prop\" />\n"
+        "    </SectionBox>\n"
+        "  );\n"
+        "}\n"
+    )
+    (tmp_path / "helper.tsx").write_text(
+        "import React from 'react';\n"
+        "export function Helper({ mystery }: { mystery: string }) {\n"
+        "  return <span>{mystery}</span>;\n"
+        "}\n"
+    )
+    from export_sdk_props import collect_reference_usage
+
+    usage = collect_reference_usage(str(tmp_path))
+    # `key` is React's, Helper is not a CommonComponent — only the
+    # SDK-observed prop survives.
+    assert usage == {"SectionBox": ["title"]}
