@@ -119,3 +119,43 @@ describe('formatWatts', () => {
     expect(formatWatts(null)).toBe('—');
   });
 });
+
+describe('failure isolation and injected discovery', () => {
+  it('a single failing query degrades its field, not the snapshot', async () => {
+    // The power query throwing (Prometheus restarting mid-wave) must
+    // leave the chips discovered and TDP joined — per-query failures
+    // are independent, same as intel_client.py's run_query contract.
+    const { request } = transport({
+      chips: vector([{ labels: { chip: 'card0', node: 'n1' }, value: 1 }]),
+      tdp: vector([{ labels: { chip: 'card0', node: 'n1' }, value: 150 }]),
+    });
+    let threw = 0;
+    const throwing = async (path: string): Promise<unknown> => {
+      const promql = decodeURIComponent(path.split('query=')[1] ?? '');
+      if (promql === INTEL_QUERIES.power) {
+        threw += 1;
+        throw new Error('503 mid-restart');
+      }
+      return request(path);
+    };
+    const snap = await fetchIntelGpuMetrics(throwing, ['monitoring', 'prometheus-k8s:9090']);
+    expect(threw).toBe(1); // the failure really was injected
+    expect(snap).not.toBeNull();
+    expect(snap!.chips).toHaveLength(1);
+    expect(snap!.chips[0].power_watts).toBeNull();
+    expect(snap!.chips[0].tdp_watts).toBe(150);
+  });
+
+  it('an injected (namespace, service) skips the discovery probe', async () => {
+    const { request, calls } = transport({
+      chips: vector([{ labels: { chip: 'card0', node: 'n1' }, value: 1 }]),
+    });
+    const snap = await fetchIntelGpuMetrics(request, ['monitoring', 'prometheus-k8s:9090']);
+    expect(snap).not.toBeNull();
+    expect(snap!.namespace).toBe('monitoring');
+    expect(snap!.service).toBe('prometheus-k8s:9090');
+    // No `query=1` probe ran — the caller's discovery is reused (the
+    // shared-chain contract both metrics clients follow).
+    expect(calls.some(p => decodeURIComponent(p).endsWith('query=1'))).toBe(false);
+  });
+});
