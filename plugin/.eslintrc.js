@@ -8,10 +8,13 @@
 // and refresh semantics — a wrong deps array is a real correctness
 // bug (stale snapshot served after refresh), not a style issue, and
 // it is exactly the class the in-repo static gate
-// (tools/ts_static_check.py) documents as out of scope.
+// (tools/ts_static_check.py) documents as out of scope. The plugin is
+// exact-pinned in devDependencies so the rules resolve
+// deterministically.
 module.exports = {
   root: true,
   extends: ['@headlamp-k8s/eslint-config'],
+  plugins: ['react-hooks'],
   rules: {
     // Prettier owns layout; the shared config's indent rule fights
     // Prettier's JSX ternary formatting (same exclusion the
@@ -19,5 +22,13 @@ module.exports = {
     indent: 'off',
     'react-hooks/rules-of-hooks': 'error',
     'react-hooks/exhaustive-deps': 'error',
+    // Deliberate divergence from the reference's no-`any` style: the
+    // domain mirrors type cluster JSON as Record<string, any> on
+    // purpose — the contract is TOTALITY over unknown shapes (every
+    // helper returns its documented fallback on garbage), pinned by
+    // the api/*.edge.test.ts suites, not by narrowing at the edges.
+    // The reference narrows per call site instead; both are sound,
+    // this one matches the Python engine the mirrors are pinned to.
+    '@typescript-eslint/no-explicit-any': 'off',
   },
 };
