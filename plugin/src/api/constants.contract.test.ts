@@ -106,7 +106,7 @@ describe('Intel GPU contract (reference k8s.ts parity)', () => {
 
   it('keeps the honesty matrix truthful about what i915 hwmon provides', () => {
     const byRow = Object.fromEntries(
-      INTEL_METRIC_AVAILABILITY.map(([row, available]) => [row, available])
+      INTEL_METRIC_AVAILABILITY.map(([row, available]) => [row, available] as [string, boolean])
     );
     expect(byRow['Package power (W)']).toBe(true);
     expect(byRow['TDP / power limit (W)']).toBe(true);
