@@ -1,0 +1,74 @@
+/**
+ * Every registered route must MOUNT: the route components Headlamp
+ * receives are provider-wrapped pages (the reference wraps every
+ * route in its data provider, index.tsx:92-96) — a page registered
+ * without its provider throws on the context hook the moment Headlamp
+ * navigates to it, which no registration-count test can catch. Mounts
+ * run over the mixed fixture so both providers' pages see real data.
+ */
+
+import { render } from '@testing-library/react';
+import React from 'react';
+import { afterEach, describe, expect, it, vi } from 'vitest';
+
+vi.mock('@kinvolk/headlamp-plugin/lib', () => import('./testing/mockHeadlampLib'));
+vi.mock('@kinvolk/headlamp-plugin/lib/CommonComponents', () =>
+  import('./testing/mockCommonComponents')
+);
+
+import { loadFixture } from './testing/fixtures';
+import {
+  captured,
+  resetRequestLog,
+  setMockApiHandler,
+  setMockCluster,
+} from './testing/mockHeadlampLib';
+import './index';
+
+afterEach(() => {
+  setMockApiHandler(null);
+  resetRequestLog();
+});
+
+/** Mount every captured route, asserting the count first so a broken
+ * registration can never turn these into zero-iteration green runs. */
+function mountAll() {
+  expect(captured.routes).toHaveLength(11);
+  for (const route of captured.routes) {
+    const Component = route.component as React.ComponentType;
+    const { container, unmount } = render(<Component />);
+    // A page that mounted produced SOMETHING (content or a loader); a
+    // missing provider wrapper would have thrown on the context hook.
+    expect(container.firstChild, String(route.path)).not.toBeNull();
+    unmount();
+  }
+}
+
+describe('route components', () => {
+  it('all eleven mount on the mixed fixture without throwing', () => {
+    const { fleet } = loadFixture('mixed');
+    setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
+    mountAll();
+  });
+
+  it('all eleven also mount on an empty cluster (empty-state branches)', () => {
+    setMockCluster({ nodes: [], pods: [] });
+    mountAll();
+  });
+
+  it('all eleven survive a cluster that fails every imperative path', () => {
+    // RBAC-style outage: reactive lists error, every ApiProxy call
+    // throws. Pages must render their error/degraded branches, never
+    // a crash — the ADR-003 contract end-to-end.
+    setMockCluster({
+      nodes: null,
+      pods: null,
+      nodeError: 'nodes is forbidden',
+      podError: 'pods is forbidden',
+    });
+    setMockApiHandler(() => {
+      throw new Error('everything is forbidden');
+    });
+    mountAll();
+  });
+});
