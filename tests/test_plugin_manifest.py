@@ -1,0 +1,89 @@
+"""plugin/package.json and the workflow gate wiring.
+
+Guards the round-5 supply-chain properties against regression: the
+dependency tree stays EXACT-pinned (the dev image cannot generate a
+lockfile — plugin/VERIFIED.md — so the pins are the reproducibility
+mechanism until the release workflow commits one), the four-gate
+script set stays intact, and the release workflow runs the same gates
+CI runs (a release must never ship with fewer checks than a push).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MANIFEST = os.path.join(REPO, "plugin", "package.json")
+CI = os.path.join(REPO, ".github", "workflows", "ci.yaml")
+RELEASE = os.path.join(REPO, ".github", "workflows", "release.yaml")
+
+EXACT_VERSION = re.compile(r"^\d+\.\d+\.\d+$")
+
+
+def manifest() -> dict:
+    with open(MANIFEST, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def test_dev_dependencies_are_exact_pinned():
+    doc = manifest()
+    offenders = {
+        name: version
+        for name, version in doc["devDependencies"].items()
+        if not EXACT_VERSION.fullmatch(version)
+    }
+    assert not offenders, f"ranged devDependencies break reproducibility: {offenders}"
+
+
+def test_peer_dependencies_stay_ranges():
+    # Peers express HOST compatibility — pinning them exactly would
+    # wrongly reject every Headlamp whose React differs by a patch.
+    doc = manifest()
+    for name, version in doc["peerDependencies"].items():
+        assert version.startswith("^"), (name, version)
+
+
+def test_the_four_gates_and_build_scripts_exist():
+    scripts = manifest()["scripts"]
+    for gate in ("tsc", "lint", "format:check", "test"):
+        assert gate in scripts, f"missing gate script: {gate}"
+    for step in ("build", "package", "start", "lint:fix", "format"):
+        assert step in scripts, f"missing script: {step}"
+    assert scripts["lint"].startswith("eslint")
+    assert scripts["format:check"].startswith("prettier --check")
+
+
+def test_release_runs_at_least_the_ci_plugin_gates():
+    # The release workflow re-runs the gate set before packaging; a
+    # release must never ship with fewer checks than an ordinary push.
+    with open(RELEASE, "r", encoding="utf-8") as f:
+        release = f.read()
+    for command in ("tsc --noEmit", "npm run lint", "npm run format:check", "vitest run"):
+        assert command in release, f"release workflow lost gate: {command}"
+    with open(CI, "r", encoding="utf-8") as f:
+        ci = f.read()
+    for command in ("tsc --noEmit", "npm run lint", "npm run format:check", "vitest run"):
+        assert command in ci, f"ci plugin job lost gate: {command}"
+
+
+def test_version_compat_matches_the_catalog():
+    doc = manifest()
+    with open(os.path.join(REPO, "artifacthub-pkg.yml"), "r", encoding="utf-8") as f:
+        catalog = yaml.safe_load(f)
+    assert (
+        doc["headlamp"]["version-compat"]
+        == catalog["annotations"]["headlamp/plugin/version-compat"]
+    )
+
+
+def test_plugin_version_matches_catalog_version():
+    # The release workflow fails fast on tag/package skew; this pins
+    # the third corner — package.json vs the committed catalog.
+    doc = manifest()
+    with open(os.path.join(REPO, "artifacthub-pkg.yml"), "r", encoding="utf-8") as f:
+        catalog = yaml.safe_load(f)
+    assert str(doc["version"]) == str(catalog["version"])
