@@ -173,8 +173,13 @@ def rollup_to_dict(fleet: FleetArrays) -> dict[str, Any]:
     converting elements piecemeal issues a separate device→host
     transfer per scalar (hundreds for the per-node vector), which over
     a tunneled/remote TPU turns a sub-millisecond rollup into tens of
-    seconds."""
-    out = jax.device_get(rollup_arrays(fleet))
+    seconds. The fetch goes through the runtime transfer funnel: inside
+    a request's TransferBatch it coalesces with every other pending
+    stage (forecast, mesh shards) into one round-trip; standalone it is
+    the same single counted device_get as before."""
+    from ..runtime import transfer
+
+    out = transfer.fetch(rollup_arrays(fleet))
     result = aggregates_to_host_dict(out, fleet.n_nodes)
     result.update(
         {

@@ -103,19 +103,24 @@ def python_fleet_stats(view: FleetView) -> dict[str, Any]:
     }
 
 
-#: Fleet size below which the Python loops ALWAYS serve: measured at
-#: ≤ ~5 ms there (BENCH_r03: 2.51 ms @ 256 nodes) — no device dispatch
-#: on any host beats that, so no probe is worth running. Above it, the
-#: winner is HOST-DEPENDENT: the fused program's cost is flat but equals
-#: the device *dispatch* latency, ~155 ms over a tunneled v5e
-#: (BENCH_r03 rollup_xla_ms_{256,1024} ≈ 157/154) yet single-digit ms
-#: on a local PCIe-attached device, while the Python loops grow linearly
-#: (~0.01 ms/node measured). A static crossover constant is therefore
-#: wrong on one host class or the other — so past this floor the policy
-#: MEASURES both backends once per process and picks the winner per
-#: request (ADR-006's "callers choose by scale", upgraded to "chosen by
-#: measured per-host crossover").
-XLA_ROLLUP_MIN_NODES = 512
+#: Fleet size below which the Python loops ALWAYS serve — no probe is
+#: worth running there. Re-derived for the device-resident cache
+#: (ADR-012): the old 512 floor was measured against the upload-
+#: inclusive XLA path (encode + host→device transfer on every call);
+#: with the fleet cached on device the rollup pays dispatch only, and
+#: the measured cached-path crossover moves to ~64 nodes
+#: (xla_cached 0.49 ms vs python 0.59 ms @ 62 nodes; 0.42 vs 0.31 @ 32
+#: — r06 measurements on the CI host, recorded in OPERATIONS.md).
+#: Below 64 nodes the Python pass is ≤ ~0.6 ms, which no probe can
+#: repay. Above it the winner stays HOST-DEPENDENT — cached dispatch is
+#: sub-ms on a local device but still one tunnel RTT (~89 ms) on a
+#: tunneled one, while Python grows linearly (~0.01 ms/node) — so past
+#: this floor the policy MEASURES both backends once per process and
+#: picks the winner per request (ADR-006's "callers choose by scale",
+#: upgraded to "chosen by measured per-host crossover"). The probe now
+#: times the CACHED path when the view is versioned, i.e. exactly what
+#: steady-state requests will serve.
+XLA_ROLLUP_MIN_NODES = 64
 
 
 #: Consecutive calibrate/XLA failures after which the process stops
@@ -403,10 +408,16 @@ def _calibrate(view: FleetView) -> dict[str, Any]:
 
 
 def _xla_stats(view: FleetView) -> dict[str, Any]:
-    from .encode import encode_fleet
+    from ..runtime.device_cache import fleet_cache
     from .fleet_jax import rollup_to_dict
 
-    stats = rollup_to_dict(encode_fleet(view.nodes, view.pods))
+    # Versioned views (server snapshots) hit the device-resident cache:
+    # a warm request re-uses the columns already living on device and
+    # pays dispatch + one coalesced device_get only — the host→device
+    # upload that dominated rollup_xla_ms in BENCH_r05 happens once per
+    # snapshot version, usually on the background-sync warm. Unversioned
+    # views fall through to a fresh host encode inside fleet_for.
+    stats = rollup_to_dict(fleet_cache.fleet_for(view))
     # Exact generation names (see _generation_counts): the device-side
     # histogram is fixed-vocabulary; the display histogram is not.
     stats["generation_counts"] = _generation_counts(view.nodes)

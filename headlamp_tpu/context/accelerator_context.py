@@ -202,6 +202,13 @@ class AcceleratorDataContext:
         self._plugin_pod_errors: dict[str, str | None] = {}
         self._refresh_count = 0
         self._cached_snapshot: ClusterSnapshot | None = None
+        #: Monotone snapshot generation, bumped by every _build_snapshot
+        #: and stamped onto each provider FleetView (FleetView.version).
+        #: Clean ticks reuse the cached snapshot and therefore the
+        #: generation — which is exactly the invalidation contract the
+        #: device-resident fleet cache keys on (ADR-012): unchanged
+        #: fleet ⇒ same version ⇒ warm device arrays stay valid.
+        self._snapshot_generation = 0
         #: Set by either track when a sync actually changed state (watch
         #: events applied, a re-list ran, imperative results differed,
         #: an error stream flipped). A CLEAN tick — quiet watch, stable
@@ -584,9 +591,11 @@ class AcceleratorDataContext:
         views = classify_fleet(
             self._all_nodes or [], self._all_pods or [], self._providers
         )
+        self._snapshot_generation += 1
         providers: dict[str, ProviderState] = {}
         for p in self._providers:
             view = views[p.name]
+            view.version = self._snapshot_generation
             # Merge imperative-track plugin pods not already present in
             # the reactive list (UID dedup across tracks).
             seen = {obj.uid(pod) for pod in view.plugin_pods}

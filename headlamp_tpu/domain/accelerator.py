@@ -81,6 +81,15 @@ class FleetView:
     nodes: list[Any] = field(default_factory=list)
     pods: list[Any] = field(default_factory=list)
     plugin_pods: list[Any] = field(default_factory=list)
+    #: Snapshot generation this view was built from — stamped by the
+    #: data context's ``_build_snapshot`` (monotone per context, bumped
+    #: only when a sync actually changed state, so a clean tick keeps
+    #: the number). It is the device-cache key
+    #: (``runtime.device_cache``): same version ⇒ identical nodes/pods ⇒
+    #: the device-resident columns may be reused. ``None`` (raw
+    #: ``classify_fleet`` views: CLI one-shots, tests, benches) opts out
+    #: of caching entirely.
+    version: int | None = None
 
     @property
     def plugin_installed(self) -> bool:

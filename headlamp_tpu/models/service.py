@@ -101,10 +101,12 @@ def forecast_from_history(
     )
     if dispatch.fit_mse is not None:
         # One device_get for predictions AND the fit-quality scalar —
-        # a separate float() would cost an extra tunnel round-trip.
-        import jax
+        # a separate float() would cost an extra tunnel round-trip. Via
+        # the transfer funnel it also coalesces with the fleet rollup's
+        # fetch when a request batch is active.
+        from ..runtime import transfer
 
-        preds, fit_mse_arr = jax.device_get((preds, dispatch.fit_mse))
+        preds, fit_mse_arr = transfer.fetch((preds, dispatch.fit_mse))
         fit_mse = float(fit_mse_arr)
     else:
         preds = np.asarray(preds)

@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..analytics.encode import FleetArrays
 from ..analytics.fleet_jax import aggregates_to_host_dict, local_aggregates
+from ..runtime import transfer
 
 
 def _mesh_1d(axis_name: str, n_devices: int | None) -> Mesh:
@@ -124,7 +125,10 @@ def _rollup_with_reducer(
         else shard_map(rollup_body, **specs)
     )
     with mesh:
-        out = jax.device_get(rollup_shard(*node_cols, *pod_cols))
+        # Funnel fetch: coalesces with the request's other pending
+        # device reads when a TransferBatch is active, and is the same
+        # single counted device_get standalone.
+        out = transfer.fetch(rollup_shard(*node_cols, *pod_cols))
     return aggregates_to_host_dict(out, fleet.n_nodes)
 
 
@@ -248,7 +252,7 @@ def alltoall_generation_histogram(fleet: FleetArrays, mesh: Mesh) -> "np.ndarray
             in_specs=(P("hosts"), P("hosts")),
             out_specs=P(),
         )(gen, valid)
-    return jax.device_get(full)[:vocab]
+    return transfer.fetch(full)[:vocab]
 
 
 def sharded_make_windows(

@@ -1,0 +1,38 @@
+"""Runtime services for the serving path.
+
+Device-residency and transfer discipline live here, between the pure
+analytics kernels and the HTTP host: the analytics layer says *what*
+to compute, this package decides *where the arrays live* and *how many
+device round-trips a request pays*.
+
+- :mod:`transfer` — the single funnel every serving-path device→host
+  fetch goes through: per-request coalescing (``TransferBatch``) plus
+  the blocking-transfer counters bench.py and /healthz report.
+- :mod:`device_cache` — ``DeviceFleetCache``: columnar fleets kept
+  resident on device across requests, keyed by snapshot version, so
+  the XLA rollup stops re-uploading host arrays on every call.
+
+Everything is import-guarded: a jax-less host can import this package
+(the server does) and only pays for what it calls.
+"""
+
+from .device_cache import DeviceFleetCache, fleet_cache
+from .transfer import (
+    TransferBatch,
+    active_batch,
+    defer,
+    device_get,
+    fetch,
+    transfer_stats,
+)
+
+__all__ = [
+    "DeviceFleetCache",
+    "TransferBatch",
+    "active_batch",
+    "defer",
+    "device_get",
+    "fetch",
+    "fleet_cache",
+    "transfer_stats",
+]

@@ -1,0 +1,148 @@
+"""Device-resident fleet arrays, keyed by snapshot version.
+
+BENCH_r05 put ``rollup_xla_ms_1024`` at 123.8 ms against a 9.45 ms
+Python pass — not compute (the fused program is flat across 256→1024
+nodes) but the per-call transfer tax: every ``fleet_stats`` call
+re-encoded the snapshot to host numpy and re-uploaded the columns, and
+the rollup's device_get then paid the tunnel RTT on top. The upload half
+of that tax is pure waste: the fleet only changes when the background
+sync produces a NEW snapshot, yet the serving path re-shipped identical
+bytes on every request.
+
+:class:`DeviceFleetCache` removes it. Each provider keeps at most one
+entry — the columnar :class:`~headlamp_tpu.analytics.encode.FleetArrays`
+for one snapshot version, with every numpy column replaced by its
+``jax.device_put`` twin. ``fleet_rollup``'s ``jnp.asarray(...)`` calls
+are no-ops on committed device arrays, so the cached FleetArrays drops
+into ``rollup_to_dict`` unchanged and a warm hit uploads nothing.
+
+Invalidation contract (ADR-012): the snapshot generation IS the key. The
+data context stamps a monotone ``version`` onto every ``FleetView`` it
+builds; a clean background tick reuses the cached snapshot object and
+therefore the version (cache hit), a changed fleet gets a new generation
+(miss → re-encode + re-upload, old entry dropped). Views without a
+version — CLI one-shots, tests building raw ``classify_fleet`` views —
+are never cached and never served stale: they take the encode+upload
+path every call, exactly the pre-cache behavior.
+
+Failures propagate: a broken device backend must surface to
+``fleet_stats``'s existing try/except so its failure memoization (and
+the Python fallback) keeps working — this cache must never convert
+"device broken" into "serve stale arrays".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..analytics.encode import FleetArrays
+    from ..domain.accelerator import FleetView
+
+
+def _to_device(fleet: "FleetArrays") -> "FleetArrays":
+    """A FleetArrays twin with every array column committed to device.
+    Scalars (n_nodes/n_pods) and node_names stay host-side — the rollup
+    reads them in Python."""
+    import jax
+    import numpy as np
+
+    replacements = {
+        field.name: jax.device_put(value)
+        for field in dataclasses.fields(fleet)
+        if isinstance(value := getattr(fleet, field.name), np.ndarray)
+    }
+    # One barrier for the whole upload: entries enter the cache fully
+    # transferred, so a later hit can never block on a straggling copy.
+    for arr in replacements.values():
+        arr.block_until_ready()
+    return dataclasses.replace(fleet, **replacements)
+
+
+class DeviceFleetCache:
+    """Per-provider device-resident ``FleetArrays``, one entry each,
+    keyed by the view's snapshot ``version``.
+
+    Thread-safe for the server's access pattern: the background sync
+    warms it off the request path, request threads hit it concurrently.
+    The lock guards only dict bookkeeping; encode + upload happen
+    outside it (two threads racing the same cold version do redundant
+    work once rather than serializing every warm hit behind an upload).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: dict[str, tuple[int, "FleetArrays"]] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def fleet_for(self, view: "FleetView") -> "FleetArrays":
+        """The columnar fleet for ``view`` — device-resident from cache
+        when the version matches, freshly encoded (and cached when the
+        view carries a version) otherwise."""
+        from ..analytics.encode import encode_fleet
+
+        version = getattr(view, "version", None)
+        provider = view.provider.name
+        if version is not None:
+            with self._lock:
+                entry = self._entries.get(provider)
+                if entry is not None and entry[0] == version:
+                    self.hits += 1
+                    return entry[1]
+            self.misses += 1
+            fleet = _to_device(encode_fleet(view.nodes, view.pods))
+            with self._lock:
+                self._entries[provider] = (version, fleet)
+            return fleet
+        # Unversioned view: pre-cache behavior, host arrays every call.
+        self.misses += 1
+        return encode_fleet(view.nodes, view.pods)
+
+    def warm(self, view: "FleetView") -> bool:
+        """Background-sync hook: encode + upload ``view`` now so the
+        next request hits warm. Swallows nothing — but the caller (the
+        sync loop) treats any exception as non-fatal, mirroring how
+        calibration failures are handled there. Returns True when an
+        upload happened, False when the entry was already current or
+        the view is unversioned."""
+        version = getattr(view, "version", None)
+        if version is None:
+            return False
+        from ..analytics.encode import encode_fleet
+
+        with self._lock:
+            entry = self._entries.get(view.provider.name)
+            if entry is not None and entry[0] == version:
+                return False
+        fleet = _to_device(encode_fleet(view.nodes, view.pods))
+        with self._lock:
+            self._entries[view.provider.name] = (version, fleet)
+        return True
+
+    def invalidate(self) -> None:
+        """Drop every entry (operator lever, rides /refresh's cache
+        epoch bump; also frees device memory on demand)."""
+        with self._lock:
+            self._entries.clear()
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def snapshot(self) -> dict[str, object]:
+        """Observability block for /healthz and bench."""
+        with self._lock:
+            entries = {name: version for name, (version, _f) in self._entries.items()}
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hit_rate(), 4),
+            "entries": entries,
+        }
+
+
+#: Process-wide cache instance — one device, one resident fleet set.
+fleet_cache = DeviceFleetCache()

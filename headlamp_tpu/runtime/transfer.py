@@ -1,0 +1,179 @@
+"""Request-scoped device→host transfer coalescing.
+
+Every blocking ``jax.device_get`` the serving path pays goes through
+this module's :func:`device_get` funnel. That buys two things:
+
+1. **Counting.** ``transfer_stats`` records how many blocking fetches
+   the process (and the currently active batch) has paid — the number
+   behind bench.py's ``device_gets_per_request`` and the /healthz
+   transfer block. Over a tunneled device each blocking fetch costs a
+   full tunnel RTT (~89 ms floor, BENCH_r05), so the count IS the
+   latency model; it must be observable, not assumed.
+
+2. **Coalescing.** A :class:`TransferBatch` installed for the scope of
+   one HTTP request (``DashboardApp.handle``) lets independent stages —
+   the XLA fleet rollup, the forecast's (predictions, fit_mse) pair, a
+   sharded-mesh rollup — *register* dispatched device arrays instead of
+   each blocking on its own fetch. The first stage that needs a value
+   flushes everything registered so far in ONE ``jax.device_get``: all
+   pending trees ride one tunnel round-trip. JAX dispatch is async, so
+   registration costs nothing device-side; only the flush blocks.
+
+The batch is carried in a :mod:`contextvars` ContextVar, so under
+``ThreadingHTTPServer`` each request thread sees only its own batch and
+code below the app layer (``fleet_jax.rollup_to_dict``,
+``models.service``) needs no plumbed-through argument. No batch active
+(CLI renders, tests, benches calling the kernels directly) means
+:func:`fetch` degrades to a plain counted ``jax.device_get`` — the
+pre-coalescer behavior, one fetch per call site.
+
+jax is imported lazily inside the fetch paths only: a jax-less host can
+import this module (the server does unconditionally) and never touch it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from contextvars import ContextVar
+from typing import Any, Callable, Iterator
+
+
+class TransferStats:
+    """Monotonic process-wide transfer counters. Writes are GIL-atomic
+    int bumps; readers (bench deltas, /healthz) tolerate the benign
+    races that implies."""
+
+    def __init__(self) -> None:
+        self.blocking_gets = 0
+        #: Trees that rode a flush alongside at least one other tree —
+        #: round-trips that would each have been a blocking get before
+        #: the coalescer.
+        self.coalesced_trees = 0
+
+    def snapshot(self) -> dict[str, int]:
+        return {
+            "blocking_gets": self.blocking_gets,
+            "coalesced_trees": self.coalesced_trees,
+        }
+
+
+transfer_stats = TransferStats()
+
+_ACTIVE: ContextVar["TransferBatch | None"] = ContextVar(
+    "hl_tpu_transfer_batch", default=None
+)
+
+
+def active_batch() -> "TransferBatch | None":
+    return _ACTIVE.get()
+
+
+def _counted_device_get(tree: Any, batch: "TransferBatch | None") -> Any:
+    import jax
+
+    transfer_stats.blocking_gets += 1
+    if batch is not None:
+        batch.blocking_gets += 1
+    return jax.device_get(tree)
+
+
+def device_get(tree: Any) -> Any:
+    """A counted blocking fetch — drop-in for ``jax.device_get`` at call
+    sites that need the value NOW regardless of any batch (calibration
+    probes, benches timing a single transfer)."""
+    return _counted_device_get(tree, _ACTIVE.get())
+
+
+class _Handle:
+    """One registered tree's future host value. ``result()`` flushes the
+    owning batch on first access — everything registered before that
+    moment rides the same device_get."""
+
+    __slots__ = ("_batch", "_value", "_resolved")
+
+    def __init__(self, batch: "TransferBatch") -> None:
+        self._batch = batch
+        self._value: Any = None
+        self._resolved = False
+
+    def result(self) -> Any:
+        if not self._resolved:
+            self._batch.flush()
+        return self._value
+
+
+class TransferBatch:
+    """All pending device→host fetches of one request.
+
+    Stages call :meth:`register` with dispatched (still-async) device
+    arrays and get a handle; ``handle.result()`` — or an explicit
+    :meth:`flush` — materializes every pending tree in one blocking
+    ``jax.device_get``. Registration after a flush simply opens the
+    next round: a request whose stages interleave register/consume still
+    pays one fetch per *wave*, never one per stage.
+
+    Thread-safe: the request thread owns the batch via the context
+    variable, but an overlap worker (the metrics route's concurrent
+    forecast) may share it; ``_lock`` keeps flush atomic.
+    """
+
+    def __init__(self) -> None:
+        self._pending: list[tuple[Any, _Handle]] = []
+        self._lock = threading.Lock()
+        #: Blocking fetches paid while this batch was active (flushes
+        #: and direct counted gets alike) — the per-request number.
+        self.blocking_gets = 0
+
+    def register(self, tree: Any) -> _Handle:
+        handle = _Handle(self)
+        with self._lock:
+            self._pending.append((tree, handle))
+        return handle
+
+    def flush(self) -> None:
+        """Materialize every pending tree in ONE blocking device_get."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+        if not pending:
+            return
+        values = _counted_device_get([tree for tree, _h in pending], self)
+        if len(pending) > 1:
+            transfer_stats.coalesced_trees += len(pending)
+        for (_tree, handle), value in zip(pending, values):
+            handle._value = value
+            handle._resolved = True
+
+    @contextlib.contextmanager
+    def scope(self) -> Iterator["TransferBatch"]:
+        """Install this batch for the calling context; flush leftovers on
+        exit so a stage that registered but never consumed cannot leak
+        an unresolved handle past the request."""
+        token = _ACTIVE.set(self)
+        try:
+            yield self
+        finally:
+            _ACTIVE.reset(token)
+            self.flush()
+
+
+def fetch(tree: Any) -> Any:
+    """THE serving-path fetch: coalesce when a request batch is active
+    (register + flush-on-demand, riding one device_get with every other
+    pending stage), plain counted device_get otherwise."""
+    batch = _ACTIVE.get()
+    if batch is None:
+        return _counted_device_get(tree, None)
+    return batch.register(tree).result()
+
+
+def defer(tree: Any) -> Callable[[], Any]:
+    """Non-blocking registration for dispatch-then-join stages: returns
+    a zero-arg resolver. With a batch active the tree joins the batch;
+    without one the resolver pays its own counted get when called —
+    either way nothing blocks until the resolver runs."""
+    batch = _ACTIVE.get()
+    if batch is not None:
+        handle = batch.register(tree)
+        return handle.result
+    return lambda: _counted_device_get(tree, None)

@@ -30,8 +30,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 from urllib.parse import parse_qs, urlparse
 
+import concurrent.futures
+
 from ..context.accelerator_context import AcceleratorDataContext, ClusterSnapshot
 from ..metrics.client import fetch_tpu_metrics
+from ..runtime.transfer import TransferBatch
 from ..pages.native import native_node_page, native_pod_page
 from ..registration import Registry, register_plugin
 from ..transport.api_proxy import MockTransport, Transport
@@ -91,6 +94,23 @@ def _analytics_health() -> dict[str, Any]:
         return {"calibrated": False}
 
 
+def _runtime_health() -> dict[str, Any]:
+    """Transfer-funnel and device-cache counters for /healthz: how many
+    blocking device_gets the process has paid, how often warm requests
+    hit the device-resident fleet — the observable side of ADR-012's
+    one-RTT-per-request contract."""
+    try:
+        from ..runtime.device_cache import fleet_cache
+        from ..runtime.transfer import transfer_stats
+
+        return {
+            "transfer": transfer_stats.snapshot(),
+            "fleet_cache": fleet_cache.snapshot(),
+        }
+    except Exception:  # noqa: BLE001 — health must never 500 on analytics
+        return {}
+
+
 def _force_recalibration() -> None:
     """Operator recovery lever: ``/refresh?recalibrate=1`` drops the
     rollup timings AND any pinned broken-backend state, so the next
@@ -105,6 +125,16 @@ def _force_recalibration() -> None:
 
         calibration.reset()
     except Exception:  # noqa: BLE001 — refresh must never 500 on analytics
+        pass
+    try:
+        # The re-probe should measure what steady state serves — warm
+        # device-resident arrays — but a recalibration is also the
+        # operator's "something is off on the device" lever, so drop
+        # the resident fleets and let the next sync/request re-upload.
+        from ..runtime.device_cache import fleet_cache
+
+        fleet_cache.invalidate()
+    except Exception:  # noqa: BLE001
         pass
 
 
@@ -170,6 +200,16 @@ class DashboardApp:
         #: enable_watch(). Reentrant because a restart set()s the old
         #: handle while already holding it.
         self._bg_lock = threading.RLock()
+        #: Per-request transfer accounting (written in handle()'s
+        #: finally, read racily by bench/healthz — GIL-atomic int ops).
+        #: ``last_request_device_gets`` is the number ISSUE r06's
+        #: acceptance pins at 1 for a warm-cache request.
+        self.requests_served = 0
+        self.request_device_gets = 0
+        self.last_request_device_gets = 0
+        #: Lazily-created worker pool for the metrics route's
+        #: fetch∥forecast overlap (see _metrics_and_forecast).
+        self._overlap_pool: concurrent.futures.ThreadPoolExecutor | None = None
 
     @property
     def registry(self) -> Registry:
@@ -235,6 +275,7 @@ class DashboardApp:
                 self._record_sync(None)
             else:
                 self._record_sync(snap)
+                self._warm_device_cache(snap)
 
         def loop() -> None:
             sync_once()  # hydrate immediately; first page view must not block
@@ -251,6 +292,30 @@ class DashboardApp:
         # strand the app with a permanently stale snapshot).
         threading.Thread(target=loop, daemon=True, name="hl-tpu-sync").start()
         return stop
+
+    def _warm_device_cache(self, snap: Any) -> None:
+        """Background-sync hook: upload the TPU fleet's columnar arrays
+        to device as soon as a new snapshot lands, so the first request
+        against it is already a cache hit (the upload happens off the
+        request path — the entire point of the device-resident cache).
+        Gated on the XLA floor: below it the measured policy serves the
+        Python rollup, which never touches the arrays. Any failure is
+        absorbed — a broken device backend degrades requests to the
+        Python fallback via the calibration machinery, and the warm
+        must not kill the sync heartbeat rehearsing the same error."""
+        try:
+            state = snap.providers.get("tpu")
+            if state is None or state.view.version is None:
+                return
+            from ..analytics.stats import XLA_ROLLUP_MIN_NODES
+
+            if len(state.view.nodes) < XLA_ROLLUP_MIN_NODES:
+                return
+            from ..runtime.device_cache import fleet_cache
+
+            fleet_cache.warm(state.view)
+        except Exception:  # noqa: BLE001 — warm is an optimization only
+            pass
 
     def _record_sync(self, snap: Any) -> None:
         """Track consecutive failing syncs for /healthz. A sync counts as
@@ -417,6 +482,47 @@ class DashboardApp:
             )
             return forecast
 
+    def _metrics_and_forecast(self) -> tuple[Any, Any]:
+        """Metrics + forecast for the metrics route, overlapped.
+
+        Sequentially these serialize two network-bound phases: the
+        Prometheus instant-query fan-out (`metrics/client.py`, a
+        ThreadPoolExecutor joining up to 8 queries) and then the
+        forecast (range query + jit'd fit whose device dispatch is
+        async). The forecast cache is keyed on chip IDENTITY — stable
+        across scrapes — so when a recent metrics snapshot exists
+        (`_peek_metrics`) the forecast can start from it immediately
+        while the instant queries refresh concurrently; the join only
+        recomputes if the fresh scrape changed the chip set (nodes
+        added/removed), in which case the sequential cost returns for
+        exactly that request. Cold cache (no peekable snapshot) stays
+        sequential — there is nothing to overlap with."""
+        peeked = self._peek_metrics()
+        if peeked is None or not peeked.chips:
+            metrics = self._cached_metrics()
+            return metrics, self._forecast_for(metrics)
+        pool = self._overlap_pool
+        if pool is None:
+            # Two workers: a second metrics-route request overlapping
+            # while the first's fetch is still joining must not
+            # serialize behind it here (the caches have their own locks).
+            pool = self._overlap_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="hl-tpu-overlap"
+            )
+        fetch = pool.submit(self._cached_metrics)
+        try:
+            forecast = self._forecast_for(peeked)
+        finally:
+            metrics = fetch.result()
+        if metrics is None or not metrics.chips:
+            # The fresh scrape failed/emptied: render it that way — the
+            # page must reflect what the fetch said, and a forecast
+            # beside a dead scrape would be incoherent.
+            return metrics, None
+        if self._metrics_key(metrics) != self._metrics_key(peeked):
+            forecast = self._forecast_for(metrics)
+        return metrics, forecast
+
     def _compute_forecast(self, metrics: Any) -> Any:
         # Delegates to the shared host glue (models.service) so the CLI
         # and HTTP consumers render identical metrics pages. Import is
@@ -437,9 +543,19 @@ class DashboardApp:
         """(status, content_type, body) for a GET. Pure enough to test
         without sockets. Never raises: route errors become a 500 page
         (a traceback must not leak into a response, and one broken
-        route must not kill the handler thread)."""
+        route must not kill the handler thread).
+
+        Every request runs inside its own TransferBatch scope: stages
+        that produce device arrays (XLA rollup, forecast, mesh shards)
+        register into it via the runtime transfer funnel, and the first
+        consumer flushes ALL of them in one blocking ``jax.device_get``
+        — one tunnel RTT per request instead of one per stage. The
+        batch also counts the request's blocking fetches, which is the
+        ``device_gets_per_request`` number bench.py reports."""
+        batch = TransferBatch()
         try:
-            return self._handle(path)
+            with batch.scope():
+                return self._handle(path)
         except Exception as e:  # noqa: BLE001 — error boundary
             body = self._page_html(
                 "Error",
@@ -447,6 +563,10 @@ class DashboardApp:
                 f"{html.escape(type(e).__name__)}: {html.escape(str(e))}</div>",
             )
             return 500, "text/html", body
+        finally:
+            self.requests_served += 1
+            self.request_device_gets += batch.blocking_gets
+            self.last_request_device_gets = batch.blocking_gets
 
     def _handle(self, path: str) -> tuple[int, str, str]:
         parsed = urlparse(path)
@@ -476,6 +596,7 @@ class DashboardApp:
                         # startup too, when "probe not yet run" is the
                         # most informative state.
                         "analytics": _analytics_health(),
+                        "runtime": _runtime_health(),
                     }
                 )
                 return 200, "application/json", body
@@ -500,6 +621,7 @@ class DashboardApp:
                     "consecutive_sync_failures": failures,
                     "background_sync": background,
                     "analytics": _analytics_health(),
+                    "runtime": _runtime_health(),
                 }
             )
             return 200, "application/json", body
@@ -583,8 +705,7 @@ class DashboardApp:
             # make the substring filter arbitrarily expensive.
             paging["query"] = params.get("q", [""])[0][:253]
         if route.kind == "metrics":
-            metrics = self._cached_metrics()
-            forecast = self._forecast_for(metrics)
+            metrics, forecast = self._metrics_and_forecast()
             el = route.component(metrics, forecast)
         elif route.kind == "intel-metrics":
             from ..metrics.intel_client import fetch_intel_gpu_metrics

@@ -123,6 +123,11 @@ class ParseResult:
     #: style width check subtracts these (prettier can rewrap the code
     #: around a string but never the string itself).
     string_chars: dict[int, int] = field(default_factory=dict)
+    #: END line of each `// prettier-ignore` / `/* prettier-ignore */`
+    #: comment: prettier leaves the NEXT node verbatim, so the style
+    #: pass must extend protection over the following statement too
+    #: (resolved token-wise in run()).
+    prettier_ignore_lines: set[int] = field(default_factory=set)
 
 
 class _Parser:
@@ -169,16 +174,22 @@ class _Parser:
                 self.advance()
             elif c == "/" and self.peek(1) == "/":
                 self.result.protected_lines.add(self.line)
+                body_start = self.pos + 2
                 while self.pos < self.n and self.peek() != "\n":
                     self.advance()
+                if self.src[body_start : self.pos].strip() == "prettier-ignore":
+                    self.result.prettier_ignore_lines.add(self.line)
             elif c == "/" and self.peek(1) == "*":
                 start = self.line
                 self.advance(2)
+                body_start = self.pos
                 while self.pos < self.n and not (self.peek() == "*" and self.peek(1) == "/"):
                     self.advance()
                 if self.pos >= self.n:
                     self.error("unterminated block comment", start)
                     return
+                if self.src[body_start : self.pos].strip() == "prettier-ignore":
+                    self.result.prettier_ignore_lines.add(self.line)
                 self.advance(2)
                 self.result.protected_lines.update(range(start, self.line + 1))
             else:
@@ -456,7 +467,42 @@ class _Parser:
         self.scan_js()
         for opened, line in self.depth_stack:
             self.error(f"'{opened}' never closed", line)
+        self._protect_prettier_ignored()
         return self.result
+
+    def _protect_prettier_ignored(self) -> None:
+        """Extend ``protected_lines`` over the statement following each
+        `prettier-ignore` comment: prettier leaves that whole node
+        verbatim, so none of its lines may fail the style gate
+        (local-fail ⇒ CI-fail would break otherwise — the gate's one
+        contract). The ignored span runs from the first token after the
+        comment to wherever its statement ends token-wise: the close of
+        the first bracket group when one opens (a multi-line array/call
+        like `TpuDataContext.tsx:177`'s dependency array), an enclosing
+        group's close, or a depth-0 `;`/`,`."""
+        tokens = self.result.tokens
+        for comment_line in self.result.prettier_ignore_lines:
+            idx = next(
+                (k for k, t in enumerate(tokens) if t[2] > comment_line), None
+            )
+            if idx is None:
+                continue
+            start_line = tokens[idx][2]
+            end_line = start_line
+            depth = 0
+            for kind, value, ln in tokens[idx:]:
+                if kind == "punct" and value in _OPEN:
+                    depth += 1
+                elif kind == "punct" and value in _CLOSE:
+                    depth -= 1
+                    if depth <= 0:
+                        end_line = ln
+                        break
+                elif depth == 0 and kind == "punct" and value in (";", ","):
+                    end_line = ln
+                    break
+                end_line = ln
+            self.result.protected_lines.update(range(start_line, end_line + 1))
 
 
 def parse_source(path: str, src: str) -> ParseResult:
@@ -827,6 +873,38 @@ class _IdentifierPass:
                 at_chunk_start = False
             i += 1
 
+    def _bind_arrow_type_params(self, open_paren: int) -> None:
+        """Generic arrow functions: `const f = <T, U extends X>(x: T):
+        T => x` — the `<…>` group immediately before an arrow's params
+        declares its type parameters, same as the `function` branch's
+        generics. Only `.ts` token streams reach this shape (in `.tsx` a
+        leading `<` lexes as JSX); `<`/`>` are not in the bracket map,
+        so walk the angle depth by hand, backwards from the `(`."""
+        j = open_paren - 1
+        if j < 0 or not self._punct_at(j, ">"):
+            return
+        depth = 1
+        j -= 1
+        while j >= 0 and depth:
+            kind, value, _ln = self.toks[j]
+            if kind == "punct" and value == ">":
+                depth += 1
+            elif kind == "punct" and value == "<":
+                depth -= 1
+                if depth == 0:
+                    break
+            j -= 1
+        if depth:
+            return
+        # Every non-keyword word inside declares — type params AND their
+        # constraint names, mirroring the `function` generics branch:
+        # over-binding is the safe direction for a pass whose contract
+        # is zero false positives.
+        for k in range(j + 1, open_paren):
+            kind, value, _ln = self.toks[k]
+            if kind == "word" and value not in _TS_KEYWORDS:
+                self.declared.add(value)
+
     def _annotation_terminator(self, i: int) -> str | None:
         """From the token after `):`, scan the (possible) return-type
         annotation and report what ends it at depth 0: `'=>'` for an
@@ -876,6 +954,7 @@ class _IdentifierPass:
                             and self._annotation_terminator(after + 1) == "=>"
                         ):
                             self._bind_params(i)
+                            self._bind_arrow_type_params(i)
                 elif kind == "punct" and value == "=>":
                     # `x =>` binds x — including `key: x =>` object
                     # properties, but NOT `(…): RetType =>` where the
