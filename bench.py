@@ -45,6 +45,10 @@ Extras reported alongside (same JSON line, `extra` object):
   scalar riding the predictions' single device_get (the suspected
   regression contributor; the serving path fuses them at
   `models/service.py:104`).
+- ``telemetry_overhead_ns_per_span`` / ``handle_ms_tracing_{on,off}``
+  / ``trace_ring_memory_kb`` — the ADR-013 telemetry budget numbers:
+  per-span tracing cost, handle() latency with tracing on vs off
+  (acceptance: ≤5% delta), and the trace ring's resident size.
 
 Prints ONE JSON line:
   {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": ..., "extra": {...}}
@@ -406,7 +410,10 @@ def bench_request_transfer_discipline() -> dict:
         hits0, misses0 = fleet_cache.hits, fleet_cache.misses
         gets = []
         for _ in range(5):
-            app._last_sync = 0.0  # force the next snapshot build (a tick)
+            # Force the next snapshot build (a tick). -inf, not 0.0:
+            # _last_sync is monotonic-based now and time.monotonic can
+            # legitimately be < min_sync on a fresh host.
+            app._last_sync = float("-inf")
             snap = app._synced_snapshot()
             app._warm_device_cache(snap)  # what sync_once does per tick
             status, _, body = app.handle("/tpu")
@@ -466,6 +473,57 @@ def bench_watch_steady_state(n_nodes: int = 1024) -> dict:
         f"sync_relist_ms_{n_nodes}": round(relist_ms, 2),
         f"relist_objects_per_tick_{n_nodes}": objects_total,
         f"watch_objects_per_quiet_tick_{n_nodes}": 0,
+    }
+
+
+def bench_telemetry(fleet) -> dict:
+    """ADR-013 acceptance numbers for the telemetry subsystem:
+
+    - ``telemetry_overhead_ns_per_span`` — per-span cost of the tracing
+      context manager under an active trace (enter + exit + attr
+      stamp), the number the ADR's 50 µs budget bounds.
+    - ``handle_ms_tracing_{on,off}`` — median /tpu handle() with the
+      global tracing switch on vs off, same app and snapshot; the
+      on/off delta over the off figure is the ≤5% acceptance check.
+    - ``trace_ring_memory_kb`` — deep size of the ring after the on-leg
+      requests, bounding what a full ring costs resident."""
+    from headlamp_tpu.obs import span, set_tracing, trace_ring, trace_request
+
+    # Per-span: real spans under a live trace, amortized over a batch.
+    set_tracing(True)
+    trace_ring.clear()
+    n = 2000
+    with trace_request("/bench"):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with span("bench.span", idx=1):
+                pass
+        per_span_ns = (time.perf_counter() - t0) / n * 1e9
+
+    app = make_app(fleet)
+    app.handle("/tpu")  # warm: sync + rollup compile outside the timing
+
+    def handle_p50() -> float:
+        samples = []
+        for _ in range(15):
+            t0 = time.perf_counter()
+            status, _, body = app.handle("/tpu")
+            samples.append((time.perf_counter() - t0) * 1000)
+            assert status == 200 and body
+        return statistics.median(samples)
+
+    try:
+        on_ms = handle_p50()
+        ring_kb = trace_ring.memory_bytes() / 1024
+        set_tracing(False)
+        off_ms = handle_p50()
+    finally:
+        set_tracing(True)
+    return {
+        "telemetry_overhead_ns_per_span": round(per_span_ns, 1),
+        "handle_ms_tracing_on": round(on_ms, 2),
+        "handle_ms_tracing_off": round(off_ms, 2),
+        "trace_ring_memory_kb": round(ring_kb, 1),
     }
 
 
@@ -535,6 +593,7 @@ def main() -> None:
         rollup.update(bench_rollup_cached(n))
     transfers = bench_request_transfer_discipline()
     watch = bench_watch_steady_state()
+    telemetry = bench_telemetry(fleet)
     print(
         json.dumps(
             {
@@ -572,6 +631,7 @@ def main() -> None:
                     **rollup,
                     **transfers,
                     **watch,
+                    **telemetry,
                 },
             },
             ensure_ascii=False,

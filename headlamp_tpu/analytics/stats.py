@@ -22,6 +22,9 @@ from typing import Any, Callable
 
 from ..domain import objects, tpu
 from ..domain.accelerator import FleetView
+from ..obs.metrics import registry as _metrics_registry
+from ..obs.trace import annotate as _annotate
+from ..obs.trace import span as _span
 
 #: Node-utilization percentage at or above which a node counts as hot —
 #: the UI kit's critical threshold (`NodesPage.tsx:38`).
@@ -48,6 +51,7 @@ def python_fleet_stats(view: FleetView) -> dict[str, Any]:
     """Pure-Python reference implementation: same aggregates, same key
     set, no jax. Also the numeric oracle the XLA rollup is tested
     against."""
+    _annotate(backend="python")
     provider = view.provider
     summary = dict(
         objects.allocation_summary(
@@ -279,6 +283,30 @@ class _Calibration:
 
 calibration = _Calibration()
 
+# Calibration state as scrapeable gauges (ADR-013): callback views over
+# the singleton above — /healthz's analytics block and /metricsz read
+# the SAME _measured tuple, so they cannot drift. None (uncalibrated)
+# omits the sample rather than fabricating a zero timing.
+_metrics_registry.gauge_fn(
+    "headlamp_tpu_calibration_xla_seconds",
+    "Measured XLA rollup latency from the last calibration probe",
+    lambda: calibration.xla_ms / 1000.0 if calibration.xla_ms is not None else None,
+)
+_metrics_registry.gauge_fn(
+    "headlamp_tpu_calibration_python_per_node_seconds",
+    "Measured Python rollup latency per node from the last calibration probe",
+    lambda: (
+        calibration.python_ms_per_node / 1000.0
+        if calibration.python_ms_per_node is not None
+        else None
+    ),
+)
+_metrics_registry.gauge_fn(
+    "headlamp_tpu_calibration_broken_info",
+    "1 when the device backend is pinned broken (requests serve Python)",
+    lambda: 1.0 if calibration.broken_reason is not None else 0.0,
+)
+
 
 def chosen_backend(n_nodes: int) -> str:
     """Which backend the default policy would serve an ``n_nodes`` fleet
@@ -310,7 +338,19 @@ def fleet_stats(view: FleetView, *, backend: str | None = None) -> dict[str, Any
     silently degrading, so a parity test on a jax-less host must skip,
     not vacuously compare Python to itself. On the default path any
     jax-side failure falls back: analytics acceleration must never cost
-    a page."""
+    a page.
+
+    Traced as ``analytics.rollup`` (ADR-013) with node count up front
+    and the served backend annotated by whichever leaf actually ran —
+    the trace must show what the request PAID, not what the policy
+    intended."""
+    with _span("analytics.rollup", nodes=len(view.nodes)):
+        return _fleet_stats_dispatch(view, backend)
+
+
+def _fleet_stats_dispatch(
+    view: FleetView, backend: str | None = None
+) -> dict[str, Any]:
     if backend == "python":
         return python_fleet_stats(view)
     if backend == "xla":
@@ -390,9 +430,12 @@ def _calibrate(view: FleetView) -> dict[str, Any]:
             samples.append((time.perf_counter() - t0) * 1000)
         return statistics.median(samples)
 
-    stats = _xla_stats(view)  # warm-up: compile for this fleet-shape bucket
-    xla_ms = timed(lambda: _xla_stats(view))
-    python_ms = timed(lambda: python_fleet_stats(view))
+    # Its own span (ADR-013): the probe is THE latency spike a trace
+    # reader hunting a slow first at-scale request needs to see named.
+    with _span("analytics.calibrate", nodes=len(view.nodes)):
+        stats = _xla_stats(view)  # warm-up: compile for this fleet-shape bucket
+        xla_ms = timed(lambda: _xla_stats(view))
+        python_ms = timed(lambda: python_fleet_stats(view))
     # One atomic publish after BOTH passes: no concurrent reader can
     # observe a half-published calibration (which would misroute
     # first-calibration losers onto the XLA path and let their
@@ -411,6 +454,7 @@ def _xla_stats(view: FleetView) -> dict[str, Any]:
     from ..runtime.device_cache import fleet_cache
     from .fleet_jax import rollup_to_dict
 
+    _annotate(backend="xla")
     # Versioned views (server snapshots) hit the device-resident cache:
     # a warm request re-uses the columns already living on device and
     # pays dispatch + one coalesced device_get only — the host→device

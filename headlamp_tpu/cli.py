@@ -43,7 +43,13 @@ PAGES = {
 def render_page(
     page: str, transport: Transport, *, clock: Callable[[], float] = time.time
 ) -> str:
-    """Render one page to text against a transport (exposed for tests)."""
+    """Render one page to text against a transport (exposed for tests).
+
+    ``clock`` is wall time on purpose (ADR-013 clock audit): every use
+    below is a displayed timestamp or a Prometheus query-range bound —
+    values that must agree with the cluster's real time. Nothing here
+    computes an elapsed duration from it.
+    """
     registry = register_plugin()
     route = registry.route_for(PAGES[page])
     assert route is not None

@@ -168,6 +168,11 @@ class AcceleratorDataContext:
         self._providers = providers
         self._sources = dict(sources if sources is not None else default_sources())
         self._timeout_s = timeout_s
+        # Wall clock on purpose (ADR-013 clock audit): it only stamps
+        # snapshot.fetched_at, a displayed timestamp pages show as
+        # "fetched HH:MM:SS". Elapsed-time telemetry (sync coalescing,
+        # healthz staleness, cache TTLs) lives in the server app on
+        # time.monotonic and must never derive from this.
         self._clock = clock
         self._page_limit = page_limit if page_limit is not None else self.PAGE_LIMIT
         #: Optional server-side pod filter (e.g. ACTIVE_PODS_FIELD_SELECTOR

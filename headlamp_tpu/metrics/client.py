@@ -32,6 +32,7 @@ import urllib.parse
 from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping
 
+from ..obs.trace import span as _span
 from ..transport.api_proxy import ApiError, Transport
 
 # ---------------------------------------------------------------------------
@@ -285,7 +286,12 @@ def fetch_tpu_metrics(
     logical-metric candidate queries plus the node map in parallel, and
     join into per-chip rows. None when no Prometheus answers."""
     t_start = time.perf_counter()
-    found = prometheus or find_prometheus_path(transport, timeout_s)
+    # ADR-013 stage spans: discovery (the candidate-chain probe — the
+    # whole chain times out serially against a dark cluster, which is
+    # the pathological latency this span exists to expose) and the
+    # parallel fan-out below.
+    with _span("metrics.discover", pinned=prometheus is not None):
+        found = prometheus or find_prometheus_path(transport, timeout_s)
     if found is None:
         return None
     namespace, service = found
@@ -305,10 +311,11 @@ def fetch_tpu_metrics(
     queries: list[str] = [NODE_MAP_QUERY]
     for candidates in LOGICAL_METRICS.values():
         queries.extend(candidates)
-    with concurrent.futures.ThreadPoolExecutor(
-        max_workers=min(8, len(queries)), thread_name_prefix="hl-tpu-promql"
-    ) as pool:
-        results = dict(zip(queries, pool.map(run_query, queries)))
+    with _span("metrics.fanout", queries=len(queries), service=service):
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(8, len(queries)), thread_name_prefix="hl-tpu-promql"
+        ) as pool:
+            results = dict(zip(queries, pool.map(run_query, queries)))
 
     instance_map = _build_instance_map(results[NODE_MAP_QUERY])
 
@@ -401,6 +408,10 @@ def fetch_utilization_history(
     page view doesn't re-walk candidates the instant path already
     eliminated. None when no candidate has enough real history."""
     namespace, service = prometheus
+    # Wall clock ON PURPOSE (clock-skew audit, ADR-013): start/end are
+    # Prometheus range-query bounds — epoch timestamps the server
+    # interprets — not elapsed-time math. Monotonic belongs to
+    # durations (fetch_ms uses perf_counter); never to these.
     end = clock()
     start = end - window_s
     n_samples = int(window_s // step_s) + 1

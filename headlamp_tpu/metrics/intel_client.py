@@ -141,6 +141,10 @@ def fetch_intel_gpu_metrics(
         namespace=namespace,
         service=service,
         chips=sorted(chips.values(), key=lambda c: (c.node, c.chip)),
+        # Wall clock for the DISPLAYED fetch stamp, perf_counter for the
+        # MEASURED fetch duration — never mix the two (ADR-013 clock
+        # audit): an NTP step mid-fetch would corrupt a wall-clock
+        # elapsed but can only relabel a display timestamp.
         fetched_at=clock(),
         fetch_ms=round((time.perf_counter() - t_start) * 1000, 1),
     )

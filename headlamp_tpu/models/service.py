@@ -12,6 +12,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from ..metrics.client import UtilizationHistory
+from ..obs.trace import span as _span
 from .forecast import ForecastConfig, fit_and_forecast_with_dispatch
 
 
@@ -68,12 +69,16 @@ def compute_forecast(
     if metrics is None or not metrics.chips:
         return None
     try:
-        history = fetch_utilization_history(
-            transport,
-            prometheus=(metrics.namespace, metrics.service),
-            clock=clock or _time.time,
-            preferred_query=metrics.resolved_series.get("tensorcore_utilization"),
-        )
+        # ADR-013: the two forecast phases traced separately — the
+        # range query is network-bound, the fit is device-bound, and a
+        # slow metrics page needs to show WHICH one it paid.
+        with _span("forecast.history"):
+            history = fetch_utilization_history(
+                transport,
+                prometheus=(metrics.namespace, metrics.service),
+                clock=clock or _time.time,
+                preferred_query=metrics.resolved_series.get("tensorcore_utilization"),
+            )
         if history is None:
             return None
         return forecast_from_history(history)
@@ -96,21 +101,26 @@ def forecast_from_history(
 
     cfg = cfg or ForecastConfig()
     t0 = time.perf_counter()
-    preds, dispatch = fit_and_forecast_with_dispatch(
-        np.asarray(history.series), cfg, steps=steps
-    )
-    if dispatch.fit_mse is not None:
-        # One device_get for predictions AND the fit-quality scalar —
-        # a separate float() would cost an extra tunnel round-trip. Via
-        # the transfer funnel it also coalesces with the fleet rollup's
-        # fetch when a request batch is active.
-        from ..runtime import transfer
+    with _span(
+        "forecast.fit", series=len(history.series), steps=steps
+    ) as fit_span:
+        preds, dispatch = fit_and_forecast_with_dispatch(
+            np.asarray(history.series), cfg, steps=steps
+        )
+        if fit_span is not None:
+            fit_span.attrs["inference_path"] = dispatch.path
+        if dispatch.fit_mse is not None:
+            # One device_get for predictions AND the fit-quality scalar —
+            # a separate float() would cost an extra tunnel round-trip. Via
+            # the transfer funnel it also coalesces with the fleet rollup's
+            # fetch when a request batch is active.
+            from ..runtime import transfer
 
-        preds, fit_mse_arr = transfer.fetch((preds, dispatch.fit_mse))
-        fit_mse = float(fit_mse_arr)
-    else:
-        preds = np.asarray(preds)
-        fit_mse = None
+            preds, fit_mse_arr = transfer.fetch((preds, dispatch.fit_mse))
+            fit_mse = float(fit_mse_arr)
+        else:
+            preds = np.asarray(preds)
+            fit_mse = None
     fit_ms = round((time.perf_counter() - t0) * 1000, 1)
 
     chips = []

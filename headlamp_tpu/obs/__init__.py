@@ -1,0 +1,58 @@
+"""Unified telemetry subsystem (ADR-013).
+
+Three pieces, one package:
+
+- :mod:`.metrics` — the process metric registry behind ``/metricsz``
+  (counters, gauges, fixed-log-bucket histograms, Prometheus text
+  exposition). The transfer/device-cache/calibration counter bags are
+  views over it.
+- :mod:`.trace` — contextvar-carried request traces (span nesting,
+  monotonic timing, per-span attributes) retained in a bounded ring.
+- :mod:`.debug_pages` — the waterfall page over the ring; its JSON
+  twin is served at ``/debug/traces`` by the app layer.
+
+Stdlib-only: the server imports this unconditionally, so it must load
+on jax-less hosts and cost nothing when tracing is off.
+"""
+
+from __future__ import annotations
+
+from .metrics import DEFAULT_LATENCY_BUCKETS, MetricRegistry, registry
+from .trace import (
+    SPAN_OVERHEAD_BUDGET_NS,
+    TRACE_RING_CAPACITY,
+    Span,
+    Trace,
+    TraceRing,
+    annotate,
+    set_tracing,
+    span,
+    trace_request,
+    trace_ring,
+    tracing_enabled,
+)
+
+#: The ring's depth is itself scrapeable — an operator alerting on
+#: "server up but ring empty" catches a disabled-tracing deploy.
+registry.gauge_fn(
+    "headlamp_tpu_trace_ring_traces_count",
+    "Completed request traces currently retained for /debug/traces",
+    lambda: float(len(trace_ring)),
+)
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS",
+    "MetricRegistry",
+    "registry",
+    "SPAN_OVERHEAD_BUDGET_NS",
+    "TRACE_RING_CAPACITY",
+    "Span",
+    "Trace",
+    "TraceRing",
+    "annotate",
+    "set_tracing",
+    "span",
+    "trace_request",
+    "trace_ring",
+    "tracing_enabled",
+]

@@ -1,0 +1,125 @@
+"""Trace waterfall page — the HTML face of the trace ring.
+
+Built from the same ``ui/vdom.py`` components as every other page and
+registered as a normal route (``/debug/traces/html``, registration.py),
+so the host renders it through the standard nav/chrome and the
+"all registered routes render" test covers it for free. The JSON twin
+lives at ``/debug/traces`` (served directly by the app layer — it is
+data, not a page).
+
+Layout: traces sorted slowest-first (the page exists to answer "what
+were the slowest recent requests"), each with a per-span row — an
+indented stage label, a proportional bar positioned at the span's
+offset within the request, and the duration + attributes. Bar geometry
+is inline style (percentages of the trace duration); classes carry the
+visual identity so style.py themes it with the rest of the kit.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from ..ui.vdom import Element, h
+
+
+def _fmt_ms(ms: float) -> str:
+    return f"{ms:.2f} ms" if ms < 100 else f"{ms:.0f} ms"
+
+
+def _fmt_attrs(attrs: dict[str, Any]) -> str:
+    return " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+
+
+def _span_rows(
+    span: dict[str, Any], trace_ms: float, depth: int
+) -> list[Element]:
+    """Flatten one span subtree into waterfall rows, depth-first —
+    children render under their parent at one more indent level, which
+    reads as the call tree without nested markup."""
+    scale = max(trace_ms, 1e-6)
+    left = min(span["start_ms"] / scale * 100.0, 100.0)
+    width = max(min(span["duration_ms"] / scale * 100.0, 100.0 - left), 0.5)
+    rows = [
+        h(
+            "div",
+            {"class_": "hl-span-row"},
+            h(
+                "span",
+                {
+                    "class_": "hl-span-label",
+                    "style": f"padding-left:{depth * 16}px",
+                },
+                span["name"],
+            ),
+            h(
+                "span",
+                {"class_": "hl-span-track"},
+                h(
+                    "span",
+                    {
+                        "class_": "hl-span-bar",
+                        "style": f"margin-left:{left:.2f}%;width:{width:.2f}%",
+                    },
+                ),
+            ),
+            h("span", {"class_": "hl-span-ms"}, _fmt_ms(span["duration_ms"])),
+            span["attrs"]
+            and h("span", {"class_": "hl-span-attrs"}, _fmt_attrs(span["attrs"])),
+        )
+    ]
+    for child in span["children"]:
+        rows.extend(_span_rows(child, trace_ms, depth + 1))
+    return rows
+
+
+def _trace_section(trace: dict[str, Any]) -> Element:
+    started = time.strftime(
+        "%H:%M:%S", time.localtime(trace["started_at"])
+    )  # wall clock is for DISPLAY only (ADR-013); durations are monotonic
+    status = trace["status"]
+    status_class = "hl-status-ok" if status < 400 else "hl-status-err"
+    return h(
+        "section",
+        {"class_": "hl-section hl-trace"},
+        h(
+            "header",
+            {"class_": "hl-trace-header"},
+            h("span", {"class_": f"hl-status {status_class}"}, str(status)),
+            h("strong", None, trace["route"]),
+            h(
+                "span",
+                {"class_": "hl-hint"},
+                f"{_fmt_ms(trace['duration_ms'])} · {trace['device_gets']} "
+                f"device_get(s) · started {started}",
+            ),
+        ),
+        [_span_rows(s, trace["duration_ms"], 0) for s in trace["spans"]]
+        or h("p", {"class_": "hl-hint"}, "No instrumented stages recorded."),
+    )
+
+
+def traces_page(traces: list[dict[str, Any]]) -> Element:
+    """The waterfall page. ``traces`` is ``trace_ring.snapshot()`` —
+    newest first; re-sorted slowest-first here because that is the
+    question the page answers."""
+    ordered = sorted(traces, key=lambda t: -t["duration_ms"])
+    return h(
+        "div",
+        {"class_": "hl-traces"},
+        h("h1", None, "Request Traces"),
+        h(
+            "p",
+            {"class_": "hl-hint"},
+            f"{len(ordered)} recent request(s), slowest first. "
+            "Raw JSON: /debug/traces · correlate device_get counts with "
+            "/metricsz transfer counters (OPERATIONS.md runbook).",
+        ),
+        [_trace_section(t) for t in ordered]
+        if ordered
+        else h(
+            "div",
+            {"class_": "hl-empty-content"},
+            "No traces captured yet — load a page, then refresh.",
+        ),
+    )

@@ -1,0 +1,351 @@
+"""Metric registry + Prometheus text exposition (ADR-013).
+
+The process-wide registry behind ``GET /metricsz``. Three instrument
+kinds — :class:`Counter`, :class:`Gauge`, :class:`Histogram` — plus
+callback gauges for values that already live elsewhere (the calibration
+timings, the fleet-cache hit ratio, the trace-ring depth): the existing
+counter bags in ``runtime/transfer.py`` / ``runtime/device_cache.py``
+keep their ``snapshot()`` shapes for /healthz, but their storage moves
+HERE so /metricsz and /healthz can never disagree on a number.
+
+Concurrency model ("lock-light", ADR-013): instruments take one
+per-metric ``threading.Lock`` around their read-modify-write — a
+~100 ns acquire on an uncontended lock, paid once or twice per request,
+far below the 5% handle-overhead budget. What the design avoids is a
+REGISTRY-wide lock on the hot path: get-or-create goes through the
+registry lock once at wiring time, after which callers hold a direct
+instrument reference and never touch registry state again. Exposition
+(`render`) snapshots each instrument under its own lock, so a scrape
+never blocks a request for longer than one child copy.
+
+Naming is validated at registration: every metric must match
+``headlamp_tpu_[a-z0-9_]+`` and end in a unit suffix (the exposition
+test enforces the same grammar from the outside). Counters must end in
+``_total``; histograms carry a real unit (``_seconds``/``_bytes``)
+because their ``_bucket``/``_sum``/``_count`` series are derived from
+the base name.
+
+Stdlib-only on purpose: the server imports this unconditionally, and a
+jax-less host must be able to scrape itself.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from bisect import bisect_left
+from typing import Any, Callable, Iterator
+
+_NAME_RE = re.compile(r"^headlamp_tpu_[a-z0-9_]+$")
+
+#: Unit suffix grammar the exposition test (tests/test_metricsz.py)
+#: re-asserts from outside. ``_total`` for counters, base units for
+#: measurements, ``_count`` for cardinalities, ``_ratio`` for 0..1,
+#: ``_info`` for 0/1 state flags.
+UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_ratio", "_count", "_info")
+
+#: Fixed log-2 latency buckets, 1 ms .. ~16 s. Request handling spans
+#: sub-ms cached renders to multi-second cold Prometheus probe chains +
+#: first jit compiles; a geometric ladder covers that range in 15
+#: buckets with constant relative error, and FIXED buckets keep every
+#: process's histograms aggregable in one PromQL sum().
+DEFAULT_LATENCY_BUCKETS = tuple(0.001 * 2.0**i for i in range(15))
+
+
+def _validate_name(name: str, kind: str) -> None:
+    if not _NAME_RE.match(name):
+        raise ValueError(f"metric name {name!r} must match {_NAME_RE.pattern}")
+    if not name.endswith(UNIT_SUFFIXES):
+        raise ValueError(f"metric name {name!r} must end in one of {UNIT_SUFFIXES}")
+    if kind == "counter" and not name.endswith("_total"):
+        raise ValueError(f"counter {name!r} must end in '_total'")
+    if kind == "histogram" and not name.endswith(("_seconds", "_bytes")):
+        # _bucket/_sum/_count are derived from the base name, so the
+        # base itself must carry the unit.
+        raise ValueError(f"histogram {name!r} must end in '_seconds' or '_bytes'")
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(value: float) -> str:
+    """Prometheus sample value: integral floats render as integers
+    (counters read naturally), everything else as repr (full float
+    precision survives the round-trip)."""
+    f = float(value)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _label_str(labels: tuple[str, ...], values: tuple[str, ...]) -> str:
+    if not labels:
+        return ""
+    pairs = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in zip(labels, values)
+    )
+    return "{" + pairs + "}"
+
+
+class Counter:
+    """Monotone counter, optionally labeled. ``inc`` takes the
+    per-metric lock (see module docstring for why that is cheap
+    enough); ``value``/``value_for`` are the /healthz-view readers."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str, labels: tuple[str, ...] = ()) -> None:
+        self.name = name
+        self.help = help
+        self.labels = tuple(labels)
+        self._lock = threading.Lock()
+        self._values: dict[tuple[str, ...], float] = {}
+
+    def _key(self, labels: dict[str, Any]) -> tuple[str, ...]:
+        if set(labels) != set(self.labels):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labels}, got {tuple(labels)}"
+            )
+        return tuple(str(labels[name]) for name in self.labels)
+
+    def inc(self, amount: float = 1, **labels: Any) -> None:
+        if amount < 0:
+            raise ValueError(f"{self.name}: counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    @property
+    def value(self) -> float:
+        """Unlabeled value (0 before the first inc)."""
+        return self._values.get((), 0.0)
+
+    def value_for(self, **labels: Any) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def samples(self) -> list[tuple[tuple[str, ...], float]]:
+        with self._lock:
+            return sorted(self._values.items())
+
+    def render_into(self, out: list[str]) -> None:
+        samples = self.samples() or [((), 0.0)]
+        for values, v in samples:
+            out.append(f"{self.name}{_label_str(self.labels, values)} {_fmt(v)}")
+
+
+class Gauge(Counter):
+    """Settable gauge — shares Counter's labeled-child storage but
+    allows ``set`` and negative movement."""
+
+    kind = "gauge"
+
+    def inc(self, amount: float = 1, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+
+class CallbackGauge:
+    """Gauge whose value is computed at scrape time by a zero-arg
+    callable — the 'view over existing state' instrument (calibration
+    timings, cache hit ratio, ring depth). The callback returning
+    ``None`` omits the sample (an uncalibrated timing has no honest
+    number); raising omits it too — a scrape must never 500 because one
+    producer broke."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str, fn: Callable[[], float | None]) -> None:
+        self.name = name
+        self.help = help
+        self.labels: tuple[str, ...] = ()
+        self.fn = fn
+
+    def render_into(self, out: list[str]) -> None:
+        try:
+            value = self.fn()
+        except Exception:  # noqa: BLE001 — scrape survives broken producers
+            value = None
+        if value is not None:
+            out.append(f"{self.name} {_fmt(float(value))}")
+
+
+class _HistogramChild:
+    __slots__ = ("counts", "sum", "count", "lock")
+
+    def __init__(self, n_buckets: int) -> None:
+        # counts[i] = observations in (bucket[i-1], bucket[i]];
+        # counts[n] = observations above the last finite bucket.
+        self.counts = [0] * (n_buckets + 1)
+        self.sum = 0.0
+        self.count = 0
+        self.lock = threading.Lock()
+
+    def observe(self, value: float, buckets: tuple[float, ...]) -> None:
+        idx = bisect_left(buckets, value)
+        with self.lock:
+            self.counts[idx] += 1
+            self.sum += value
+            self.count += 1
+
+
+class Histogram:
+    """Fixed-bucket histogram (log ladder by default). Buckets are
+    per-metric, shared by every labeled child, and rendered cumulative
+    with a ``+Inf`` terminal — the shape PromQL's histogram_quantile
+    expects."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        buckets: tuple[float, ...] = DEFAULT_LATENCY_BUCKETS,
+        labels: tuple[str, ...] = (),
+    ) -> None:
+        if list(buckets) != sorted(buckets) or len(set(buckets)) != len(buckets):
+            raise ValueError(f"{name}: buckets must be strictly increasing")
+        self.name = name
+        self.help = help
+        self.labels = tuple(labels)
+        self.buckets = tuple(float(b) for b in buckets)
+        self._lock = threading.Lock()
+        self._children: dict[tuple[str, ...], _HistogramChild] = {}
+
+    def _key(self, labels: dict[str, Any]) -> tuple[str, ...]:
+        if set(labels) != set(self.labels):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labels}, got {tuple(labels)}"
+            )
+        return tuple(str(labels[name]) for name in self.labels)
+
+    def _child(self, key: tuple[str, ...]) -> _HistogramChild:
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(
+                    key, _HistogramChild(len(self.buckets))
+                )
+        return child
+
+    def observe(self, value: float, **labels: Any) -> None:
+        self._child(self._key(labels)).observe(float(value), self.buckets)
+
+    def count_for(self, **labels: Any) -> int:
+        child = self._children.get(self._key(labels))
+        return child.count if child is not None else 0
+
+    def render_into(self, out: list[str]) -> None:
+        with self._lock:
+            items = sorted(self._children.items())
+        if not items:
+            # An empty histogram still exposes its series so dashboards
+            # and the exposition test see the shape before traffic.
+            items = [((), _HistogramChild(len(self.buckets)))] if not self.labels else []
+        for values, child in items:
+            with child.lock:
+                counts = list(child.counts)
+                total = child.count
+                total_sum = child.sum
+            cumulative = 0
+            for bound, n in zip(self.buckets, counts):
+                cumulative += n
+                labels_le = _label_str(
+                    self.labels + ("le",), values + (_fmt(bound),)
+                )
+                out.append(f"{self.name}_bucket{labels_le} {cumulative}")
+            labels_inf = _label_str(self.labels + ("le",), values + ("+Inf",))
+            out.append(f"{self.name}_bucket{labels_inf} {total}")
+            out.append(f"{self.name}_sum{_label_str(self.labels, values)} {_fmt(total_sum)}")
+            out.append(f"{self.name}_count{_label_str(self.labels, values)} {total}")
+
+
+class MetricRegistry:
+    """Name → instrument map with get-or-create semantics: the server,
+    the transfer funnel, and the device cache all wire their metrics at
+    construction time, and tests constructing many DashboardApps must
+    share (accumulate into) one process-wide instrument rather than
+    fight over registration."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Any] = {}
+
+    def _get_or_create(self, name: str, factory: Callable[[], Any], kind: str) -> Any:
+        _validate_name(name, kind)
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if existing.kind != kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as {existing.kind}"
+                    )
+                return existing
+            metric = factory()
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str, labels: tuple[str, ...] = ()) -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help, labels), "counter")
+
+    def gauge(self, name: str, help: str, labels: tuple[str, ...] = ()) -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(name, help, labels), "gauge")
+
+    def gauge_fn(
+        self, name: str, help: str, fn: Callable[[], float | None]
+    ) -> CallbackGauge:
+        """Callback gauge. Re-registering the same name swaps the
+        callback (latest producer wins) — module singletons register at
+        import, but test fixtures that rebuild those singletons must be
+        able to re-point the view."""
+        gauge = self._get_or_create(name, lambda: CallbackGauge(name, help, fn), "gauge")
+        if isinstance(gauge, CallbackGauge):
+            gauge.fn = fn
+        return gauge
+
+    def histogram(
+        self,
+        name: str,
+        help: str,
+        buckets: tuple[float, ...] = DEFAULT_LATENCY_BUCKETS,
+        labels: tuple[str, ...] = (),
+    ) -> Histogram:
+        return self._get_or_create(
+            name, lambda: Histogram(name, help, buckets, labels), "histogram"
+        )
+
+    def __iter__(self) -> Iterator[Any]:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return iter(sorted(metrics, key=lambda m: m.name))
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4 — the /metricsz
+        body. One HELP + TYPE block per metric, samples after."""
+        out: list[str] = []
+        for metric in self:
+            out.append(f"# HELP {metric.name} {_escape_help(metric.help)}")
+            out.append(f"# TYPE {metric.name} {metric.kind}")
+            metric.render_into(out)
+        return "\n".join(out) + "\n"
+
+
+#: THE process registry — everything /metricsz serves. Instruments are
+#: registered by the modules that own the numbers (server/app.py for
+#: request metrics, runtime/* for the transfer funnel, analytics/stats
+#: for calibration) so the registry itself stays producer-agnostic.
+registry = MetricRegistry()

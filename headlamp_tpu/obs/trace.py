@@ -1,0 +1,266 @@
+"""Request-scoped span tracing + bounded trace retention (ADR-013).
+
+The answer to "where did this 90 ms request go?". ``DashboardApp.handle``
+opens a :class:`trace_request` around each request; every instrumented
+stage below it — context sync, Prometheus discovery/fan-out, XLA rollup,
+calibration probe, forecast fit, device-cache upload, transfer flush,
+HTML render — wraps itself in :func:`span`, and the completed trace
+lands in :data:`trace_ring` where ``/debug/traces`` (JSON) and the
+waterfall page serve it.
+
+Carried in a :mod:`contextvars` ContextVar exactly like the transfer
+batch (``runtime/transfer.py`` ``_ACTIVE``): under ThreadingHTTPServer
+each request thread sees only its own trace, and instrumented code
+below the app layer needs no plumbed-through argument. The metrics
+route's overlap worker inherits the trace via ``contextvars
+.copy_context`` in the app layer; its spans append into the shared
+parent's children list, which is safe — list.append is GIL-atomic and
+each span owns its own timestamps.
+
+Clock discipline (the clock-skew satellite's contract): span durations
+and offsets come from ``time.perf_counter`` — monotonic, immune to NTP
+steps — while each trace carries ONE wall-clock ``started_at`` for
+display only. No elapsed number in a trace is ever derived from
+``time.time``.
+
+Overhead: with no trace active, ``span.__enter__`` is one
+ContextVar.get and a ``None`` check; with one active it is an object
+allocation, a list append, and two perf_counter calls. Budgeted at
+``SPAN_OVERHEAD_BUDGET_NS`` per span (ADR-013), enforced by a tier-1
+smoke test and reported by bench.py's ``telemetry_overhead_ns_per_span``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import deque
+from contextvars import ContextVar
+from typing import Any
+
+#: ADR-013 per-span overhead budget. Measured ~1–3 µs on the CI host
+#: (bench r07); the budget leaves an order of magnitude of headroom so
+#: the smoke test never flakes on a loaded runner while still catching
+#: a regression that adds locking or wall-clock syscalls to the span
+#: path.
+SPAN_OVERHEAD_BUDGET_NS = 50_000
+
+#: Completed traces retained for /debug/traces. Bounded so a long-lived
+#: server's debug surface costs O(capacity) memory (bench reports the
+#: actual footprint as ``trace_ring_memory_kb``), FIFO so the surface
+#: always answers "what happened recently".
+TRACE_RING_CAPACITY = 64
+
+#: Kill switch — HEADLAMP_TPU_TRACING=0 disables trace capture at
+#: startup (spans become no-ops because no trace is ever active).
+#: bench.py toggles the same flag via set_tracing for its on/off delta.
+_enabled = os.environ.get("HEADLAMP_TPU_TRACING", "1").lower() not in ("0", "false")
+
+
+def set_tracing(on: bool) -> None:
+    global _enabled
+    _enabled = on
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+class Span:
+    """One timed stage. ``t0``/``t1`` are perf_counter stamps; children
+    nest in call order. Plain mutable object, no lock: a span is only
+    written by the context that opened it (or, for the shared request
+    root, appended to GIL-atomically by overlap workers)."""
+
+    __slots__ = ("name", "t0", "t1", "attrs", "children")
+
+    def __init__(self, name: str, attrs: dict[str, Any]) -> None:
+        self.name = name
+        self.t0 = time.perf_counter()
+        self.t1: float | None = None
+        self.attrs = attrs
+        self.children: list["Span"] = []
+
+
+#: The innermost open span of the calling context — the parent the next
+#: ``span(...)`` nests under. None means no trace is active (CLI
+#: renders, tests, background threads) and spans no-op.
+_ACTIVE: ContextVar["Span | None"] = ContextVar("hl_tpu_active_span", default=None)
+
+
+class span:
+    """``with span("analytics.rollup", nodes=256):`` — times the block
+    as a child of the innermost open span. Yields the Span (for late
+    attrs) or None when no trace is active. Hand-rolled context manager
+    rather than @contextmanager: the generator machinery costs ~2× per
+    enter/exit and this is the per-stage hot path."""
+
+    __slots__ = ("_name", "_attrs", "_node", "_token")
+
+    def __init__(self, name: str, **attrs: Any) -> None:
+        self._name = name
+        self._attrs = attrs
+        self._node: Span | None = None
+
+    def __enter__(self) -> Span | None:
+        parent = _ACTIVE.get()
+        if parent is None:
+            return None
+        node = Span(self._name, self._attrs)
+        parent.children.append(node)
+        self._node = node
+        self._token = _ACTIVE.set(node)
+        return node
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        node = self._node
+        if node is not None:
+            _ACTIVE.reset(self._token)
+            node.t1 = time.perf_counter()
+            if exc_type is not None:
+                # The stage that FAILED is exactly the one an operator
+                # reads the trace for.
+                node.attrs["error"] = exc_type.__name__
+        return False
+
+
+def annotate(**attrs: Any) -> None:
+    """Attach attributes to the innermost open span (no-op without
+    one). Lets producers that don't own a span — the device cache
+    reporting hit/miss to the rollup span above it — enrich the trace
+    without restructuring call sites."""
+    node = _ACTIVE.get()
+    if node is not None:
+        node.attrs.update(attrs)
+
+
+class Trace:
+    """One request's span tree plus display metadata. ``started_at`` is
+    wall clock (an operator correlates it with external logs); every
+    duration inside is perf_counter-derived."""
+
+    __slots__ = ("path", "started_at", "root", "route", "status", "device_gets")
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.started_at = time.time()
+        self.root = Span("request", {})
+        self.route = path
+        self.status = 0
+        self.device_gets = 0
+
+    def finish(self, *, route: str, status: int, device_gets: int) -> None:
+        self.route = route
+        self.status = status
+        self.device_gets = device_gets
+        if self.root.t1 is None:
+            self.root.t1 = time.perf_counter()
+
+    def to_dict(self) -> dict[str, Any]:
+        t0 = self.root.t0
+        end = self.root.t1 if self.root.t1 is not None else t0
+        return {
+            "path": self.path,
+            "route": self.route,
+            "status": self.status,
+            "started_at": round(self.started_at, 3),
+            "duration_ms": round((end - t0) * 1000, 3),
+            "device_gets": self.device_gets,
+            "spans": [_span_dict(c, t0) for c in self.root.children],
+        }
+
+
+def _span_dict(s: Span, t0: float) -> dict[str, Any]:
+    end = s.t1 if s.t1 is not None else s.t0
+    return {
+        "name": s.name,
+        "start_ms": round((s.t0 - t0) * 1000, 3),
+        "duration_ms": round((end - s.t0) * 1000, 3),
+        "attrs": dict(s.attrs),
+        "children": [_span_dict(c, t0) for c in s.children],
+    }
+
+
+class trace_request:
+    """Install a fresh trace for the calling context (the app layer's
+    per-request wrapper — the tracing analogue of TransferBatch.scope).
+    Yields the Trace, or None when tracing is disabled globally, the
+    caller opted out (``enabled=False``: health/metrics/debug probes
+    must not pollute the ring), or a trace is already active (nested
+    handles would corrupt attribution)."""
+
+    __slots__ = ("_path", "_enabled", "_trace", "_token")
+
+    def __init__(self, path: str, *, enabled: bool = True) -> None:
+        self._path = path
+        self._enabled = enabled
+        self._trace: Trace | None = None
+
+    def __enter__(self) -> Trace | None:
+        if not (_enabled and self._enabled) or _ACTIVE.get() is not None:
+            return None
+        trace = Trace(self._path)
+        self._trace = trace
+        self._token = _ACTIVE.set(trace.root)
+        return trace
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        trace = self._trace
+        if trace is not None:
+            _ACTIVE.reset(self._token)
+            trace.root.t1 = time.perf_counter()
+        return False
+
+
+class TraceRing:
+    """Bounded FIFO of completed traces (as JSON-ready dicts — freezing
+    at record time means the debug surfaces serialize snapshots, never
+    live span trees an overlap worker might still be appending to)."""
+
+    def __init__(self, capacity: int = TRACE_RING_CAPACITY) -> None:
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._traces: deque[dict[str, Any]] = deque(maxlen=capacity)
+
+    def record(self, trace: dict[str, Any]) -> None:
+        with self._lock:
+            self._traces.append(trace)
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        """Newest first — the debug surfaces lead with what just
+        happened."""
+        with self._lock:
+            return list(reversed(self._traces))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+    def __len__(self) -> int:
+        return len(self._traces)
+
+    def memory_bytes(self) -> int:
+        """Recursive shallow-size sum over retained traces — the number
+        bench reports as ``trace_ring_memory_kb`` so the retention cost
+        stays measured, not assumed."""
+        seen: set[int] = set()
+
+        def size(obj: Any) -> int:
+            if id(obj) in seen:
+                return 0
+            seen.add(id(obj))
+            total = sys.getsizeof(obj)
+            if isinstance(obj, dict):
+                total += sum(size(k) + size(v) for k, v in obj.items())
+            elif isinstance(obj, (list, tuple)):
+                total += sum(size(item) for item in obj)
+            return total
+
+        with self._lock:
+            return sum(size(t) for t in self._traces)
+
+
+#: Process-wide ring — one server, one recent-request debug surface.
+trace_ring = TraceRing()

@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..analytics.encode import FleetArrays
 from ..analytics.fleet_jax import aggregates_to_host_dict, local_aggregates
+from ..obs.trace import span as _span
 from ..runtime import transfer
 
 
@@ -128,7 +129,10 @@ def _rollup_with_reducer(
         # Funnel fetch: coalesces with the request's other pending
         # device reads when a TransferBatch is active, and is the same
         # single counted device_get standalone.
-        out = transfer.fetch(rollup_shard(*node_cols, *pod_cols))
+        with _span(
+            "mesh.rollup", reducer=reducer, hosts=mesh.devices.size
+        ):
+            out = transfer.fetch(rollup_shard(*node_cols, *pod_cols))
     return aggregates_to_host_dict(out, fleet.n_nodes)
 
 

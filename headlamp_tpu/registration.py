@@ -15,6 +15,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from .obs.debug_pages import traces_page
 from .integrations import (
     build_node_intel_columns,
     build_node_tpu_columns,
@@ -181,6 +182,18 @@ def register_plugin(registry: Registry | None = None) -> Registry:
                 native_nodes_page,
                 kind="native-nodes",
                 paged=True,
+            ),
+            # Telemetry debug surface (ADR-013): a registered route like
+            # any page — the host's kind dispatch hands it the trace
+            # ring — but deliberately absent from the sidebar (it is an
+            # operator tool, not a navigation destination; its JSON twin
+            # is /debug/traces). /debug is outside both provider
+            # prefixes, so the TS-parity route counts are unaffected.
+            Route(
+                "/debug/traces/html",
+                "debug-traces",
+                traces_page,
+                kind="traces",
             ),
         ]
     )

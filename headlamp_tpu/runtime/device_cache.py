@@ -37,6 +37,10 @@ import dataclasses
 import threading
 from typing import TYPE_CHECKING
 
+from ..obs.metrics import registry as _metrics_registry
+from ..obs.trace import annotate as _annotate
+from ..obs.trace import span as _span
+
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from ..analytics.encode import FleetArrays
     from ..domain.accelerator import FleetView
@@ -75,13 +79,53 @@ class DeviceFleetCache:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._entries: dict[str, tuple[int, "FleetArrays"]] = {}
-        self.hits = 0
-        self.misses = 0
+        # Dual accounting (ADR-013): the registry counters are
+        # process-global (get-or-create shares them across instances)
+        # and feed /metricsz; the ``_hits_n``/``_misses_n`` ints are
+        # per-instance so ``hits``/``misses``/``snapshot()`` keep their
+        # original fresh-instance-starts-at-zero semantics for /healthz,
+        # bench deltas, and tests.
+        self._hits_n = 0
+        self._misses_n = 0
+        self._hits = _metrics_registry.counter(
+            "headlamp_tpu_fleet_cache_hits_total",
+            "fleet_for calls served from device-resident arrays",
+        )
+        self._misses = _metrics_registry.counter(
+            "headlamp_tpu_fleet_cache_misses_total",
+            "fleet_for calls that paid an encode (and, versioned, an upload)",
+        )
+        hits, misses = self._hits, self._misses
+
+        def _process_hit_ratio() -> float:
+            total = hits.value + misses.value
+            return hits.value / total if total else 0.0
+
+        # Closes over the shared counters, not self: the ratio stays
+        # process-wide even when tests churn through instances (each
+        # __init__ re-registers, but every closure computes the same
+        # global value).
+        _metrics_registry.gauge_fn(
+            "headlamp_tpu_fleet_cache_hit_ratio",
+            "Device fleet cache hit ratio since process start",
+            _process_hit_ratio,
+        )
+
+    @property
+    def hits(self) -> int:
+        return self._hits_n
+
+    @property
+    def misses(self) -> int:
+        return self._misses_n
 
     def fleet_for(self, view: "FleetView") -> "FleetArrays":
         """The columnar fleet for ``view`` — device-resident from cache
         when the version matches, freshly encoded (and cached when the
-        view carries a version) otherwise."""
+        view carries a version) otherwise. Annotates the enclosing
+        trace span (the rollup's) with the hit/miss outcome — whether a
+        slow rollup paid an upload is the first thing a trace reader
+        needs to know."""
         from ..analytics.encode import encode_fleet
 
         version = getattr(view, "version", None)
@@ -90,15 +134,22 @@ class DeviceFleetCache:
             with self._lock:
                 entry = self._entries.get(provider)
                 if entry is not None and entry[0] == version:
-                    self.hits += 1
+                    self._hits_n += 1
+                    self._hits.inc()
+                    _annotate(fleet_cache="hit")
                     return entry[1]
-            self.misses += 1
-            fleet = _to_device(encode_fleet(view.nodes, view.pods))
+            self._misses_n += 1
+            self._misses.inc()
+            _annotate(fleet_cache="miss")
+            with _span("device_cache.upload", nodes=len(view.nodes)):
+                fleet = _to_device(encode_fleet(view.nodes, view.pods))
             with self._lock:
                 self._entries[provider] = (version, fleet)
             return fleet
         # Unversioned view: pre-cache behavior, host arrays every call.
-        self.misses += 1
+        self._misses_n += 1
+        self._misses.inc()
+        _annotate(fleet_cache="unversioned")
         return encode_fleet(view.nodes, view.pods)
 
     def warm(self, view: "FleetView") -> bool:

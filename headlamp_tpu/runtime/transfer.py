@@ -38,18 +38,42 @@ import threading
 from contextvars import ContextVar
 from typing import Any, Callable, Iterator
 
+from ..obs.metrics import registry as _metrics_registry
+from ..obs.trace import span as _span
+
 
 class TransferStats:
-    """Monotonic process-wide transfer counters. Writes are GIL-atomic
-    int bumps; readers (bench deltas, /healthz) tolerate the benign
-    races that implies."""
+    """Monotonic process-wide transfer counters — since ADR-013 a view
+    over the obs metric registry: the storage lives in registry
+    counters so /metricsz scrapes the same numbers /healthz reports,
+    and the two surfaces can never disagree. The property readers keep
+    the pre-registry attribute API (bench deltas, tests)."""
 
     def __init__(self) -> None:
-        self.blocking_gets = 0
-        #: Trees that rode a flush alongside at least one other tree —
-        #: round-trips that would each have been a blocking get before
-        #: the coalescer.
-        self.coalesced_trees = 0
+        self._blocking = _metrics_registry.counter(
+            "headlamp_tpu_transfer_blocking_gets_total",
+            "Blocking device_get round-trips paid by the process "
+            "(each costs a full tunnel RTT on a tunneled device)",
+        )
+        self._coalesced = _metrics_registry.counter(
+            "headlamp_tpu_transfer_coalesced_trees_total",
+            "Trees that rode a flush alongside at least one other tree "
+            "- round-trips the coalescer saved",
+        )
+
+    @property
+    def blocking_gets(self) -> int:
+        return int(self._blocking.value)
+
+    @property
+    def coalesced_trees(self) -> int:
+        return int(self._coalesced.value)
+
+    def record_blocking_get(self) -> None:
+        self._blocking.inc()
+
+    def record_coalesced(self, trees: int) -> None:
+        self._coalesced.inc(trees)
 
     def snapshot(self) -> dict[str, int]:
         return {
@@ -72,7 +96,7 @@ def active_batch() -> "TransferBatch | None":
 def _counted_device_get(tree: Any, batch: "TransferBatch | None") -> Any:
     import jax
 
-    transfer_stats.blocking_gets += 1
+    transfer_stats.record_blocking_get()
     if batch is not None:
         batch.blocking_gets += 1
     return jax.device_get(tree)
@@ -137,9 +161,13 @@ class TransferBatch:
             pending, self._pending = self._pending, []
         if not pending:
             return
-        values = _counted_device_get([tree for tree, _h in pending], self)
+        # The transfer-flush stage in request traces (ADR-013): on a
+        # tunneled device this span IS the tunnel RTT, which is why it
+        # gets first-class attribution.
+        with _span("transfer.flush", trees=len(pending)):
+            values = _counted_device_get([tree for tree, _h in pending], self)
         if len(pending) > 1:
-            transfer_stats.coalesced_trees += len(pending)
+            transfer_stats.record_coalesced(len(pending))
         for (_tree, handle), value in zip(pending, values):
             handle._value = value
             handle._resolved = True

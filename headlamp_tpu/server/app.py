@@ -6,6 +6,9 @@ Serves the registered plugin surface over stdlib ``http.server``:
 - ``GET /refresh?back=<url>`` — imperative-track refresh then redirect
   (the manual refresh button, `OverviewPage.tsx:143-158`)
 - ``GET /healthz``            — liveness + snapshot freshness JSON
+- ``GET /metricsz``           — Prometheus text self-exposition (ADR-013)
+- ``GET /debug/traces``       — recent request traces as JSON (the HTML
+  waterfall lives at the registered ``/debug/traces/html`` route)
 
 Cluster state comes from one AcceleratorDataContext synced at most once
 per ``min_sync_interval_s`` (request-coalesced polling — the reactive
@@ -21,6 +24,7 @@ the full UI runs with zero cluster.
 
 from __future__ import annotations
 
+import contextvars
 import html
 import json
 import re
@@ -34,6 +38,8 @@ import concurrent.futures
 
 from ..context.accelerator_context import AcceleratorDataContext, ClusterSnapshot
 from ..metrics.client import fetch_tpu_metrics
+from ..obs.metrics import registry as metrics_registry
+from ..obs.trace import annotate, span, trace_request, trace_ring
 from ..runtime.transfer import TransferBatch
 from ..pages.native import native_node_page, native_pod_page
 from ..registration import Registry, register_plugin
@@ -90,8 +96,13 @@ def _analytics_health() -> dict[str, Any]:
             "broken_reason": calibration.broken_reason,
         }
         return cal
-    except Exception:  # noqa: BLE001 — health must never 500 on analytics
-        return {"calibrated": False}
+    except Exception as exc:  # noqa: BLE001 — health must never 500 on analytics
+        # Degraded, not silent (ISSUE r07 satellite): a broken analytics
+        # import used to report the same shape as "probe not yet run",
+        # hiding real breakage behind a healthy-looking block. The error
+        # TYPE is enough for an operator to grep; the message could
+        # carry cluster strings and /healthz is unauthenticated.
+        return {"calibrated": False, "error": type(exc).__name__}
 
 
 def _runtime_health() -> dict[str, Any]:
@@ -107,8 +118,10 @@ def _runtime_health() -> dict[str, Any]:
             "transfer": transfer_stats.snapshot(),
             "fleet_cache": fleet_cache.snapshot(),
         }
-    except Exception:  # noqa: BLE001 — health must never 500 on analytics
-        return {}
+    except Exception as exc:  # noqa: BLE001 — health must never 500 on analytics
+        # An empty block read as "no runtime telemetry wired"; a named
+        # error reads as what it is — degraded observability.
+        return {"error": type(exc).__name__}
 
 
 def _force_recalibration() -> None:
@@ -146,6 +159,7 @@ class DashboardApp:
         registry: Registry | None = None,
         min_sync_interval_s: float = 5.0,
         clock: Any = time.time,
+        monotonic: Any = time.monotonic,
         pod_field_selector: str | None = None,
     ) -> None:
         self._ctx = AcceleratorDataContext(
@@ -154,8 +168,21 @@ class DashboardApp:
         self._transport = transport
         self._registry = registry if registry is not None else register_plugin()
         self._min_sync = min_sync_interval_s
+        # Clock-skew discipline (ADR-013): ``clock`` (wall) is ONLY for
+        # displayed timestamps (snapshot fetched_at, page "now");
+        # ``monotonic`` drives every elapsed/TTL/age computation, so an
+        # NTP step or operator date change can never wedge sync
+        # coalescing or serve an immortal cache entry.
         self._clock = clock
-        self._last_sync = 0.0
+        self._mono = monotonic
+        # -inf, not 0.0: time.monotonic's epoch is arbitrary (boot time
+        # on Linux) and can be small on a fresh host — 0.0 would silently
+        # suppress the first inline sync for up to min_sync seconds.
+        self._last_sync = float("-inf")
+        #: Monotonic stamp of the last completed sync, for the /healthz
+        #: staleness/wedge math (the snapshot's own fetched_at stays
+        #: wall-clock, for display).
+        self._last_snapshot_mono: float | None = None
         # ThreadingHTTPServer serves requests concurrently; the context
         # and the check-then-act on _last_sync are not thread-safe, so
         # all state mutation funnels through one lock (renders of an
@@ -167,7 +194,10 @@ class DashboardApp:
         #: never served for fleet B within the TTL.
         self._forecast_cache: tuple[int, Any, float, Any] | None = None
         self._metrics_lock = threading.Lock()
-        self._metrics_cache: tuple[int, float, Any] | None = None
+        #: (epoch, monotonic expiry, monotonic fetched-at, metrics) —
+        #: the fetched-at stamp feeds _peek_metrics' age check, which
+        #: must not trust the snapshot's wall-clock fetched_at.
+        self._metrics_cache: tuple[int, float, float, Any] | None = None
         #: Bumped by /refresh. Cache entries record the epoch current
         #: when their fetch *started*; a mismatched epoch invalidates
         #: them. This lets refresh invalidate without touching
@@ -210,6 +240,23 @@ class DashboardApp:
         #: Lazily-created worker pool for the metrics route's
         #: fetch∥forecast overlap (see _metrics_and_forecast).
         self._overlap_pool: concurrent.futures.ThreadPoolExecutor | None = None
+        # Process-level request instruments (ADR-013). get-or-create:
+        # tests build many DashboardApps per process and they must share
+        # the registry rather than collide on re-registration.
+        self._req_hist = metrics_registry.histogram(
+            "headlamp_tpu_request_duration_seconds",
+            "End-to-end handle() latency per route template.",
+            labels=("route",),
+        )
+        self._req_total = metrics_registry.counter(
+            "headlamp_tpu_requests_total",
+            "Requests served, by route template and status code.",
+            labels=("route", "status"),
+        )
+        self._sync_fail_total = metrics_registry.counter(
+            "headlamp_tpu_sync_failures_total",
+            "Cluster syncs that raised or produced an errors-bearing snapshot.",
+        )
 
     @property
     def registry(self) -> Registry:
@@ -268,9 +315,10 @@ class DashboardApp:
             try:
                 with self._lock:
                     self._ctx.sync()
-                    self._last_sync = self._clock()
+                    self._last_sync = self._mono()
                     snap = self._ctx.snapshot()
                     self._last_snapshot = snap
+                    self._last_snapshot_mono = self._mono()
             except Exception:  # noqa: BLE001 — keep the heartbeat alive
                 self._record_sync(None)
             else:
@@ -327,6 +375,7 @@ class DashboardApp:
             self._sync_failures = 0
         else:
             self._sync_failures += 1
+            self._sync_fail_total.inc()
 
     def _background_live(self) -> bool:
         return self._background_stop is not None and not self._background_stop.is_set()
@@ -337,24 +386,34 @@ class DashboardApp:
         # holds self._lock across each tick, and with watch enabled a
         # tick spans the bounded watch windows (seconds against a real
         # apiserver) — a page view must never stall behind that.
-        if self._background_live():
-            snap = self._last_snapshot
-            if snap is not None:
+        with span("sync.snapshot") as node:
+            if self._background_live():
+                snap = self._last_snapshot
+                if snap is not None:
+                    if node is not None:
+                        node.attrs["source"] = "background"
+                    return snap
+                # Not yet hydrated: fall through and build one under the
+                # lock (races the loop's first tick harmlessly — ctx.sync
+                # and snapshot builds are serialized by the lock).
+            with self._lock:
+                now = self._mono()
+                if (
+                    not self._background_live()
+                    and now - self._last_sync >= self._min_sync
+                ):
+                    self._ctx.sync()
+                    self._last_sync = now
+                    snap = self._ctx.snapshot()
+                    self._record_sync(snap)
+                    self._last_snapshot_mono = self._mono()
+                    annotate(source="inline-sync")
+                else:
+                    snap = self._ctx.snapshot()
+                    annotate(source="coalesced")
+                self._last_snapshot = snap
+                annotate(nodes=len(snap.all_nodes or []))
                 return snap
-            # Not yet hydrated: fall through and build one under the
-            # lock (races the loop's first tick harmlessly — ctx.sync
-            # and snapshot builds are serialized by the lock).
-        with self._lock:
-            now = self._clock()
-            if not self._background_live() and now - self._last_sync >= self._min_sync:
-                self._ctx.sync()
-                self._last_sync = now
-                snap = self._ctx.snapshot()
-                self._record_sync(snap)
-            else:
-                snap = self._ctx.snapshot()
-            self._last_snapshot = snap
-            return snap
 
     #: Consecutive failing syncs at which /healthz flips ``ok`` to false
     #: — one blip must not restart a pod, a persistent failure must not
@@ -398,9 +457,9 @@ class DashboardApp:
         on every view within the TTL."""
         with self._metrics_lock:
             epoch = self._cache_epoch
-            now = self._clock()
+            now = self._mono()
             if self._metrics_cache is not None:
-                cached_epoch, expiry, cached = self._metrics_cache
+                cached_epoch, expiry, _, cached = self._metrics_cache
                 if cached_epoch == epoch and now < expiry:
                     return cached
             metrics = fetch_tpu_metrics(self._transport, clock=self._clock)
@@ -411,9 +470,11 @@ class DashboardApp:
             # a dark cluster, first jit compile downstream) must not
             # burn its own freshness window and serve a born-expired
             # entry.
+            done = self._mono()
             self._metrics_cache = (
                 epoch,
-                self._clock() + self.METRICS_TTL_S,
+                done + self.METRICS_TTL_S,
+                done,
                 metrics,
             )
             return metrics
@@ -432,7 +493,9 @@ class DashboardApp:
         where telemetry is a progressive enhancement (the topology
         heatmap): they must not pay the Prometheus probe chain, only
         reuse what a recent metrics view already paid for. Age is judged
-        from the snapshot's own fetched_at, not the serving TTL.
+        from the cache entry's monotonic fetch stamp, not the serving
+        TTL (and not the snapshot's wall-clock fetched_at, which an NTP
+        step could swing either way — ADR-013 clock audit).
 
         Non-blocking: _cached_metrics holds the lock across its whole
         fetch, and a peek that waited for a dark cluster's probe chain
@@ -443,10 +506,10 @@ class DashboardApp:
         try:
             if self._metrics_cache is None:
                 return None
-            cached_epoch, _, cached = self._metrics_cache
+            cached_epoch, _, fetched_mono, cached = self._metrics_cache
             if cached_epoch != self._cache_epoch or cached is None:
                 return None
-            if self._clock() - cached.fetched_at > self.METRICS_PEEK_MAX_AGE_S:
+            if self._mono() - fetched_mono > self.METRICS_PEEK_MAX_AGE_S:
                 return None
             return cached
         finally:
@@ -466,7 +529,7 @@ class DashboardApp:
         # TTL window; concurrent requests wait and reuse its result.
         with self._forecast_lock:
             epoch = self._cache_epoch
-            now = self._clock()
+            now = self._mono()
             if self._forecast_cache is not None:
                 cached_epoch, cached_key, expiry, cached = self._forecast_cache
                 if cached_epoch == epoch and now < expiry and cached_key == key:
@@ -477,7 +540,7 @@ class DashboardApp:
             self._forecast_cache = (
                 epoch,
                 key,
-                self._clock() + self.FORECAST_TTL_S,
+                self._mono() + self.FORECAST_TTL_S,
                 forecast,
             )
             return forecast
@@ -509,7 +572,14 @@ class DashboardApp:
             pool = self._overlap_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=2, thread_name_prefix="hl-tpu-overlap"
             )
-        fetch = pool.submit(self._cached_metrics)
+        # copy_context: the worker must inherit this request's active
+        # trace (a ContextVar) so the fetch's metrics.discover/fanout
+        # spans attach to the request waterfall instead of vanishing.
+        # Span-tree appends from two threads are safe — list.append is
+        # GIL-atomic and the branches are disjoint.
+        fetch = pool.submit(
+            contextvars.copy_context().run, self._cached_metrics
+        )
         try:
             forecast = self._forecast_for(peeked)
         finally:
@@ -539,6 +609,31 @@ class DashboardApp:
     # Request handling (framework-level, server-agnostic)
     # ------------------------------------------------------------------
 
+    #: Route labels whose traces stay OUT of the ring: a kubelet probing
+    #: /healthz every 5 s would evict every real page trace within
+    #: minutes, Prometheus scraping /metricsz likewise, and tracing the
+    #: trace endpoints would make the ring describe itself. Their
+    #: request METRICS still record — only ring retention is skipped.
+    _RING_EXCLUDED = frozenset(
+        {"/healthz", "/metricsz", "/debug/traces", "/debug/traces/html"}
+    )
+
+    def _route_label(self, path: str) -> str:
+        """Bounded-cardinality route template for metric labels. Dynamic
+        detail paths collapse to their template and unknown paths to
+        'other' — a URL scanner walking random paths must not mint one
+        label child (and one ring entry name) per probe."""
+        route_path = urlparse(path).path.rstrip("/") or "/tpu"
+        if route_path in ("/healthz", "/refresh", "/metricsz", "/debug/traces"):
+            return route_path
+        if _NODE_DETAIL_RE.match(route_path):
+            return "/node/{name}"
+        if _POD_DETAIL_RE.match(route_path):
+            return "/pod/{namespace}/{name}"
+        if self._registry.route_for(route_path) is not None:
+            return route_path
+        return "other"
+
     def handle(self, path: str) -> tuple[int, str, str]:
         """(status, content_type, body) for a GET. Pure enough to test
         without sockets. Never raises: route errors become a 500 page
@@ -551,22 +646,48 @@ class DashboardApp:
         consumer flushes ALL of them in one blocking ``jax.device_get``
         — one tunnel RTT per request instead of one per stage. The
         batch also counts the request's blocking fetches, which is the
-        ``device_gets_per_request`` number bench.py reports."""
+        ``device_gets_per_request`` number bench.py reports.
+
+        Telemetry (ADR-013): each request also runs inside a
+        ``trace_request`` scope — stage spans opened anywhere below
+        (sync, analytics, transfer flush, render) attach to it via the
+        contextvar, and the completed trace lands in the ring — and
+        records its latency/status into the Prometheus registry. Both
+        happen HERE, not in ``serve()``, so the CLI-less test path and
+        any future host are measured identically."""
+        t0 = time.perf_counter()
+        route_label = self._route_label(path)
         batch = TransferBatch()
-        try:
-            with batch.scope():
-                return self._handle(path)
-        except Exception as e:  # noqa: BLE001 — error boundary
-            body = self._page_html(
-                "Error",
-                "<div class='hl-error' role='alert'>Internal error: "
-                f"{html.escape(type(e).__name__)}: {html.escape(str(e))}</div>",
-            )
-            return 500, "text/html", body
-        finally:
-            self.requests_served += 1
-            self.request_device_gets += batch.blocking_gets
-            self.last_request_device_gets = batch.blocking_gets
+        status = 500
+        with trace_request(
+            path, enabled=route_label not in self._RING_EXCLUDED
+        ) as trace:
+            try:
+                with batch.scope():
+                    status, content_type, body = self._handle(path)
+                    return status, content_type, body
+            except Exception as e:  # noqa: BLE001 — error boundary
+                body = self._page_html(
+                    "Error",
+                    "<div class='hl-error' role='alert'>Internal error: "
+                    f"{html.escape(type(e).__name__)}: {html.escape(str(e))}</div>",
+                )
+                return 500, "text/html", body
+            finally:
+                self.requests_served += 1
+                self.request_device_gets += batch.blocking_gets
+                self.last_request_device_gets = batch.blocking_gets
+                self._req_hist.observe(
+                    time.perf_counter() - t0, route=route_label
+                )
+                self._req_total.inc(route=route_label, status=str(status))
+                if trace is not None:
+                    trace.finish(
+                        route=route_label,
+                        status=status,
+                        device_gets=batch.blocking_gets,
+                    )
+                    trace_ring.record(trace.to_dict())
 
     def _handle(self, path: str) -> tuple[int, str, str]:
         parsed = urlparse(path)
@@ -600,7 +721,14 @@ class DashboardApp:
                     }
                 )
                 return 200, "application/json", body
-            age = max(self._clock() - snap.fetched_at, 0.0)
+            # Age on the monotonic stamp, not fetched_at (wall): a
+            # backwards NTP step would otherwise fake freshness and hide
+            # a wedged loop; a forwards one would flap ok:false. The
+            # stamp is None only before any completed sync, and then
+            # snap is None too (checked above), so 0.0 is unreachable
+            # paranoia, not a real state.
+            stamp = self._last_snapshot_mono
+            age = max(self._mono() - stamp, 0.0) if stamp is not None else 0.0
             interval = self._background_interval
             wedged = (
                 background
@@ -623,6 +751,22 @@ class DashboardApp:
                     "analytics": _analytics_health(),
                     "runtime": _runtime_health(),
                 }
+            )
+            return 200, "application/json", body
+
+        if route_path == "/metricsz":
+            # Prometheus self-exposition (ADR-013). Like /healthz this
+            # must never block or 500: render() walks lock-light
+            # in-memory instruments and callback gauges swallow their
+            # own errors, so a scrape is safe at any process state.
+            return 200, "text/plain", metrics_registry.render()
+
+        if route_path == "/debug/traces":
+            # JSON twin of /debug/traces/html — the ring's raw contents
+            # for jq/curl; entries are frozen dicts, so dumps never
+            # races an in-flight request.
+            body = json.dumps(
+                {"capacity": trace_ring.capacity, "traces": trace_ring.snapshot()}
             )
             return 200, "application/json", body
 
@@ -665,27 +809,36 @@ class DashboardApp:
         node_match = _NODE_DETAIL_RE.match(route_path)
         if node_match:
             snap = self._synced_snapshot()
-            el = native_node_page(
-                snap, node_match.group(1), now=self._clock(), registry=self._registry
-            )
+            with span("page.component", kind="native-node-detail"):
+                el = native_node_page(
+                    snap,
+                    node_match.group(1),
+                    now=self._clock(),
+                    registry=self._registry,
+                )
             status = 404 if el.props.get("data-notfound") else 200
-            return status, "text/html", self._page_html(
-                f"Node {node_match.group(1)}", render_html(el), route_path
-            )
+            with span("render.html"):
+                body = self._page_html(
+                    f"Node {node_match.group(1)}", render_html(el), route_path
+                )
+            return status, "text/html", body
         pod_match = _POD_DETAIL_RE.match(route_path)
         if pod_match:
             snap = self._synced_snapshot()
-            el = native_pod_page(
-                snap,
-                pod_match.group(1),
-                pod_match.group(2),
-                now=self._clock(),
-                registry=self._registry,
-            )
+            with span("page.component", kind="native-pod-detail"):
+                el = native_pod_page(
+                    snap,
+                    pod_match.group(1),
+                    pod_match.group(2),
+                    now=self._clock(),
+                    registry=self._registry,
+                )
             status = 404 if el.props.get("data-notfound") else 200
-            return status, "text/html", self._page_html(
-                f"Pod {pod_match.group(2)}", render_html(el), route_path
-            )
+            with span("render.html"):
+                body = self._page_html(
+                    f"Pod {pod_match.group(2)}", render_html(el), route_path
+                )
+            return status, "text/html", body
 
         route = self._registry.route_for(route_path)
         if route is None:
@@ -704,24 +857,33 @@ class DashboardApp:
             # cluster string; cap its length so a hostile URL cannot
             # make the substring filter arbitrarily expensive.
             paging["query"] = params.get("q", [""])[0][:253]
-        if route.kind == "metrics":
-            metrics, forecast = self._metrics_and_forecast()
-            el = route.component(metrics, forecast)
-        elif route.kind == "intel-metrics":
-            from ..metrics.intel_client import fetch_intel_gpu_metrics
+        with span("page.component", kind=route.kind):
+            if route.kind == "metrics":
+                metrics, forecast = self._metrics_and_forecast()
+                el = route.component(metrics, forecast)
+            elif route.kind == "intel-metrics":
+                from ..metrics.intel_client import fetch_intel_gpu_metrics
 
-            el = route.component(
-                fetch_intel_gpu_metrics(self._transport, clock=self._clock)
-            )
-        elif route.kind == "topology":
-            # Cache PEEK only: the heatmap is a progressive enhancement;
-            # the topology paint must never pay the Prometheus chain.
-            el = route.component(snap, metrics=self._peek_metrics())
-        elif route.kind == "native-nodes":
-            el = route.component(snap, now=now, registry=self._registry, **paging)
-        else:
-            el = route.component(snap, now=now, **paging)
-        return 200, "text/html", self._page_html(route.name, render_html(el), route_path)
+                el = route.component(
+                    fetch_intel_gpu_metrics(self._transport, clock=self._clock)
+                )
+            elif route.kind == "topology":
+                # Cache PEEK only: the heatmap is a progressive
+                # enhancement; the topology paint must never pay the
+                # Prometheus chain.
+                el = route.component(snap, metrics=self._peek_metrics())
+            elif route.kind == "native-nodes":
+                el = route.component(snap, now=now, registry=self._registry, **paging)
+            elif route.kind == "traces":
+                # The waterfall page renders the ring itself — no
+                # snapshot/now, by design: it must work even when the
+                # cluster sync is the thing being debugged.
+                el = route.component(trace_ring.snapshot())
+            else:
+                el = route.component(snap, now=now, **paging)
+        with span("render.html"):
+            body = self._page_html(route.name, render_html(el), route_path)
+        return 200, "text/html", body
 
     def _page_html(self, title: str, body: str, active: str = "") -> str:
         nav = "".join(

@@ -86,4 +86,27 @@ main { max-width:1100px; margin:0 auto; padding:16px; }
                    #eee 4px,#eee 8px) !important; }
 .hl-mesh-links { color:var(--muted); font-size:12px; }
 .hl-attention { border-color:var(--warn); }
+/* Trace waterfall (/debug/traces/html, ADR-013): one .hl-trace section
+   per request, span rows as label | proportional bar | duration. Bars
+   position with inline margin-left/width percentages of the trace's
+   total duration — the page is static HTML, so layout math happens at
+   render time, not in CSS. */
+.hl-trace-header { display:flex; align-items:center; gap:10px;
+                   margin-bottom:8px; }
+.hl-trace-header .hl-hint { margin-left:auto; }
+.hl-trace-path { font-family:ui-monospace,monospace; font-weight:600; }
+.hl-span-row { display:flex; align-items:center; gap:8px; font-size:12px;
+               padding:2px 0; border-bottom:1px dotted var(--line); }
+.hl-span-label { flex:0 0 240px; font-family:ui-monospace,monospace;
+                 white-space:nowrap; overflow:hidden;
+                 text-overflow:ellipsis; }
+.hl-span-track { flex:1; position:relative; height:12px;
+                 background:var(--bg); border-radius:4px; }
+.hl-span-bar { height:100%; border-radius:4px; background:#1565c0;
+               opacity:0.85; }
+.hl-span-ms { flex:0 0 72px; text-align:right; color:var(--muted);
+              font-variant-numeric:tabular-nums; }
+.hl-span-attrs { flex:0 1 auto; color:var(--muted);
+                 font-family:ui-monospace,monospace; white-space:nowrap;
+                 overflow:hidden; text-overflow:ellipsis; }
 """

@@ -121,7 +121,11 @@ class TestRegistration:
             "/intel/metrics",
         }
         native_paths = {"/nodes"}
-        assert {r.path for r in reg.routes} == tpu_paths | intel_paths | native_paths
+        # ADR-013: the trace waterfall registers as a route (so it gets
+        # styling + the registry dispatch) but adds no sidebar entry.
+        debug_paths = {"/debug/traces/html"}
+        expected = tpu_paths | intel_paths | native_paths | debug_paths
+        assert {r.path for r in reg.routes} == expected
         # Both providers inject into Node and Pod detail views.
         assert sorted(s.resource_kind for s in reg.detail_sections) == [
             "Node", "Node", "Pod", "Pod",

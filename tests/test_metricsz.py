@@ -1,0 +1,189 @@
+"""Prometheus exposition-format conformance for /metricsz (ADR-013,
+satellite: the mini text-format parser).
+
+A minimal parser for the 0.0.4 text format scrapes the endpoint through
+the app layer and re-asserts, from the OUTSIDE, the invariants the
+registry promises: HELP/TYPE present for every sample family, histogram
+buckets cumulative and monotone with ``+Inf == _count``, and every
+metric name matching the ``headlamp_tpu_`` grammar with a unit suffix.
+The parser knows nothing about the registry's internals on purpose —
+it reads the wire format the way a real Prometheus server would.
+"""
+
+import re
+
+import pytest
+
+from headlamp_tpu.obs.metrics import UNIT_SUFFIXES
+from headlamp_tpu.server import DashboardApp, make_demo_transport
+
+NAME_RE = re.compile(r"^headlamp_tpu_[a-z0-9_]+$")
+SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>\S+)$"
+)
+LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str):
+    """(helps, types, samples) from Prometheus text format. Samples are
+    (name, labels dict, float value), in document order."""
+    helps: dict[str, str] = {}
+    types: dict[str, str] = {}
+    samples: list[tuple[str, dict[str, str], float]] = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name, _, help_text = line[len("# HELP "):].partition(" ")
+            helps[name] = help_text
+        elif line.startswith("# TYPE "):
+            name, _, kind = line[len("# TYPE "):].partition(" ")
+            assert kind in ("counter", "gauge", "histogram"), line
+            types[name] = kind
+        elif line.startswith("#"):
+            continue
+        else:
+            m = SAMPLE_RE.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            labels = dict(LABEL_RE.findall(m.group("labels") or ""))
+            raw = m.group("value")
+            value = float("inf") if raw == "+Inf" else float(raw)
+            samples.append((m.group("name"), labels, value))
+    return helps, types, samples
+
+
+def base_name(sample_name: str, types: dict[str, str]) -> str:
+    """Map a histogram's derived series back to its declared family."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if types.get(base) == "histogram":
+                return base
+    return sample_name
+
+
+@pytest.fixture(scope="module")
+def exposition() -> str:
+    """One scrape after real traffic across the instrumented routes —
+    every family asserted below must exist because a REQUEST made it
+    exist, not because a test reached into the registry."""
+    app = DashboardApp(make_demo_transport("v5p32"), min_sync_interval_s=0.0)
+    for path in ("/tpu", "/tpu/nodes", "/tpu/metrics", "/nope", "/healthz"):
+        app.handle(path)
+    status, ctype, body = app.handle("/metricsz")
+    assert status == 200 and ctype == "text/plain"
+    return body
+
+
+class TestFormat:
+    def test_every_sample_has_help_and_type(self, exposition):
+        helps, types, samples = parse_exposition(exposition)
+        assert samples, "scrape produced no samples"
+        for name, _, _ in samples:
+            base = base_name(name, types)
+            assert base in helps, f"{name} has no # HELP"
+            assert base in types, f"{name} has no # TYPE"
+
+    def test_name_grammar_and_unit_suffixes(self, exposition):
+        helps, types, _ = parse_exposition(exposition)
+        for name in types:
+            assert NAME_RE.match(name), name
+            assert name.endswith(UNIT_SUFFIXES), (
+                f"{name} lacks a unit suffix {UNIT_SUFFIXES}"
+            )
+        for name, kind in types.items():
+            if kind == "counter":
+                assert name.endswith("_total"), name
+
+    def test_histogram_buckets_monotone_and_consistent(self, exposition):
+        _, types, samples = parse_exposition(exposition)
+        hist_names = [n for n, k in types.items() if k == "histogram"]
+        assert hist_names
+        for hist in hist_names:
+            # Group the derived series per labelset (excluding le).
+            by_child: dict[tuple, dict] = {}
+            for name, labels, value in samples:
+                if base_name(name, types) != hist:
+                    continue
+                key = tuple(
+                    sorted((k, v) for k, v in labels.items() if k != "le")
+                )
+                child = by_child.setdefault(key, {"buckets": []})
+                if name.endswith("_bucket"):
+                    le = labels["le"]
+                    bound = float("inf") if le == "+Inf" else float(le)
+                    child["buckets"].append((bound, value))
+                elif name.endswith("_sum"):
+                    child["sum"] = value
+                elif name.endswith("_count"):
+                    child["count"] = value
+            for key, child in by_child.items():
+                buckets = sorted(child["buckets"])
+                assert buckets, (hist, key)
+                assert buckets[-1][0] == float("inf"), (
+                    f"{hist}{key}: no +Inf bucket"
+                )
+                counts = [c for _, c in buckets]
+                assert counts == sorted(counts), (
+                    f"{hist}{key}: buckets not cumulative-monotone: {counts}"
+                )
+                assert "count" in child and "sum" in child, (hist, key)
+                assert counts[-1] == child["count"], (
+                    f"{hist}{key}: +Inf bucket != _count"
+                )
+                if child["count"] > 0:
+                    assert child["sum"] >= 0
+
+    def test_counter_values_are_finite_and_nonnegative(self, exposition):
+        _, types, samples = parse_exposition(exposition)
+        for name, _, value in samples:
+            if types.get(base_name(name, types)) == "counter":
+                assert 0 <= value < float("inf"), name
+
+
+class TestCoverage:
+    """The acceptance list: per-route latency histograms, status
+    counters, transfer/device-cache counters, sync failures."""
+
+    def test_per_route_latency_histogram(self, exposition):
+        _, types, samples = parse_exposition(exposition)
+        assert types["headlamp_tpu_request_duration_seconds"] == "histogram"
+        routes = {
+            labels["route"]
+            for name, labels, _ in samples
+            if name == "headlamp_tpu_request_duration_seconds_count"
+        }
+        assert {"/tpu", "/tpu/nodes", "/tpu/metrics"} <= routes
+
+    def test_status_code_counters(self, exposition):
+        _, types, samples = parse_exposition(exposition)
+        assert types["headlamp_tpu_requests_total"] == "counter"
+        seen = {
+            (labels["route"], labels["status"])
+            for name, labels, _ in samples
+            if name == "headlamp_tpu_requests_total"
+        }
+        assert ("/tpu", "200") in seen
+        assert ("other", "404") in seen  # the /nope request
+
+    def test_transfer_and_cache_and_sync_counters_exposed(self, exposition):
+        _, types, _ = parse_exposition(exposition)
+        for name in (
+            "headlamp_tpu_transfer_blocking_gets_total",
+            "headlamp_tpu_transfer_coalesced_trees_total",
+            "headlamp_tpu_fleet_cache_hits_total",
+            "headlamp_tpu_fleet_cache_misses_total",
+            "headlamp_tpu_sync_failures_total",
+        ):
+            assert name in types, name
+            assert types[name] == "counter", name
+
+    def test_trace_ring_gauge_exposed(self, exposition):
+        _, types, samples = parse_exposition(exposition)
+        assert types["headlamp_tpu_trace_ring_traces_count"] == "gauge"
+        values = [
+            v for n, _, v in samples if n == "headlamp_tpu_trace_ring_traces_count"
+        ]
+        assert values and values[0] >= 0

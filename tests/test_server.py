@@ -118,11 +118,13 @@ class TestHandle:
         assert payload["ok"] is True and payload["consecutive_sync_failures"] == 0
 
     def test_healthz_flags_wedged_background_loop(self):
+        # Staleness is judged on the injected MONOTONIC clock (ADR-013
+        # clock audit) — the wall clock is display-only on this path.
         clock_value = [1000.0]
         app = DashboardApp(
             make_demo_transport("v5e4"),
             min_sync_interval_s=0.0,
-            clock=lambda: clock_value[0],
+            monotonic=lambda: clock_value[0],
         )
         app.handle("/tpu")  # snapshot at t=1000
         # Simulate a live background loop that stopped producing
@@ -135,11 +137,12 @@ class TestHandle:
         assert payload["last_sync_age_s"] > 30
 
     def test_sync_coalescing(self):
+        # Coalescing gates on the monotonic clock, not wall time.
         clock_value = [100.0]
         app = DashboardApp(
             make_demo_transport("v5e4"),
             min_sync_interval_s=5.0,
-            clock=lambda: clock_value[0],
+            monotonic=lambda: clock_value[0],
         )
         t = app._transport
 
@@ -246,11 +249,12 @@ class TestCaching:
         return sum(1 for c in transport.calls if "query?query=1" in c)
 
     def test_metrics_ttl_cache(self):
+        # The serving TTL runs on the monotonic clock (ADR-013).
         clock = [100.0]
         app = DashboardApp(
             make_demo_transport("v5e4"),
             min_sync_interval_s=0.0,
-            clock=lambda: clock[0],
+            monotonic=lambda: clock[0],
         )
         app.handle("/tpu/metrics")
         probes = self._probe_count(app._transport)
@@ -265,7 +269,7 @@ class TestCaching:
         app = DashboardApp(
             make_demo_transport("v5e4"),
             min_sync_interval_s=0.0,
-            clock=lambda: clock[0],
+            monotonic=lambda: clock[0],
         )
         app.handle("/tpu/metrics")
         probes = self._probe_count(app._transport)
@@ -466,6 +470,7 @@ class TestConcurrentLoad:
         routes = [
             "/tpu", "/tpu/metrics", "/tpu/topology", "/tpu/nodes",
             "/tpu/pods", "/healthz", "/refresh?back=/tpu", "/nodes",
+            "/metricsz", "/debug/traces", "/debug/traces/html",
         ]
 
         def hit(i: int) -> int:
