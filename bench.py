@@ -49,6 +49,14 @@ Extras reported alongside (same JSON line, `extra` object):
   / ``trace_ring_memory_kb`` — the ADR-013 telemetry budget numbers:
   per-span tracing cost, handle() latency with tracing on vs off
   (acceptance: ≤5% delta), and the trace ring's resident size.
+- ``connections_opened_per_request`` / ``connection_reuse_rate`` /
+  ``scrape_paint_rtt_multiplier`` — the ADR-014 transport-pool
+  acceptance numbers, measured over REAL sockets (the fixture fleet
+  served by a local HTTP/1.1 server, scraped through the pooled
+  ``KubeTransport``): handshakes per warm paint (must be ≤ 1), reused
+  fraction of pooled checkouts (must be ≥ 0.9), and HTTP round trips
+  (requests + handshakes) per paint — the budget ADR-014 tracks
+  across PRs.
 
 Prints ONE JSON line:
   {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": ..., "extra": {...}}
@@ -527,6 +535,98 @@ def bench_telemetry(fleet) -> dict:
     }
 
 
+def bench_transport_pool(fleet) -> dict:
+    """ADR-014 acceptance numbers over REAL sockets. The in-process
+    MockTransport the other benches use never opens a connection, so
+    this bench serves the same fixture fleet over an actual local
+    HTTP/1.1 server (ThreadingHTTPServer proxying each GET to the
+    mock) and scrapes it through the pooled ``KubeTransport`` — every
+    list, discovery probe, instant query and range query pays a real
+    socket checkout. A fresh ``DashboardApp`` per iteration defeats
+    the TTL caches (same discipline as the headline) while the SHARED
+    transport keeps the pool and the discovery cache warm — exactly
+    the server's steady state, where one transport outlives every
+    request. Reports, from the pool's own counters (delta across the
+    timed window):
+
+    - ``connections_opened_per_request`` — handshakes per warm paint
+      (ADR-014 acceptance: ≤ 1; a warm pool re-opens nothing).
+    - ``connection_reuse_rate`` — reused / (opened + reused) over the
+      window (acceptance: ≥ 0.9).
+    - ``scrape_paint_rtt_multiplier`` — HTTP round trips per paint:
+      (requests + handshakes) / paints. Discovery collapse and socket
+      reuse both push it down; it is the cross-PR budget number.
+    """
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from headlamp_tpu.fleet import fixtures as fx
+    from headlamp_tpu.server import DashboardApp
+    from headlamp_tpu.server.app import add_demo_prometheus
+    from headlamp_tpu.transport import ApiError, KubeTransport
+
+    mock = fx.fleet_transport(fleet)
+    add_demo_prometheus(mock, fleet)
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"  # keep-alive: what a kubectl proxy speaks
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            try:
+                payload = mock.request(self.path)
+                status = 200
+            except ApiError as e:
+                payload = {"kind": "Status", "message": str(e)}
+                status = e.status or 502
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *_args):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    server.daemon_threads = True
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    transport = KubeTransport(f"http://127.0.0.1:{server.server_address[1]}")
+    iterations = 10
+    try:
+        # Warm paint: pays the discovery probe chain + the pool's first
+        # handshakes; everything after runs the steady state.
+        status, _, page = DashboardApp(transport, min_sync_interval_s=0.0).handle(
+            "/tpu/metrics"
+        )
+        assert status == 200 and "Fleet Telemetry" in page
+        before = transport.pool.snapshot()
+        samples = []
+        for _ in range(iterations):
+            app = DashboardApp(transport, min_sync_interval_s=0.0)
+            t0 = time.perf_counter()
+            status, _, page = app.handle("/tpu/metrics")
+            samples.append((time.perf_counter() - t0) * 1000)
+            assert status == 200 and page
+        after = transport.pool.snapshot()
+    finally:
+        server.shutdown()
+        server.server_close()
+        transport.pool.close()
+    opened = after["connections_opened"] - before["connections_opened"]
+    reused = after["connections_reused"] - before["connections_reused"]
+    requests = opened + reused
+    return {
+        "transport_pool_paint_p50_ms": round(statistics.median(samples), 2),
+        "transport_http_requests_per_paint": round(requests / iterations, 2),
+        "connections_opened_per_request": round(opened / iterations, 3),
+        "connection_reuse_rate": (
+            round(reused / requests, 4) if requests else None
+        ),
+        "scrape_paint_rtt_multiplier": round((requests + opened) / iterations, 2),
+    }
+
+
 def bench_paint_1024() -> tuple[float, str]:
     """/tpu overview paint at 1024 TPU nodes — past XLA_ROLLUP_MIN_NODES,
     so the warm-up request triggers the calibration probe and the timed
@@ -594,6 +694,7 @@ def main() -> None:
     transfers = bench_request_transfer_discipline()
     watch = bench_watch_steady_state()
     telemetry = bench_telemetry(fleet)
+    transport_pool = bench_transport_pool(fleet)
     print(
         json.dumps(
             {
@@ -632,6 +733,7 @@ def main() -> None:
                     **transfers,
                     **watch,
                     **telemetry,
+                    **transport_pool,
                 },
             },
             ensure_ascii=False,

@@ -38,6 +38,7 @@ from typing import Any, Callable, Mapping
 from ..domain import objects as obj
 from ..domain.accelerator import PROVIDERS, FleetView, Provider, classify_fleet
 from ..transport.api_proxy import DEFAULT_TIMEOUT_S, ApiError, Transport
+from ..transport.pool import fanout, pool_of
 from .sources import ProviderSource, default_sources, workload_matches_provider
 from .sources import NODES_PATH, PODS_PATH
 
@@ -423,19 +424,18 @@ class AcceleratorDataContext:
         # fingerprint walks when nobody will read the verdict.
         before = self._imperative_fingerprint() if detect_changes else None
 
-        def fetch_one(provider: Provider, source: ProviderSource) -> None:
+        def fetch_one(item: tuple[Provider, ProviderSource]) -> None:
+            provider, source = item
             self._fetch_workloads(provider, source)
             self._fetch_plugin_pods(provider, source)
 
         if len(sourced) == 1:
-            fetch_one(*sourced[0])
+            fetch_one(sourced[0])
         else:
-            with concurrent.futures.ThreadPoolExecutor(
-                max_workers=len(sourced), thread_name_prefix="hl-tpu-provider"
-            ) as pool:
-                futures = [pool.submit(fetch_one, p, s) for p, s in sourced]
-                for f in futures:
-                    f.result()
+            # Shared RTT-aware scheduler (ADR-014): persistent workers
+            # instead of a per-tick ThreadPoolExecutor, width sized from
+            # the transport pool's RTT stats when real sockets back it.
+            fanout.map(fetch_one, sourced, pool=pool_of(self._transport))
 
         if detect_changes and self._imperative_fingerprint() != before:
             self._changed = True

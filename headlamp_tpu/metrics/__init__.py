@@ -15,9 +15,12 @@ from .client import (
     TpuChipMetrics,
     TpuMetricsSnapshot,
     UtilizationHistory,
+    cached_prometheus,
     fetch_tpu_metrics,
     fetch_utilization_history,
     find_prometheus_path,
+    invalidate_prometheus,
+    resolve_prometheus,
 )
 from .format import format_bytes, format_percent, format_ratio_bar
 
@@ -27,10 +30,13 @@ __all__ = [
     "TpuChipMetrics",
     "TpuMetricsSnapshot",
     "UtilizationHistory",
+    "cached_prometheus",
     "fetch_tpu_metrics",
     "fetch_utilization_history",
     "find_prometheus_path",
     "format_bytes",
     "format_percent",
     "format_ratio_bar",
+    "invalidate_prometheus",
+    "resolve_prometheus",
 ]

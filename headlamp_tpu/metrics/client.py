@@ -7,9 +7,14 @@ Mirrors the reference client's four-stage shape
    services through the apiserver service proxy with a trivial query
    (``query=1``), first responder wins (`metrics.ts:61-90`). The chain
    adds Google Managed Prometheus's in-cluster frontend to the three
-   community-standard services.
+   community-standard services. The winner is cached per transport
+   (ADR-014): a warm request skips the probe chain entirely — the
+   chain is up to 6 serial round trips, pure RTT waste once the
+   answer is known — and the cache self-invalidates when the fan-out
+   proves the cached service dead.
 2. **Fan-out** — the logical TPU metrics are queried in parallel
-   (`metrics.ts:101-116` does Promise.all; here a thread pool).
+   (`metrics.ts:101-116` does Promise.all; here the shared RTT-aware
+   fan-out scheduler over the transport's keep-alive pool).
 3. **Schema tolerance** — each *logical* metric (tensorcore
    utilization, HBM used/total, memory-bandwidth utilization, duty
    cycle) is a fallback chain of candidate series names, because the
@@ -26,14 +31,15 @@ pages render the guided "install kube-prometheus/GMP" box, never crash.
 
 from __future__ import annotations
 
-import concurrent.futures
 import time
 import urllib.parse
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping
 
 from ..obs.trace import span as _span
 from ..transport.api_proxy import ApiError, Transport
+from ..transport.pool import fanout, pool_of
 
 # ---------------------------------------------------------------------------
 # Service discovery
@@ -86,7 +92,8 @@ def find_prometheus_path(
     transport: Transport, timeout_s: float = 2.0
 ) -> tuple[str, str] | None:
     """Probe the chain with ``query=1``; return the first working
-    (namespace, service) or None."""
+    (namespace, service) or None. Always probes — use
+    :func:`resolve_prometheus` on hot paths to amortize the chain."""
     for namespace, service in PROMETHEUS_SERVICES:
         try:
             data = transport.request(
@@ -97,6 +104,51 @@ def find_prometheus_path(
         if isinstance(data, Mapping) and data.get("status") == "success":
             return namespace, service
     return None
+
+
+#: Discovered (namespace, service) per live transport. Weak keys: a
+#: transport's cache entry dies with it, and tests' throwaway
+#: MockTransports never accumulate. Positive results only — a cluster
+#: with no Prometheus yet must keep getting re-probed (the app's own
+#: metrics TTL bounds how often that happens).
+_DISCOVERY_CACHE: "weakref.WeakKeyDictionary[Any, tuple[str, str]]" = (
+    weakref.WeakKeyDictionary()
+)
+
+
+def cached_prometheus(transport: Transport) -> tuple[str, str] | None:
+    """The cached discovery for ``transport``, without probing."""
+    try:
+        return _DISCOVERY_CACHE.get(transport)
+    except TypeError:  # unhashable / non-weakrefable transport
+        return None
+
+
+def resolve_prometheus(
+    transport: Transport, timeout_s: float = 2.0
+) -> tuple[str, str] | None:
+    """Cached :func:`find_prometheus_path`: the probe chain (up to 6
+    serial round trips against a dark cluster) runs once per transport;
+    every later call is a dict hit. :func:`invalidate_prometheus` drops
+    the entry when the cached service stops answering (ADR-014)."""
+    cached = cached_prometheus(transport)
+    if cached is not None:
+        return cached
+    found = find_prometheus_path(transport, timeout_s)
+    if found is not None:
+        try:
+            _DISCOVERY_CACHE[transport] = found
+        except TypeError:
+            pass
+    return found
+
+
+def invalidate_prometheus(transport: Transport) -> None:
+    """Forget ``transport``'s cached discovery — next fetch re-probes."""
+    try:
+        _DISCOVERY_CACHE.pop(transport, None)
+    except TypeError:
+        pass
 
 
 # ---------------------------------------------------------------------------
@@ -282,19 +334,23 @@ def fetch_tpu_metrics(
     clock: Callable[[], float] = time.time,
     prometheus: tuple[str, str] | None = None,
 ) -> TpuMetricsSnapshot | None:
-    """Discover Prometheus (unless ``prometheus`` pins it), fan out all
-    logical-metric candidate queries plus the node map in parallel, and
-    join into per-chip rows. None when no Prometheus answers."""
+    """Discover Prometheus (unless ``prometheus`` pins it; cached per
+    transport otherwise), fan out all logical-metric candidate queries
+    plus the node map in parallel over the transport's connection pool,
+    and join into per-chip rows. None when no Prometheus answers."""
     t_start = time.perf_counter()
     # ADR-013 stage spans: discovery (the candidate-chain probe — the
     # whole chain times out serially against a dark cluster, which is
-    # the pathological latency this span exists to expose) and the
-    # parallel fan-out below.
-    with _span("metrics.discover", pinned=prometheus is not None):
-        found = prometheus or find_prometheus_path(transport, timeout_s)
+    # the pathological latency this span exists to expose; `cached`
+    # marks the warm path that skips it) and the parallel fan-out below.
+    from_cache = prometheus is None and cached_prometheus(transport) is not None
+    with _span("metrics.discover", pinned=prometheus is not None, cached=from_cache):
+        found = prometheus or resolve_prometheus(transport, timeout_s)
     if found is None:
         return None
     namespace, service = found
+
+    transport_failures: list[str] = []
 
     def run_query(promql: str) -> list[Mapping[str, Any]]:
         try:
@@ -302,20 +358,30 @@ def fetch_tpu_metrics(
                 _proxy_query_path(namespace, service, promql), timeout_s
             )
         except ApiError:
+            transport_failures.append(promql)  # list.append is GIL-atomic
             return []
         return _vector_result(data)
 
     # Fan out: every candidate of every logical metric plus the node map
     # in one parallel wave — one slow series costs max(latency), not
     # sum(latency). Candidate order still decides which result is used.
+    # The shared scheduler sizes the wave from the pool's RTT stats:
+    # idle pooled sockets are free width, extra sockets must earn their
+    # handshake (ADR-014).
     queries: list[str] = [NODE_MAP_QUERY]
     for candidates in LOGICAL_METRICS.values():
         queries.extend(candidates)
     with _span("metrics.fanout", queries=len(queries), service=service):
-        with concurrent.futures.ThreadPoolExecutor(
-            max_workers=min(8, len(queries)), thread_name_prefix="hl-tpu-promql"
-        ) as pool:
-            results = dict(zip(queries, pool.map(run_query, queries)))
+        results = dict(
+            zip(queries, fanout.map(run_query, queries, pool=pool_of(transport)))
+        )
+
+    if len(transport_failures) == len(queries):
+        # Every single query failed at the transport layer: the
+        # discovered service is gone (rolled, rescheduled). Drop the
+        # cached discovery so the next fetch re-probes the chain
+        # instead of fanning out against a corpse forever.
+        invalidate_prometheus(transport)
 
     instance_map = _build_instance_map(results[NODE_MAP_QUERY])
 

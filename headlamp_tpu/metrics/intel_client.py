@@ -12,12 +12,12 @@ iGPU power are NOT obtainable from a standard node-exporter setup.
 
 from __future__ import annotations
 
-import concurrent.futures
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from ..transport.api_proxy import ApiError, Transport
+from ..transport.pool import fanout, pool_of
 from .client import (
     _build_instance_map,
     _node_of,
@@ -25,7 +25,7 @@ from .client import (
     _sample_labels,
     _sample_value,
     _vector_result,
-    find_prometheus_path,
+    resolve_prometheus,
 )
 
 #: The reference's PromQL set (`metrics.ts:101-116`). The power rate
@@ -96,11 +96,12 @@ def fetch_intel_gpu_metrics(
     clock: Callable[[], float] = time.time,
     prometheus: tuple[str, str] | None = None,
 ) -> IntelMetricsSnapshot | None:
-    """Discover (shared chain) then run the 4 queries in parallel and
+    """Discover (shared chain, cached per transport — ADR-014) then run
+    the 4 queries in parallel over the transport's connection pool and
     join per (node, chip). None when no Prometheus answers
     (`metrics.ts:97-98`)."""
     t_start = time.perf_counter()
-    found = prometheus or find_prometheus_path(transport, timeout_s)
+    found = prometheus or resolve_prometheus(transport, timeout_s)
     if found is None:
         return None
     namespace, service = found
@@ -115,8 +116,16 @@ def fetch_intel_gpu_metrics(
         return _vector_result(data)
 
     names = list(INTEL_QUERIES)
-    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
-        results = dict(zip(names, pool.map(run_query, (INTEL_QUERIES[n] for n in names))))
+    results = dict(
+        zip(
+            names,
+            fanout.map(
+                run_query,
+                [INTEL_QUERIES[n] for n in names],
+                pool=pool_of(transport),
+            ),
+        )
+    )
 
     instance_map = _build_instance_map(results["node_map"])
 

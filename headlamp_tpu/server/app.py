@@ -105,19 +105,27 @@ def _analytics_health() -> dict[str, Any]:
         return {"calibrated": False, "error": type(exc).__name__}
 
 
-def _runtime_health() -> dict[str, Any]:
-    """Transfer-funnel and device-cache counters for /healthz: how many
-    blocking device_gets the process has paid, how often warm requests
-    hit the device-resident fleet — the observable side of ADR-012's
-    one-RTT-per-request contract."""
+def _runtime_health(transport: Any = None) -> dict[str, Any]:
+    """Transfer-funnel, device-cache, and transport-pool counters for
+    /healthz: how many blocking device_gets the process has paid, how
+    often warm requests hit the device-resident fleet (ADR-012), and
+    how many TCP handshakes the keep-alive pool saved (ADR-014). The
+    ``transport`` block appears only when the app's transport is pooled
+    (KubeTransport) — MockTransport-backed demo/test apps report the
+    other blocks unchanged."""
     try:
         from ..runtime.device_cache import fleet_cache
         from ..runtime.transfer import transfer_stats
+        from ..transport.pool import pool_of
 
-        return {
+        out = {
             "transfer": transfer_stats.snapshot(),
             "fleet_cache": fleet_cache.snapshot(),
         }
+        pool = pool_of(transport)
+        if pool is not None:
+            out["transport"] = pool.snapshot()
+        return out
     except Exception as exc:  # noqa: BLE001 — health must never 500 on analytics
         # An empty block read as "no runtime telemetry wired"; a named
         # error reads as what it is — degraded observability.
@@ -717,7 +725,7 @@ class DashboardApp:
                         # startup too, when "probe not yet run" is the
                         # most informative state.
                         "analytics": _analytics_health(),
-                        "runtime": _runtime_health(),
+                        "runtime": _runtime_health(self._transport),
                     }
                 )
                 return 200, "application/json", body
@@ -749,7 +757,7 @@ class DashboardApp:
                     "consecutive_sync_failures": failures,
                     "background_sync": background,
                     "analytics": _analytics_health(),
-                    "runtime": _runtime_health(),
+                    "runtime": _runtime_health(self._transport),
                 }
             )
             return 200, "application/json", body

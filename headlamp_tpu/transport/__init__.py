@@ -17,14 +17,30 @@ from .api_proxy import (
     WatchTransport,
     with_timeout,
 )
+from .pool import (
+    ConnectionPool,
+    FanoutScheduler,
+    PooledResponse,
+    PoolExhausted,
+    choose_width,
+    fanout,
+    pool_of,
+)
 
 __all__ = [
     "ApiError",
+    "ConnectionPool",
+    "FanoutScheduler",
     "KubeTransport",
     "MockTransport",
+    "PooledResponse",
+    "PoolExhausted",
     "RequestTimeout",
     "Transport",
     "WatchFeed",
     "WatchTransport",
+    "choose_width",
+    "fanout",
+    "pool_of",
     "with_timeout",
 ]

@@ -8,9 +8,11 @@ This module provides the same contract for Python:
 - :class:`Transport` — the protocol (``request(path, timeout_s)``).
 - :func:`with_timeout` — hard wall-clock cap on any callable, the analogue
   of the reference's ``withTimeout`` Promise.race.
-- :class:`KubeTransport` — real HTTP via stdlib ``urllib`` against an API
-  server base URL (``kubectl proxy``, or in-cluster with a service-account
-  bearer token).
+- :class:`KubeTransport` — real HTTP against an API server base URL
+  (``kubectl proxy``, or in-cluster with a service-account bearer
+  token), over the keep-alive :class:`~headlamp_tpu.transport.pool.
+  ConnectionPool` (ADR-014) so repeat calls reuse sockets instead of
+  paying a fresh TCP+TLS handshake per round trip.
 - :class:`MockTransport` — the test double: path -> canned response /
   exception, with call recording (mirrors the vitest
   ``ApiProxy.request`` mocks, `IntelGpuDataContext.test.tsx:7-15`).
@@ -18,12 +20,13 @@ This module provides the same contract for Python:
 
 from __future__ import annotations
 
+import contextvars
 import json
 import ssl
 import threading
-import urllib.error
-import urllib.request
 from typing import Any, Callable, Mapping, Protocol
+
+from .pool import ConnectionPool, PoolExhausted
 
 #: Default per-request timeout, matching the reference's 2 000 ms
 #: (`IntelGpuDataContext.tsx:72`).
@@ -85,12 +88,16 @@ def with_timeout(fn: Callable[[], Any], timeout_s: float, path: str = "") -> Any
     (not a shared pool): urllib's socket timeout does not cover DNS
     resolution, so a stalled resolver can park threads indefinitely — a
     bounded pool would exhaust and then spuriously time out every later
-    request against a healthy server."""
+    request against a healthy server. The worker runs under the
+    caller's copied contextvars, so the pool's ``transport.connect`` /
+    ``transport.reuse`` spans land in the live request trace (plain
+    threads inherit nothing; same discipline as the fan-out workers)."""
     outcome: dict[str, Any] = {}
+    ctx = contextvars.copy_context()
 
     def runner() -> None:
         try:
-            outcome["value"] = fn()
+            outcome["value"] = ctx.run(fn)
         except BaseException as e:  # noqa: BLE001 — re-raised in caller
             outcome["error"] = e
 
@@ -105,12 +112,21 @@ def with_timeout(fn: Callable[[], Any], timeout_s: float, path: str = "") -> Any
 
 
 class KubeTransport:
-    """Real API-server transport over stdlib HTTP.
+    """Real API-server transport over pooled keep-alive HTTP.
 
     ``base_url`` examples:
     - ``http://127.0.0.1:8001`` (kubectl proxy — no auth needed)
     - ``https://10.0.0.1`` in-cluster, with ``bearer_token`` from the
       mounted service account and ``ca_cert`` for verification.
+
+    Every request runs over :attr:`pool` (one pool per transport —
+    injectable for tests), so a warm scrape→paint request reuses the
+    sockets the previous one opened instead of re-handshaking per call
+    (ADR-014). The pool also guarantees the response object is closed
+    on every exit path, including non-2xx raises — the resource leak
+    the previous ``urlopen`` sites had (``urllib.error.HTTPError`` IS
+    the open response; raising it out of the ``with`` left its fp to
+    the GC).
     """
 
     def __init__(
@@ -120,8 +136,10 @@ class KubeTransport:
         bearer_token: str | None = None,
         ca_cert: str | None = None,
         insecure_skip_verify: bool = False,
+        pool: ConnectionPool | None = None,
     ) -> None:
         self.base_url = base_url.rstrip("/")
+        self.pool = pool if pool is not None else ConnectionPool()
         self._headers: dict[str, str] = {"Accept": "application/json"}
         if bearer_token:
             self._headers["Authorization"] = f"Bearer {bearer_token}"
@@ -155,21 +173,29 @@ class KubeTransport:
         def do_request() -> Any:
             import http.client
 
-            req = urllib.request.Request(url, headers=self._headers)
             try:
-                with urllib.request.urlopen(
-                    req, timeout=timeout_s, context=self._ssl_context
+                with self.pool.request(
+                    url,
+                    headers=self._headers,
+                    timeout_s=timeout_s,
+                    context=self._ssl_context,
                 ) as resp:
+                    # Read the body BEFORE the status check: a fully
+                    # drained response is what lets close() return the
+                    # connection to the pool, and error bodies (k8s
+                    # Status objects) are tiny.
                     body = resp.read()
-            except urllib.error.HTTPError as e:
-                raise ApiError(path, f"HTTP {e.code}", status=e.code) from e
-            except urllib.error.URLError as e:
-                raise ApiError(path, str(e.reason)) from e
+                    if not 200 <= resp.status < 300:
+                        raise ApiError(
+                            path, f"HTTP {resp.status}", status=resp.status
+                        )
+            except PoolExhausted as e:
+                raise ApiError(path, f"connection pool exhausted: {e}") from e
             except (OSError, http.client.HTTPException) as e:
-                # A response cut mid-read (reset, truncated chunk) is a
-                # transport failure like any other — callers must see
-                # ApiError, never a raw socket exception.
-                raise ApiError(path, f"read failed: {e}") from e
+                # Refused connect, reset mid-read, truncated chunk, TLS
+                # failure — callers must see ApiError, never a raw
+                # socket exception.
+                raise ApiError(path, f"request failed: {e}") from e
             try:
                 return json.loads(body)
             except json.JSONDecodeError as e:
@@ -187,21 +213,26 @@ class KubeTransport:
         def do_request() -> list[Any]:
             import http.client
 
-            req = urllib.request.Request(url, headers=self._headers)
             events: list[Any] = []
             try:
-                with urllib.request.urlopen(
-                    req, timeout=timeout_s, context=self._ssl_context
+                with self.pool.request(
+                    url,
+                    headers=self._headers,
+                    timeout_s=timeout_s,
+                    context=self._ssl_context,
                 ) as resp:
+                    if not 200 <= resp.status < 300:
+                        resp.read()
+                        raise ApiError(
+                            path, f"HTTP {resp.status}", status=resp.status
+                        )
                     for raw in resp:
                         line = raw.strip()
                         if not line:
                             continue
                         events.append(json.loads(line))
-            except urllib.error.HTTPError as e:
-                raise ApiError(path, f"HTTP {e.code}", status=e.code) from e
-            except urllib.error.URLError as e:
-                raise ApiError(path, str(e.reason)) from e
+            except PoolExhausted as e:
+                raise ApiError(path, f"connection pool exhausted: {e}") from e
             except (OSError, http.client.HTTPException) as e:
                 # Long-lived watch streams get cut mid-body far more
                 # often than short GETs complete abnormally: a reset or

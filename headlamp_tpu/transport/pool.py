@@ -1,0 +1,622 @@
+"""Keep-alive connection pool + RTT-aware fan-out scheduling (ADR-014).
+
+BENCH_r05 put the scrape→paint p50 at 161 ms against a ~89 ms tunnel
+RTT floor: the request path is round-trip-bound, not compute-bound, and
+``urllib.request.urlopen`` paid a fresh TCP (+TLS) handshake for every
+Kubernetes/Prometheus call — the discovery probe chain, the 16-query
+instant fan-out, every list page. This module is the classic serving-
+stack fix, with round-trip count treated as a first-class budget:
+
+- :class:`ConnectionPool` — per-host keep-alive ``http.client``
+  connections with a bounded concurrent-checkout cap, LRU idle
+  eviction, and stale-socket detection with one transparent retry.
+  Every open/reuse/eviction dual-accounts into per-pool ints (the
+  /healthz view, bench deltas) and the process metric registry
+  (/metricsz), and stamps ``transport.connect`` / ``transport.reuse``
+  spans into the active request trace so saved round trips are visible
+  in the ADR-013 waterfall.
+- :class:`FanoutScheduler` — a persistent worker pool (no per-fetch
+  ThreadPoolExecutor churn) whose fan-out *width* is chosen from the
+  pool's measured RTT statistics: idle pooled sockets are free
+  concurrency, while each socket beyond them costs a connect handshake
+  that must pay for itself against the serial round-trip time it
+  saves. Without a pool (MockTransport) it degrades to a fixed-width
+  map over the same persistent workers.
+
+Stdlib-only, like the rest of the transport layer: the pool must work
+on a jax-less host and inside the test suite with zero extra deps.
+"""
+
+from __future__ import annotations
+
+import http.client
+import ssl
+import threading
+import time
+import weakref
+from typing import Any, Callable, Iterator, Sequence, TypeVar
+from urllib.parse import urlsplit
+
+from ..obs.metrics import registry as _metrics_registry
+from ..obs.trace import span as _span
+
+#: Concurrent checked-out connections per host. Matches the historical
+#: fan-out ceiling (metrics/client.py capped its per-fetch executor at
+#: 8): one warm fan-out can run full-width without ever queueing, and a
+#: misbehaving caller cannot open an unbounded socket flood at the
+#: apiserver.
+DEFAULT_MAX_PER_HOST = 8
+
+#: Idle keep-alive lifetime. kube-apiserver and the common proxies in
+#: front of it close idle client connections well above this; evicting
+#: first means the pool, not the peer, decides when a socket dies — a
+#: peer-closed socket is exactly the stale-retry path this bound keeps
+#: rare.
+DEFAULT_IDLE_TTL_S = 60.0
+
+#: EWMA smoothing for the per-pool connect/request RTT estimates the
+#: fan-out width choice reads. 0.3 ≈ the last ~5 observations dominate:
+#: reactive enough to follow a tunnel RTT shift, stable enough that one
+#: outlier does not flip the width decision.
+EWMA_ALPHA = 0.3
+
+_T = TypeVar("_T")
+_R = TypeVar("_R")
+
+#: Failure modes of writing/reading on a kept-alive socket the peer
+#: already closed — the retry-once set. Anything else (refused connect,
+#: DNS, TLS handshake) fails loudly on fresh sockets too and must not
+#: be retried into a double-send.
+_STALE_ERRORS = (
+    http.client.RemoteDisconnected,
+    http.client.CannotSendRequest,
+    http.client.ResponseNotReady,
+    BrokenPipeError,
+    ConnectionResetError,
+    ConnectionAbortedError,
+)
+
+# Registry instruments (ADR-013 get-or-create: many pools per test
+# process share one set). Per-pool ints stay the behavioral/bench view;
+# these are the fleet-aggregable /metricsz view, written on the same
+# code paths so the two surfaces can never disagree on a transition.
+_OPENED = _metrics_registry.counter(
+    "headlamp_tpu_transport_connections_opened_total",
+    "TCP(+TLS) connections the transport pool opened, per host "
+    "(each costs at least one extra round trip before the request).",
+    labels=("host",),
+)
+_REUSED = _metrics_registry.counter(
+    "headlamp_tpu_transport_connections_reused_total",
+    "Requests served over an already-open pooled connection, per host "
+    "(handshake round trips the pool saved).",
+    labels=("host",),
+)
+_EVICTED = _metrics_registry.counter(
+    "headlamp_tpu_transport_idle_evicted_total",
+    "Idle pooled connections closed by TTL expiry or idle-slot overflow.",
+)
+_STALE_RETRIES = _metrics_registry.counter(
+    "headlamp_tpu_transport_stale_retries_total",
+    "Requests transparently retried on a fresh connection after a "
+    "kept-alive socket turned out peer-closed.",
+)
+_CONNECT_HIST = _metrics_registry.histogram(
+    "headlamp_tpu_transport_connect_latency_seconds",
+    "TCP(+TLS) connection establishment latency, per host.",
+    labels=("host",),
+)
+
+#: Live pools, for the process-wide pool-size gauge: the registry's
+#: callback gauge sums open connections across every pool still alive
+#: (the server's one KubeTransport in production; many short-lived ones
+#: under test).
+_LIVE_POOLS: "weakref.WeakSet[ConnectionPool]" = weakref.WeakSet()
+
+_metrics_registry.gauge_fn(
+    "headlamp_tpu_transport_pool_connections_count",
+    "Open pooled connections (idle + checked out) across live pools.",
+    lambda: float(sum(p.open_connections for p in list(_LIVE_POOLS))),
+)
+
+
+class PoolExhausted(Exception):
+    """Checkout blocked past its budget: every per-host slot stayed
+    checked out. Callers see it via the transport's ApiError mapping —
+    it signals local saturation, not a server failure."""
+
+
+class _PooledConn:
+    """One keep-alive connection plus the bookkeeping the pool needs:
+    monotonic idle stamp (TTL eviction) and its host key."""
+
+    __slots__ = ("raw", "key", "idle_since")
+
+    def __init__(self, raw: http.client.HTTPConnection, key: tuple) -> None:
+        self.raw = raw
+        self.key = key
+        self.idle_since = 0.0
+
+
+class _HostSlot:
+    """Per-(scheme, host, port) state: the idle stack, the checkout
+    semaphore, and the open-connection count."""
+
+    __slots__ = ("idle", "sem", "open_count", "lock")
+
+    def __init__(self, max_per_host: int) -> None:
+        #: MRU stack: reuse the most recently returned socket (warmest,
+        #: least likely peer-closed) and let the stack's cold end age
+        #: out through the TTL — LRU eviction, MRU reuse.
+        self.idle: list[_PooledConn] = []
+        self.sem = threading.BoundedSemaphore(max_per_host)
+        self.open_count = 0
+        self.lock = threading.Lock()
+
+
+class PooledResponse:
+    """A response whose connection returns to the pool on close.
+
+    Reuse contract: the connection goes back only when the body was
+    fully consumed (``isclosed``) and the server did not ask to close
+    (``will_close``); anything else — abandoned mid-read, HTTP/1.0
+    peer, ``Connection: close`` — discards the socket. ``close`` is
+    idempotent and ALWAYS releases the checkout slot, which is the
+    resource-leak guarantee the old ``urlopen`` sites lacked on their
+    non-2xx raise paths."""
+
+    def __init__(
+        self,
+        pool: "ConnectionPool",
+        conn: _PooledConn,
+        resp: http.client.HTTPResponse,
+    ) -> None:
+        self._pool = pool
+        self._conn = conn
+        self._resp = resp
+        self._closed = False
+
+    @property
+    def status(self) -> int:
+        return self._resp.status
+
+    def read(self) -> bytes:
+        return self._resp.read()
+
+    def __iter__(self) -> Iterator[bytes]:
+        return iter(self._resp)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        reusable = self._resp.isclosed() and not self._resp.will_close
+        if not reusable:
+            # Abandoned body or peer-terminated stream: the socket may
+            # carry unread bytes and must never serve another request.
+            self._resp.close()
+        self._pool._release(self._conn, reusable=reusable)
+
+    def __enter__(self) -> "PooledResponse":
+        return self
+
+    def __exit__(self, *_exc: Any) -> None:
+        self.close()
+
+
+class ConnectionPool:
+    """Bounded per-host keep-alive pool over ``http.client``.
+
+    Thread-safe: ThreadingHTTPServer request threads, the fan-out
+    scheduler's workers, and ``with_timeout``'s per-call threads all
+    check out concurrently. A checkout that would exceed
+    ``max_per_host`` blocks up to the request timeout, then raises
+    :class:`PoolExhausted` — backpressure, not a socket flood.
+
+    ``monotonic`` is injectable for the idle-TTL tests (ADR-013 clock
+    discipline: TTL math never touches wall clock)."""
+
+    def __init__(
+        self,
+        *,
+        max_per_host: int = DEFAULT_MAX_PER_HOST,
+        max_idle_per_host: int | None = None,
+        idle_ttl_s: float = DEFAULT_IDLE_TTL_S,
+        monotonic: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.max_per_host = max_per_host
+        self.max_idle_per_host = (
+            max_idle_per_host if max_idle_per_host is not None else max_per_host
+        )
+        self.idle_ttl_s = idle_ttl_s
+        self._mono = monotonic
+        self._lock = threading.Lock()
+        self._hosts: dict[tuple, _HostSlot] = {}
+        # Per-pool plain ints (GIL-atomic increments under each slot's
+        # lock): the /healthz ints and the bench's delta source. The
+        # registry counters above are written on the same transitions.
+        self.opened = 0
+        self.reused = 0
+        self.evicted = 0
+        self.stale_retries = 0
+        # RTT estimates feeding FanoutScheduler.choose_width. Aggregate
+        # (not per-host): a pool fronts one apiserver base URL.
+        self._connect_ewma_ms: float | None = None
+        self._rtt_ewma_ms: float | None = None
+        _LIVE_POOLS.add(self)
+
+    # -- stats ---------------------------------------------------------
+
+    @property
+    def open_connections(self) -> int:
+        with self._lock:
+            slots = list(self._hosts.values())
+        return sum(s.open_count for s in slots)
+
+    def idle_count(self) -> int:
+        with self._lock:
+            slots = list(self._hosts.values())
+        return sum(len(s.idle) for s in slots)
+
+    def connect_ewma_ms(self) -> float | None:
+        return self._connect_ewma_ms
+
+    def rtt_ewma_ms(self) -> float | None:
+        return self._rtt_ewma_ms
+
+    def snapshot(self) -> dict[str, Any]:
+        """The /healthz transport block: per-pool ints plus the live
+        derived numbers an operator reads first (see OPERATIONS.md)."""
+        total = self.opened + self.reused
+        return {
+            "connections_opened": self.opened,
+            "connections_reused": self.reused,
+            "idle_evicted": self.evicted,
+            "stale_retries": self.stale_retries,
+            "open_connections": self.open_connections,
+            "idle_connections": self.idle_count(),
+            "reuse_rate": round(self.reused / total, 4) if total else None,
+            "connect_ewma_ms": (
+                round(self._connect_ewma_ms, 2)
+                if self._connect_ewma_ms is not None
+                else None
+            ),
+            "rtt_ewma_ms": (
+                round(self._rtt_ewma_ms, 2)
+                if self._rtt_ewma_ms is not None
+                else None
+            ),
+        }
+
+    def _observe_connect(self, host_label: str, seconds: float) -> None:
+        _CONNECT_HIST.observe(seconds, host=host_label)
+        ms = seconds * 1000.0
+        prev = self._connect_ewma_ms
+        self._connect_ewma_ms = (
+            ms if prev is None else prev + EWMA_ALPHA * (ms - prev)
+        )
+
+    def _observe_rtt(self, seconds: float) -> None:
+        ms = seconds * 1000.0
+        prev = self._rtt_ewma_ms
+        self._rtt_ewma_ms = ms if prev is None else prev + EWMA_ALPHA * (ms - prev)
+
+    # -- checkout / release --------------------------------------------
+
+    def _slot(self, key: tuple) -> _HostSlot:
+        with self._lock:
+            slot = self._hosts.get(key)
+            if slot is None:
+                slot = self._hosts[key] = _HostSlot(self.max_per_host)
+            return slot
+
+    def _evict_expired(self, slot: _HostSlot, now: float) -> None:
+        # Called under slot.lock. The idle list is MRU-ordered, so
+        # expiry accumulates at the front; still scan the whole list —
+        # it is ≤ max_idle_per_host entries.
+        keep: list[_PooledConn] = []
+        for conn in slot.idle:
+            if now - conn.idle_since > self.idle_ttl_s:
+                conn.raw.close()
+                slot.open_count -= 1
+                self.evicted += 1
+                _EVICTED.inc()
+            else:
+                keep.append(conn)
+        slot.idle[:] = keep
+
+    def _checkout(
+        self,
+        key: tuple,
+        timeout_s: float,
+        context: ssl.SSLContext | None,
+    ) -> tuple[_PooledConn, bool]:
+        """One (connection, was_reused) under an acquired slot. The
+        caller MUST route the connection into _release (normally via
+        PooledResponse.close) or _discard+_release exactly once."""
+        scheme, host, port = key
+        slot = self._slot(key)
+        if not slot.sem.acquire(timeout=max(timeout_s, 0.001)):
+            raise PoolExhausted(
+                f"{host}:{port}: all {self.max_per_host} pooled connections "
+                f"stayed checked out for {timeout_s:g}s"
+            )
+        counted = False
+        try:
+            with slot.lock:
+                self._evict_expired(slot, self._mono())
+                if slot.idle:
+                    conn = slot.idle.pop()
+                    self.reused += 1
+                    _REUSED.inc(host=f"{host}:{port}")
+                    # Reused sockets carry the connect-time timeout of
+                    # whichever request opened them; re-arm for this one.
+                    if conn.raw.sock is not None:
+                        conn.raw.sock.settimeout(timeout_s)
+                    return conn, True
+                slot.open_count += 1
+                counted = True
+            host_label = f"{host}:{port}"
+            with _span("transport.connect", host=host_label):
+                t0 = time.perf_counter()
+                if scheme == "https":
+                    raw: http.client.HTTPConnection = http.client.HTTPSConnection(
+                        host, port, timeout=timeout_s, context=context
+                    )
+                else:
+                    raw = http.client.HTTPConnection(host, port, timeout=timeout_s)
+                raw.connect()
+                self._observe_connect(host_label, time.perf_counter() - t0)
+            self.opened += 1
+            _OPENED.inc(host=host_label)
+            return _PooledConn(raw, key), False
+        except BaseException:
+            # Failed open: the slot the semaphore reserved never
+            # materialized into a connection — undo its accounting.
+            if counted:
+                self._drop_open_count(slot)
+            slot.sem.release()
+            raise
+
+    def _drop_open_count(self, slot: _HostSlot) -> None:
+        with slot.lock:
+            if slot.open_count > 0:
+                slot.open_count -= 1
+
+    def _release(self, conn: _PooledConn, *, reusable: bool) -> None:
+        slot = self._slot(conn.key)
+        if reusable:
+            with slot.lock:
+                conn.idle_since = self._mono()
+                slot.idle.append(conn)
+                # Idle-slot overflow: evict the LRU end of the stack.
+                while len(slot.idle) > self.max_idle_per_host:
+                    victim = slot.idle.pop(0)
+                    victim.raw.close()
+                    slot.open_count -= 1
+                    self.evicted += 1
+                    _EVICTED.inc()
+        else:
+            conn.raw.close()
+            self._drop_open_count(slot)
+        slot.sem.release()
+
+    def _discard(self, conn: _PooledConn) -> None:
+        """Close a checked-out connection WITHOUT releasing its slot —
+        the stale-retry path keeps the slot for its replacement so the
+        retry cannot deadlock against a full pool."""
+        conn.raw.close()
+        self._drop_open_count(self._slot(conn.key))
+
+    # -- the request entry point ---------------------------------------
+
+    def request(
+        self,
+        url: str,
+        *,
+        headers: dict[str, str] | None = None,
+        timeout_s: float = 2.0,
+        context: ssl.SSLContext | None = None,
+        method: str = "GET",
+    ) -> PooledResponse:
+        """Issue ``method url`` over a pooled connection and return the
+        live response. The caller must close it (context manager) —
+        close returns the connection to the pool when the body was
+        fully read, and releases the checkout slot unconditionally.
+
+        Stale-retry contract: a request that fails with a peer-closed
+        symptom on a REUSED socket is retried exactly once on a fresh
+        connection. Fresh-connection failures and second failures
+        propagate — they are real errors, not keep-alive races."""
+        parts = urlsplit(url)
+        scheme = parts.scheme or "http"
+        host = parts.hostname or ""
+        port = parts.port or (443 if scheme == "https" else 80)
+        key = (scheme, host, port)
+        path = parts.path or "/"
+        if parts.query:
+            path += "?" + parts.query
+
+        slot = self._slot(key)
+        for attempt in (0, 1):
+            conn, reused = self._checkout(key, timeout_s, context)
+            if reused:
+                with _span("transport.reuse", host=f"{host}:{port}"):
+                    pass
+            t0 = time.perf_counter()
+            try:
+                conn.raw.request(method, path, headers=headers or {})
+                resp = conn.raw.getresponse()
+            except _STALE_ERRORS:
+                self._discard(conn)
+                if reused and attempt == 0:
+                    self.stale_retries += 1
+                    _STALE_RETRIES.inc()
+                    # Keep the slot: _discard left the semaphore held,
+                    # and the retry's _checkout would deadlock on a
+                    # saturated pool waiting for our own slot.
+                    slot.sem.release()
+                    continue
+                slot.sem.release()
+                raise
+            except BaseException:
+                self._discard(conn)
+                slot.sem.release()
+                raise
+            self._observe_rtt(time.perf_counter() - t0)
+            return PooledResponse(self, conn, resp)
+        raise AssertionError("unreachable: retry loop exits via return/raise")
+
+    def close(self) -> None:
+        """Close every idle connection (checked-out ones close through
+        their PooledResponse). Idempotent; the pool stays usable."""
+        with self._lock:
+            slots = list(self._hosts.values())
+        for slot in slots:
+            with slot.lock:
+                for conn in slot.idle:
+                    conn.raw.close()
+                    slot.open_count -= 1
+                slot.idle.clear()
+
+
+# ---------------------------------------------------------------------------
+# RTT-aware fan-out scheduling
+# ---------------------------------------------------------------------------
+
+#: Upper bound on any single fan-out's width — the historical 8-worker
+#: ceiling, now also the per-host checkout cap's partner: a full-width
+#: fan-out exactly fills one host's pool and never queues behind itself.
+DEFAULT_MAX_WIDTH = DEFAULT_MAX_PER_HOST
+
+#: Workers in the shared executor. Two concurrent full-width fan-outs
+#: (metrics route overlap + a background sync's provider chains) run
+#: without queueing; beyond that requests queue instead of spawning
+#: unbounded threads.
+_EXECUTOR_WORKERS = 16
+
+
+def choose_width(
+    n_items: int,
+    *,
+    idle: int,
+    connect_ms: float | None,
+    rtt_ms: float | None,
+    max_width: int = DEFAULT_MAX_WIDTH,
+) -> int:
+    """Fan-out width from pool state: how many sockets should ``n``
+    queries spread across?
+
+    Idle pooled sockets are free concurrency — reusing them costs no
+    handshake, so width starts there (at least 1). Each socket BEYOND
+    the idle set costs one connect (measured: ``connect_ms``), which is
+    only worth paying while it saves more serial round-trip time than
+    it costs: going from width w to w+1 saves ``rtt_ms * n * (1/w -
+    1/(w+1))`` of serial queue time. With no measurements yet (cold
+    pool, mock transport) there is nothing to budget against and the
+    historical full width applies."""
+    cap = max(1, min(n_items, max_width))
+    if n_items <= 1:
+        return cap
+    if connect_ms is None or rtt_ms is None:
+        return cap
+    width = max(1, min(idle, cap))
+    while width < cap:
+        serial_saving_ms = rtt_ms * n_items * (1.0 / width - 1.0 / (width + 1))
+        if serial_saving_ms <= connect_ms:
+            break
+        width += 1
+    return width
+
+
+class FanoutScheduler:
+    """Persistent fan-out workers + the width policy above.
+
+    One process-wide instance (``fanout``) replaces the per-call
+    ``ThreadPoolExecutor`` churn in the Prometheus clients and the
+    context's imperative track: thread creation is not free (~100 µs a
+    thread, paid 16× per metrics fetch before this), and a persistent
+    pool also gives the width policy a stable place to live.
+
+    Work is partitioned into ``width`` chunks, each chunk running its
+    items serially on one worker — so at most ``width`` transport
+    connections are in flight for this fan-out, which is exactly the
+    invariant the width policy prices. Workers inherit the caller's
+    contextvars (``contextvars.copy_context``) so transport/metrics
+    spans land in the live request trace."""
+
+    def __init__(self, *, max_width: int = DEFAULT_MAX_WIDTH) -> None:
+        self.max_width = max_width
+        self._lock = threading.Lock()
+        self._executor: Any = None
+
+    def _pool_executor(self) -> Any:
+        if self._executor is None:
+            with self._lock:
+                if self._executor is None:
+                    import concurrent.futures
+
+                    self._executor = concurrent.futures.ThreadPoolExecutor(
+                        max_workers=_EXECUTOR_WORKERS,
+                        thread_name_prefix="hl-tpu-fanout",
+                    )
+        return self._executor
+
+    def width_for(self, n_items: int, pool: ConnectionPool | None) -> int:
+        if pool is None:
+            return max(1, min(n_items, self.max_width))
+        return choose_width(
+            n_items,
+            idle=pool.idle_count(),
+            connect_ms=pool.connect_ewma_ms(),
+            rtt_ms=pool.rtt_ewma_ms(),
+            max_width=min(self.max_width, pool.max_per_host),
+        )
+
+    def map(
+        self,
+        fn: Callable[[_T], _R],
+        items: Sequence[_T],
+        *,
+        pool: ConnectionPool | None = None,
+    ) -> list[_R]:
+        """``[fn(x) for x in items]`` at the chosen width, results in
+        input order. Exceptions propagate (the Prometheus clients wrap
+        ``fn`` in their own per-query ApiError catch, so a raise here
+        is a programming error, not a network blip)."""
+        n = len(items)
+        if n == 0:
+            return []
+        width = self.width_for(n, pool)
+        if width <= 1 or n == 1:
+            return [fn(item) for item in items]
+        import contextvars
+
+        executor = self._pool_executor()
+        chunks = [list(range(i, n, width)) for i in range(width)]
+
+        def run_chunk(indices: list[int]) -> list[tuple[int, _R]]:
+            return [(i, fn(items[i])) for i in indices]
+
+        futures = [
+            executor.submit(contextvars.copy_context().run, run_chunk, chunk)
+            for chunk in chunks
+        ]
+        out: list[Any] = [None] * n
+        for future in futures:
+            for i, result in future.result():
+                out[i] = result
+        return out
+
+
+#: THE process fan-out scheduler — the Prometheus clients and the
+#: context's imperative track share its workers.
+fanout = FanoutScheduler()
+
+
+def pool_of(transport: Any) -> ConnectionPool | None:
+    """The transport's connection pool when it has one (KubeTransport),
+    else None (MockTransport and friends) — the seam fan-out callers
+    use so width policy engages exactly when real sockets are in play."""
+    pool = getattr(transport, "pool", None)
+    return pool if isinstance(pool, ConnectionPool) else None

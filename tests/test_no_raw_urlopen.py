@@ -1,0 +1,103 @@
+"""Raw-urlopen gate (tools/no_raw_urlopen_check.py, ADR-014).
+
+Two halves, mirroring tests/test_ts_static.py:
+  1. The gate itself: the live repo tree must be clean — every HTTP
+     call outside ``headlamp_tpu/transport/`` goes through the pooled
+     transport, never raw ``urllib.request.urlopen``.
+  2. Mutation coverage: sources that smuggle urlopen in (direct
+     attribute call, ``from urllib.request import urlopen``, module
+     alias, bare-reference callback) must each produce a diagnostic —
+     and the sanctioned forms (transport/ itself, a same-named method
+     on another object, prose mentions) must not.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from no_raw_urlopen_check import _check_source, check_tree  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_repo_tree_is_clean():
+    diagnostics = check_tree(REPO)
+    assert diagnostics == [], "\n".join(str(d) for d in diagnostics)
+
+
+class TestMutations:
+    def _diags(self, src):
+        return _check_source("mut.py", src)
+
+    def test_direct_attribute_call_flagged(self):
+        diags = self._diags(
+            "import urllib.request\n"
+            "resp = urllib.request.urlopen('http://x')\n"
+        )
+        assert len(diags) == 1
+        assert diags[0].line == 2
+
+    def test_from_import_flagged(self):
+        diags = self._diags(
+            "from urllib.request import urlopen\n"
+            "resp = urlopen('http://x')\n"
+        )
+        assert len(diags) == 1
+
+    def test_aliased_from_import_flagged(self):
+        diags = self._diags(
+            "from urllib.request import urlopen as fetch\n"
+            "resp = fetch('http://x')\n"
+        )
+        assert len(diags) == 1
+
+    def test_module_alias_flagged(self):
+        diags = self._diags(
+            "import urllib.request as req\n"
+            "resp = req.urlopen('http://x')\n"
+        )
+        assert len(diags) == 1
+
+    def test_from_urllib_import_request_flagged(self):
+        diags = self._diags(
+            "from urllib import request\n"
+            "resp = request.urlopen('http://x')\n"
+        )
+        assert len(diags) == 1
+
+    def test_bare_reference_as_callback_flagged(self):
+        # Passing urlopen as a callable bypasses the pool identically.
+        diags = self._diags(
+            "from urllib.request import urlopen\n"
+            "fetch_all(urlopen, urls)\n"
+        )
+        assert len(diags) == 1
+
+    def test_unrelated_urlopen_attribute_not_flagged(self):
+        # A same-named method on some other object is not the stdlib's.
+        diags = self._diags("client.urlopen('http://x')\n")
+        assert diags == []
+
+    def test_prose_and_strings_not_flagged(self):
+        diags = self._diags(
+            '"""docs mention urllib.request.urlopen freely."""\n'
+            "note = 'urllib.request.urlopen'\n"
+        )
+        assert diags == []
+
+    def test_transport_dir_is_exempt(self, tmp_path):
+        pkg = tmp_path / "headlamp_tpu" / "transport"
+        pkg.mkdir(parents=True)
+        (pkg / "impl.py").write_text(
+            "import urllib.request\nurllib.request.urlopen('http://x')\n"
+        )
+        outside = tmp_path / "headlamp_tpu" / "other.py"
+        outside.write_text(
+            "import urllib.request\nurllib.request.urlopen('http://x')\n"
+        )
+        diags = check_tree(str(tmp_path))
+        assert len(diags) == 1
+        assert "other.py" in diags[0].path

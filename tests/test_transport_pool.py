@@ -1,0 +1,363 @@
+"""Connection-pool tests (ADR-014): reuse, checkout cap, idle eviction,
+stale-socket retry-once, dual accounting, and fan-out width policy.
+
+All socket-level behaviors run against a real local HTTP/1.1 keep-alive
+server (ThreadingHTTPServer) whose accept path counts and retains every
+TCP connection — so "the pool reused a socket" is asserted from the
+SERVER's accept count, not from the pool's own bookkeeping, and the
+stale-retry test can kill live sockets server-side to force the
+peer-closed race deterministically.
+"""
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from headlamp_tpu.obs.metrics import registry
+from headlamp_tpu.transport import ApiError, KubeTransport
+from headlamp_tpu.transport.pool import (
+    ConnectionPool,
+    FanoutScheduler,
+    PoolExhausted,
+    choose_width,
+)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"  # keep-alive by default
+
+    def do_GET(self):
+        if self.path.startswith("/slow"):
+            time.sleep(self.server.slow_s)
+        if self.path.startswith("/missing"):
+            status, body = 404, b'{"kind":"Status","code":404}'
+        else:
+            status, body = 200, json.dumps({"path": self.path}).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # keep test output clean
+        pass
+
+
+class _CountingServer(ThreadingHTTPServer):
+    """Counts accepted TCP connections and retains the sockets so tests
+    can kill them out from under the pool."""
+
+    daemon_threads = True
+    slow_s = 0.0
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.connects = 0
+        self.client_sockets = []
+        self._accept_lock = threading.Lock()
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        with self._accept_lock:
+            self.connects += 1
+            self.client_sockets.append(sock)
+        return sock, addr
+
+    def kill_connections(self):
+        """Hard-close every accepted socket — the 'idle keep-alive
+        connection the peer dropped' scenario."""
+        with self._accept_lock:
+            for sock in self.client_sockets:
+                # shutdown(), not just close(): the handler thread's
+                # makefile() holds fd references, so close() alone is
+                # deferred — shutdown tears the TCP stream down NOW.
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self.client_sockets.clear()
+        # Let the handler threads observe the close before the test
+        # issues its next request.
+        time.sleep(0.02)
+
+
+@pytest.fixture()
+def server():
+    srv = _CountingServer(("127.0.0.1", 0), _Handler)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def _url(server, path="/x"):
+    return f"http://127.0.0.1:{server.server_address[1]}{path}"
+
+
+def _counter_total(name):
+    """Sum a registry counter across its label children."""
+    for instrument in registry:
+        if instrument.name == name:
+            return sum(value for _labels, value in instrument.samples())
+    return 0.0
+
+
+class TestReuse:
+    def test_sequential_requests_share_one_connection(self, server):
+        pool = ConnectionPool()
+        for i in range(6):
+            with pool.request(_url(server, f"/q{i}")) as resp:
+                assert resp.status == 200
+                assert json.loads(resp.read()) == {"path": f"/q{i}"}
+        assert server.connects == 1  # the server's ground truth
+        assert pool.opened == 1
+        assert pool.reused == 5
+        assert pool.snapshot()["reuse_rate"] == pytest.approx(5 / 6, abs=1e-3)
+
+    def test_non_2xx_response_still_reuses_connection(self, server):
+        # The old urlopen path leaked the HTTPError response on non-2xx;
+        # the pool must instead drain it and keep the socket — a 404 is
+        # a normal apiserver answer (absent CRD), not a broken peer.
+        pool = ConnectionPool()
+        with pool.request(_url(server, "/missing")) as resp:
+            assert resp.status == 404
+            resp.read()
+        with pool.request(_url(server, "/ok")) as resp:
+            assert resp.status == 200
+            resp.read()
+        assert server.connects == 1
+        assert pool.reused == 1
+
+    def test_unread_body_discards_socket(self, server):
+        # close() without read(): unread bytes may sit on the socket, so
+        # it must NOT return to the pool.
+        pool = ConnectionPool()
+        with pool.request(_url(server)) as resp:
+            assert resp.status == 200  # body intentionally unread
+        assert pool.idle_count() == 0
+        with pool.request(_url(server)) as resp:
+            resp.read()
+        assert pool.opened == 2
+
+    def test_kube_transport_layers_on_pool(self, server):
+        transport = KubeTransport(_url(server, ""))
+        for i in range(3):
+            assert transport.request(f"/a{i}") == {"path": f"/a{i}"}
+        with pytest.raises(ApiError) as excinfo:
+            transport.request("/missing")
+        assert excinfo.value.status == 404
+        assert transport.request("/after") == {"path": "/after"}
+        assert server.connects == 1
+        assert transport.pool.reused == 4
+
+
+class TestCheckoutCap:
+    def test_concurrent_fanout_respects_max_per_host(self, server):
+        server.slow_s = 0.15
+        pool = ConnectionPool(max_per_host=2)
+        errors = []
+
+        def one(i):
+            try:
+                with pool.request(_url(server, f"/slow/{i}"), timeout_s=5.0) as r:
+                    assert r.status == 200
+                    r.read()
+            except Exception as exc:  # noqa: BLE001 — collected for assert
+                errors.append(exc)
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        # 6 concurrent requests over a cap of 2: the first wave opens 2
+        # sockets, every later request blocks for a slot then reuses an
+        # idle socket — the server must never see a 3rd handshake.
+        assert server.connects == 2
+        assert pool.opened == 2
+        assert pool.reused == 4
+        assert pool.open_connections <= 2
+
+    def test_exhausted_checkout_raises_pool_exhausted(self, server):
+        pool = ConnectionPool(max_per_host=1)
+        held = pool.request(_url(server, "/held"))  # slot checked out
+        try:
+            with pytest.raises(PoolExhausted):
+                pool.request(_url(server, "/blocked"), timeout_s=0.05)
+        finally:
+            held.read()
+            held.close()
+        # Slot freed: the next request proceeds (and reuses the socket).
+        with pool.request(_url(server, "/after")) as resp:
+            assert resp.status == 200
+            resp.read()
+        assert pool.opened == 1
+
+
+class TestIdleEviction:
+    def test_idle_ttl_evicts_and_reopens(self, server):
+        clock = [0.0]
+        pool = ConnectionPool(idle_ttl_s=30.0, monotonic=lambda: clock[0])
+        with pool.request(_url(server)) as resp:
+            resp.read()
+        assert pool.idle_count() == 1
+
+        clock[0] = 10.0  # inside the TTL: reuse
+        with pool.request(_url(server)) as resp:
+            resp.read()
+        assert pool.reused == 1
+
+        clock[0] = 50.0  # 40 s idle > 30 s TTL: evict, fresh handshake
+        with pool.request(_url(server)) as resp:
+            resp.read()
+        assert pool.evicted == 1
+        assert pool.opened == 2
+        assert server.connects == 2
+
+    def test_idle_overflow_evicts_lru(self, server):
+        server.slow_s = 0.1
+        pool = ConnectionPool(max_per_host=4, max_idle_per_host=1)
+        responses = [pool.request(_url(server, f"/slow/{i}")) for i in range(3)]
+        for resp in responses:
+            resp.read()
+            resp.close()
+        # 3 concurrent checkouts needed 3 sockets, but only 1 may stay
+        # idle; the 2 surplus ones are closed at check-in.
+        assert pool.opened == 3
+        assert pool.idle_count() == 1
+        assert pool.evicted == 2
+
+
+class TestStaleRetry:
+    def test_peer_closed_idle_socket_retries_once(self, server):
+        pool = ConnectionPool()
+        with pool.request(_url(server, "/warm")) as resp:
+            resp.read()
+        server.kill_connections()
+        # The pool cannot know the socket died; the request must fail
+        # internally and transparently retry on a fresh connection.
+        with pool.request(_url(server, "/retry")) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read()) == {"path": "/retry"}
+        assert pool.stale_retries == 1
+        assert pool.opened == 2
+
+    def test_fresh_connection_failure_propagates(self, server):
+        # A failure on a FRESH socket is a real error — no retry loop.
+        pool = ConnectionPool()
+        port = server.server_address[1]
+        server.shutdown()
+        server.server_close()
+        with pytest.raises(OSError):
+            pool.request(f"http://127.0.0.1:{port}/x", timeout_s=0.5)
+        assert pool.stale_retries == 0
+        assert pool.open_connections == 0
+
+    def test_kube_transport_surfaces_stale_retry_transparently(self, server):
+        transport = KubeTransport(_url(server, ""))
+        assert transport.request("/a") == {"path": "/a"}
+        server.kill_connections()
+        assert transport.request("/b") == {"path": "/b"}
+        assert transport.pool.stale_retries == 1
+
+
+class TestDualAccounting:
+    def test_pool_ints_and_registry_counters_agree(self, server):
+        """The /healthz ints (per-pool) and the /metricsz counters
+        (process registry) are written on the same transitions — their
+        deltas over any scenario must match exactly."""
+        before = {
+            name: _counter_total(f"headlamp_tpu_transport_{name}")
+            for name in (
+                "connections_opened_total",
+                "connections_reused_total",
+                "idle_evicted_total",
+                "stale_retries_total",
+            )
+        }
+        clock = [0.0]
+        pool = ConnectionPool(idle_ttl_s=30.0, monotonic=lambda: clock[0])
+        for _ in range(3):  # 1 open + 2 reuses
+            with pool.request(_url(server)) as resp:
+                resp.read()
+        clock[0] = 100.0  # TTL eviction + fresh open
+        with pool.request(_url(server)) as resp:
+            resp.read()
+        server.kill_connections()  # stale retry + fresh open
+        with pool.request(_url(server)) as resp:
+            resp.read()
+
+        deltas = {
+            name: _counter_total(f"headlamp_tpu_transport_{name}") - before[name]
+            for name in before
+        }
+        assert deltas["connections_opened_total"] == pool.opened == 3
+        assert deltas["connections_reused_total"] == pool.reused == 3
+        assert deltas["idle_evicted_total"] == pool.evicted == 1
+        assert deltas["stale_retries_total"] == pool.stale_retries == 1
+        snap = pool.snapshot()
+        assert snap["connections_opened"] == pool.opened
+        assert snap["connections_reused"] == pool.reused
+
+    def test_pool_size_gauge_tracks_open_connections(self, server):
+        rendered = registry.render()
+        assert "headlamp_tpu_transport_pool_connections_count" in rendered
+        pool = ConnectionPool()
+        with pool.request(_url(server)) as resp:
+            resp.read()
+        assert pool.open_connections == 1
+        line = next(
+            line
+            for line in registry.render().splitlines()
+            if line.startswith("headlamp_tpu_transport_pool_connections_count")
+        )
+        assert float(line.split()[-1]) >= 1.0
+        pool.close()
+        assert pool.open_connections == 0
+
+
+class TestFanoutWidth:
+    def test_unknown_stats_full_width(self):
+        # Cold pool / mock transport: nothing to budget against.
+        assert choose_width(8, idle=0, connect_ms=None, rtt_ms=None) == 8
+        assert choose_width(3, idle=0, connect_ms=None, rtt_ms=None) == 3
+
+    def test_idle_sockets_are_free_width(self):
+        # Plenty of idle sockets: use them all (capped), no debate.
+        assert choose_width(8, idle=8, connect_ms=50.0, rtt_ms=10.0) == 8
+
+    def test_expensive_connects_narrow_the_fanout(self):
+        # Connect costs 200 ms, RTT 10 ms, nothing idle: widening 1→2
+        # saves 16·10·(1-1/2) = 80 ms serial time but costs a 200 ms
+        # handshake — stay narrow.
+        assert choose_width(16, idle=0, connect_ms=200.0, rtt_ms=10.0) == 1
+
+    def test_cheap_connects_widen_to_cap(self):
+        # Connect ~1 ms against 90 ms RTT: handshakes always pay off.
+        assert choose_width(16, idle=0, connect_ms=1.0, rtt_ms=90.0) == 8
+
+    def test_marginal_saving_cutoff(self):
+        # 8 items, RTT 100 ms: width 2→3 saves 8·100·(1/2-1/3)=133 ms;
+        # 3→4 saves 67 ms. A 100 ms connect stops exactly at width 3.
+        assert choose_width(8, idle=0, connect_ms=100.0, rtt_ms=100.0) == 3
+
+    def test_map_preserves_order_and_runs_all(self):
+        sched = FanoutScheduler()
+        items = list(range(23))
+        assert sched.map(lambda x: x * 2, items) == [x * 2 for x in items]
+
+    def test_map_serial_when_single_item(self):
+        sched = FanoutScheduler()
+        tid = []
+        sched.map(lambda _x: tid.append(threading.get_ident()), [1])
+        assert tid == [threading.get_ident()]  # no executor hop

@@ -1459,7 +1459,15 @@ def main() -> int:
     for diag in diagnostics:
         print(diag)
     print(f"{len(diagnostics)} problem(s) in {root}")
-    return 1 if diagnostics else 0
+    # Python-side transport gate rides the same entry point (ADR-014):
+    # no raw urllib.request.urlopen outside headlamp_tpu/transport/.
+    import no_raw_urlopen_check
+
+    urlopen_diags = no_raw_urlopen_check.check_tree()
+    for diag in urlopen_diags:
+        print(diag)
+    print(f"{len(urlopen_diags)} raw-urlopen problem(s)")
+    return 1 if diagnostics or urlopen_diags else 0
 
 
 if __name__ == "__main__":
