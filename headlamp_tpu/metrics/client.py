@@ -31,6 +31,7 @@ pages render the guided "install kube-prometheus/GMP" box, never crash.
 
 from __future__ import annotations
 
+import re
 import time
 import urllib.parse
 import weakref
@@ -192,6 +193,64 @@ NODE_MAP_QUERY = "node_uname_info"
 
 
 # ---------------------------------------------------------------------------
+# Batched scrape (ADR-015): matcher-joined instant queries
+# ---------------------------------------------------------------------------
+
+#: ``name`` or ``name{selector}`` — the only shapes our candidate
+#: queries take. Anything fancier (functions, offsets) is unbatchable
+#: and keeps its own request.
+_SELECTOR_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?$")
+
+
+def _parse_selector(promql: str) -> tuple[str, str] | None:
+    """Split a simple series selector into (metric name, label selector
+    body) — ``("duty_cycle", 'accelerator=~"tpu.*"')`` — or None when
+    the expression is not a plain selector."""
+    m = _SELECTOR_RE.match(promql)
+    if m is None:
+        return None
+    return m.group(1), m.group(2) or ""
+
+
+def batched_instant_queries(
+    queries: list[str],
+) -> list[tuple[str, dict[str, str]]]:
+    """Union per-metric instant queries into matcher-joined batches:
+    every candidate sharing a label selector collapses into ONE
+    ``{__name__=~"a|b|c",selector}`` query, and the response demuxes
+    back per metric by the ``__name__`` label. Our 16-query fan-out
+    (15 candidates + node map) folds into 2 batches — the single
+    biggest term in BENCH_r06's 28 HTTP requests per paint.
+
+    Returns ``[(batched_promql, {series_name: original_promql})]`` in
+    first-seen selector order; an unbatchable expression rides along as
+    its own singleton batch so callers need no special case."""
+    groups: dict[str, list[tuple[str, str]]] = {}
+    order: list[str] = []
+    out: list[tuple[str, dict[str, str]]] = []
+    for promql in queries:
+        parsed = _parse_selector(promql)
+        if parsed is None:
+            out.append((promql, {promql: promql}))
+            continue
+        name, selector = parsed
+        if selector not in groups:
+            groups[selector] = []
+            order.append(selector)
+        if all(name != n for n, _ in groups[selector]):
+            groups[selector].append((name, promql))
+    for selector in order:
+        pairs = groups[selector]
+        # Metric names are [a-zA-Z0-9_:] — no regex metacharacters —
+        # so the alternation needs no escaping. Anchored: Prometheus
+        # fully anchors __name__=~ itself.
+        matcher = "__name__=~\"" + "|".join(n for n, _ in pairs) + "\""
+        body = matcher + ("," + selector if selector else "")
+        out.append(("{" + body + "}", {n: q for n, q in pairs}))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Result model
 # ---------------------------------------------------------------------------
 
@@ -327,17 +386,64 @@ _FRACTION_METRICS = (
 FRACTION_MAX = 1.2
 
 
+def _strip_name_label(sample: Mapping[str, Any]) -> Mapping[str, Any]:
+    """Demuxed sample minus its ``__name__`` label, for exact parity
+    with what the corresponding per-metric query returns from the
+    fixtures (the join itself never reads ``__name__``)."""
+    labels = _sample_labels(sample)
+    if "__name__" not in labels:
+        return sample
+    out = dict(sample)
+    out["metric"] = {k: v for k, v in labels.items() if k != "__name__"}
+    return out
+
+
+def _fanout_batched(
+    run_query: Callable[[str], list[Mapping[str, Any]]],
+    queries: list[str],
+    pool: Any,
+) -> dict[str, list[Mapping[str, Any]]]:
+    """Run the instant-query fan-out as matcher-joined batches, demuxing
+    per-candidate samples by ``__name__``. Batching is an OPTIMIZATION,
+    never a dependency (the Pallas policy): a batch that fails at the
+    transport layer, returns non-success, or comes back EMPTY falls
+    back to its member queries one by one — some frontends (GMP) are
+    entitled to reject a cross-metric ``__name__`` regex, and an empty
+    batch is indistinguishable from that rejection, so only the
+    unbatched answer is treated as authoritative."""
+    batches = batched_instant_queries(queries)
+    batch_results = fanout.map(run_query, [b[0] for b in batches], pool=pool)
+    results: dict[str, list[Mapping[str, Any]]] = {q: [] for q in queries}
+    fallback: list[str] = []
+    for (_, by_name), samples in zip(batches, batch_results):
+        if not samples:
+            fallback.extend(by_name.values())
+            continue
+        for sample in samples:
+            target = by_name.get(str(_sample_labels(sample).get("__name__", "")))
+            if target is not None:
+                results[target].append(_strip_name_label(sample))
+    if fallback:
+        for q, r in zip(fallback, fanout.map(run_query, fallback, pool=pool)):
+            results[q] = r
+    return results
+
+
 def fetch_tpu_metrics(
     transport: Transport,
     *,
     timeout_s: float = 2.0,
     clock: Callable[[], float] = time.time,
     prometheus: tuple[str, str] | None = None,
+    batched: bool = True,
 ) -> TpuMetricsSnapshot | None:
     """Discover Prometheus (unless ``prometheus`` pins it; cached per
     transport otherwise), fan out all logical-metric candidate queries
-    plus the node map in parallel over the transport's connection pool,
-    and join into per-chip rows. None when no Prometheus answers."""
+    plus the node map over the transport's connection pool — as two
+    matcher-joined batched queries by default (ADR-015), or one request
+    per candidate with ``batched=False`` (the escape hatch and the
+    parity baseline) — and join into per-chip rows. None when no
+    Prometheus answers."""
     t_start = time.perf_counter()
     # ADR-013 stage spans: discovery (the candidate-chain probe — the
     # whole chain times out serially against a dark cluster, which is
@@ -351,36 +457,44 @@ def fetch_tpu_metrics(
     namespace, service = found
 
     transport_failures: list[str] = []
+    issued: list[str] = []
 
     def run_query(promql: str) -> list[Mapping[str, Any]]:
+        issued.append(promql)  # list.append is GIL-atomic
         try:
             data = transport.request(
                 _proxy_query_path(namespace, service, promql), timeout_s
             )
         except ApiError:
-            transport_failures.append(promql)  # list.append is GIL-atomic
+            transport_failures.append(promql)
             return []
         return _vector_result(data)
 
     # Fan out: every candidate of every logical metric plus the node map
-    # in one parallel wave — one slow series costs max(latency), not
-    # sum(latency). Candidate order still decides which result is used.
-    # The shared scheduler sizes the wave from the pool's RTT stats:
-    # idle pooled sockets are free width, extra sockets must earn their
-    # handshake (ADR-014).
+    # — batched into matcher-joined queries by default (two requests
+    # instead of sixteen), or one parallel wave per candidate — so one
+    # slow series costs max(latency), not sum(latency). Candidate order
+    # still decides which result is used. The shared scheduler sizes
+    # each wave from the pool's RTT stats: idle pooled sockets are free
+    # width, extra sockets must earn their handshake (ADR-014).
     queries: list[str] = [NODE_MAP_QUERY]
     for candidates in LOGICAL_METRICS.values():
         queries.extend(candidates)
-    with _span("metrics.fanout", queries=len(queries), service=service):
-        results = dict(
-            zip(queries, fanout.map(run_query, queries, pool=pool_of(transport)))
-        )
+    pool = pool_of(transport)
+    with _span(
+        "metrics.fanout", queries=len(queries), service=service, batched=batched
+    ):
+        if batched:
+            results = _fanout_batched(run_query, queries, pool)
+        else:
+            results = dict(zip(queries, fanout.map(run_query, queries, pool=pool)))
 
-    if len(transport_failures) == len(queries):
-        # Every single query failed at the transport layer: the
-        # discovered service is gone (rolled, rescheduled). Drop the
-        # cached discovery so the next fetch re-probes the chain
-        # instead of fanning out against a corpse forever.
+    if issued and len(transport_failures) == len(issued):
+        # Every query actually issued (batched AND the per-metric
+        # fallbacks) failed at the transport layer: the discovered
+        # service is gone (rolled, rescheduled). Drop the cached
+        # discovery so the next fetch re-probes the chain instead of
+        # fanning out against a corpse forever.
         invalidate_prometheus(transport)
 
     instance_map = _build_instance_map(results[NODE_MAP_QUERY])

@@ -12,7 +12,9 @@ BASELINE metrics page gains on top of parity.
 from .forecast import (
     ForecastConfig,
     InferenceDispatch,
+    WarmState,
     fit_and_forecast,
+    fit_and_forecast_incremental,
     fit_and_forecast_with_dispatch,
     forecast_next,
     forecast_next_with_dispatch,
@@ -28,7 +30,9 @@ from .forecast import (
 __all__ = [
     "ForecastConfig",
     "InferenceDispatch",
+    "WarmState",
     "fit_and_forecast",
+    "fit_and_forecast_incremental",
     "fit_and_forecast_with_dispatch",
     "forecast_next",
     "forecast_next_with_dispatch",

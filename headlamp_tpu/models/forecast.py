@@ -157,17 +157,31 @@ class InferenceDispatch(NamedTuple):
     upgrade must show up in /healthz-adjacent surfaces and the bench,
     not vanish behind the XLA fallback)."""
 
-    path: str                        #: "pallas" | "xla" | "repeat"
+    path: str                        #: "pallas[-warm]" | "xla[-warm]" | "repeat"
     fallback_reason: str | None = None  #: set when Pallas was tried and failed
     #: Final training MSE of the fit, as a DEVICE scalar (None on the
     #: persistence path) — callers materialize it together with the
     #: predictions in one device_get; a separate float() would cost an
-    #: extra round-trip over a tunneled chip.
+    #: extra round-trip over a tunneled chip. The incremental entry
+    #: (:func:`fit_and_forecast_incremental`) sets it to a HOST float
+    #: instead — the demotion check already paid the fetch.
     fit_mse: Any = None
+    #: Generation of the :class:`WarmState` this fit refined (ADR-015).
+    #: Set on warm fits AND on demoted-to-cold fits (the carry was
+    #: consulted either way); None on a from-scratch cold fit.
+    carried_from_generation: int | None = None
+    #: Why a warm refinement was thrown away for a cold refit — the
+    #: never-silent half of the demotion policy (same contract as the
+    #: Pallas ``fallback_reason``). None unless a demotion happened.
+    warm_demotion_reason: str | None = None
 
     @property
     def used_pallas(self) -> bool:
-        return self.path == "pallas"
+        return self.path in ("pallas", "pallas-warm")
+
+    @property
+    def warm(self) -> bool:
+        return self.path.endswith("-warm")
 
 
 def forecast_next_with_dispatch(
@@ -204,25 +218,25 @@ def forecast_next(
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps"))
-def _fit_program(
+def _warm_fit_program(
     series: jax.Array,
-    key: jax.Array,
+    params: Params,
+    opt_state: Any,
     cfg: ForecastConfig,
     steps: int,
-) -> tuple[Params, jax.Array]:
-    """windowing → init → ``steps`` optimizer steps (lax.scan) →
-    (fitted params, final training MSE), as ONE XLA program. A Python
-    training loop would issue one device dispatch per step — tens of
-    round-trips on a remote/tunneled TPU for a fit the fused program
-    finishes in a single dispatch; the windowing (``make_windows``'s
-    gathers) is fused in too, because each un-jitted jnp op is its own
-    dispatch and over a tunneled chip those round-trips dominate the
-    whole fit. The final MSE travels with the params so surfacing fit
-    quality costs no extra dispatch."""
+) -> tuple[Params, Any, jax.Array]:
+    """windowing → ``steps`` optimizer steps (lax.scan) from the GIVEN
+    ``(params, opt_state)`` → (params, opt_state, final training MSE),
+    as ONE XLA program. This is THE training program: the cold fit is
+    exactly this program seeded from a fresh init (see
+    :func:`_fit_program_with_state`), so warm refinement and cold fit
+    can never train different models — there is only one scan body. A
+    Python training loop would issue one device dispatch per step —
+    tens of round-trips on a remote/tunneled TPU for a fit the fused
+    program finishes in a single dispatch; the windowing
+    (``make_windows``'s gathers) is fused in too."""
     x, y = make_windows(series, cfg.window, cfg.horizon)
-    params = init_params(key, cfg)
     optimizer = optax.adam(cfg.learning_rate)
-    opt_state = optimizer.init(params)
 
     def body(
         carry: tuple[Params, Any], _: None
@@ -233,12 +247,104 @@ def _fit_program(
         p = optax.apply_updates(p, updates)
         return (p, s), loss
 
-    (params, _), _ = jax.lax.scan(body, (params, opt_state), None, length=steps)
+    (params, opt_state), _ = jax.lax.scan(
+        body, (params, opt_state), None, length=steps
+    )
     # Self-assessment of the RETURNED model: scan losses are computed
     # before each update, so losses[-1] would describe the penultimate
     # params. One more loss_fn at the final params stays in the fused
     # program — negligible next to the scan.
-    return params, loss_fn(params, x, y)
+    return params, opt_state, loss_fn(params, x, y)
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps"))
+def _fit_program_with_state(
+    series: jax.Array,
+    key: jax.Array,
+    cfg: ForecastConfig,
+    steps: int,
+) -> tuple[Params, Any, jax.Array]:
+    """Cold fit that also surfaces the optimizer state: fresh init →
+    the shared training scan (nested jit inlines into this trace) →
+    (params, opt_state, final MSE). The state is what ADR-015's warm
+    starts carry across TTL windows."""
+    params = init_params(key, cfg)
+    opt_state = optax.adam(cfg.learning_rate).init(params)
+    return _warm_fit_program(series, params, opt_state, cfg, steps)
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps"))
+def _fit_program(
+    series: jax.Array,
+    key: jax.Array,
+    cfg: ForecastConfig,
+    steps: int,
+) -> tuple[Params, jax.Array]:
+    """(fitted params, final training MSE) — the historical cold-fit
+    surface (bench parity checks use it), now a thin view over
+    :func:`_fit_program_with_state`."""
+    params, _, final_loss = _fit_program_with_state(series, key, cfg, steps)
+    return params, final_loss
+
+
+def _infer_recent(
+    params: Params, series: jax.Array, cfg: ForecastConfig,
+    inference: str, batch_p: int,
+) -> jax.Array:
+    """Inference stage shared by every fused program: predict the next
+    horizon from each trace's latest window, via the Pallas kernel or
+    XLA forward (chosen statically at trace time)."""
+    recent = series[:, -cfg.window:]
+    if inference == "pallas":
+        from .pallas_forward import forecast_forward_padded
+
+        return forecast_forward_padded(
+            params, recent, batch_p=batch_p, horizon=cfg.horizon, interpret=False
+        )
+    return forward(params, recent)
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "inference", "batch_p"))
+def _warm_fit_forecast_program(
+    series: jax.Array,
+    params: Params,
+    opt_state: Any,
+    cfg: ForecastConfig,
+    steps: int,
+    inference: str,
+    batch_p: int,
+) -> tuple[jax.Array, Params, Any, jax.Array]:
+    """Warm refinement + inference as ONE XLA program / ONE dispatch:
+    windowing → short refinement scan from the carried ``(params,
+    opt_state)`` → inference, returning ``(predictions, params,
+    opt_state, final MSE)`` so the caller can carry the refined state
+    into the next TTL window. The fit is :func:`_warm_fit_program`
+    itself — nested jit inlines into this trace, so warm serving and
+    the standalone warm fit can never train different models."""
+    params, opt_state, final_loss = _warm_fit_program(
+        series, params, opt_state, cfg, steps
+    )
+    out = _infer_recent(params, series, cfg, inference, batch_p)
+    return out, params, opt_state, final_loss
+
+
+@partial(jax.jit, static_argnames=("cfg", "steps", "inference", "batch_p"))
+def _fit_forecast_state_program(
+    series: jax.Array,
+    key: jax.Array,
+    cfg: ForecastConfig,
+    steps: int,
+    inference: str,
+    batch_p: int,
+) -> tuple[jax.Array, Params, Any, jax.Array]:
+    """Cold fit + inference as ONE program, also surfacing the fitted
+    ``(params, opt_state)`` for the ADR-015 warm-start carry. Built on
+    :func:`_fit_program_with_state` (fresh init → the shared training
+    scan), so this and :func:`_warm_fit_forecast_program` train via the
+    SAME scan body."""
+    params, opt_state, final_loss = _fit_program_with_state(series, key, cfg, steps)
+    out = _infer_recent(params, series, cfg, inference, batch_p)
+    return out, params, opt_state, final_loss
 
 
 @partial(jax.jit, static_argnames=("cfg", "steps", "inference", "batch_p"))
@@ -257,19 +363,11 @@ def _fit_forecast_program(
     (BENCH_r03 measured the rollup's dispatch at ~150 ms end-to-end),
     so fusing the pair nearly halves the serving-path forecast cost.
 
-    The fit is :func:`_fit_program` itself — nested jit inlines into the
-    enclosing trace, so the serving path and the standalone fit (which
-    the bench's parity check uses) can never train different models."""
-    params, final_loss = _fit_program(series, key, cfg, steps)
-    recent = series[:, -cfg.window:]
-    if inference == "pallas":
-        from .pallas_forward import forecast_forward_padded
-
-        out = forecast_forward_padded(
-            params, recent, batch_p=batch_p, horizon=cfg.horizon, interpret=False
-        )
-    else:
-        out = forward(params, recent)
+    Thin view over :func:`_fit_forecast_state_program` for callers that
+    don't carry warm state."""
+    out, _, _, final_loss = _fit_forecast_state_program(
+        series, key, cfg, steps, inference, batch_p
+    )
     return out, final_loss
 
 
@@ -340,3 +438,164 @@ def fit_and_forecast(
     """:func:`fit_and_forecast_with_dispatch` without the record."""
     out, _ = fit_and_forecast_with_dispatch(series, cfg, steps=steps, seed=seed)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Warm-start incremental fitting (ADR-015)
+# ---------------------------------------------------------------------------
+
+#: Refinement steps for a warm fit. The carried params already sit near
+#: a minimum for this fleet's dynamics; ~1/6 of the cold budget tracks
+#: the drift between TTL windows.
+WARM_STEPS = 10
+
+#: A warm fit whose final MSE exceeds ``tolerance × last cold MSE``
+#: self-demotes to a cold refit. 2× leaves headroom for ordinary drift
+#: while catching a carry that has gone stale (fleet regime change,
+#: optimizer state poisoned by a pathological window).
+COLD_MSE_TOLERANCE = 2.0
+
+#: Absolute MSE floor for the demotion comparison: near-zero cold MSEs
+#: (flat synthetic traces) would otherwise make ANY warm fit look like a
+#: regression by ratio alone.
+_DEMOTION_MSE_FLOOR = 1e-4
+
+
+class WarmState(NamedTuple):
+    """Fitted params + optimizer state carried across TTL windows, keyed
+    by fleet content (the caller owns the keying — see
+    ``DashboardApp._metrics_key``). ``cold_mse`` anchors the demotion
+    check; ``generation`` counts cold fits for this key so dispatch
+    records can say which lineage a warm fit refined."""
+
+    params: Params
+    opt_state: Any
+    cold_mse: float        #: host float — the fetch was already paid
+    generation: int        #: increments on every cold (re)fit
+    cfg: ForecastConfig    #: carry is invalid if the caller's cfg changed
+    n_chips: int           #: param shapes are chip-count-independent, but
+                           #: a fleet resize means different dynamics
+
+
+def _platform_and_pallas(
+    cfg: ForecastConfig, n_chips: int
+) -> tuple[str, int, str | None]:
+    """Resolve the inference path exactly like the cold entry: returns
+    ``(inference, batch_p, fallback_reason)`` — ``("pallas", p, None)``
+    on a healthy TPU backend, else ``("xla", 0, reason-or-None)``."""
+    if jax.devices()[0].platform == "tpu" and _pallas_broken_reason is None:
+        try:
+            from .pallas_forward import check_single_tile, pallas_batch_p
+
+            check_single_tile(cfg.window, cfg.hidden, cfg.horizon)
+            return "pallas", pallas_batch_p(n_chips), None
+        except Exception as exc:  # noqa: BLE001 — optimization, not a dependency
+            _record_pallas_broken(f"{type(exc).__name__}: {exc}"[:200])
+    return "xla", 0, _pallas_broken_reason
+
+
+def fit_and_forecast_incremental(
+    series: jax.Array,
+    cfg: ForecastConfig | None = None,
+    *,
+    state: WarmState | None = None,
+    steps: int = 60,
+    warm_steps: int = WARM_STEPS,
+    seed: int = 0,
+    cold_mse_tolerance: float = COLD_MSE_TOLERANCE,
+) -> tuple[jax.Array, InferenceDispatch, WarmState | None]:
+    """Warm-start entry: refine the carried :class:`WarmState` with a
+    short scan instead of refitting from scratch, falling back (and
+    RECORDING why) whenever the carry can't be trusted.
+
+    Returns ``(predictions, dispatch, new_state)``. The dispatch's
+    ``fit_mse`` is a HOST float here — the demotion check must compare
+    MSEs on the host anyway, so the predictions+MSE materialization is
+    paid once inside this call (one device_get), not deferred.
+
+    Demotion policy (never silent, same contract as the Pallas
+    fallback): a warm fit whose final MSE exceeds
+    ``cold_mse_tolerance × max(cold_mse, floor)`` is thrown away and a
+    cold refit runs, with ``warm_demotion_reason`` set in the dispatch.
+    A cfg/fleet-shape mismatch or a warm-program exception demotes the
+    same way. The persistence ("repeat") path passes the state through
+    untouched — a too-short window says nothing about the carry."""
+    cfg = cfg or ForecastConfig()
+    series = jnp.asarray(series, dtype=jnp.float32)
+    n_chips, length = series.shape
+    if length < cfg.window + cfg.horizon:
+        last = series[:, -1:]
+        preds = jnp.repeat(last, cfg.horizon, axis=1)
+        return preds, InferenceDispatch("repeat"), state
+
+    inference, batch_p, fallback = _platform_and_pallas(cfg, n_chips)
+
+    def _run_fused(program: Callable[..., Any], *head: Any) -> Any:
+        """Run a fused state program on the resolved path; a Pallas
+        failure memoizes the breakage and re-runs on XLA (the same
+        optimization-never-dependency policy as the cold entry), so
+        only genuine training failures escape to the caller."""
+        nonlocal inference, batch_p, fallback
+        try:
+            return program(*head, inference, batch_p)
+        except Exception as exc:  # noqa: BLE001
+            if inference != "pallas":
+                raise
+            _record_pallas_broken(f"{type(exc).__name__}: {exc}"[:200])
+            inference, batch_p, fallback = "xla", 0, _pallas_broken_reason
+            return program(*head, "xla", 0)
+
+    demotion: str | None = None
+    carried_gen: int | None = None
+    if state is not None:
+        carried_gen = state.generation
+        if state.cfg != cfg or state.n_chips != n_chips:
+            demotion = (
+                f"carry mismatch: cfg/fleet changed "
+                f"(chips {state.n_chips}->{n_chips})"
+            )
+        else:
+            try:
+                out, params, opt_state, mse_dev = _run_fused(
+                    _warm_fit_forecast_program,
+                    series, state.params, state.opt_state, cfg, warm_steps,
+                )
+                # One host round-trip for everything the caller and the
+                # demotion check need (ADR-012 funnel discipline).
+                preds_host, warm_mse = jax.device_get((out, mse_dev))
+                warm_mse = float(warm_mse)
+            except Exception as exc:  # noqa: BLE001 — carry is an optimization
+                demotion = f"warm program failed: {type(exc).__name__}: {exc}"[:200]
+            else:
+                bound = cold_mse_tolerance * max(state.cold_mse, _DEMOTION_MSE_FLOOR)
+                if warm_mse > bound:
+                    demotion = (
+                        f"warm mse {warm_mse:.3g} > {cold_mse_tolerance:g}x "
+                        f"cold {state.cold_mse:.3g}"
+                    )
+                else:
+                    new_state = WarmState(
+                        params, opt_state, state.cold_mse,
+                        state.generation, cfg, n_chips,
+                    )
+                    dispatch = InferenceDispatch(
+                        f"{inference}-warm", fallback, fit_mse=warm_mse,
+                        carried_from_generation=state.generation,
+                    )
+                    return preds_host, dispatch, new_state
+
+    # Cold fit — from scratch, or demoted from a rejected warm attempt.
+    key = jax.random.PRNGKey(seed)
+    out, params, opt_state, mse_dev = _run_fused(
+        _fit_forecast_state_program, series, key, cfg, steps
+    )
+    preds_host, cold_mse = jax.device_get((out, mse_dev))
+    cold_mse = float(cold_mse)
+    generation = (state.generation + 1) if state is not None else 0
+    new_state = WarmState(params, opt_state, cold_mse, generation, cfg, n_chips)
+    dispatch = InferenceDispatch(
+        inference, fallback, fit_mse=cold_mse,
+        carried_from_generation=carried_gen,
+        warm_demotion_reason=demotion,
+    )
+    return preds_host, dispatch, new_state

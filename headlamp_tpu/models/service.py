@@ -13,7 +13,14 @@ from typing import Any, Callable
 
 from ..metrics.client import UtilizationHistory
 from ..obs.trace import span as _span
-from .forecast import ForecastConfig, fit_and_forecast_with_dispatch
+from .forecast import (
+    WARM_STEPS,
+    ForecastConfig,
+    InferenceDispatch,
+    WarmState,
+    fit_and_forecast_incremental,
+    fit_and_forecast_with_dispatch,
+)
 
 
 @dataclass
@@ -44,6 +51,12 @@ class ForecastView:
     #: path) — the model's self-assessment, shown so operators can judge
     #: how much to trust the prediction.
     fit_mse: float | None = None
+    #: Generation of the warm-start carry this fit refined (ADR-015);
+    #: None when the fit was from-scratch cold with no carry consulted.
+    carried_from_generation: int | None = None
+    #: Why a warm refinement self-demoted to a cold refit — never-silent
+    #: demotion record, mirrored from the InferenceDispatch.
+    warm_demotion_reason: str | None = None
 
     @property
     def at_risk(self) -> list[ChipForecast]:
@@ -122,7 +135,20 @@ def forecast_from_history(
             preds = np.asarray(preds)
             fit_mse = None
     fit_ms = round((time.perf_counter() - t0) * 1000, 1)
+    return _summarize(history, cfg, preds, dispatch, fit_ms, fit_mse)
 
+
+def _summarize(
+    history: UtilizationHistory,
+    cfg: ForecastConfig,
+    preds: Any,
+    dispatch: InferenceDispatch,
+    fit_ms: float,
+    fit_mse: float | None,
+) -> ForecastView:
+    """Host-side per-chip risk summary shared by the cold and warm
+    entries — one definition so they cannot drift on what "at risk"
+    means."""
     chips = []
     for key, trace, pred in zip(history.keys, history.series, preds):
         peak = float(pred.max())
@@ -149,4 +175,73 @@ def forecast_from_history(
         inference_path=dispatch.path,
         inference_fallback_reason=dispatch.fallback_reason,
         fit_mse=fit_mse,
+        carried_from_generation=dispatch.carried_from_generation,
+        warm_demotion_reason=dispatch.warm_demotion_reason,
     )
+
+
+def forecast_from_history_incremental(
+    history: UtilizationHistory,
+    cfg: ForecastConfig | None = None,
+    *,
+    state: WarmState | None = None,
+    steps: int = 60,
+    warm_steps: int = WARM_STEPS,
+) -> tuple[ForecastView, WarmState | None]:
+    """Warm-start variant of :func:`forecast_from_history`: refines the
+    carried :class:`WarmState` (ADR-015) and returns the new carry with
+    the view. The incremental entry already materializes predictions +
+    MSE in one host fetch, so no transfer-funnel round-trip here."""
+    import time
+
+    import numpy as np
+
+    cfg = cfg or ForecastConfig()
+    t0 = time.perf_counter()
+    with _span(
+        "forecast.fit", series=len(history.series), steps=steps, warm=state is not None
+    ) as fit_span:
+        preds, dispatch, new_state = fit_and_forecast_incremental(
+            np.asarray(history.series), cfg,
+            state=state, steps=steps, warm_steps=warm_steps,
+        )
+        if fit_span is not None:
+            fit_span.attrs["inference_path"] = dispatch.path
+    fit_ms = round((time.perf_counter() - t0) * 1000, 1)
+    fit_mse = None if dispatch.fit_mse is None else float(dispatch.fit_mse)
+    view = _summarize(history, cfg, np.asarray(preds), dispatch, fit_ms, fit_mse)
+    return view, new_state
+
+
+def compute_forecast_incremental(
+    transport: Any,
+    metrics: Any,
+    *,
+    state: WarmState | None = None,
+    clock: Callable[[], float] | None = None,
+) -> tuple[ForecastView | None, WarmState | None]:
+    """:func:`compute_forecast` with the ADR-015 warm-start carry:
+    returns ``(view, new_state)``; any failure degrades to ``(None,
+    state)`` — the carry survives a flaky scrape so the next attempt
+    can still warm-start."""
+    import time as _time
+
+    from ..metrics.client import fetch_utilization_history
+
+    if metrics is None or not metrics.chips:
+        return None, state
+    try:
+        with _span("forecast.history"):
+            history = fetch_utilization_history(
+                transport,
+                prometheus=(metrics.namespace, metrics.service),
+                clock=clock or _time.time,
+                preferred_query=metrics.resolved_series.get("tensorcore_utilization"),
+            )
+        if history is None:
+            return None, state
+        return forecast_from_history_incremental(history, state=state)
+    except Exception:
+        # Forecast is a progressive enhancement — any failure costs the
+        # section, never the page.
+        return None, state

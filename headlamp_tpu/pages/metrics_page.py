@@ -216,14 +216,17 @@ def _inference_label(view: Any) -> str:
     prediction, and — when Pallas was tried and failed — why it fell
     back (the silent-fallback policy must stay observable)."""
     path = getattr(view, "inference_path", "xla")
-    if path == "pallas":
-        return "Pallas TPU kernel"
+    # ADR-015 warm-start refinements carry a "-warm" suffix; the label
+    # keeps the kernel name and says so, rather than hiding the carry.
+    warm = ", warm-start fit" if path.endswith("-warm") else ""
+    if path.startswith("pallas"):
+        return f"Pallas TPU kernel{warm}"
     if path == "repeat":
         return "persistence (history shorter than one window; no kernel ran)"
     reason = getattr(view, "inference_fallback_reason", None)
     if reason:
-        return f"XLA (Pallas fallback: {reason})"
-    return "XLA"
+        return f"XLA (Pallas fallback: {reason}){warm}"
+    return f"XLA{warm}"
 
 
 def metrics_page(

@@ -11,12 +11,16 @@ device round-trips a request pays*.
 - :mod:`device_cache` — ``DeviceFleetCache``: columnar fleets kept
   resident on device across requests, keyed by snapshot version, so
   the XLA rollup stops re-uploading host arrays on every call.
+- :mod:`refresh` — ``Refresher``: keyed stale-while-revalidate cache
+  (TTL + grace, single-flight) that moves expensive recomputes — the
+  forecast fit above all — off the request path (ADR-015).
 
 Everything is import-guarded: a jax-less host can import this package
 (the server does) and only pays for what it calls.
 """
 
 from .device_cache import DeviceFleetCache, fleet_cache
+from .refresh import Refresher
 from .transfer import (
     TransferBatch,
     active_batch,
@@ -28,6 +32,7 @@ from .transfer import (
 
 __all__ = [
     "DeviceFleetCache",
+    "Refresher",
     "TransferBatch",
     "active_batch",
     "defer",

@@ -1,0 +1,299 @@
+"""Stale-while-revalidate keyed refresher (ADR-015).
+
+The serving-path answer to the cold-fit cliff: BENCH_r06 put the cold
+forecast fit at ~2.4 s, and before this module that cost landed on
+whichever USER REQUEST happened to hit the TTL lapse — while holding
+the cache lock, so every concurrent metrics view stalled behind it.
+
+:class:`Refresher` makes expiry a background event instead of a
+request-path one:
+
+- **fresh** (``age ≤ ttl_s``): serve from cache, touch nothing.
+- **stale** (``ttl_s < age ≤ grace_s``): serve the stale value
+  IMMEDIATELY and kick exactly one background recompute (single-flight
+  per key+epoch); the next request after it lands sees fresh data.
+- **cold / past grace / epoch bumped**: the only case that blocks —
+  and concurrent requests for the same key join the in-flight compute
+  rather than duplicating it.
+
+Clock discipline (ADR-013): every age comparison runs on the injected
+``monotonic`` — tests drive expiry by advancing a list cell, never by
+sleeping. Wall clock never enters the math.
+
+Failure policy: a FOREGROUND compute error propagates to every joined
+waiter (they asked for a value and there is none). A BACKGROUND refit
+error is absorbed — the stale value keeps serving until grace runs
+out, which degrades exactly like the pre-refresher cache would have,
+except the error is counted (``refit_errors`` in :meth:`snapshot`)
+instead of silent.
+
+Stdlib-only: the server imports this unconditionally; the values being
+refreshed (fleet metrics, forecast views) are opaque here.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Hashable
+
+from ..obs.metrics import registry as _metrics_registry
+from ..obs.trace import span as _span
+
+# Registry instruments (ADR-013 get-or-create; the ``refresher`` label
+# separates the metrics cache from the forecast cache). Per-instance
+# ints in Refresher stay the /healthz + test view; both are written on
+# the same transitions so the surfaces cannot disagree.
+_SERVED_FRESH = _metrics_registry.counter(
+    "headlamp_tpu_refresh_served_fresh_total",
+    "Cache reads answered by a within-TTL value (no work scheduled).",
+    labels=("refresher",),
+)
+_SERVED_STALE = _metrics_registry.counter(
+    "headlamp_tpu_refresh_served_stale_total",
+    "Cache reads answered by a stale-but-in-grace value while a "
+    "background refresh ran — request-path stalls this design removed.",
+    labels=("refresher",),
+)
+_REFITS = _metrics_registry.counter(
+    "headlamp_tpu_refresh_refits_total",
+    "Recomputes executed (foreground cold fills + background refreshes).",
+    labels=("refresher",),
+)
+_DEMOTIONS = _metrics_registry.counter(
+    "headlamp_tpu_refresh_demotions_to_cold_total",
+    "Warm-start fits demoted to cold refits by the ADR-015 MSE check "
+    "(reported by the compute fn via note_demotion).",
+    labels=("refresher",),
+)
+_FIT_HIST = _metrics_registry.histogram(
+    "headlamp_tpu_refresh_fit_duration_seconds",
+    "Wall duration of refresher recomputes (the cost the grace window "
+    "hides from the request path).",
+    labels=("refresher",),
+)
+
+
+class _Flight:
+    """One in-flight compute for a (key, epoch): late arrivals wait on
+    ``done`` instead of recomputing."""
+
+    __slots__ = ("done", "value", "error")
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
+        self.value: Any = None
+        self.error: BaseException | None = None
+
+
+class _Entry:
+    __slots__ = ("value", "fetched_mono", "epoch")
+
+    def __init__(self, value: Any, fetched_mono: float, epoch: int) -> None:
+        self.value = value
+        self.fetched_mono = fetched_mono
+        self.epoch = epoch
+
+
+class Refresher:
+    """Keyed single-flight cache with a TTL (fresh) + grace (stale-
+    servable) window. ``compute`` callables ALWAYS run outside the map
+    lock — the whole point is that a multi-second fit never blocks
+    readers of other keys (or, within grace, of the same key)."""
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        ttl_s: float,
+        grace_s: float,
+        monotonic: Callable[[], float] | None = None,
+        max_entries: int = 8,
+    ) -> None:
+        if grace_s < ttl_s:
+            raise ValueError("grace_s must be >= ttl_s (grace extends the TTL)")
+        self.name = name
+        self.ttl_s = ttl_s
+        self.grace_s = grace_s
+        self.max_entries = max_entries
+        self._monotonic = monotonic or time.monotonic
+        self._lock = threading.Lock()
+        self._entries: dict[Hashable, _Entry] = {}
+        self._flights: dict[tuple[Hashable, int], _Flight] = {}
+        # /healthz + test view (registry counters are the fleet view).
+        self.served_fresh = 0
+        self.served_stale = 0
+        self.refits = 0
+        self.refit_errors = 0
+        self.demotions_to_cold = 0
+
+    # -- read paths ------------------------------------------------------
+
+    def get(
+        self, key: Hashable, compute: Callable[[], Any], *, epoch: int = 0
+    ) -> Any:
+        """Value for ``key``, running/joining ``compute`` as needed.
+        Blocks only when no same-epoch value within grace exists."""
+        now = self._monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and entry.epoch == epoch:
+                age = now - entry.fetched_mono
+                if age <= self.ttl_s:
+                    self.served_fresh += 1
+                    _SERVED_FRESH.inc(refresher=self.name)
+                    return entry.value
+                if age <= self.grace_s:
+                    # Serve stale NOW; exactly one background refresh.
+                    self.served_stale += 1
+                    _SERVED_STALE.inc(refresher=self.name)
+                    fkey = (key, epoch)
+                    if fkey not in self._flights:
+                        flight = _Flight()
+                        self._flights[fkey] = flight
+                        threading.Thread(
+                            target=self._background_refit,
+                            args=(key, epoch, compute, flight),
+                            name=f"refresh-{self.name}",
+                            daemon=True,
+                        ).start()
+                    return entry.value
+            # Cold / past grace / epoch bumped: block (or join a flight).
+            fkey = (key, epoch)
+            flight = self._flights.get(fkey)
+            if flight is None:
+                flight = _Flight()
+                self._flights[fkey] = flight
+                leader = True
+            else:
+                leader = False
+        if leader:
+            return self._foreground_fill(key, epoch, compute, flight)
+        flight.done.wait()
+        if flight.error is not None:
+            raise flight.error
+        return flight.value
+
+    def peek(
+        self, key: Hashable, *, epoch: int = 0, max_age_s: float | None = None
+    ) -> Any | None:
+        """Non-blocking read: the cached value if it matches ``epoch``
+        and is younger than ``max_age_s`` (default: the grace window),
+        else None. Never computes."""
+        limit = self.grace_s if max_age_s is None else max_age_s
+        now = self._monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry.epoch != epoch:
+                return None
+            if now - entry.fetched_mono > limit:
+                return None
+            return entry.value
+
+    # -- compute paths ---------------------------------------------------
+
+    def _run_compute(self, compute: Callable[[], Any]) -> Any:
+        """The timed, traced recompute — shared by foreground and
+        background so the histogram sees every fit."""
+        t0 = time.perf_counter()
+        try:
+            with _span("refresh.fit", refresher=self.name):
+                return compute()
+        finally:
+            _FIT_HIST.observe(time.perf_counter() - t0, refresher=self.name)
+
+    def _store(self, key: Hashable, value: Any, epoch: int) -> None:
+        with self._lock:
+            self._entries[key] = _Entry(value, self._monotonic(), epoch)
+            self.refits += 1
+            while len(self._entries) > self.max_entries:
+                oldest = min(
+                    self._entries, key=lambda k: self._entries[k].fetched_mono
+                )
+                del self._entries[oldest]
+        _REFITS.inc(refresher=self.name)
+
+    def _foreground_fill(
+        self,
+        key: Hashable,
+        epoch: int,
+        compute: Callable[[], Any],
+        flight: _Flight,
+    ) -> Any:
+        try:
+            value = self._run_compute(compute)
+        except BaseException as exc:
+            with self._lock:
+                self.refit_errors += 1
+                self._flights.pop((key, epoch), None)
+            flight.error = exc
+            flight.done.set()
+            raise
+        self._store(key, value, epoch)
+        with self._lock:
+            self._flights.pop((key, epoch), None)
+        flight.value = value
+        flight.done.set()
+        return value
+
+    def _background_refit(
+        self,
+        key: Hashable,
+        epoch: int,
+        compute: Callable[[], Any],
+        flight: _Flight,
+    ) -> None:
+        try:
+            value = self._run_compute(compute)
+        except BaseException:
+            # Absorbed by design: the stale value keeps serving until
+            # grace runs out — same degradation as the pre-refresher
+            # cache, but counted instead of silent.
+            with self._lock:
+                self.refit_errors += 1
+                self._flights.pop((key, epoch), None)
+            flight.done.set()
+            return
+        self._store(key, value, epoch)
+        with self._lock:
+            self._flights.pop((key, epoch), None)
+        flight.value = value
+        flight.done.set()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Block until no compute is in flight (or ``timeout_s`` runs
+        out; returns False then). For tests and benchmarks that must
+        not race a background refit across an assertion or process
+        exit — the serving path never calls this. Waits on REAL time:
+        the injected monotonic only governs ages, and tests freeze it."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                flights = list(self._flights.values())
+            if not flights:
+                return True
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            flights[0].done.wait(min(remaining, 0.25))
+
+    # -- observability ---------------------------------------------------
+
+    def note_demotion(self) -> None:
+        """Record an ADR-015 warm→cold demotion (the compute fn knows;
+        the refresher owns the counter surfaces)."""
+        with self._lock:
+            self.demotions_to_cold += 1
+        _DEMOTIONS.inc(refresher=self.name)
+
+    def snapshot(self) -> dict[str, int]:
+        """Plain-int view for /healthz (mirrors the registry counters)."""
+        with self._lock:
+            return {
+                "served_fresh": self.served_fresh,
+                "served_stale": self.served_stale,
+                "refits": self.refits,
+                "refit_errors": self.refit_errors,
+                "demotions_to_cold": self.demotions_to_cold,
+                "entries": len(self._entries),
+            }

@@ -24,7 +24,6 @@ the full UI runs with zero cluster.
 
 from __future__ import annotations
 
-import contextvars
 import html
 import json
 import re
@@ -34,12 +33,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 from urllib.parse import parse_qs, urlparse
 
-import concurrent.futures
-
 from ..context.accelerator_context import AcceleratorDataContext, ClusterSnapshot
 from ..metrics.client import fetch_tpu_metrics
 from ..obs.metrics import registry as metrics_registry
 from ..obs.trace import annotate, span, trace_request, trace_ring
+from ..runtime.refresh import Refresher
 from ..runtime.transfer import TransferBatch
 from ..pages.native import native_node_page, native_pod_page
 from ..registration import Registry, register_plugin
@@ -105,14 +103,18 @@ def _analytics_health() -> dict[str, Any]:
         return {"calibrated": False, "error": type(exc).__name__}
 
 
-def _runtime_health(transport: Any = None) -> dict[str, Any]:
-    """Transfer-funnel, device-cache, and transport-pool counters for
-    /healthz: how many blocking device_gets the process has paid, how
-    often warm requests hit the device-resident fleet (ADR-012), and
-    how many TCP handshakes the keep-alive pool saved (ADR-014). The
-    ``transport`` block appears only when the app's transport is pooled
-    (KubeTransport) — MockTransport-backed demo/test apps report the
-    other blocks unchanged."""
+def _runtime_health(
+    transport: Any = None, refreshers: tuple[Refresher, ...] = ()
+) -> dict[str, Any]:
+    """Transfer-funnel, device-cache, transport-pool, and refresher
+    counters for /healthz: how many blocking device_gets the process
+    has paid, how often warm requests hit the device-resident fleet
+    (ADR-012), how many TCP handshakes the keep-alive pool saved
+    (ADR-014), and how often the stale-while-revalidate caches kept a
+    fit off the request path (ADR-015). The ``transport`` block appears
+    only when the app's transport is pooled (KubeTransport) —
+    MockTransport-backed demo/test apps report the other blocks
+    unchanged."""
     try:
         from ..runtime.device_cache import fleet_cache
         from ..runtime.transfer import transfer_stats
@@ -125,6 +127,8 @@ def _runtime_health(transport: Any = None) -> dict[str, Any]:
         pool = pool_of(transport)
         if pool is not None:
             out["transport"] = pool.snapshot()
+        if refreshers:
+            out["refresh"] = {r.name: r.snapshot() for r in refreshers}
         return out
     except Exception as exc:  # noqa: BLE001 — health must never 500 on analytics
         # An empty block read as "no runtime telemetry wired"; a named
@@ -196,22 +200,35 @@ class DashboardApp:
         # all state mutation funnels through one lock (renders of an
         # already-built snapshot stay lock-free).
         self._lock = threading.Lock()
-        self._forecast_lock = threading.Lock()
-        #: (epoch, content key, expiry, value) — keyed on the Prometheus
-        #: target and the chip set so a forecast fitted for fleet A is
-        #: never served for fleet B within the TTL.
-        self._forecast_cache: tuple[int, Any, float, Any] | None = None
-        self._metrics_lock = threading.Lock()
-        #: (epoch, monotonic expiry, monotonic fetched-at, metrics) —
-        #: the fetched-at stamp feeds _peek_metrics' age check, which
-        #: must not trust the snapshot's wall-clock fetched_at.
-        self._metrics_cache: tuple[int, float, float, Any] | None = None
+        # Stale-while-revalidate caches (ADR-015): the refresher owns
+        # TTL/grace/single-flight; the app owns the keys (Prometheus
+        # target + chip set for forecasts — see _metrics_key) and the
+        # epoch. The pre-r09 design held a plain lock across the whole
+        # fetch/fit, so a TTL lapse stalled every concurrent metrics
+        # view behind a multi-second cold fit.
+        self._metrics_refresher = Refresher(
+            "metrics",
+            ttl_s=self.METRICS_TTL_S,
+            grace_s=self.METRICS_GRACE_S,
+            monotonic=monotonic,
+        )
+        self._forecast_refresher = Refresher(
+            "forecast",
+            ttl_s=self.FORECAST_TTL_S,
+            grace_s=self.FORECAST_GRACE_S,
+            monotonic=monotonic,
+        )
+        #: Warm-start carries per forecast key (ADR-015): fitted params
+        #: + optimizer state handed back to the next (re)fit for the
+        #: same fleet. Guarded by its own lock — entries are written
+        #: from refresher background workers.
+        self._warm_forecast_states: dict[Any, Any] = {}
+        self._warm_lock = threading.Lock()
         #: Bumped by /refresh. Cache entries record the epoch current
         #: when their fetch *started*; a mismatched epoch invalidates
-        #: them. This lets refresh invalidate without touching
-        #: _metrics_lock/_forecast_lock — both are held across
-        #: multi-second network fetches / jax fits, and the refresh
-        #: redirect must never stall behind those.
+        #: them. This lets refresh invalidate without touching the
+        #: refreshers' locks — computes run for seconds, and the
+        #: refresh redirect must never stall behind those.
         self._cache_epoch = 0
         #: Last fully-built snapshot, published atomically (single
         #: reference assignment) after each sync — /healthz reads this
@@ -245,9 +262,6 @@ class DashboardApp:
         self.requests_served = 0
         self.request_device_gets = 0
         self.last_request_device_gets = 0
-        #: Lazily-created worker pool for the metrics route's
-        #: fetch∥forecast overlap (see _metrics_and_forecast).
-        self._overlap_pool: concurrent.futures.ThreadPoolExecutor | None = None
         # Process-level request instruments (ADR-013). get-or-create:
         # tests build many DashboardApps per process and they must share
         # the registry rather than collide on re-registration.
@@ -442,10 +456,21 @@ class DashboardApp:
     #: gains a point per step anyway, and the fit (jax compile + scan)
     #: must not run on every page view.
     FORECAST_TTL_S = 60.0
+    #: Stale-while-revalidate grace (ADR-015): past the TTL but within
+    #: this TOTAL age, a forecast is served immediately while a
+    #: background worker refits — no request ever pays the fit. Ten
+    #: minutes: a forecast that old is still directionally honest for a
+    #: capacity dashboard, and only a key idle longer than this pays a
+    #: blocking fit again.
+    FORECAST_GRACE_S = 600.0
     #: Instant metrics fetches are briefly cached too: the Prometheus
     #: round-trip is cheap but not free, and without a TTL every page
     #: view pays it while the forecast beside it is served from cache.
     METRICS_TTL_S = 5.0
+    #: Grace for the metrics scrape — matches METRICS_PEEK_MAX_AGE_S:
+    #: the same "a minute-old snapshot beats blocking" judgement the
+    #: heatmap peek already made.
+    METRICS_GRACE_S = 60.0
 
     @staticmethod
     def _metrics_key(metrics: Any) -> Any:
@@ -460,32 +485,26 @@ class DashboardApp:
         )
 
     def _cached_metrics(self) -> Any:
-        """TTL-cached `fetch_tpu_metrics`. A failed fetch (None) is also
-        cached — a down Prometheus must not re-pay the full probe chain
-        on every view within the TTL."""
-        with self._metrics_lock:
-            epoch = self._cache_epoch
-            now = self._mono()
-            if self._metrics_cache is not None:
-                cached_epoch, expiry, _, cached = self._metrics_cache
-                if cached_epoch == epoch and now < expiry:
-                    return cached
-            metrics = fetch_tpu_metrics(self._transport, clock=self._clock)
-            # Stored under the epoch read BEFORE the fetch: a refresh
-            # arriving mid-fetch bumps the epoch and this entry is born
-            # stale, so the next view refetches. The TTL, by contrast,
-            # starts AFTER the fetch — a slow fetch (probe chain against
-            # a dark cluster, first jit compile downstream) must not
-            # burn its own freshness window and serve a born-expired
-            # entry.
-            done = self._mono()
-            self._metrics_cache = (
-                epoch,
-                done + self.METRICS_TTL_S,
-                done,
-                metrics,
-            )
-            return metrics
+        """`fetch_tpu_metrics` behind the stale-while-revalidate
+        refresher: fresh within METRICS_TTL_S, served-stale (with a
+        background refetch) within METRICS_GRACE_S, blocking only when
+        cold. A failed fetch (None) is also cached — a down Prometheus
+        must not re-pay the full probe chain on every view within the
+        TTL. The epoch is read BEFORE the fetch: a /refresh arriving
+        mid-fetch bumps it and the entry is born stale, so the next
+        view refetches; the freshness window starts AFTER the fetch
+        (refresher stamps at store time), so a slow probe chain never
+        burns its own TTL."""
+        # TTLs re-read per call: the class attrs are operator/test knobs
+        # and must keep working when overridden after construction.
+        r = self._metrics_refresher
+        r.ttl_s = self.METRICS_TTL_S
+        r.grace_s = max(self.METRICS_GRACE_S, self.METRICS_TTL_S)
+        return r.get(
+            "metrics",
+            lambda: fetch_tpu_metrics(self._transport, clock=self._clock),
+            epoch=self._cache_epoch,
+        )
 
     #: How stale a cached telemetry snapshot may be and still tint the
     #: topology heatmap. Deliberately looser than METRICS_TTL_S: the
@@ -501,117 +520,86 @@ class DashboardApp:
         where telemetry is a progressive enhancement (the topology
         heatmap): they must not pay the Prometheus probe chain, only
         reuse what a recent metrics view already paid for. Age is judged
-        from the cache entry's monotonic fetch stamp, not the serving
-        TTL (and not the snapshot's wall-clock fetched_at, which an NTP
-        step could swing either way — ADR-013 clock audit).
+        from the refresher's monotonic fetch stamp, not the serving TTL
+        (and not the snapshot's wall-clock fetched_at, which an NTP step
+        could swing either way — ADR-013 clock audit). Non-blocking by
+        construction: Refresher.peek only touches the entry map, never a
+        compute."""
+        return self._metrics_refresher.peek(
+            "metrics",
+            epoch=self._cache_epoch,
+            max_age_s=self.METRICS_PEEK_MAX_AGE_S,
+        )
 
-        Non-blocking: _cached_metrics holds the lock across its whole
-        fetch, and a peek that waited for a dark cluster's probe chain
-        would be exactly the stall it exists to avoid — under
-        contention the tint is skipped, never awaited."""
-        if not self._metrics_lock.acquire(blocking=False):
-            return None
-        try:
-            if self._metrics_cache is None:
-                return None
-            cached_epoch, _, fetched_mono, cached = self._metrics_cache
-            if cached_epoch != self._cache_epoch or cached is None:
-                return None
-            if self._mono() - fetched_mono > self.METRICS_PEEK_MAX_AGE_S:
-                return None
-            return cached
-        finally:
-            self._metrics_lock.release()
+    #: Warm-start carries kept per forecast key. Small on purpose: each
+    #: carry holds ~115k float32 params + adam moments (<2 MB); a
+    #: dashboard serves a handful of fleets, not hundreds.
+    WARM_STATE_MAX_KEYS = 8
 
     def _forecast_for(self, metrics: Any) -> Any:
         """Forecast view for the metrics page, or None. None whenever
         the analytics extras (jax/optax) are absent — the forecast is a
         progressive enhancement, never a hard dependency of the page —
-        or history is too thin to be honest. TTL-cached, keyed on the
-        metrics content (see `_metrics_key`)."""
+        or history is too thin to be honest. Stale-while-revalidate
+        cached, keyed on the metrics content (see `_metrics_key`): a
+        TTL lapse within the grace window serves the previous view
+        immediately and refits on a background worker, so the
+        multi-second fit never lands on a user request (the pre-r09
+        design held a lock across the fit and stalled every concurrent
+        metrics view — ISSUE r09's satellite regression test pins the
+        fix)."""
         if metrics is None or not metrics.chips:
             return None
         key = self._metrics_key(metrics)
-        # Dedicated lock (not self._lock — the fit can take seconds and
-        # must not block unrelated pages): exactly one thread refits per
-        # TTL window; concurrent requests wait and reuse its result.
-        with self._forecast_lock:
-            epoch = self._cache_epoch
-            now = self._mono()
-            if self._forecast_cache is not None:
-                cached_epoch, cached_key, expiry, cached = self._forecast_cache
-                if cached_epoch == epoch and now < expiry and cached_key == key:
-                    return cached
-            forecast = self._compute_forecast(metrics)
-            # TTL stamped after the fit (see _cached_metrics): a first
-            # jit compile can take longer than the TTL itself.
-            self._forecast_cache = (
-                epoch,
-                key,
-                self._mono() + self.FORECAST_TTL_S,
-                forecast,
-            )
-            return forecast
+        r = self._forecast_refresher
+        r.ttl_s = self.FORECAST_TTL_S
+        r.grace_s = max(self.FORECAST_GRACE_S, self.FORECAST_TTL_S)
+        return r.get(
+            key,
+            lambda: self._compute_forecast(metrics),
+            epoch=self._cache_epoch,
+        )
 
     def _metrics_and_forecast(self) -> tuple[Any, Any]:
-        """Metrics + forecast for the metrics route, overlapped.
-
-        Sequentially these serialize two network-bound phases: the
-        Prometheus instant-query fan-out (`metrics/client.py`, a
-        ThreadPoolExecutor joining up to 8 queries) and then the
-        forecast (range query + jit'd fit whose device dispatch is
-        async). The forecast cache is keyed on chip IDENTITY — stable
-        across scrapes — so when a recent metrics snapshot exists
-        (`_peek_metrics`) the forecast can start from it immediately
-        while the instant queries refresh concurrently; the join only
-        recomputes if the fresh scrape changed the chip set (nodes
-        added/removed), in which case the sequential cost returns for
-        exactly that request. Cold cache (no peekable snapshot) stays
-        sequential — there is nothing to overlap with."""
-        peeked = self._peek_metrics()
-        if peeked is None or not peeked.chips:
-            metrics = self._cached_metrics()
-            return metrics, self._forecast_for(metrics)
-        pool = self._overlap_pool
-        if pool is None:
-            # Two workers: a second metrics-route request overlapping
-            # while the first's fetch is still joining must not
-            # serialize behind it here (the caches have their own locks).
-            pool = self._overlap_pool = concurrent.futures.ThreadPoolExecutor(
-                max_workers=2, thread_name_prefix="hl-tpu-overlap"
-            )
-        # copy_context: the worker must inherit this request's active
-        # trace (a ContextVar) so the fetch's metrics.discover/fanout
-        # spans attach to the request waterfall instead of vanishing.
-        # Span-tree appends from two threads are safe — list.append is
-        # GIL-atomic and the branches are disjoint.
-        fetch = pool.submit(
-            contextvars.copy_context().run, self._cached_metrics
-        )
-        try:
-            forecast = self._forecast_for(peeked)
-        finally:
-            metrics = fetch.result()
-        if metrics is None or not metrics.chips:
-            # The fresh scrape failed/emptied: render it that way — the
-            # page must reflect what the fetch said, and a forecast
-            # beside a dead scrape would be incoherent.
-            return metrics, None
-        if self._metrics_key(metrics) != self._metrics_key(peeked):
-            forecast = self._forecast_for(metrics)
-        return metrics, forecast
+        """Metrics + forecast for the metrics route. Sequential on
+        purpose since the refreshers landed (ADR-015): in steady state
+        BOTH calls are cache reads — stale values serve immediately
+        while background workers revalidate — so there is nothing left
+        to overlap; the r07-era fetch∥forecast thread-pool overlap was
+        retired with the blocking paths it hid."""
+        metrics = self._cached_metrics()
+        return metrics, self._forecast_for(metrics)
 
     def _compute_forecast(self, metrics: Any) -> Any:
         # Delegates to the shared host glue (models.service) so the CLI
-        # and HTTP consumers render identical metrics pages. Import is
-        # lazy and guarded: models.service itself imports jax-dependent
-        # modules at call time, but the import alone must not break a
-        # host without the analytics extras.
+        # and HTTP consumers render identical metrics pages; the HTTP
+        # host uses the incremental entry so fitted params + optimizer
+        # state carry across TTL windows (ADR-015 warm starts). Import
+        # is lazy and guarded: models.service itself imports
+        # jax-dependent modules at call time, but the import alone must
+        # not break a host without the analytics extras.
         try:
-            from ..models.service import compute_forecast
+            from ..models.service import compute_forecast_incremental
         except ImportError:
             return None
-        return compute_forecast(self._transport, metrics, clock=self._clock)
+        key = self._metrics_key(metrics)
+        with self._warm_lock:
+            state = self._warm_forecast_states.get(key)
+        view, new_state = compute_forecast_incremental(
+            self._transport, metrics, state=state, clock=self._clock
+        )
+        with self._warm_lock:
+            if new_state is not None:
+                # Re-insert at the end: dict order is the LRU-ish
+                # eviction order below.
+                self._warm_forecast_states.pop(key, None)
+                self._warm_forecast_states[key] = new_state
+                while len(self._warm_forecast_states) > self.WARM_STATE_MAX_KEYS:
+                    oldest = next(iter(self._warm_forecast_states))
+                    del self._warm_forecast_states[oldest]
+        if view is not None and view.warm_demotion_reason is not None:
+            self._forecast_refresher.note_demotion()
+        return view
 
     # ------------------------------------------------------------------
     # Request handling (framework-level, server-agnostic)
@@ -725,7 +713,10 @@ class DashboardApp:
                         # startup too, when "probe not yet run" is the
                         # most informative state.
                         "analytics": _analytics_health(),
-                        "runtime": _runtime_health(self._transport),
+                        "runtime": _runtime_health(
+                            self._transport,
+                            (self._metrics_refresher, self._forecast_refresher),
+                        ),
                     }
                 )
                 return 200, "application/json", body
@@ -757,7 +748,10 @@ class DashboardApp:
                     "consecutive_sync_failures": failures,
                     "background_sync": background,
                     "analytics": _analytics_health(),
-                    "runtime": _runtime_health(self._transport),
+                    "runtime": _runtime_health(
+                        self._transport,
+                        (self._metrics_refresher, self._forecast_refresher),
+                    ),
                 }
             )
             return 200, "application/json", body
@@ -1010,8 +1004,9 @@ def add_demo_prometheus(t: MockTransport, fleet: dict) -> MockTransport:
     intel_nodes = [
         n["metadata"]["name"] for n in fleet["nodes"] if is_intel_gpu_node(n)
     ]
+    uname: list[tuple[dict, float]] = []
     if intel_nodes:
-        uname, chips_s, power_s, tdp_s = [], [], [], []
+        chips_s, power_s, tdp_s = [], [], []
         for i, node in enumerate(intel_nodes):
             instance = f"10.1.0.{i + 1}:9100"
             uname.append(({"instance": instance, "nodename": node}, 1))
@@ -1026,6 +1021,36 @@ def add_demo_prometheus(t: MockTransport, fleet: dict) -> MockTransport:
     t.add(q("tensorcore_utilization"), vec(util))
     t.add(q("hbm_bytes_used"), vec(used))
     t.add(q("hbm_bytes_total"), vec(total))
+
+    # Batched scrape (ADR-015): the client's default fan-out issues
+    # matcher-joined `{__name__=~...}` queries; serve them the union of
+    # the same samples with __name__ injected for the demux, so the
+    # batched and per-metric paths return identical values. Batches
+    # whose members have no demo data are left unregistered — the
+    # client's fallback re-asks per metric, exercising the real policy.
+    from ..metrics.client import (
+        LOGICAL_METRICS,
+        NODE_MAP_QUERY,
+        batched_instant_queries,
+    )
+
+    demo_series: dict[str, list[tuple[dict, float]]] = {
+        "tensorcore_utilization": util,
+        "hbm_bytes_used": used,
+        "hbm_bytes_total": total,
+        NODE_MAP_QUERY: uname,
+    }
+    batchable = [NODE_MAP_QUERY]
+    for candidates in LOGICAL_METRICS.values():
+        batchable.extend(candidates)
+    for batched_promql, by_name in batched_instant_queries(batchable):
+        samples = [
+            ({**labels, "__name__": name}, v)
+            for name in by_name
+            for labels, v in demo_series.get(name, [])
+        ]
+        if samples:
+            t.add(q(batched_promql), vec(samples))
 
     # Range queries: synthesize utilization history on exactly the
     # requested (start, end, step) grid so the forecaster has real

@@ -337,3 +337,117 @@ class TestDemoWiring:
         first_range_calls = sum(1 for c in t.calls if "query_range" in c)
         app.handle("/tpu/metrics")  # within TTL: no refit, no refetch
         assert sum(1 for c in t.calls if "query_range" in c) == first_range_calls
+
+
+class TestWarmStart:
+    """ADR-015 warm-start incremental fit: the carried (params,
+    opt_state) refines with a short scan; an untrustworthy carry
+    demotes to a cold refit with the reason RECORDED, never silently."""
+
+    def _series(self, n_chips=3, length=48):
+        import numpy as np
+
+        base = np.linspace(0.2, 0.8, length, dtype="float32")
+        return np.tile(base, (n_chips, 1)) + 0.01 * np.arange(
+            n_chips, dtype="float32"
+        ).reshape(-1, 1)
+
+    def test_cold_fit_seeds_state(self):
+        from headlamp_tpu.models import fit_and_forecast_incremental
+
+        out, d, state = fit_and_forecast_incremental(self._series(), steps=12)
+        assert d.path in ("xla", "pallas") and not d.warm
+        assert d.warm_demotion_reason is None and d.carried_from_generation is None
+        assert state is not None and state.generation == 0
+        assert state.cold_mse == d.fit_mse and state.n_chips == 3
+
+    def test_warm_fit_within_tolerance_of_cold(self):
+        # Parity: refining a converged carry on the SAME series must
+        # stay within the demotion tolerance of the cold MSE (the warm
+        # scan body is the cold scan body — only the step count and the
+        # starting point differ), and be recorded as the warm path.
+        from headlamp_tpu.models import fit_and_forecast_incremental
+        from headlamp_tpu.models.forecast import COLD_MSE_TOLERANCE
+
+        series = self._series()
+        _, cold, state = fit_and_forecast_incremental(series, steps=30)
+        out, warm, state2 = fit_and_forecast_incremental(
+            series, state=state, steps=30, warm_steps=5
+        )
+        assert warm.warm and warm.path.endswith("-warm")
+        assert warm.warm_demotion_reason is None
+        assert warm.carried_from_generation == 0
+        assert warm.fit_mse <= COLD_MSE_TOLERANCE * max(cold.fit_mse, 1e-4)
+        assert state2.generation == 0  # warm refinement is not a new lineage
+        assert out.shape[0] == 3
+
+    def test_fleet_resize_demotes_with_reason(self):
+        from headlamp_tpu.models import fit_and_forecast_incremental
+
+        _, _, state = fit_and_forecast_incremental(self._series(3), steps=12)
+        out, d, state2 = fit_and_forecast_incremental(
+            self._series(5), state=state, steps=12
+        )
+        assert not d.warm and d.path in ("xla", "pallas")
+        assert "chips 3->5" in d.warm_demotion_reason
+        assert d.carried_from_generation == 0
+        assert state2.generation == 1 and state2.n_chips == 5
+        assert out.shape[0] == 5
+
+    def test_bad_warm_mse_demotes_to_cold(self):
+        # A carry whose recorded cold MSE is absurdly good makes the
+        # warm fit fail the tolerance check: the result must come from
+        # a cold refit, with the MSE comparison in the recorded reason.
+        from headlamp_tpu.models import fit_and_forecast_incremental
+
+        series = self._series()
+        _, _, state = fit_and_forecast_incremental(series, steps=12)
+        rigged = state._replace(cold_mse=1e-12)
+        _, d, state2 = fit_and_forecast_incremental(
+            series, state=rigged, steps=12, warm_steps=2
+        )
+        assert not d.warm and "warm mse" in d.warm_demotion_reason
+        assert state2.generation == 1
+        assert state2.cold_mse == d.fit_mse  # the new cold baseline
+
+    def test_short_history_passes_state_through(self):
+        import numpy as np
+
+        from headlamp_tpu.models import ForecastConfig, fit_and_forecast_incremental
+
+        cfg = ForecastConfig()
+        _, _, state = fit_and_forecast_incremental(self._series(), steps=12)
+        short = np.full((3, cfg.window // 2), 0.4, dtype="float32")
+        out, d, state2 = fit_and_forecast_incremental(short, state=state)
+        assert d.path == "repeat"
+        assert state2 is state  # untouched: a short window says nothing
+
+    def test_service_threads_warm_fields_to_view(self):
+        from headlamp_tpu.models.service import forecast_from_history_incremental
+
+        t = matrix_transport(lambda c, ts: 0.5 + 0.1 * ((ts // 60) % 3))
+        hist = fetch_utilization_history(
+            t, prometheus=PROM, window_s=3600, step_s=60, clock=lambda: 10_000.0
+        )
+        cold_view, state = forecast_from_history_incremental(hist, steps=12)
+        assert cold_view.carried_from_generation is None
+        warm_view, _ = forecast_from_history_incremental(
+            hist, state=state, steps=12, warm_steps=4
+        )
+        assert warm_view.inference_path.endswith("-warm")
+        assert warm_view.carried_from_generation == 0
+        assert warm_view.warm_demotion_reason is None
+        # The per-chip summary survives the warm path identically.
+        assert len(warm_view.chips) == len(cold_view.chips)
+        # The page says the fit was warm-started (dispatch observability).
+        el = metrics_page(
+            TpuMetricsSnapshot(
+                namespace="monitoring",
+                service="prometheus-k8s:9090",
+                chips=[TpuChipMetrics(node="n1", accelerator_id="0", duty_cycle=0.4)],
+                availability={"duty_cycle": True},
+                fetch_ms=1.0,
+            ),
+            warm_view,
+        )
+        assert "warm-start fit" in text_content(el)

@@ -220,6 +220,118 @@ class TestFetchAndJoin:
         assert len(snap.by_node["a"]) == 2
 
 
+class TestBatchedScrape:
+    """ADR-015 matcher-joined batching: the per-metric fan-out folds
+    into ``{__name__=~"a|b|c",selector}`` queries, and the demuxed
+    results must be IDENTICAL to the unbatched path — batching is an
+    optimization, never a dependency."""
+
+    def _add_batched_routes(self, t, series):
+        """Register the batched-query responses the production fetch
+        will issue, built from the same union the client batches —
+        exact routes, so they win over make_prom_transport's
+        empty-vector prefix."""
+        from headlamp_tpu.metrics.client import (
+            LOGICAL_METRICS,
+            NODE_MAP_QUERY,
+            batched_instant_queries,
+        )
+
+        batchable = [NODE_MAP_QUERY]
+        for candidates in LOGICAL_METRICS.values():
+            batchable.extend(candidates)
+        for batched_promql, by_name in batched_instant_queries(batchable):
+            samples = [
+                ({**labels, "__name__": name}, value)
+                for name in by_name
+                for labels, value in series.get(name, [])
+            ]
+            if samples:
+                t.add(proxy_path(batched_promql), vector(samples))
+
+    def test_grouped_by_selector_in_first_seen_order(self):
+        from headlamp_tpu.metrics.client import batched_instant_queries
+
+        batches = batched_instant_queries(
+            ["a", 'b{x="1"}', "c", 'd{x="1"}', "a"]  # dup name dropped
+        )
+        assert batches[0] == ('{__name__=~"a|c"}', {"a": "a", "c": "c"})
+        assert batches[1] == (
+            '{__name__=~"b|d",x="1"}',
+            {"b": 'b{x="1"}', "d": 'd{x="1"}'},
+        )
+
+    def test_unbatchable_expression_rides_as_singleton(self):
+        from headlamp_tpu.metrics.client import batched_instant_queries
+
+        expr = "rate(foo_total[5m])"
+        batches = batched_instant_queries([expr, "bar"])
+        assert (expr, {expr: expr}) in batches
+        assert ('{__name__=~"bar"}', {"bar": "bar"}) in batches
+
+    def test_batched_results_identical_to_unbatched(self):
+        import dataclasses
+
+        node = "gke-tpu-node-1"
+        series = {
+            "tensorcore_utilization": [
+                ({"node": node, "accelerator_id": "0"}, 0.85),
+                ({"node": node, "accelerator_id": "1"}, 0.42),
+            ],
+            "hbm_bytes_used": [({"node": node, "accelerator_id": "0"}, 12 * GIB)],
+            "hbm_bytes_total": [({"node": node, "accelerator_id": "0"}, 16 * GIB)],
+            "node_uname_info": [({"node": node, "machine": "tpu-vm"}, 1.0)],
+        }
+
+        def snap_and_queries(batched):
+            t = make_prom_transport(series)
+            if batched:
+                self._add_batched_routes(t, series)
+            snap = fetch_tpu_metrics(t, batched=batched)
+            queries = sum(1 for c in t.calls if "query?query=" in c)
+            return snap, queries
+
+        unbatched, n_unbatched = snap_and_queries(False)
+        batched, n_batched = snap_and_queries(True)
+        assert [dataclasses.asdict(c) for c in batched.chips] == [
+            dataclasses.asdict(c) for c in unbatched.chips
+        ]
+        assert batched.availability == unbatched.availability
+        assert batched.resolved_series == unbatched.resolved_series
+        # The fold is the point: strictly fewer requests, ≤8 + discovery.
+        assert n_batched < n_unbatched
+        assert n_batched <= 8 + 1  # +1: the discovery probe
+
+    def test_empty_batch_falls_back_to_per_metric_queries(self):
+        # A GMP-style frontend may reject or empty-answer a cross-metric
+        # matcher: the data must still arrive via the per-metric
+        # fallback wave — no registered batched routes here, so every
+        # batch resolves empty against the prefix.
+        t = make_prom_transport({
+            "tensorcore_utilization": [({"node": "n1", "accelerator_id": "0"}, 0.7)],
+        })
+        snap = fetch_tpu_metrics(t, batched=True)
+        assert snap is not None
+        assert snap.chips[0].tensorcore_utilization == 0.7
+        assert snap.availability["tensorcore_utilization"] is True
+
+    def test_demux_strips_name_label_from_metric_labels(self):
+        # Joined rows must key on the chip labels exactly as the
+        # unbatched path does: a leaked __name__ would fork join keys.
+        series = {
+            "tensorcore_utilization": [
+                ({"node": "n1", "accelerator_id": "0"}, 0.6),
+            ],
+            "hbm_bytes_used": [({"node": "n1", "accelerator_id": "0"}, GIB)],
+        }
+        t = make_prom_transport(series)
+        self._add_batched_routes(t, series)
+        snap = fetch_tpu_metrics(t, batched=True)
+        assert len(snap.chips) == 1  # one chip, not one per metric
+        assert snap.chips[0].tensorcore_utilization == 0.6
+        assert snap.chips[0].hbm_bytes_used == GIB
+
+
 class TestFormatters:
     def test_format_percent(self):
         assert format_percent(0.874) == "87.4%"

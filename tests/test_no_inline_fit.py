@@ -1,0 +1,101 @@
+"""Inline-fit gate (tools/no_inline_fit_check.py, ADR-015).
+
+Two halves, mirroring tests/test_no_raw_urlopen.py:
+  1. The gate itself: the live repo tree must be clean — no serving
+     code outside ``headlamp_tpu/models/`` (and the refresher) calls
+     ``fit_and_forecast*`` directly; request handlers go through the
+     stale-while-revalidate refresher.
+  2. Mutation coverage: sources that smuggle a fit call back in
+     (attribute call, ``from ... import`` with/without alias, bare
+     reference passed as a callback) must each produce a diagnostic —
+     and sanctioned look-alikes (other names, prose mentions, stores)
+     must not.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from no_inline_fit_check import _check_source, check_tree  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_repo_tree_is_clean():
+    diagnostics = check_tree(REPO)
+    assert diagnostics == [], "\n".join(str(d) for d in diagnostics)
+
+
+def test_models_and_refresher_are_exempt():
+    paths = {d.path for d in check_tree(REPO)}
+    assert not any("models" in p or "refresh.py" in p for p in paths)
+
+
+class TestMutations:
+    def _diags(self, src):
+        return _check_source("mut.py", src)
+
+    def test_attribute_call_flagged(self):
+        diags = self._diags(
+            "from headlamp_tpu import models\n"
+            "preds = models.fit_and_forecast(series)\n"
+        )
+        assert len(diags) == 1 and diags[0].line == 2
+
+    def test_with_dispatch_variant_flagged(self):
+        diags = self._diags(
+            "import headlamp_tpu.models.forecast as fc\n"
+            "out, d = fc.fit_and_forecast_with_dispatch(series)\n"
+        )
+        assert len(diags) == 1
+
+    def test_incremental_variant_flagged(self):
+        diags = self._diags(
+            "from headlamp_tpu.models.forecast import fit_and_forecast_incremental\n"
+        )
+        assert len(diags) == 1 and diags[0].line == 1
+
+    def test_import_and_call_both_flagged(self):
+        diags = self._diags(
+            "from headlamp_tpu.models import fit_and_forecast\n"
+            "x = fit_and_forecast(series)\n"
+        )
+        assert [d.line for d in diags] == [1, 2]
+
+    def test_aliased_import_reference_flagged(self):
+        # The alias hides the forbidden prefix from the bare-name scan;
+        # the import tracking must carry it.
+        diags = self._diags(
+            "from headlamp_tpu.models import fit_and_forecast as quick_fit\n"
+            "cb = quick_fit\n"
+        )
+        assert [d.line for d in diags] == [1, 2]
+
+    def test_bare_reference_as_callback_flagged(self):
+        diags = self._diags(
+            "def wire(refresher):\n"
+            "    refresher.get('k', fit_and_forecast_with_dispatch)\n"
+        )
+        assert len(diags) == 1 and diags[0].line == 2
+
+    def test_unrelated_names_clean(self):
+        diags = self._diags(
+            "def fit_and_rank(x):\n"
+            "    return forecast_for(x)\n"
+            "view = refresher.get(key, lambda: compute_forecast(m))\n"
+        )
+        assert diags == []
+
+    def test_prose_and_strings_clean(self):
+        diags = self._diags(
+            "# fit_and_forecast is forbidden here\n"
+            "DOC = 'call fit_and_forecast via the refresher'\n"
+        )
+        assert diags == []
+
+    def test_unparseable_reports_instead_of_crashing(self):
+        diags = self._diags("def broken(:\n")
+        assert len(diags) == 1 and "unparseable" in diags[0].message

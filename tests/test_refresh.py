@@ -1,0 +1,241 @@
+"""Refresher (ADR-015 stale-while-revalidate) contract tests.
+
+All age math runs on an injected monotonic list-cell clock — no test
+sleeps to expire anything. Real time appears only where the contract
+itself is about threads (single-flight joins, background refits), and
+there the tests wait on events/drain(), never fixed sleeps.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from headlamp_tpu.runtime.refresh import Refresher
+
+
+def make(ttl=5.0, grace=60.0, **kw):
+    clock = [1000.0]
+    r = Refresher("t", ttl_s=ttl, grace_s=grace, monotonic=lambda: clock[0], **kw)
+    return r, clock
+
+
+def test_grace_must_cover_ttl():
+    with pytest.raises(ValueError):
+        Refresher("t", ttl_s=10.0, grace_s=5.0)
+
+
+def test_fresh_hit_never_recomputes():
+    r, clock = make()
+    calls = [0]
+
+    def compute():
+        calls[0] += 1
+        return calls[0]
+
+    assert r.get("k", compute) == 1  # cold fill blocks
+    clock[0] += r.ttl_s  # age == ttl is still fresh
+    assert r.get("k", compute) == 1
+    assert calls[0] == 1
+    snap = r.snapshot()
+    assert snap["served_fresh"] == 1 and snap["refits"] == 1
+
+
+def test_stale_within_grace_serves_old_value_and_refits_in_background():
+    r, clock = make(ttl=5.0, grace=60.0)
+    calls = [0]
+
+    def compute():
+        calls[0] += 1
+        return calls[0]
+
+    assert r.get("k", compute) == 1
+    clock[0] += 6.0  # past ttl, inside grace
+    # Served IMMEDIATELY with the stale value — the fit cost moves off
+    # the request path, which is the whole point of the module.
+    assert r.get("k", compute) == 1
+    assert r.drain()
+    assert r.snapshot()["served_stale"] == 1
+    assert calls[0] == 2  # the background refit ran
+    assert r.get("k", compute) == 2  # the refreshed value now serves fresh
+
+
+def test_stale_serve_spawns_exactly_one_refit():
+    r, clock = make(ttl=5.0, grace=60.0)
+    release = threading.Event()
+    calls = [0]
+
+    def compute():
+        calls[0] += 1
+        if calls[0] > 1:
+            release.wait(5.0)
+        return calls[0]
+
+    r.get("k", compute)
+    clock[0] += 6.0
+    # Many stale reads while the single background flight is blocked:
+    # single-flight per (key, epoch) must not stack refits.
+    for _ in range(5):
+        assert r.get("k", compute) == 1
+    release.set()
+    assert r.drain()
+    assert calls[0] == 2
+    assert r.snapshot()["served_stale"] == 5
+
+
+def test_past_grace_blocks_for_fresh_value():
+    r, clock = make(ttl=5.0, grace=10.0)
+    calls = [0]
+
+    def compute():
+        calls[0] += 1
+        return calls[0]
+
+    r.get("k", compute)
+    clock[0] += 11.0  # past grace: too old to serve
+    assert r.get("k", compute) == 2
+    assert r.snapshot()["served_stale"] == 0
+
+
+def test_epoch_bump_invalidates_entry():
+    r, clock = make()
+    calls = [0]
+
+    def compute():
+        calls[0] += 1
+        return calls[0]
+
+    assert r.get("k", compute, epoch=0) == 1
+    # Same key, bumped epoch (the /refresh handler's invalidation):
+    # the within-TTL entry must NOT serve.
+    assert r.get("k", compute, epoch=1) == 2
+    assert calls[0] == 2
+    # The old epoch's entry is gone too (overwritten by the new fill).
+    assert r.peek("k", epoch=0) is None
+    assert r.peek("k", epoch=1) == 2
+
+
+def test_concurrent_cold_misses_join_one_flight():
+    r, _clock = make()
+    started = threading.Event()
+    release = threading.Event()
+    calls = [0]
+
+    def compute():
+        calls[0] += 1
+        started.set()
+        release.wait(5.0)
+        return "v"
+
+    results = []
+    threads = [
+        threading.Thread(target=lambda: results.append(r.get("k", compute)))
+        for _ in range(4)
+    ]
+    threads[0].start()
+    assert started.wait(5.0)
+    for t in threads[1:]:
+        t.start()
+    release.set()
+    for t in threads:
+        t.join(5.0)
+    assert results == ["v"] * 4
+    assert calls[0] == 1  # one leader computed; three waiters joined
+
+
+def test_foreground_error_propagates_to_all_waiters():
+    r, _clock = make()
+    started = threading.Event()
+    release = threading.Event()
+
+    def compute():
+        started.set()
+        release.wait(5.0)
+        raise RuntimeError("scrape down")
+
+    errors = []
+
+    def reader():
+        try:
+            r.get("k", compute)
+        except RuntimeError as e:
+            errors.append(str(e))
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    threads[0].start()
+    assert started.wait(5.0)
+    for t in threads[1:]:
+        t.start()
+    release.set()
+    for t in threads:
+        t.join(5.0)
+    assert errors == ["scrape down"] * 3
+    assert r.snapshot()["refit_errors"] == 1
+    # The failed flight is cleared: the next get retries the compute.
+    assert r.get("k", lambda: "recovered") == "recovered"
+
+
+def test_background_error_absorbed_and_counted():
+    r, clock = make(ttl=5.0, grace=60.0)
+    calls = [0]
+
+    def compute():
+        calls[0] += 1
+        if calls[0] > 1:
+            raise RuntimeError("refit failed")
+        return "v1"
+
+    r.get("k", compute)
+    clock[0] += 6.0
+    assert r.get("k", compute) == "v1"  # stale served despite refit error
+    assert r.drain()
+    assert r.snapshot()["refit_errors"] == 1
+    # Still inside grace: the old value keeps serving (degraded, counted).
+    assert r.get("k", compute) == "v1"
+
+
+def test_entries_capped_by_lru_on_fetch_time():
+    r, clock = make(max_entries=2)
+    for i, key in enumerate(("a", "b", "c")):
+        clock[0] += 1.0
+        r.get(key, lambda i=i: i)
+    assert r.snapshot()["entries"] == 2
+    assert r.peek("a") is None  # oldest fetched_mono evicted
+    assert r.peek("b") == 1 and r.peek("c") == 2
+
+
+def test_peek_never_computes_and_honors_max_age():
+    r, clock = make(ttl=5.0, grace=60.0)
+    assert r.peek("k") is None
+    r.get("k", lambda: "v")
+    clock[0] += 10.0
+    assert r.peek("k") == "v"  # default limit is the grace window
+    assert r.peek("k", max_age_s=5.0) is None
+    assert r.snapshot()["refits"] == 1
+
+
+def test_note_demotion_counts():
+    r, _clock = make()
+    r.note_demotion()
+    r.note_demotion()
+    assert r.snapshot()["demotions_to_cold"] == 2
+
+
+def test_drain_reports_timeout():
+    r, clock = make(ttl=5.0, grace=60.0)
+    release = threading.Event()
+    calls = [0]
+
+    def compute():
+        calls[0] += 1
+        if calls[0] > 1:
+            release.wait(10.0)
+        return calls[0]
+
+    r.get("k", compute)
+    clock[0] += 6.0
+    r.get("k", compute)  # spawns the blocked background refit
+    assert r.drain(timeout_s=0.1) is False
+    release.set()
+    assert r.drain()

@@ -268,7 +268,12 @@ class TestCaching:
         queries = self._query_count(app._transport)
         app.handle("/tpu/metrics")  # within TTL: served from cache
         assert self._query_count(app._transport) == queries
-        clock[0] += app.METRICS_TTL_S + 1
+        # Past the GRACE window, not just the TTL: within grace the
+        # refresher serves stale and refetches on a background worker
+        # (ADR-015 — covered by test_refresh.py / the stale-serve tests
+        # below), so only a past-grace read deterministically blocks on
+        # a synchronous refetch this assertion can count.
+        clock[0] += app.METRICS_GRACE_S + 1
         app.handle("/tpu/metrics")
         assert self._query_count(app._transport) > queries
         # The warm refetch fans out but does NOT re-walk the discovery
@@ -368,6 +373,80 @@ class TestCaching:
         # Different chip set: stale forecast must NOT be served.
         m2 = metrics([("n2", "0")])
         assert app._forecast_for(m2) == "forecast" and len(fits) == 2
+
+    def test_slow_refit_never_blocks_stale_forecast_reads(self):
+        # THE r09 regression test: pre-ADR-015, _forecast_for held the
+        # cache lock across the whole fit, so a TTL lapse parked every
+        # concurrent metrics request behind a multi-second refit. Now a
+        # reader inside the grace window gets the same-key, same-epoch
+        # stale entry IMMEDIATELY while exactly one background refit
+        # runs — proven with an injected fit that stays blocked until
+        # the test releases it.
+        import threading
+        from types import SimpleNamespace
+
+        clock = [100.0]
+        app = DashboardApp(
+            make_demo_transport("v5e4"),
+            min_sync_interval_s=0.0,
+            monotonic=lambda: clock[0],
+        )
+        release = threading.Event()
+        fits = []
+
+        def slow_fit(m):
+            fits.append(1)
+            if len(fits) > 1:
+                release.wait(10.0)
+            return f"view{len(fits)}"
+
+        app._compute_forecast = slow_fit
+        m = SimpleNamespace(
+            namespace="monitoring",
+            service="prometheus-k8s:9090",
+            chips=[SimpleNamespace(node="n1", accelerator_id="0")],
+        )
+        try:
+            assert app._forecast_for(m) == "view1"  # cold fill (fast)
+            clock[0] += app.FORECAST_TTL_S + 1  # stale, inside grace
+            # Served stale while the injected refit is STILL BLOCKED —
+            # this very call would have hung before the refresher.
+            assert app._forecast_for(m) == "view1"
+            # Concurrent same-key readers also get the stale entry and
+            # do NOT stack a second flight (single-flight per key+epoch).
+            got = []
+            t = threading.Thread(target=lambda: got.append(app._forecast_for(m)))
+            t.start()
+            t.join(5.0)
+            assert got == ["view1"] and len(fits) == 2
+            assert app._forecast_refresher.snapshot()["served_stale"] == 2
+        finally:
+            release.set()
+        assert app._forecast_refresher.drain()
+        assert app._forecast_for(m) == "view2"  # the refit landed
+
+    def test_background_refit_warm_starts_from_carried_state(self):
+        # App-level warm carry: the state the cold fit seeded must feed
+        # the background refit after the TTL lapse, and the refreshed
+        # view must SAY so (path "*-warm") — never a silent cold refit.
+        clock = [100.0]
+        app = DashboardApp(
+            make_demo_transport("v5e4"),
+            min_sync_interval_s=0.0,
+            monotonic=lambda: clock[0],
+        )
+        status, _, _ = app.handle("/tpu/metrics")
+        assert status == 200 and len(app._warm_forecast_states) == 1
+        clock[0] += app.FORECAST_TTL_S + 1
+        status, _, _ = app.handle("/tpu/metrics")  # stale serve + refit
+        assert status == 200
+        assert app._forecast_refresher.drain()
+        m = app._cached_metrics()
+        view = app._forecast_refresher.peek(
+            app._metrics_key(m), epoch=app._cache_epoch
+        )
+        assert view is not None and view.inference_path.endswith("-warm")
+        assert view.warm_demotion_reason is None
 
 
 class TestBackgroundSync:

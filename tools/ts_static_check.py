@@ -1467,7 +1467,15 @@ def main() -> int:
     for diag in urlopen_diags:
         print(diag)
     print(f"{len(urlopen_diags)} raw-urlopen problem(s)")
-    return 1 if diagnostics or urlopen_diags else 0
+    # Forecast-fit gate rides along too (ADR-015): request handlers go
+    # through the refresher, never call fit_and_forecast* inline.
+    import no_inline_fit_check
+
+    fit_diags = no_inline_fit_check.check_tree()
+    for diag in fit_diags:
+        print(diag)
+    print(f"{len(fit_diags)} inline-fit problem(s)")
+    return 1 if diagnostics or urlopen_diags or fit_diags else 0
 
 
 if __name__ == "__main__":
