@@ -70,6 +70,12 @@ Extras reported alongside (same JSON line, `extra` object):
   instant-query requests per steady-state scrape with the ADR-015
   matcher-joined batching on vs off (acceptance: batched ≤ 8; was 28
   pre-pool, 15 unbatched).
+- ``slo_eval_overhead_us_per_request`` / ``exemplar_overhead_ns_per_observe``
+  / ``flight_ring_memory_kb`` / ``sloz_paint_ms`` — the ADR-016 SLO
+  subsystem budget: per-request cost of the burn-rate feeds + violation
+  check (acceptance: < 50 µs), per-observe cost of exemplar capture
+  under an active trace, a full flight ring's resident size, and the
+  /sloz/html evaluation+render latency.
 - ``prev_round_regressions`` — fail-soft round-over-round comparator:
   shared numeric metrics >25% worse than the latest committed
   ``BENCH_r*.json`` are named here (details on stderr), direction-aware
@@ -743,6 +749,95 @@ def bench_telemetry(fleet) -> dict:
     }
 
 
+def bench_slo(fleet) -> dict:
+    """ADR-016 acceptance numbers for the SLO engine, exemplars and the
+    flight recorder:
+
+    - ``slo_eval_overhead_us_per_request`` — the three calls the serving
+      path adds per request (latency feed, status feed, violation
+      check) on a scratch engine, amortized (acceptance: < 50 µs).
+    - ``exemplar_overhead_ns_per_observe`` — Histogram.observe under an
+      active trace with the exemplar source installed, minus the same
+      observe with it uninstalled.
+    - ``flight_ring_memory_kb`` — resident size of a FULL ring (256
+      recent + 64 pinned representative wide events).
+    - ``sloz_paint_ms`` — /sloz/html median: evaluate every objective +
+      render, after real traffic has populated the windows."""
+    from headlamp_tpu.obs import exemplars as exemplars_mod
+    from headlamp_tpu.obs import set_tracing, trace_request
+    from headlamp_tpu.obs.flight import FlightRecorder, wide_event
+    from headlamp_tpu.obs.metrics import Histogram
+    from headlamp_tpu.obs.slo import REQUEST_DURATION, REQUESTS_TOTAL, SLOEngine
+
+    engine = SLOEngine()
+    n = 5000
+    latency_labels = {"route": "/tpu"}
+    status_labels = {"route": "/tpu", "status": "200"}
+    t0 = time.perf_counter()
+    for _ in range(n):
+        engine.feed_latency(REQUEST_DURATION, 0.012, latency_labels)
+        engine.feed_error(REQUESTS_TOTAL, 1, status_labels)
+        engine.violations("/tpu", 0.012, 200)
+    per_request_us = (time.perf_counter() - t0) / n * 1e6
+
+    # Exemplar capture delta: same scratch histogram, source on vs off,
+    # inside a live trace so the ContextVar read actually resolves.
+    hist = Histogram("headlamp_tpu_bench_scratch_seconds", "bench scratch")
+    set_tracing(True)
+
+    def observe_ns() -> float:
+        with trace_request("/bench-exemplar"):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                hist.observe(0.012)
+            return (time.perf_counter() - t0) / n * 1e9
+
+    try:
+        with_ns = observe_ns()
+        exemplars_mod.uninstall()
+        without_ns = observe_ns()
+    finally:
+        exemplars_mod.install()
+
+    ring = FlightRecorder()
+    event = wide_event(
+        path="/tpu/metrics?window=1h",
+        route="/tpu/metrics",
+        status=200,
+        duration_s=0.137,
+        trace={
+            "trace_id": "deadbeef00112233",
+            "spans": [
+                {"name": "sync.snapshot", "duration_ms": 12.0, "children": []},
+                {"name": "metrics.fanout", "duration_ms": 80.0, "children": []},
+                {"name": "render.html", "duration_ms": 9.0, "children": []},
+            ],
+        },
+        counters_before={"transport.reused": 10, "cache.hits": 5},
+        counters_after={"transport.reused": 14, "cache.hits": 6},
+    )
+    for _ in range(ring.capacity):
+        ring.record(dict(event))
+    for _ in range(ring.pinned_capacity):
+        ring.record(dict(event, slo_violations=["scrape_paint"]), pinned=True)
+
+    app = make_app(fleet)
+    app.handle("/tpu")
+    app.handle("/tpu/metrics")  # feed the real engine some real traffic
+    samples = []
+    for _ in range(15):
+        t0 = time.perf_counter()
+        status, _, body = app.handle("/sloz/html")
+        samples.append((time.perf_counter() - t0) * 1000)
+        assert status == 200 and "Service Level Objectives" in body
+    return {
+        "slo_eval_overhead_us_per_request": round(per_request_us, 2),
+        "exemplar_overhead_ns_per_observe": round(with_ns - without_ns, 1),
+        "flight_ring_memory_kb": round(ring.memory_bytes() / 1024, 1),
+        "sloz_paint_ms": round(statistics.median(samples), 2),
+    }
+
+
 def bench_transport_pool(fleet) -> dict:
     """ADR-014 acceptance numbers over REAL sockets. The in-process
     MockTransport the other benches use never opens a connection, so
@@ -911,6 +1006,7 @@ def main() -> None:
     transfers = bench_request_transfer_discipline()
     watch = bench_watch_steady_state()
     telemetry = bench_telemetry(fleet)
+    slo = bench_slo(fleet)
     transport_pool = bench_transport_pool(fleet)
     record = {
         "metric": (
@@ -951,6 +1047,7 @@ def main() -> None:
             **transfers,
             **watch,
             **telemetry,
+            **slo,
             **transport_pool,
         },
     }

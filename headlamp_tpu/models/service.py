@@ -213,6 +213,38 @@ def forecast_from_history_incremental(
     return view, new_state
 
 
+def forecast_slo_burn(
+    series: list[float],
+    *,
+    state: WarmState | None = None,
+    steps: int = 60,
+) -> tuple[list[float] | None, WarmState | None]:
+    """Fit the service's OWN scrape→paint latency series and return
+    predicted latencies for the next ``steps`` ticks, plus the warm
+    carry (ADR-015) — the SLO engine's self-forecast (ADR-016)
+    classifies them against the objective threshold to project budget
+    exhaustion. Lives here, not in obs/, because the inline-fit gate
+    confines ``fit_and_forecast*`` to the models layer; degrades to
+    ``(None, state)`` on any failure (jax-less host, thin series) so
+    /sloz renders a named reason instead of 500ing."""
+    import numpy as np
+
+    if len(series) < 2:
+        return None, state
+    try:
+        with _span("slo.budget_fit", series=len(series), steps=steps):
+            preds, _dispatch, new_state = fit_and_forecast_incremental(
+                np.asarray(series, dtype=float),
+                ForecastConfig(),
+                state=state,
+                steps=steps,
+            )
+        return [float(p) for p in np.asarray(preds)], new_state
+    except Exception:
+        # Same progressive-enhancement posture as the page forecast.
+        return None, state
+
+
 def compute_forecast_incremental(
     transport: Any,
     metrics: Any,

@@ -1,18 +1,29 @@
 """Unified telemetry subsystem (ADR-013).
 
-Three pieces, one package:
+Six pieces, one package:
 
 - :mod:`.metrics` — the process metric registry behind ``/metricsz``
   (counters, gauges, fixed-log-bucket histograms, Prometheus text
-  exposition). The transfer/device-cache/calibration counter bags are
-  views over it.
+  exposition, per-bucket exemplar storage). The transfer/device-cache/
+  calibration counter bags are views over it.
 - :mod:`.trace` — contextvar-carried request traces (span nesting,
   monotonic timing, per-span attributes) retained in a bounded ring.
-- :mod:`.debug_pages` — the waterfall page over the ring; its JSON
-  twin is served at ``/debug/traces`` by the app layer.
+- :mod:`.exemplars` — the glue that points the metrics layer's
+  exemplar hook at the trace layer's active trace id (installed below,
+  at package import, so every traced histogram observe carries its
+  request's id with no per-call-site wiring).
+- :mod:`.slo` — declarative SLOs + multi-window burn-rate evaluation
+  fed from registry instrument observers (ADR-016); serves /sloz,
+  the /healthz ``runtime.slo`` block, and per-SLO /metricsz gauges.
+- :mod:`.flight` — the always-on flight recorder: one wide event per
+  request, errored/SLO-violating ones pinned, dumped at /debug/flightz.
+- :mod:`.debug_pages` — the waterfall + SLO status pages over the
+  rings; their JSON twins are served by the app layer.
 
 Stdlib-only: the server imports this unconditionally, so it must load
-on jax-less hosts and cost nothing when tracing is off.
+on jax-less hosts and cost nothing when tracing is off. (The SLO
+self-forecast touches models/ lazily, at evaluation time, never at
+import.)
 """
 
 from __future__ import annotations
@@ -25,12 +36,21 @@ from .trace import (
     Trace,
     TraceRing,
     annotate,
+    current_trace_id,
     set_tracing,
     span,
     trace_request,
     trace_ring,
     tracing_enabled,
 )
+
+# Ordering: .exemplars and .slo sit above .metrics/.trace, so those two
+# must be fully imported first (cycle safety).
+from . import exemplars as _exemplars
+from .flight import FlightRecorder, flight_recorder, wide_event
+from .slo import SLOEngine, SLOSpec, default_specs, engine as slo_engine, set_engine as set_slo_engine
+
+_exemplars.install()
 
 #: The ring's depth is itself scrapeable — an operator alerting on
 #: "server up but ring empty" catches a disabled-tracing deploy.
@@ -50,9 +70,18 @@ __all__ = [
     "Trace",
     "TraceRing",
     "annotate",
+    "current_trace_id",
     "set_tracing",
     "span",
     "trace_request",
     "trace_ring",
     "tracing_enabled",
+    "FlightRecorder",
+    "flight_recorder",
+    "wide_event",
+    "SLOEngine",
+    "SLOSpec",
+    "default_specs",
+    "slo_engine",
+    "set_slo_engine",
 ]

@@ -1,18 +1,26 @@
-"""Trace waterfall page — the HTML face of the trace ring.
+"""Trace waterfall + SLO status pages — the HTML faces of obs/.
 
 Built from the same ``ui/vdom.py`` components as every other page and
-registered as a normal route (``/debug/traces/html``, registration.py),
-so the host renders it through the standard nav/chrome and the
-"all registered routes render" test covers it for free. The JSON twin
-lives at ``/debug/traces`` (served directly by the app layer — it is
-data, not a page).
+registered as normal routes (``/debug/traces/html`` and ``/sloz/html``,
+registration.py), so the host renders them through the standard
+nav/chrome and the "all registered routes render" test covers them for
+free. The JSON twins live at ``/debug/traces`` and ``/sloz`` (served
+directly by the app layer — they are data, not pages).
 
-Layout: traces sorted slowest-first (the page exists to answer "what
-were the slowest recent requests"), each with a per-span row — an
-indented stage label, a proportional bar positioned at the span's
-offset within the request, and the duration + attributes. Bar geometry
-is inline style (percentages of the trace duration); classes carry the
-visual identity so style.py themes it with the rest of the kit.
+Waterfall layout: traces sorted slowest-first (the page exists to
+answer "what were the slowest recent requests"), each with a per-span
+row — an indented stage label, a proportional bar positioned at the
+span's offset within the request, and the duration + attributes. Bar
+geometry is inline style (percentages of the trace duration); classes
+carry the visual identity so style.py themes it with the rest of the
+kit. Each trace section carries an ``id="trace-<trace_id>"`` anchor —
+the click target of /sloz/html's exemplar links, closing the two-hop
+loop from a burning objective to the exact request's waterfall.
+
+SLO layout: one section per objective — state chip, burn rate per
+window against the page/warn thresholds, error-budget meter, recent
+latency exemplars linking to their traces — plus the self-forecast's
+projected budget exhaustion (ADR-016).
 """
 
 from __future__ import annotations
@@ -20,6 +28,7 @@ from __future__ import annotations
 import time
 from typing import Any
 
+from ..ui.components import BudgetBar, StatusLabel
 from ..ui.vdom import Element, h
 
 
@@ -79,9 +88,15 @@ def _trace_section(trace: dict[str, Any]) -> Element:
     )  # wall clock is for DISPLAY only (ADR-013); durations are monotonic
     status = trace["status"]
     status_class = "hl-status-ok" if status < 400 else "hl-status-err"
+    trace_id = trace.get("trace_id", "")
+    props: dict[str, Any] = {"class_": "hl-section hl-trace"}
+    if trace_id:
+        # The anchor /sloz/html exemplar links (and any /metricsz
+        # exemplar copy-paste) land on.
+        props["id"] = f"trace-{trace_id}"
     return h(
         "section",
-        {"class_": "hl-section hl-trace"},
+        props,
         h(
             "header",
             {"class_": "hl-trace-header"},
@@ -91,7 +106,8 @@ def _trace_section(trace: dict[str, Any]) -> Element:
                 "span",
                 {"class_": "hl-hint"},
                 f"{_fmt_ms(trace['duration_ms'])} · {trace['device_gets']} "
-                f"device_get(s) · started {started}",
+                f"device_get(s) · started {started}"
+                + (f" · trace {trace_id}" if trace_id else ""),
             ),
         ),
         [_span_rows(s, trace["duration_ms"], 0) for s in trace["spans"]]
@@ -122,4 +138,118 @@ def traces_page(traces: list[dict[str, Any]]) -> Element:
             {"class_": "hl-empty-content"},
             "No traces captured yet — load a page, then refresh.",
         ),
+    )
+
+
+#: Engine state → StatusLabel status vocabulary.
+_SLO_STATE_STATUS = {"ok": "success", "warn": "warning", "page": "error"}
+
+
+def _forecast_line(forecast: dict[str, Any] | None) -> Element | None:
+    if forecast is None:
+        return None
+    windows = forecast.get("projected_exhaustion_windows")
+    if windows is not None:
+        text = (
+            f"Self-forecast ({forecast['slo']}): projected error-budget "
+            f"exhaustion in {windows} × {forecast.get('window', '1h')} "
+            f"window(s) at burn {forecast.get('projected_burn_rate', 0)}."
+        )
+    else:
+        text = (
+            f"Self-forecast ({forecast['slo']}): no projection "
+            f"({forecast.get('reason', 'unknown')}; "
+            f"{forecast.get('points', 0)} latency sample(s))."
+        )
+    return h("p", {"class_": "hl-hint hl-slo-forecast"}, text)
+
+
+def _exemplar_links(exemplars: list[dict[str, Any]]) -> Element | None:
+    if not exemplars:
+        return None
+    return h(
+        "p",
+        {"class_": "hl-slo-exemplars hl-hint"},
+        "Exemplar traces: ",
+        [
+            h(
+                "a",
+                {
+                    "class_": "hl-slo-exemplar",
+                    "href": f"/debug/traces/html#trace-{e['trace_id']}",
+                },
+                f"{e['trace_id'][:8]} ({e['value'] * 1000:.0f} ms)",
+            )
+            for e in exemplars
+            if e.get("trace_id")
+        ],
+    )
+
+
+def _slo_section(slo: dict[str, Any], page_burn: float, warn_burn: float) -> Element:
+    state = slo["state"]
+    burn_rows = []
+    for window, rate in slo["burn_rates"].items():
+        events = slo["events"][window]
+        level = "err" if rate >= page_burn else "warn" if rate >= warn_burn else "ok"
+        burn_rows.append(
+            h(
+                "div",
+                {"class_": f"hl-slo-burn hl-slo-burn-{level}", "data-window": window},
+                h("span", {"class_": "hl-slo-burn-window"}, window),
+                h("span", {"class_": "hl-slo-burn-rate"}, f"{rate:g}×"),
+                h(
+                    "span",
+                    {"class_": "hl-hint"},
+                    f"{events['good']} good / {events['bad']} bad",
+                ),
+            )
+        )
+    return h(
+        "section",
+        {"class_": "hl-section hl-slo", "data-slo": slo["name"], "data-state": state},
+        h(
+            "header",
+            {"class_": "hl-slo-header"},
+            StatusLabel(_SLO_STATE_STATUS.get(state, ""), state),
+            h("strong", None, slo["name"]),
+            h(
+                "span",
+                {"class_": "hl-hint"},
+                f"{slo['description']} · target {slo['target'] * 100:g}% "
+                f"within {slo['threshold_s'] * 1000:g} ms",
+            ),
+        ),
+        h("div", {"class_": "hl-slo-burns"}, burn_rows),
+        BudgetBar(slo["budget_remaining_ratio"]),
+        _exemplar_links(slo.get("exemplars", [])),
+    )
+
+
+def slo_page(report: dict[str, Any]) -> Element:
+    """The SLO status page. ``report`` is ``SLOEngine.report()`` —
+    burning objectives sort first because they are why the page was
+    opened."""
+    state_rank = {"page": 0, "warn": 1, "ok": 2}
+    ordered = sorted(
+        report.get("slos", []), key=lambda s: state_rank.get(s["state"], 3)
+    )
+    page_burn = report.get("page_burn_threshold", 0.0)
+    warn_burn = report.get("warn_burn_threshold", 0.0)
+    return h(
+        "div",
+        {"class_": "hl-slos"},
+        h("h1", None, "Service Level Objectives"),
+        h(
+            "p",
+            {"class_": "hl-hint"},
+            f"{len(ordered)} objective(s); page ≥ {page_burn:g}× on the fast "
+            f"windows, warn ≥ {warn_burn:g}× on the slow ones. Raw JSON: "
+            "/sloz · pinned bad requests: /debug/flightz (OPERATIONS.md "
+            "runbook).",
+        ),
+        _forecast_line(report.get("budget_forecast")),
+        [_slo_section(s, page_burn, warn_burn) for s in ordered]
+        if ordered
+        else h("div", {"class_": "hl-empty-content"}, "No SLOs declared."),
     )

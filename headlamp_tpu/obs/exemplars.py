@@ -1,0 +1,59 @@
+"""Trace-exemplar glue: histograms ↔ the active request trace.
+
+The OpenMetrics exemplar pattern (ISSUE r10 tentpole): every log-bucket
+histogram observe made inside a traced request records the request's
+trace id against the bucket the value landed in, and ``/metricsz``
+emits it as an exemplar clause on the bucket line::
+
+    headlamp_tpu_request_duration_seconds_bucket{route="/tpu/metrics",le="2.048"} 17 # {trace_id="9f3a..."} 1.842
+
+That makes a burning latency SLO resolvable in two hops: /sloz names
+the objective, its exemplars name concrete trace ids, and
+/debug/traces (or the waterfall page) shows where each of those
+requests spent its time.
+
+This module exists so the layering stays acyclic: ``obs/metrics.py``
+must not import the trace layer (the registry is the bottom of obs/),
+and ``obs/trace.py`` must not know about histograms. The hook is
+installed at package import (obs/__init__) and costs one ContextVar
+read per observe — measured by bench.py as
+``exemplar_overhead_ns_per_observe``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+from .metrics import Histogram, set_exemplar_source
+from .trace import current_trace_id
+
+
+def install() -> None:
+    """Point the metrics layer's exemplar source at the trace layer's
+    context. Idempotent; obs/__init__ calls it once at import."""
+    set_exemplar_source(current_trace_id)
+
+
+def uninstall() -> None:
+    """Disable exemplar capture (bench's off-leg and targeted tests)."""
+    set_exemplar_source(None)
+
+
+def exemplars_matching(
+    histogram: Histogram,
+    where: Callable[[dict[str, str]], bool] | None = None,
+) -> Iterable[dict[str, Any]]:
+    """Exemplars of ``histogram`` whose label set passes ``where``,
+    JSON-ready — the /sloz surface's bridge from an objective to its
+    recent traces. Newest-per-bucket by construction (each bucket keeps
+    its most recent exemplar only)."""
+    for values, le, trace_id, value in histogram.exemplars():
+        labels = dict(zip(histogram.labels, values))
+        if where is not None and not where(labels):
+            continue
+        yield {
+            "trace_id": trace_id,
+            "le": le,
+            "value": round(value, 6),
+            "labels": labels,
+        }

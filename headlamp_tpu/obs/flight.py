@@ -1,0 +1,166 @@
+"""Always-on flight recorder: one bounded wide event per request.
+
+The triage surface between a burning SLO and a span waterfall (ISSUE
+r10 tentpole). Every handled request collapses into ONE wide event —
+request line, resolved route, status, per-stage durations lifted from
+the request's span tree, and the deltas of the runtime counters
+(cache, refresher, transport) across the request — and lands in a
+bounded ring. Requests that errored (5xx) or violated a request-backed
+SLO threshold are additionally PINNED into a second ring that normal
+traffic cannot evict, so by the time an operator opens
+``GET /debug/flightz`` the interesting requests are still there even
+if thousands of healthy ones followed.
+
+Relationship to the trace ring (``obs/trace.py``): the trace ring
+keeps full span trees for the last N requests regardless of health;
+the flight recorder keeps a flat summary for MORE requests plus the
+pinned bad ones, and carries the trace id so the two join. Counter
+deltas are process-wide reads taken around the request — under
+concurrent traffic a delta can include a neighbour request's activity;
+that is accepted (documented in ADR-016) because the recorder is a
+triage surface, not an accounting one.
+
+Memory is bounded by the two ring capacities; bench.py reports the
+realized footprint as ``flight_ring_memory_kb``.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from collections import deque
+from typing import Any, Mapping
+
+#: Healthy-traffic retention. Events are flat dicts (~0.5 KB), so 256
+#: costs ~128 KB — wider than the 64-trace span ring because flat
+#: events are an order of magnitude smaller than span trees.
+FLIGHT_RING_CAPACITY = 256
+
+#: Pinned (error / SLO-violating) retention. Evicted only by newer
+#: pinned events, never by healthy traffic.
+PINNED_RING_CAPACITY = 64
+
+
+def counters_delta(
+    before: Mapping[str, Any], after: Mapping[str, Any]
+) -> dict[str, float]:
+    """Nonzero numeric movements between two flat counter snapshots.
+    Keys present only in ``after`` count from zero (a lazily created
+    counter that first fired during this request)."""
+    delta: dict[str, float] = {}
+    for key, after_value in after.items():
+        if not isinstance(after_value, (int, float)) or isinstance(after_value, bool):
+            continue
+        before_value = before.get(key, 0)
+        if not isinstance(before_value, (int, float)) or isinstance(before_value, bool):
+            before_value = 0
+        moved = after_value - before_value
+        if moved:
+            delta[key] = round(moved, 6) if isinstance(moved, float) else moved
+    return delta
+
+
+def wide_event(
+    *,
+    path: str,
+    route: str,
+    status: int,
+    duration_s: float,
+    trace: Mapping[str, Any] | None = None,
+    violations: tuple[str, ...] | list[str] = (),
+    counters_before: Mapping[str, Any] | None = None,
+    counters_after: Mapping[str, Any] | None = None,
+) -> dict[str, Any]:
+    """Collapse one request into its flight-recorder event. ``trace``
+    is the already-frozen trace dict (the same one the trace ring
+    records) — stage durations are its top-level spans, flattened to
+    name→ms; nested detail stays in the trace ring, joined by id."""
+    stages: dict[str, float] = {}
+    trace_id = None
+    if trace is not None:
+        trace_id = trace.get("trace_id")
+        for span in trace.get("spans", ()):
+            name = str(span.get("name", ""))
+            stages[name] = round(
+                stages.get(name, 0.0) + float(span.get("duration_ms", 0.0)), 3
+            )
+    event: dict[str, Any] = {
+        "request": f"GET {path}",
+        "route": route,
+        "status": status,
+        "duration_ms": round(duration_s * 1000, 3),
+        "trace_id": trace_id,
+        "stages": stages,
+        "slo_violations": list(violations),
+    }
+    if counters_before is not None and counters_after is not None:
+        event["counters"] = counters_delta(counters_before, counters_after)
+    return event
+
+
+class FlightRecorder:
+    """Two bounded FIFO rings (recent + pinned) of wide events. Events
+    are frozen dicts at record time, same discipline as TraceRing: the
+    debug surface serializes snapshots, never shared mutables."""
+
+    def __init__(
+        self,
+        capacity: int = FLIGHT_RING_CAPACITY,
+        pinned_capacity: int = PINNED_RING_CAPACITY,
+    ) -> None:
+        self.capacity = capacity
+        self.pinned_capacity = pinned_capacity
+        self._lock = threading.Lock()
+        self._recent: deque[dict[str, Any]] = deque(maxlen=capacity)
+        self._pinned: deque[dict[str, Any]] = deque(maxlen=pinned_capacity)
+
+    def record(self, event: dict[str, Any], *, pinned: bool = False) -> None:
+        """Every request lands in recent; errored / SLO-violating ones
+        ALSO land in pinned (callers pass ``pinned=True`` when the
+        event has violations or a 5xx status)."""
+        with self._lock:
+            self._recent.append(event)
+            if pinned:
+                self._pinned.append(event)
+
+    def snapshot(self) -> dict[str, list[dict[str, Any]]]:
+        """Newest-first dump for /debug/flightz — pinned first, then
+        the healthy tail."""
+        with self._lock:
+            return {
+                "pinned": list(reversed(self._pinned)),
+                "recent": list(reversed(self._recent)),
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._recent.clear()
+            self._pinned.clear()
+
+    def __len__(self) -> int:
+        return len(self._recent)
+
+    def memory_bytes(self) -> int:
+        """Recursive shallow-size over both rings (same measurement as
+        TraceRing.memory_bytes) — bench's ``flight_ring_memory_kb``."""
+        seen: set[int] = set()
+
+        def size(obj: Any) -> int:
+            if id(obj) in seen:
+                return 0
+            seen.add(id(obj))
+            total = sys.getsizeof(obj)
+            if isinstance(obj, dict):
+                total += sum(size(k) + size(v) for k, v in obj.items())
+            elif isinstance(obj, (list, tuple)):
+                total += sum(size(item) for item in obj)
+            return total
+
+        with self._lock:
+            return sum(size(e) for e in self._recent) + sum(
+                size(e) for e in self._pinned if id(e) not in seen
+            )
+
+
+#: Process-wide recorder — one server, one /debug/flightz surface.
+flight_recorder = FlightRecorder()

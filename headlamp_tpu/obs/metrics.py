@@ -95,6 +95,18 @@ def _label_str(labels: tuple[str, ...], values: tuple[str, ...]) -> str:
     return "{" + pairs + "}"
 
 
+#: Exemplar source hook — a zero-arg callable returning the active
+#: request's trace id (or None). Installed by obs/exemplars.py at
+#: import so this module stays free of trace-layer imports; None means
+#: exemplar capture is off and observe pays nothing extra.
+_EXEMPLAR_SOURCE: Callable[[], str | None] | None = None
+
+
+def set_exemplar_source(fn: Callable[[], str | None] | None) -> None:
+    global _EXEMPLAR_SOURCE
+    _EXEMPLAR_SOURCE = fn
+
+
 class Counter:
     """Monotone counter, optionally labeled. ``inc`` takes the
     per-metric lock (see module docstring for why that is cheap
@@ -108,6 +120,22 @@ class Counter:
         self.labels = tuple(labels)
         self._lock = threading.Lock()
         self._values: dict[tuple[str, ...], float] = {}
+        #: Post-update subscribers ``fn(amount, labels_dict)`` — how the
+        #: SLO engine's good/bad windows feed from the registry without
+        #: producers knowing about SLOs. Tuple, not list: reads on the
+        #: hot path are a single attribute load and the empty default
+        #: costs one falsy check.
+        self._observers: tuple[Callable[[float, dict[str, Any]], None], ...] = ()
+
+    def add_observer(self, fn: Callable[[float, dict[str, Any]], None]) -> None:
+        self._observers = self._observers + (fn,)
+
+    def _notify(self, value: float, labels: dict[str, Any]) -> None:
+        for fn in self._observers:
+            try:
+                fn(value, labels)
+            except Exception:  # noqa: BLE001 — a broken subscriber must not fail the producer
+                pass
 
     def _key(self, labels: dict[str, Any]) -> tuple[str, ...]:
         if set(labels) != set(self.labels):
@@ -122,6 +150,8 @@ class Counter:
         key = self._key(labels)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
+        if self._observers:
+            self._notify(amount, labels)
 
     @property
     def value(self) -> float:
@@ -183,8 +213,43 @@ class CallbackGauge:
             out.append(f"{self.name} {_fmt(float(value))}")
 
 
+class MultiCallbackGauge:
+    """Labeled callback gauge: ``fn`` returns an iterable of
+    ``(label_values_tuple, value)`` computed at scrape time — the
+    per-SLO state/burn-rate gauges, where the sample SET (which SLOs,
+    which windows) is itself dynamic. Same failure contract as
+    CallbackGauge: raising or returning nothing omits the samples."""
+
+    kind = "gauge"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labels: tuple[str, ...],
+        fn: Callable[[], Any],
+    ) -> None:
+        self.name = name
+        self.help = help
+        self.labels = tuple(labels)
+        self.fn = fn
+
+    def render_into(self, out: list[str]) -> None:
+        try:
+            samples = list(self.fn() or ())
+        except Exception:  # noqa: BLE001 — scrape survives broken producers
+            return
+        for values, value in samples:
+            values = tuple(str(v) for v in values)
+            if len(values) != len(self.labels):
+                continue
+            out.append(
+                f"{self.name}{_label_str(self.labels, values)} {_fmt(float(value))}"
+            )
+
+
 class _HistogramChild:
-    __slots__ = ("counts", "sum", "count", "lock")
+    __slots__ = ("counts", "sum", "count", "lock", "exemplars")
 
     def __init__(self, n_buckets: int) -> None:
         # counts[i] = observations in (bucket[i-1], bucket[i]];
@@ -193,20 +258,53 @@ class _HistogramChild:
         self.sum = 0.0
         self.count = 0
         self.lock = threading.Lock()
+        #: Per-bucket most-recent exemplar (trace_id, value) — lazily
+        #: allocated on the first traced observe so untraced processes
+        #: pay no memory and no branch beyond one None check.
+        self.exemplars: list[tuple[str, float] | None] | None = None
 
-    def observe(self, value: float, buckets: tuple[float, ...]) -> None:
+    def observe(
+        self,
+        value: float,
+        buckets: tuple[float, ...],
+        trace_id: str | None = None,
+    ) -> None:
         idx = bisect_left(buckets, value)
         with self.lock:
             self.counts[idx] += 1
             self.sum += value
             self.count += 1
+            if trace_id is not None:
+                if self.exemplars is None:
+                    self.exemplars = [None] * len(self.counts)
+                self.exemplars[idx] = (trace_id, value)
+
+
+def _exemplar_suffix(
+    exemplars: list[tuple[str, float] | None] | None, idx: int
+) -> str:
+    """OpenMetrics exemplar clause for one bucket line:
+    ``ts_bucket{le="0.128"} 7 # {trace_id="<16hex>"} 0.093``. The
+    timestamp is deliberately omitted (it is optional in the grammar) —
+    exemplars would otherwise be the one place a wall stamp leaks into
+    the no-wall-clock-gated obs/ layer."""
+    if exemplars is None or exemplars[idx] is None:
+        return ""
+    trace_id, value = exemplars[idx]
+    return f' # {{trace_id="{_escape_label(trace_id)}"}} {_fmt(value)}'
 
 
 class Histogram:
     """Fixed-bucket histogram (log ladder by default). Buckets are
     per-metric, shared by every labeled child, and rendered cumulative
     with a ``+Inf`` terminal — the shape PromQL's histogram_quantile
-    expects."""
+    expects.
+
+    Exemplars (ISSUE r10): when obs/exemplars.py has installed a trace
+    source, each observe records the active request's trace id against
+    the bucket the value landed in, and render emits it in OpenMetrics
+    exemplar syntax — the p99 outlier on a dashboard resolves to a
+    concrete /debug/traces entry."""
 
     kind = "histogram"
 
@@ -225,6 +323,11 @@ class Histogram:
         self.buckets = tuple(float(b) for b in buckets)
         self._lock = threading.Lock()
         self._children: dict[tuple[str, ...], _HistogramChild] = {}
+        #: See Counter._observers — same contract, ``fn(value, labels)``.
+        self._observers: tuple[Callable[[float, dict[str, Any]], None], ...] = ()
+
+    def add_observer(self, fn: Callable[[float, dict[str, Any]], None]) -> None:
+        self._observers = self._observers + (fn,)
 
     def _key(self, labels: dict[str, Any]) -> tuple[str, ...]:
         if set(labels) != set(self.labels):
@@ -243,11 +346,41 @@ class Histogram:
         return child
 
     def observe(self, value: float, **labels: Any) -> None:
-        self._child(self._key(labels)).observe(float(value), self.buckets)
+        source = _EXEMPLAR_SOURCE
+        trace_id = source() if source is not None else None
+        self._child(self._key(labels)).observe(
+            float(value), self.buckets, trace_id
+        )
+        if self._observers:
+            for fn in self._observers:
+                try:
+                    fn(value, labels)
+                except Exception:  # noqa: BLE001 — see Counter._notify
+                    pass
 
     def count_for(self, **labels: Any) -> int:
         child = self._children.get(self._key(labels))
         return child.count if child is not None else 0
+
+    def exemplars(self) -> list[tuple[tuple[str, ...], str, str, float]]:
+        """(label_values, le, trace_id, observed_value) for every bucket
+        holding an exemplar — what /sloz/html links into /debug/traces."""
+        with self._lock:
+            items = sorted(self._children.items())
+        out: list[tuple[tuple[str, ...], str, str, float]] = []
+        for values, child in items:
+            with child.lock:
+                exemplars = list(child.exemplars) if child.exemplars else []
+            for idx, ex in enumerate(exemplars):
+                if ex is None:
+                    continue
+                le = (
+                    _fmt(self.buckets[idx])
+                    if idx < len(self.buckets)
+                    else "+Inf"
+                )
+                out.append((values, le, ex[0], ex[1]))
+        return out
 
     def render_into(self, out: list[str]) -> None:
         with self._lock:
@@ -261,15 +394,20 @@ class Histogram:
                 counts = list(child.counts)
                 total = child.count
                 total_sum = child.sum
+                exemplars = list(child.exemplars) if child.exemplars else None
             cumulative = 0
-            for bound, n in zip(self.buckets, counts):
+            for i, (bound, n) in enumerate(zip(self.buckets, counts)):
                 cumulative += n
                 labels_le = _label_str(
                     self.labels + ("le",), values + (_fmt(bound),)
                 )
-                out.append(f"{self.name}_bucket{labels_le} {cumulative}")
+                line = f"{self.name}_bucket{labels_le} {cumulative}"
+                out.append(line + _exemplar_suffix(exemplars, i))
             labels_inf = _label_str(self.labels + ("le",), values + ("+Inf",))
-            out.append(f"{self.name}_bucket{labels_inf} {total}")
+            out.append(
+                f"{self.name}_bucket{labels_inf} {total}"
+                + _exemplar_suffix(exemplars, len(self.buckets))
+            )
             out.append(f"{self.name}_sum{_label_str(self.labels, values)} {_fmt(total_sum)}")
             out.append(f"{self.name}_count{_label_str(self.labels, values)} {total}")
 
@@ -314,6 +452,22 @@ class MetricRegistry:
         able to re-point the view."""
         gauge = self._get_or_create(name, lambda: CallbackGauge(name, help, fn), "gauge")
         if isinstance(gauge, CallbackGauge):
+            gauge.fn = fn
+        return gauge
+
+    def gauge_samples_fn(
+        self,
+        name: str,
+        help: str,
+        labels: tuple[str, ...],
+        fn: Callable[[], Any],
+    ) -> MultiCallbackGauge:
+        """Labeled callback gauge (see MultiCallbackGauge). Same
+        latest-producer-wins re-registration semantics as gauge_fn."""
+        gauge = self._get_or_create(
+            name, lambda: MultiCallbackGauge(name, help, labels, fn), "gauge"
+        )
+        if isinstance(gauge, MultiCallbackGauge):
             gauge.fn = fn
         return gauge
 

@@ -89,6 +89,20 @@ class Span:
 #: renders, tests, background threads) and spans no-op.
 _ACTIVE: ContextVar["Span | None"] = ContextVar("hl_tpu_active_span", default=None)
 
+#: The whole Trace of the calling context — what exemplar capture
+#: (obs/exemplars.py) reads per histogram observe. Separate from
+#: _ACTIVE because an observe may happen under any span depth but the
+#: exemplar must carry the REQUEST's id; contextvars.copy_context
+#: propagation (fan-out workers, background refits) carries both.
+_TRACE: ContextVar["Trace | None"] = ContextVar("hl_tpu_active_trace", default=None)
+
+
+def current_trace_id() -> str | None:
+    """Trace id of the calling context's request, or None outside one.
+    The exemplar source: one ContextVar.get per histogram observe."""
+    trace = _TRACE.get()
+    return trace.trace_id if trace is not None else None
+
 
 class span:
     """``with span("analytics.rollup", nodes=256):`` — times the block
@@ -139,13 +153,30 @@ def annotate(**attrs: Any) -> None:
 class Trace:
     """One request's span tree plus display metadata. ``started_at`` is
     wall clock (an operator correlates it with external logs); every
-    duration inside is perf_counter-derived."""
+    duration inside is perf_counter-derived. The wall stamp is PASSED
+    IN (trace_request's injectable ``wall``) rather than read here —
+    obs/ is inside the no-wall-clock gate (tools/no_wall_clock_check
+    .py), so even the display-only stamp goes through a seam.
 
-    __slots__ = ("path", "started_at", "root", "route", "status", "device_gets")
+    ``trace_id`` is a process-unique 16-hex id minted from os.urandom:
+    it is what /metricsz exemplars carry per histogram bucket and what
+    the flight recorder pins, so a burning SLO resolves to this exact
+    trace at /debug/traces (ISSUE r10 tentpole)."""
 
-    def __init__(self, path: str) -> None:
+    __slots__ = (
+        "path",
+        "started_at",
+        "trace_id",
+        "root",
+        "route",
+        "status",
+        "device_gets",
+    )
+
+    def __init__(self, path: str, *, started_at: float = 0.0) -> None:
         self.path = path
-        self.started_at = time.time()
+        self.started_at = started_at
+        self.trace_id = os.urandom(8).hex()
         self.root = Span("request", {})
         self.route = path
         self.status = 0
@@ -162,6 +193,7 @@ class Trace:
         t0 = self.root.t0
         end = self.root.t1 if self.root.t1 is not None else t0
         return {
+            "trace_id": self.trace_id,
             "path": self.path,
             "route": self.route,
             "status": self.status,
@@ -189,27 +221,36 @@ class trace_request:
     Yields the Trace, or None when tracing is disabled globally, the
     caller opted out (``enabled=False``: health/metrics/debug probes
     must not pollute the ring), or a trace is already active (nested
-    handles would corrupt attribution)."""
+    handles would corrupt attribution).
 
-    __slots__ = ("_path", "_enabled", "_trace", "_token")
+    ``wall`` supplies the display-only started_at stamp — the app layer
+    passes its injected clock; the ``time.time`` default is a seam
+    reference, never called on an injected path (no-wall-clock gate)."""
 
-    def __init__(self, path: str, *, enabled: bool = True) -> None:
+    __slots__ = ("_path", "_enabled", "_wall", "_trace", "_token", "_trace_token")
+
+    def __init__(
+        self, path: str, *, enabled: bool = True, wall: Any = time.time
+    ) -> None:
         self._path = path
         self._enabled = enabled
+        self._wall = wall
         self._trace: Trace | None = None
 
     def __enter__(self) -> Trace | None:
         if not (_enabled and self._enabled) or _ACTIVE.get() is not None:
             return None
-        trace = Trace(self._path)
+        trace = Trace(self._path, started_at=self._wall())
         self._trace = trace
         self._token = _ACTIVE.set(trace.root)
+        self._trace_token = _TRACE.set(trace)
         return trace
 
     def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
         trace = self._trace
         if trace is not None:
             _ACTIVE.reset(self._token)
+            _TRACE.reset(self._trace_token)
             trace.root.t1 = time.perf_counter()
         return False
 

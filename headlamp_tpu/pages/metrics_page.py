@@ -269,7 +269,10 @@ def metrics_page(
                 {"class_": "hl-hint"},
                 f"Source: {metrics.namespace}/{metrics.service} via apiserver "
                 f"service proxy; scrape→join took {metrics.fetch_ms:g} ms "
-                "(target <2000 ms).",
+                "(target <2000 ms — the scrape_paint objective; burn-rate "
+                "status at ",
+                h("a", {"href": "/sloz/html"}, "/sloz/html"),
+                ").",
             ),
         )
     )

@@ -15,7 +15,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
-from .obs.debug_pages import traces_page
+from .obs.debug_pages import slo_page, traces_page
 from .integrations import (
     build_node_intel_columns,
     build_node_tpu_columns,
@@ -194,6 +194,16 @@ def register_plugin(registry: Registry | None = None) -> Registry:
                 "debug-traces",
                 traces_page,
                 kind="traces",
+            ),
+            # SLO status page (ADR-016): same operator-tool posture as
+            # the waterfall — registered (so it renders through the
+            # standard chrome and the routes-render test) but not in
+            # the sidebar; its JSON twin is /sloz.
+            Route(
+                "/sloz/html",
+                "slo-status",
+                slo_page,
+                kind="slo",
             ),
         ]
     )

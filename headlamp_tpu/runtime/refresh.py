@@ -33,6 +33,7 @@ refreshed (fleet metrics, forecast views) are opaque here.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 from typing import Any, Callable, Hashable
@@ -151,9 +152,16 @@ class Refresher:
                     if fkey not in self._flights:
                         flight = _Flight()
                         self._flights[fkey] = flight
+                        # Copy the caller's contextvars into the worker
+                        # (same pattern as the transport fan-out,
+                        # transport/pool.py): the background refit's
+                        # ``refresh.fit`` span then attaches to the
+                        # REQUESTING trace instead of orphaning, and
+                        # exemplar capture sees the right trace id.
+                        ctx = contextvars.copy_context()
                         threading.Thread(
-                            target=self._background_refit,
-                            args=(key, epoch, compute, flight),
+                            target=ctx.run,
+                            args=(self._background_refit, key, epoch, compute, flight),
                             name=f"refresh-{self.name}",
                             daemon=True,
                         ).start()

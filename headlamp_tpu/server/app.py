@@ -7,8 +7,12 @@ Serves the registered plugin surface over stdlib ``http.server``:
   (the manual refresh button, `OverviewPage.tsx:143-158`)
 - ``GET /healthz``            — liveness + snapshot freshness JSON
 - ``GET /metricsz``           — Prometheus text self-exposition (ADR-013)
+- ``GET /sloz``               — SLO burn-rate report JSON (the HTML
+  status page lives at the registered ``/sloz/html`` route, ADR-016)
 - ``GET /debug/traces``       — recent request traces as JSON (the HTML
   waterfall lives at the registered ``/debug/traces/html`` route)
+- ``GET /debug/flightz``      — flight-recorder wide events (pinned
+  errored/SLO-violating requests first)
 
 Cluster state comes from one AcceleratorDataContext synced at most once
 per ``min_sync_interval_s`` (request-coalesced polling — the reactive
@@ -35,6 +39,8 @@ from urllib.parse import parse_qs, urlparse
 
 from ..context.accelerator_context import AcceleratorDataContext, ClusterSnapshot
 from ..metrics.client import fetch_tpu_metrics
+from ..obs import slo as slo_mod
+from ..obs.flight import flight_recorder, wide_event
 from ..obs.metrics import registry as metrics_registry
 from ..obs.trace import annotate, span, trace_request, trace_ring
 from ..runtime.refresh import Refresher
@@ -129,11 +135,27 @@ def _runtime_health(
             out["transport"] = pool.snapshot()
         if refreshers:
             out["refresh"] = {r.name: r.snapshot() for r in refreshers}
+        # Burn-rate states per declared SLO (ADR-016): the one-line
+        # answer a probe reader wants before opening /sloz.
+        out["slo"] = slo_mod.engine().health_block()
         return out
     except Exception as exc:  # noqa: BLE001 — health must never 500 on analytics
         # An empty block read as "no runtime telemetry wired"; a named
         # error reads as what it is — degraded observability.
         return {"error": type(exc).__name__}
+
+
+def _flatten_counters(
+    prefix: str, mapping: dict[str, Any], out: dict[str, float]
+) -> None:
+    """Flatten _runtime_health's nested dicts into dotted numeric keys —
+    the flight recorder's counter-snapshot shape (strings like the slo
+    state block fall out here)."""
+    for key, value in mapping.items():
+        if isinstance(value, dict):
+            _flatten_counters(f"{prefix}{key}.", value, out)
+        elif isinstance(value, (int, float)) and not isinstance(value, bool):
+            out[f"{prefix}{key}"] = value
 
 
 def _force_recalibration() -> None:
@@ -611,7 +633,15 @@ class DashboardApp:
     #: trace endpoints would make the ring describe itself. Their
     #: request METRICS still record — only ring retention is skipped.
     _RING_EXCLUDED = frozenset(
-        {"/healthz", "/metricsz", "/debug/traces", "/debug/traces/html"}
+        {
+            "/healthz",
+            "/metricsz",
+            "/debug/traces",
+            "/debug/traces/html",
+            "/sloz",
+            "/sloz/html",
+            "/debug/flightz",
+        }
     )
 
     def _route_label(self, path: str) -> str:
@@ -620,7 +650,14 @@ class DashboardApp:
         'other' — a URL scanner walking random paths must not mint one
         label child (and one ring entry name) per probe."""
         route_path = urlparse(path).path.rstrip("/") or "/tpu"
-        if route_path in ("/healthz", "/refresh", "/metricsz", "/debug/traces"):
+        if route_path in (
+            "/healthz",
+            "/refresh",
+            "/metricsz",
+            "/debug/traces",
+            "/sloz",
+            "/debug/flightz",
+        ):
             return route_path
         if _NODE_DETAIL_RE.match(route_path):
             return "/node/{name}"
@@ -655,9 +692,24 @@ class DashboardApp:
         route_label = self._route_label(path)
         batch = TransferBatch()
         status = 500
-        with trace_request(
-            path, enabled=route_label not in self._RING_EXCLUDED
-        ) as trace:
+        recorded = route_label not in self._RING_EXCLUDED
+        counters_before: dict[str, float] | None = None
+        if recorded:
+            # Flight-recorder baseline: the same runtime counters
+            # /healthz reports, flattened, snapshotted around the
+            # request so the wide event carries what THIS request moved
+            # (process-wide reads — a concurrent neighbour's activity
+            # can bleed in; accepted for a triage surface, ADR-016).
+            counters_before = {}
+            _flatten_counters(
+                "",
+                _runtime_health(
+                    self._transport,
+                    (self._metrics_refresher, self._forecast_refresher),
+                ),
+                counters_before,
+            )
+        with trace_request(path, enabled=recorded, wall=self._clock) as trace:
             try:
                 with batch.scope():
                     status, content_type, body = self._handle(path)
@@ -673,17 +725,46 @@ class DashboardApp:
                 self.requests_served += 1
                 self.request_device_gets += batch.blocking_gets
                 self.last_request_device_gets = batch.blocking_gets
-                self._req_hist.observe(
-                    time.perf_counter() - t0, route=route_label
-                )
+                duration_s = time.perf_counter() - t0
+                # Observed INSIDE the trace scope so the histogram
+                # bucket's exemplar carries this request's trace id.
+                self._req_hist.observe(duration_s, route=route_label)
                 self._req_total.inc(route=route_label, status=str(status))
+                trace_dict = None
                 if trace is not None:
                     trace.finish(
                         route=route_label,
                         status=status,
                         device_gets=batch.blocking_gets,
                     )
-                    trace_ring.record(trace.to_dict())
+                    trace_dict = trace.to_dict()
+                    trace_ring.record(trace_dict)
+                if recorded:
+                    counters_after: dict[str, float] = {}
+                    _flatten_counters(
+                        "",
+                        _runtime_health(
+                            self._transport,
+                            (self._metrics_refresher, self._forecast_refresher),
+                        ),
+                        counters_after,
+                    )
+                    violations = slo_mod.engine().violations(
+                        route_label, duration_s, status
+                    )
+                    flight_recorder.record(
+                        wide_event(
+                            path=path,
+                            route=route_label,
+                            status=status,
+                            duration_s=duration_s,
+                            trace=trace_dict,
+                            violations=violations,
+                            counters_before=counters_before,
+                            counters_after=counters_after,
+                        ),
+                        pinned=bool(violations) or status >= 500,
+                    )
 
     def _handle(self, path: str) -> tuple[int, str, str]:
         parsed = urlparse(path)
@@ -769,6 +850,27 @@ class DashboardApp:
             # races an in-flight request.
             body = json.dumps(
                 {"capacity": trace_ring.capacity, "traces": trace_ring.snapshot()}
+            )
+            return 200, "application/json", body
+
+        if route_path == "/sloz":
+            # Burn-rate report (ADR-016): states, per-window burn, budget
+            # remaining, latency exemplars, and the self-forecast's
+            # projected budget exhaustion. JSON twin of /sloz/html.
+            return 200, "application/json", json.dumps(slo_mod.engine().report())
+
+        if route_path == "/debug/flightz":
+            # Wide-event dump: pinned (errored / SLO-violating) requests
+            # first, then recent healthy traffic. Frozen dicts, same
+            # no-race guarantee as /debug/traces.
+            snapshot = flight_recorder.snapshot()
+            body = json.dumps(
+                {
+                    "capacity": flight_recorder.capacity,
+                    "pinned_capacity": flight_recorder.pinned_capacity,
+                    "pinned": snapshot["pinned"],
+                    "recent": snapshot["recent"],
+                }
             )
             return 200, "application/json", body
 
@@ -881,6 +983,11 @@ class DashboardApp:
                 # snapshot/now, by design: it must work even when the
                 # cluster sync is the thing being debugged.
                 el = route.component(trace_ring.snapshot())
+            elif route.kind == "slo":
+                # Same debugging-the-debugger discipline as the trace
+                # page: renders the engine's report, never the cluster
+                # snapshot, so it paints even mid-incident.
+                el = route.component(slo_mod.engine().report())
             else:
                 el = route.component(snap, now=now, **paging)
         with span("render.html"):

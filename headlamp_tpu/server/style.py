@@ -109,4 +109,26 @@ main { max-width:1100px; margin:0 auto; padding:16px; }
 .hl-span-attrs { flex:0 1 auto; color:var(--muted);
                  font-family:ui-monospace,monospace; white-space:nowrap;
                  overflow:hidden; text-overflow:ellipsis; }
+/* SLO status (/sloz/html, ADR-016): one .hl-slo section per objective
+   — state chip, per-window burn readouts colored against the page/warn
+   thresholds, error-budget meter, exemplar links into the waterfall. */
+.hl-slo-header { display:flex; align-items:center; gap:10px;
+                 margin-bottom:8px; }
+.hl-slo-header .hl-hint { margin-left:auto; }
+.hl-slo-burns { display:flex; gap:16px; margin:6px 0; flex-wrap:wrap; }
+.hl-slo-burn { display:flex; align-items:baseline; gap:6px;
+               font-size:12px; padding:2px 8px; border-radius:4px;
+               background:var(--bg); border:1px solid var(--line); }
+.hl-slo-burn-window { color:var(--muted);
+                      font-family:ui-monospace,monospace; }
+.hl-slo-burn-rate { font-weight:600;
+                    font-variant-numeric:tabular-nums; }
+.hl-slo-burn-warn { border-color:var(--warn); }
+.hl-slo-burn-warn .hl-slo-burn-rate { color:var(--warn); }
+.hl-slo-burn-err { border-color:var(--err); }
+.hl-slo-burn-err .hl-slo-burn-rate { color:var(--err); }
+.hl-budgetbar { margin:6px 0; }
+.hl-slo-exemplars a { margin-right:8px;
+                      font-family:ui-monospace,monospace; }
+.hl-slo-forecast { font-style:italic; }
 """

@@ -106,6 +106,12 @@ _CONNECT_HIST = _metrics_registry.histogram(
     "TCP(+TLS) connection establishment latency, per host.",
     labels=("host",),
 )
+_CONNECT_FAILED = _metrics_registry.counter(
+    "headlamp_tpu_transport_connect_failures_total",
+    "TCP(+TLS) connection attempts that raised before a socket was "
+    "established, per host.",
+    labels=("host",),
+)
 
 #: Live pools, for the process-wide pool-size gauge: the registry's
 #: callback gauge sums open connections across every pool still alive
@@ -365,7 +371,14 @@ class ConnectionPool:
                     )
                 else:
                     raw = http.client.HTTPConnection(host, port, timeout=timeout_s)
-                raw.connect()
+                try:
+                    raw.connect()
+                except BaseException:
+                    # Failed opens never reach the latency histogram, so
+                    # they get their own counter — the transport_connect
+                    # SLO's availability arm (ADR-016) feeds off it.
+                    _CONNECT_FAILED.inc(host=host_label)
+                    raise
                 self._observe_connect(host_label, time.perf_counter() - t0)
             self.opened += 1
             _OPENED.inc(host=host_label)

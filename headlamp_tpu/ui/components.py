@@ -142,6 +142,25 @@ def UtilizationBar(used: float, capacity: float, *, unit: str = "") -> Element:
     )
 
 
+def BudgetBar(remaining_ratio: float) -> Element:
+    """Error-budget meter for the SLO status page: shows the UNSPENT
+    fraction, colored by how little is left — the inverse reading of
+    UtilizationBar, on the same shared 70/90 thresholds (err at ≤10%
+    remaining, warn at ≤30%)."""
+    pct = max(0.0, min(1.0, float(remaining_ratio))) * 100
+    level = (
+        "err"
+        if pct <= 100 - BAR_CRIT_PCT
+        else "warn" if pct <= 100 - BAR_WARN_PCT else "ok"
+    )
+    return h(
+        "div",
+        {"class_": f"hl-budgetbar hl-utilbar hl-utilbar-{level}", "data-pct": f"{pct:.0f}"},
+        h("div", {"class_": "hl-utilbar-fill", "style": f"width:{pct:.1f}%"}),
+        h("span", {"class_": "hl-utilbar-label"}, f"{pct:.1f}% budget left"),
+    )
+
+
 def Loader(title: str = "Loading…") -> Element:
     return h("div", {"class_": "hl-loader", "role": "progressbar"}, title)
 

@@ -1,0 +1,166 @@
+"""Flight recorder: wide events, pinning, and the end-to-end triage
+loop (ISSUE r10 acceptance).
+
+Unit half: the ring's bounds/pinning semantics and the wide-event
+collapse (stage durations from the span tree, counter deltas).
+
+End-to-end half, against a real demo app: an erroring route's request
+must land PINNED in /debug/flightz carrying its trace id; that id must
+resolve in /debug/traces; and a healthy traced request's /metricsz
+exemplar must carry an id resolvable the same way — the two-hop path
+from a burning SLO to a concrete waterfall, exercised for real.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from headlamp_tpu.obs.flight import (
+    FlightRecorder,
+    counters_delta,
+    flight_recorder,
+    wide_event,
+)
+from headlamp_tpu.server import DashboardApp, make_demo_transport
+
+
+def make_app(fleet="v5p32", **kwargs):
+    return DashboardApp(make_demo_transport(fleet), min_sync_interval_s=0.0, **kwargs)
+
+
+class TestCountersDelta:
+    def test_movements_only(self):
+        before = {"a": 1, "b": 2.0, "c": 3}
+        after = {"a": 4, "b": 2.0, "c": 3}
+        assert counters_delta(before, after) == {"a": 3}
+
+    def test_new_key_counts_from_zero(self):
+        assert counters_delta({}, {"a": 2}) == {"a": 2}
+
+    def test_non_numeric_values_ignored(self):
+        assert counters_delta({"s": "ok"}, {"s": "page", "t": True}) == {}
+
+
+class TestWideEvent:
+    def test_stages_flatten_top_level_spans(self):
+        trace = {
+            "trace_id": "abc123",
+            "spans": [
+                {"name": "context.sync", "duration_ms": 10.0, "children": []},
+                {"name": "render.html", "duration_ms": 2.5, "children": []},
+                {"name": "render.html", "duration_ms": 1.5, "children": []},
+            ],
+        }
+        event = wide_event(
+            path="/tpu?x=1",
+            route="/tpu",
+            status=200,
+            duration_s=0.0151,
+            trace=trace,
+            violations=("dashboard_render",),
+            counters_before={"hits": 1},
+            counters_after={"hits": 3},
+        )
+        assert event["request"] == "GET /tpu?x=1"
+        assert event["trace_id"] == "abc123"
+        # Same-named spans aggregate — the event is flat by design.
+        assert event["stages"] == {"context.sync": 10.0, "render.html": 4.0}
+        assert event["slo_violations"] == ["dashboard_render"]
+        assert event["counters"] == {"hits": 2}
+        json.dumps(event)
+
+    def test_traceless_event_still_forms(self):
+        event = wide_event(path="/x", route="other", status=404, duration_s=0.001)
+        assert event["trace_id"] is None
+        assert event["stages"] == {}
+
+
+class TestFlightRecorder:
+    def test_recent_ring_bounds(self):
+        rec = FlightRecorder(capacity=4, pinned_capacity=2)
+        for i in range(10):
+            rec.record({"i": i})
+        snap = rec.snapshot()
+        assert [e["i"] for e in snap["recent"]] == [9, 8, 7, 6]
+        assert snap["pinned"] == []
+
+    def test_pinned_survive_healthy_eviction(self):
+        rec = FlightRecorder(capacity=4, pinned_capacity=2)
+        rec.record({"i": "bad"}, pinned=True)
+        for i in range(20):
+            rec.record({"i": i})
+        snap = rec.snapshot()
+        assert {"i": "bad"} not in snap["recent"]
+        assert snap["pinned"] == [{"i": "bad"}]
+
+    def test_pinned_ring_bounded_by_newer_pins(self):
+        rec = FlightRecorder(capacity=4, pinned_capacity=2)
+        for i in range(5):
+            rec.record({"i": i}, pinned=True)
+        assert [e["i"] for e in rec.snapshot()["pinned"]] == [4, 3]
+
+    def test_memory_bounded_and_measured(self):
+        rec = FlightRecorder(capacity=8)
+        for i in range(100):
+            rec.record({"i": i, "stages": {"a": 1.0}})
+        assert 0 < rec.memory_bytes() < 100_000
+
+
+class TestEndToEnd:
+    def test_error_request_pinned_with_trace_join(self):
+        app = make_app()
+        flight_recorder.clear()
+
+        def boom(snap, now, **kw):
+            raise RuntimeError("injected route failure")
+
+        # Register a raising route: the error boundary turns it into a
+        # 500, which must pin the request.
+        from headlamp_tpu.registration import Route
+
+        app.registry.routes.append(Route("/tpu/boom", "boom", boom))
+        status, _, _ = app.handle("/tpu/boom")
+        assert status == 500
+        snap = flight_recorder.snapshot()
+        assert snap["pinned"], "500 request was not pinned"
+        pinned = snap["pinned"][0]
+        assert pinned["route"] == "/tpu/boom"
+        assert pinned["status"] == 500
+        # The pinned event's trace id resolves at /debug/traces.
+        status, _, body = app.handle("/debug/traces")
+        ids = [t["trace_id"] for t in json.loads(body)["traces"]]
+        assert pinned["trace_id"] in ids
+
+    def test_flightz_surface_shape(self):
+        app = make_app()
+        flight_recorder.clear()
+        app.handle("/tpu")
+        status, ctype, body = app.handle("/debug/flightz")
+        assert status == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        assert payload["capacity"] == flight_recorder.capacity
+        event = payload["recent"][0]
+        assert event["route"] == "/tpu"
+        assert event["status"] == 200
+        assert event["trace_id"]
+        assert "counters" in event
+
+    def test_probe_routes_not_recorded(self):
+        app = make_app()
+        flight_recorder.clear()
+        for path in ("/healthz", "/metricsz", "/sloz", "/debug/flightz"):
+            app.handle(path)
+        assert flight_recorder.snapshot()["recent"] == []
+
+    def test_metricsz_exemplar_resolves_at_debug_traces(self):
+        app = make_app()
+        app.handle("/tpu/metrics")
+        _, _, exposition = app.handle("/metricsz")
+        exemplar_ids = re.findall(r'# \{trace_id="([0-9a-f]{16})"\}', exposition)
+        assert exemplar_ids, "no exemplars on /metricsz after traced traffic"
+        _, _, body = app.handle("/debug/traces")
+        ring_ids = {t["trace_id"] for t in json.loads(body)["traces"]}
+        assert set(exemplar_ids) & ring_ids, (
+            "no /metricsz exemplar id resolvable in /debug/traces"
+        )

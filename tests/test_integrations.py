@@ -121,9 +121,9 @@ class TestRegistration:
             "/intel/metrics",
         }
         native_paths = {"/nodes"}
-        # ADR-013: the trace waterfall registers as a route (so it gets
-        # styling + the registry dispatch) but adds no sidebar entry.
-        debug_paths = {"/debug/traces/html"}
+        # ADR-013/016: the trace waterfall and the SLO page register as
+        # routes (styling + registry dispatch) but add no sidebar entry.
+        debug_paths = {"/debug/traces/html", "/sloz/html"}
         expected = tpu_paths | intel_paths | native_paths | debug_paths
         assert {r.path for r in reg.routes} == expected
         # Both providers inject into Node and Pod detail views.

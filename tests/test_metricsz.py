@@ -1,13 +1,17 @@
 """Prometheus exposition-format conformance for /metricsz (ADR-013,
-satellite: the mini text-format parser).
+satellite: the mini text-format parser — strictified for ISSUE r10).
 
-A minimal parser for the 0.0.4 text format scrapes the endpoint through
-the app layer and re-asserts, from the OUTSIDE, the invariants the
-registry promises: HELP/TYPE present for every sample family, histogram
-buckets cumulative and monotone with ``+Inf == _count``, and every
-metric name matching the ``headlamp_tpu_`` grammar with a unit suffix.
-The parser knows nothing about the registry's internals on purpose —
-it reads the wire format the way a real Prometheus server would.
+A minimal parser for the 0.0.4 text format (plus OpenMetrics exemplar
+clauses) scrapes the endpoint through the app layer and re-asserts,
+from the OUTSIDE, the invariants the registry promises: a well-formed,
+non-empty ``# HELP`` and ``# TYPE`` pair emitted exactly once per
+family and BEFORE its samples, histogram buckets cumulative and
+monotone with ``+Inf == _count``, every metric name matching the
+``headlamp_tpu_`` grammar with a unit suffix, and exemplars appearing
+only on ``_bucket`` lines, carrying exactly a 16-hex ``trace_id`` and
+a value inside the bucket's bound. The parser knows nothing about the
+registry's internals on purpose — it reads the wire format the way a
+real Prometheus server would.
 """
 
 import re
@@ -18,40 +22,74 @@ from headlamp_tpu.obs.metrics import UNIT_SUFFIXES
 from headlamp_tpu.server import DashboardApp, make_demo_transport
 
 NAME_RE = re.compile(r"^headlamp_tpu_[a-z0-9_]+$")
+#: A sample line: name, optional labels, value, then optionally an
+#: OpenMetrics exemplar clause ``# {label="..."} value``.
 SAMPLE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
     r"(?:\{(?P<labels>[^}]*)\})?"
-    r" (?P<value>\S+)$"
+    r" (?P<value>\S+)"
+    r"(?: # \{(?P<exlabels>[^}]*)\} (?P<exvalue>\S+))?$"
 )
 LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+HELP_RE = re.compile(r"^# HELP (?P<name>\S+) (?P<text>.+)$")
+TYPE_RE = re.compile(r"^# TYPE (?P<name>\S+) (?P<kind>counter|gauge|histogram)$")
+TRACE_ID_RE = re.compile(r"^[0-9a-f]{16}$")
+
+
+def _float(raw: str) -> float:
+    return float("inf") if raw == "+Inf" else float(raw)
 
 
 def parse_exposition(text: str):
-    """(helps, types, samples) from Prometheus text format. Samples are
-    (name, labels dict, float value), in document order."""
+    """(helps, types, samples, exemplars) from Prometheus text format.
+
+    Samples are (name, labels dict, float value) in document order;
+    exemplars are (sample_name, labels dict, exemplar labels dict,
+    exemplar value). STRICT: any malformed HELP/TYPE/sample line, a
+    duplicate HELP/TYPE, or a family whose samples precede its metadata
+    is an assertion failure right here in the parser.
+    """
     helps: dict[str, str] = {}
     types: dict[str, str] = {}
     samples: list[tuple[str, dict[str, str], float]] = []
+    exemplars: list[tuple[str, dict[str, str], dict[str, str], float]] = []
+    families_with_samples: set[str] = set()
     for line in text.splitlines():
         if not line.strip():
             continue
         if line.startswith("# HELP "):
-            name, _, help_text = line[len("# HELP "):].partition(" ")
-            helps[name] = help_text
+            m = HELP_RE.match(line)
+            assert m, f"malformed HELP line: {line!r}"
+            name = m.group("name")
+            assert name not in helps, f"duplicate HELP for {name}"
+            assert name not in families_with_samples, f"HELP after samples: {name}"
+            helps[name] = m.group("text")
         elif line.startswith("# TYPE "):
-            name, _, kind = line[len("# TYPE "):].partition(" ")
-            assert kind in ("counter", "gauge", "histogram"), line
-            types[name] = kind
+            m = TYPE_RE.match(line)
+            assert m, f"malformed TYPE line: {line!r}"
+            name = m.group("name")
+            assert name not in types, f"duplicate TYPE for {name}"
+            assert name not in families_with_samples, f"TYPE after samples: {name}"
+            types[name] = m.group("kind")
         elif line.startswith("#"):
-            continue
+            pytest.fail(f"unknown comment form: {line!r}")
         else:
             m = SAMPLE_RE.match(line)
             assert m, f"unparseable sample line: {line!r}"
             labels = dict(LABEL_RE.findall(m.group("labels") or ""))
-            raw = m.group("value")
-            value = float("inf") if raw == "+Inf" else float(raw)
-            samples.append((m.group("name"), labels, value))
-    return helps, types, samples
+            name = m.group("name")
+            samples.append((name, labels, _float(m.group("value"))))
+            families_with_samples.add(name)
+            if m.group("exlabels") is not None:
+                exemplars.append(
+                    (
+                        name,
+                        labels,
+                        dict(LABEL_RE.findall(m.group("exlabels"))),
+                        _float(m.group("exvalue")),
+                    )
+                )
+    return helps, types, samples, exemplars
 
 
 def base_name(sample_name: str, types: dict[str, str]) -> str:
@@ -79,15 +117,35 @@ def exposition() -> str:
 
 class TestFormat:
     def test_every_sample_has_help_and_type(self, exposition):
-        helps, types, samples = parse_exposition(exposition)
+        helps, types, samples, _ = parse_exposition(exposition)
         assert samples, "scrape produced no samples"
         for name, _, _ in samples:
             base = base_name(name, types)
             assert base in helps, f"{name} has no # HELP"
             assert base in types, f"{name} has no # TYPE"
 
+    def test_help_text_is_never_empty(self, exposition):
+        helps, _, _, _ = parse_exposition(exposition)
+        for name, text in helps.items():
+            assert text.strip(), f"{name}: empty HELP text"
+
+    def test_metadata_only_families_are_the_known_quiet_set(self, exposition):
+        # A family rendering HELP/TYPE but zero samples is legitimate
+        # only when the instrument genuinely had nothing to report in
+        # this scenario: calibration gauges before any run, and the
+        # connect-latency histogram (the demo transport never dials a
+        # socket). Anything else going silent is a rendering bug.
+        _, types, samples, _ = parse_exposition(exposition)
+        emitted = {base_name(n, types) for n, _, _ in samples}
+        quiet = {name for name in types if name not in emitted}
+        assert quiet <= {
+            "headlamp_tpu_calibration_python_per_node_seconds",
+            "headlamp_tpu_calibration_xla_seconds",
+            "headlamp_tpu_transport_connect_latency_seconds",
+        }, f"unexpected sample-free families: {sorted(quiet)}"
+
     def test_name_grammar_and_unit_suffixes(self, exposition):
-        helps, types, _ = parse_exposition(exposition)
+        helps, types, _, _ = parse_exposition(exposition)
         for name in types:
             assert NAME_RE.match(name), name
             assert name.endswith(UNIT_SUFFIXES), (
@@ -98,7 +156,7 @@ class TestFormat:
                 assert name.endswith("_total"), name
 
     def test_histogram_buckets_monotone_and_consistent(self, exposition):
-        _, types, samples = parse_exposition(exposition)
+        _, types, samples, _ = parse_exposition(exposition)
         hist_names = [n for n, k in types.items() if k == "histogram"]
         assert hist_names
         for hist in hist_names:
@@ -137,18 +195,51 @@ class TestFormat:
                     assert child["sum"] >= 0
 
     def test_counter_values_are_finite_and_nonnegative(self, exposition):
-        _, types, samples = parse_exposition(exposition)
+        _, types, samples, _ = parse_exposition(exposition)
         for name, _, value in samples:
             if types.get(base_name(name, types)) == "counter":
                 assert 0 <= value < float("inf"), name
 
 
+class TestExemplars:
+    """OpenMetrics exemplar clauses (ISSUE r10 tentpole): bucket lines
+    may carry ``# {trace_id="<16 hex>"} value``; nothing else may."""
+
+    def test_exemplars_only_on_bucket_lines(self, exposition):
+        _, _, _, exemplars = parse_exposition(exposition)
+        for name, _, _, _ in exemplars:
+            assert name.endswith("_bucket"), (
+                f"exemplar on non-bucket series {name}"
+            )
+
+    def test_exemplar_labels_are_exactly_a_trace_id(self, exposition):
+        _, _, _, exemplars = parse_exposition(exposition)
+        for name, _, exlabels, _ in exemplars:
+            assert set(exlabels) == {"trace_id"}, (name, exlabels)
+            assert TRACE_ID_RE.match(exlabels["trace_id"]), (name, exlabels)
+
+    def test_exemplar_value_within_bucket_bound(self, exposition):
+        _, _, _, exemplars = parse_exposition(exposition)
+        for name, labels, _, value in exemplars:
+            le = labels["le"]
+            bound = float("inf") if le == "+Inf" else float(le)
+            assert 0 <= value <= bound, (name, labels, value)
+
+    def test_traced_traffic_produces_exemplars(self, exposition):
+        # The fixture's page requests ran inside trace_request scopes,
+        # so the request-duration histogram must carry at least one.
+        _, _, _, exemplars = parse_exposition(exposition)
+        families = {n for n, _, _, _ in exemplars}
+        assert "headlamp_tpu_request_duration_seconds_bucket" in families
+
+
 class TestCoverage:
     """The acceptance list: per-route latency histograms, status
-    counters, transfer/device-cache counters, sync failures."""
+    counters, transfer/device-cache counters, sync failures, SLO
+    gauges."""
 
     def test_per_route_latency_histogram(self, exposition):
-        _, types, samples = parse_exposition(exposition)
+        _, types, samples, _ = parse_exposition(exposition)
         assert types["headlamp_tpu_request_duration_seconds"] == "histogram"
         routes = {
             labels["route"]
@@ -158,7 +249,7 @@ class TestCoverage:
         assert {"/tpu", "/tpu/nodes", "/tpu/metrics"} <= routes
 
     def test_status_code_counters(self, exposition):
-        _, types, samples = parse_exposition(exposition)
+        _, types, samples, _ = parse_exposition(exposition)
         assert types["headlamp_tpu_requests_total"] == "counter"
         seen = {
             (labels["route"], labels["status"])
@@ -169,7 +260,7 @@ class TestCoverage:
         assert ("other", "404") in seen  # the /nope request
 
     def test_transfer_and_cache_and_sync_counters_exposed(self, exposition):
-        _, types, _ = parse_exposition(exposition)
+        _, types, _, _ = parse_exposition(exposition)
         for name in (
             "headlamp_tpu_transfer_blocking_gets_total",
             "headlamp_tpu_transfer_coalesced_trees_total",
@@ -181,9 +272,28 @@ class TestCoverage:
             assert types[name] == "counter", name
 
     def test_trace_ring_gauge_exposed(self, exposition):
-        _, types, samples = parse_exposition(exposition)
+        _, types, samples, _ = parse_exposition(exposition)
         assert types["headlamp_tpu_trace_ring_traces_count"] == "gauge"
         values = [
             v for n, _, v in samples if n == "headlamp_tpu_trace_ring_traces_count"
         ]
         assert values and values[0] >= 0
+
+    def test_slo_gauges_exposed(self, exposition):
+        _, types, samples, _ = parse_exposition(exposition)
+        assert types["headlamp_tpu_slo_burn_rate_ratio"] == "gauge"
+        assert types["headlamp_tpu_slo_error_budget_remaining_ratio"] == "gauge"
+        assert types["headlamp_tpu_slo_state_info"] == "gauge"
+        windows = {
+            (labels["slo"], labels["window"])
+            for n, labels, _ in samples
+            if n == "headlamp_tpu_slo_burn_rate_ratio"
+        }
+        assert ("scrape_paint", "5m") in windows
+        assert ("transport_connect", "6h") in windows
+        states = [
+            (labels["slo"], labels["state"], v)
+            for n, labels, v in samples
+            if n == "headlamp_tpu_slo_state_info"
+        ]
+        assert states and all(v == 1.0 for _, _, v in states)

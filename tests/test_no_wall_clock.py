@@ -1,0 +1,128 @@
+"""Wall-clock gate (tools/no_wall_clock_check.py, ADR-013/ADR-016).
+
+Two halves, mirroring tests/test_no_raw_urlopen.py:
+  1. The gate itself: the live ``obs/``/``runtime/``/``transport/``
+     trees must be clean — every TTL/age/burn computation runs on an
+     injected monotonic clock; wall-clock reads never happen inline.
+  2. Mutation coverage: sources that read the wall clock
+     (``time.time()``, module-aliased, ``from time import time``,
+     argless ``datetime.now()``/``utcnow()``, argless
+     ``time.localtime()``) must each produce a diagnostic — and the
+     sanctioned forms (seam DEFAULTS like ``wall=time.time``,
+     monotonic/perf_counter calls, display formatting of an
+     already-captured stamp) must not.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from no_wall_clock_check import _check_source, check_tree  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_repo_scope_is_clean():
+    diagnostics = check_tree(REPO)
+    assert diagnostics == [], "\n".join(str(d) for d in diagnostics)
+
+
+class TestMutations:
+    def _diags(self, src):
+        return _check_source("mut.py", src)
+
+    def test_time_time_call_flagged(self):
+        diags = self._diags("import time\nnow = time.time()\n")
+        assert len(diags) == 1
+        assert diags[0].line == 2
+
+    def test_module_alias_flagged(self):
+        diags = self._diags("import time as t\nnow = t.time()\n")
+        assert len(diags) == 1
+
+    def test_from_time_import_time_flagged(self):
+        # The import itself is the diagnostic: a later bare ``time()``
+        # call is invisible to reference scans, so the smuggling form
+        # is banned at the border.
+        diags = self._diags("from time import time\nnow = time()\n")
+        assert len(diags) == 1
+        assert "from time import time" in diags[0].message
+
+    def test_datetime_now_flagged(self):
+        diags = self._diags("from datetime import datetime\nd = datetime.now()\n")
+        assert len(diags) == 1
+
+    def test_datetime_now_with_tz_still_flagged(self):
+        # A tz argument changes the representation, not the read.
+        diags = self._diags(
+            "from datetime import datetime, timezone\n"
+            "d = datetime.now(timezone.utc)\n"
+        )
+        assert len(diags) == 1
+
+    def test_datetime_utcnow_via_module_flagged(self):
+        diags = self._diags("import datetime\nd = datetime.datetime.utcnow()\n")
+        assert len(diags) == 1
+
+    def test_date_today_flagged(self):
+        diags = self._diags("from datetime import date\nd = date.today()\n")
+        assert len(diags) == 1
+
+    def test_argless_localtime_flagged(self):
+        diags = self._diags("import time\nt = time.localtime()\n")
+        assert len(diags) == 1
+
+    def test_seam_default_reference_allowed(self):
+        # THE sanctioned idiom: storing the function as an injectable
+        # default, called only through the seam.
+        diags = self._diags(
+            "import time\n"
+            "def __init__(self, wall=time.time):\n"
+            "    self._wall = wall\n"
+        )
+        assert diags == []
+
+    def test_monotonic_and_perf_counter_allowed(self):
+        diags = self._diags(
+            "import time\n"
+            "a = time.monotonic()\n"
+            "b = time.perf_counter()\n"
+        )
+        assert diags == []
+
+    def test_display_formatting_of_captured_stamp_allowed(self):
+        # debug_pages formats an already-captured wall stamp: localtime
+        # WITH an argument converts, it does not read a clock.
+        diags = self._diags(
+            "import time\n"
+            "s = time.strftime('%H:%M:%S', time.localtime(stamp))\n"
+        )
+        assert diags == []
+
+    def test_datetime_fromtimestamp_allowed(self):
+        diags = self._diags(
+            "from datetime import datetime\n"
+            "d = datetime.fromtimestamp(stamp)\n"
+        )
+        assert diags == []
+
+    def test_prose_and_strings_not_flagged(self):
+        diags = self._diags(
+            '"""docs mention time.time() and datetime.now() freely."""\n'
+            "note = 'time.time()'\n"
+        )
+        assert diags == []
+
+    def test_scope_is_the_three_subtrees(self, tmp_path):
+        inside = tmp_path / "headlamp_tpu" / "obs"
+        inside.mkdir(parents=True)
+        (inside / "bad.py").write_text("import time\nnow = time.time()\n")
+        outside = tmp_path / "headlamp_tpu" / "server"
+        outside.mkdir(parents=True)
+        (outside / "app.py").write_text("import time\nnow = time.time()\n")
+        diags = check_tree(str(tmp_path))
+        assert len(diags) == 1
+        assert "bad.py" in diags[0].path

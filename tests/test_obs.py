@@ -312,3 +312,229 @@ class TestOverheadBudget:
         assert on_ms <= off_ms * 3 + 10, (
             f"tracing-on handle {on_ms:.2f}ms vs off {off_ms:.2f}ms"
         )
+
+
+# ---------------------------------------------------------------------------
+# Debug-page vdom structure (ISSUE r10 satellite: the waterfall markup
+# was rendered but unasserted — these pin its structural contract).
+# ---------------------------------------------------------------------------
+
+
+def _walk(el):
+    """Depth-first Element iterator (strings skipped)."""
+    from headlamp_tpu.ui.vdom import Element
+
+    if not isinstance(el, Element):
+        return
+    yield el
+    for child in el.children:
+        yield from _walk(child)
+
+
+def _by_class(el, cls):
+    return [e for e in _walk(el) if cls in str(e.props.get("class_", "")).split()]
+
+
+def _text(el):
+    from headlamp_tpu.ui.vdom import Element
+
+    out = []
+    for e in _walk(el):
+        for c in e.children:
+            if not isinstance(c, Element):
+                out.append(str(c))
+    return " ".join(out)
+
+
+def _fake_trace(trace_id="aabbccdd00112233", status=200, duration=40.0):
+    return {
+        "trace_id": trace_id,
+        "path": "/tpu",
+        "route": "/tpu",
+        "status": status,
+        "started_at": 1_700_000_000.0,
+        "duration_ms": duration,
+        "device_gets": 1,
+        "spans": [
+            {
+                "name": "sync.snapshot",
+                "start_ms": 0.0,
+                "duration_ms": 10.0,
+                "attrs": {},
+                "children": [
+                    {
+                        "name": "analytics.rollup",
+                        "start_ms": 2.0,
+                        "duration_ms": 4.0,
+                        "attrs": {"nodes": 8},
+                        "children": [],
+                    }
+                ],
+            },
+            {
+                "name": "render.html",
+                "start_ms": 30.0,
+                "duration_ms": 10.0,
+                "attrs": {},
+                "children": [],
+            },
+        ],
+    }
+
+
+class TestWaterfallVdom:
+    def test_sections_sorted_slowest_first_with_anchors(self):
+        from headlamp_tpu.obs.debug_pages import traces_page
+
+        fast = _fake_trace(trace_id="f" * 16, duration=5.0)
+        slow = _fake_trace(trace_id="a" * 16, duration=50.0)
+        page = traces_page([fast, slow])
+        sections = _by_class(page, "hl-trace")
+        assert [s.props["id"] for s in sections] == [
+            "trace-" + "a" * 16,
+            "trace-" + "f" * 16,
+        ]
+
+    def test_span_rows_flatten_depth_first_with_indent(self):
+        from headlamp_tpu.obs.debug_pages import traces_page
+
+        page = traces_page([_fake_trace()])
+        rows = _by_class(page, "hl-span-row")
+        labels = [_by_class(r, "hl-span-label")[0] for r in rows]
+        assert [_text(l).strip() for l in labels] == [
+            "sync.snapshot",
+            "analytics.rollup",
+            "render.html",
+        ]
+        # Child indents one level (16px per depth).
+        assert "padding-left:0px" in labels[0].props["style"]
+        assert "padding-left:16px" in labels[1].props["style"]
+
+    def test_bar_geometry_is_proportional(self):
+        from headlamp_tpu.obs.debug_pages import traces_page
+
+        page = traces_page([_fake_trace()])
+        bars = [_by_class(r, "hl-span-bar")[0] for r in _by_class(page, "hl-span-row")]
+        # sync.snapshot: 0..10 of 40ms → left 0%, width 25%.
+        assert bars[0].props["style"] == "margin-left:0.00%;width:25.00%"
+        # render.html: 30..40 of 40ms → left 75%, width 25%.
+        assert bars[2].props["style"] == "margin-left:75.00%;width:25.00%"
+
+    def test_status_and_attrs_and_trace_id_in_header(self):
+        from headlamp_tpu.obs.debug_pages import traces_page
+
+        err = _fake_trace(status=500)
+        page = traces_page([err])
+        assert _by_class(page, "hl-status-err")
+        text = _text(page)
+        assert "nodes=8" in text
+        assert "trace aabbccdd00112233" in text
+
+    def test_empty_ring_renders_empty_state(self):
+        from headlamp_tpu.obs.debug_pages import traces_page
+
+        assert _by_class(traces_page([]), "hl-empty-content")
+
+
+class TestSloPageVdom:
+    def _report(self, state="ok"):
+        return {
+            "slos": [
+                {
+                    "name": "scrape_paint",
+                    "description": "d",
+                    "target": 0.99,
+                    "threshold_s": 2.0,
+                    "state": state,
+                    "burn_rates": {"5m": 16.0, "30m": 2.0, "1h": 15.0, "6h": 1.0},
+                    "events": {
+                        w: {"good": 10, "bad": 2} for w in ("5m", "30m", "1h", "6h")
+                    },
+                    "budget_remaining_ratio": 0.25,
+                    "exemplars": [
+                        {
+                            "trace_id": "ab" * 8,
+                            "le": "4.096",
+                            "value": 3.2,
+                            "labels": {"route": "/tpu/metrics"},
+                        }
+                    ],
+                },
+                {
+                    "name": "forecast_fit",
+                    "description": "d",
+                    "target": 0.99,
+                    "threshold_s": 8.0,
+                    "state": "ok",
+                    "burn_rates": {"5m": 0.0, "30m": 0.0, "1h": 0.0, "6h": 0.0},
+                    "events": {
+                        w: {"good": 5, "bad": 0} for w in ("5m", "30m", "1h", "6h")
+                    },
+                    "budget_remaining_ratio": 1.0,
+                    "exemplars": [],
+                },
+            ],
+            "windows_s": {"5m": 300.0, "30m": 1800.0, "1h": 3600.0, "6h": 21600.0},
+            "page_burn_threshold": 14.4,
+            "warn_burn_threshold": 6.0,
+            "budget_forecast": {
+                "slo": "scrape_paint",
+                "points": 60,
+                "window": "1h",
+                "projected_exhaustion_windows": 3,
+                "projected_burn_rate": 2.0,
+            },
+        }
+
+    def test_burning_slo_sorts_first_with_state_chip(self):
+        from headlamp_tpu.obs.debug_pages import slo_page
+
+        page = slo_page(self._report(state="page"))
+        sections = _by_class(page, "hl-slo")
+        assert [s.props["data-slo"] for s in sections] == [
+            "scrape_paint",
+            "forecast_fit",
+        ]
+        assert sections[0].props["data-state"] == "page"
+        chip = _by_class(sections[0], "hl-status")[0]
+        assert chip.props["data-status"] == "error"
+
+    def test_burn_readouts_colored_against_thresholds(self):
+        from headlamp_tpu.obs.debug_pages import slo_page
+
+        section = _by_class(slo_page(self._report()), "hl-slo")[0]
+        burns = _by_class(section, "hl-slo-burn")
+        by_window = {b.props["data-window"]: b for b in burns}
+        assert "hl-slo-burn-err" in by_window["5m"].props["class_"]  # 16 ≥ 14.4
+        assert "hl-slo-burn-ok" in by_window["30m"].props["class_"]  # 2 < 6
+        assert "hl-slo-burn-ok" in by_window["6h"].props["class_"]
+
+    def test_budget_bar_and_exemplar_links(self):
+        from headlamp_tpu.obs.debug_pages import slo_page
+
+        page = slo_page(self._report())
+        bar = _by_class(page, "hl-budgetbar")[0]
+        assert bar.props["data-pct"] == "25"
+        links = _by_class(page, "hl-slo-exemplar")
+        assert links[0].props["href"] == "/debug/traces/html#trace-" + "ab" * 8
+        assert "3200 ms" in _text(links[0])
+
+    def test_forecast_projection_line(self):
+        from headlamp_tpu.obs.debug_pages import slo_page
+
+        text = _text(slo_page(self._report()))
+        assert "exhaustion in 3" in text
+
+    def test_forecast_reason_line_when_no_projection(self):
+        from headlamp_tpu.obs.debug_pages import slo_page
+
+        report = self._report()
+        report["budget_forecast"] = {
+            "slo": "scrape_paint",
+            "points": 2,
+            "window": "1h",
+            "projected_exhaustion_windows": None,
+            "reason": "insufficient_history",
+        }
+        text = _text(slo_page(report))
+        assert "insufficient_history" in text

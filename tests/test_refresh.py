@@ -239,3 +239,31 @@ def test_drain_reports_timeout():
     assert r.drain(timeout_s=0.1) is False
     release.set()
     assert r.drain()
+
+
+def test_background_refit_inherits_requesting_trace():
+    """The contextvars copy at thread-spawn time (ISSUE r10 satellite):
+    a stale-serve's background ``refresh.fit`` span must attach to the
+    REQUESTING trace instead of orphaning, and the worker must see the
+    requester's trace id (what exemplar capture records)."""
+    from headlamp_tpu.obs.trace import current_trace_id, trace_request
+
+    r, clock = make(ttl=5.0, grace=60.0)
+    seen = {}
+
+    def compute():
+        seen["trace_id"] = current_trace_id()
+        return 1
+
+    with trace_request("/warm") as warm_trace:
+        r.get("k", compute)  # cold fill, inside the warming trace
+    assert seen["trace_id"] == warm_trace.trace_id
+
+    clock[0] += 6.0  # past ttl, inside grace → stale serve + bg refit
+    with trace_request("/stale") as stale_trace:
+        r.get("k", compute)
+        assert r.drain()
+        # The background fit span landed under THIS request's root.
+        names = [s.name for s in stale_trace.root.children]
+    assert seen["trace_id"] == stale_trace.trace_id
+    assert "refresh.fit" in names

@@ -561,6 +561,7 @@ class TestConcurrentLoad:
             "/tpu", "/tpu/metrics", "/tpu/topology", "/tpu/nodes",
             "/tpu/pods", "/healthz", "/refresh?back=/tpu", "/nodes",
             "/metricsz", "/debug/traces", "/debug/traces/html",
+            "/sloz", "/sloz/html", "/debug/flightz",
         ]
 
         def hit(i: int) -> int:
@@ -580,6 +581,61 @@ class TestConcurrentLoad:
         finally:
             stop.set()
             server.shutdown()
+
+
+class TestObservabilityRoutes:
+    """The obs/ serving surfaces as ROUTES (ISSUE r10 satellite): the
+    registered HTML pages and their JSON twins, through the real app."""
+
+    def test_debug_traces_html_route_registered_and_renders(self):
+        app = make_app()
+        route = app.registry.route_for("/debug/traces/html")
+        assert route is not None and route.kind == "traces"
+        app.handle("/tpu")  # put one trace in the ring
+        status, ctype, body = app.handle("/debug/traces/html")
+        assert status == 200 and ctype == "text/html"
+        assert "Request Traces" in body
+        # The standard chrome wraps it (it is a page, not a raw dump)…
+        assert "hl-nav" in body
+        # …but it does not advertise itself in the sidebar.
+        assert 'href="/debug/traces/html"' not in body.split("<main>")[0]
+        # Anchored sections: the exemplar-link click targets.
+        assert 'id="trace-' in body
+
+    def test_sloz_html_route_registered_and_renders(self):
+        app = make_app()
+        route = app.registry.route_for("/sloz/html")
+        assert route is not None and route.kind == "slo"
+        status, ctype, body = app.handle("/sloz/html")
+        assert status == 200 and ctype == "text/html"
+        assert "Service Level Objectives" in body
+        assert "scrape_paint" in body and "hl-budgetbar" in body
+        assert 'href="/sloz/html"' not in body.split("<main>")[0]
+
+    def test_sloz_json_twin(self):
+        app = make_app()
+        status, ctype, body = app.handle("/sloz")
+        assert status == 200 and ctype == "application/json"
+        report = json.loads(body)
+        assert {s["name"] for s in report["slos"]} >= {
+            "scrape_paint",
+            "dashboard_render",
+            "forecast_fit",
+            "transport_connect",
+        }
+        assert "budget_forecast" in report
+
+    def test_healthz_carries_runtime_slo_block(self):
+        app = make_app()
+        payload = json.loads(app.handle("/healthz")[2])
+        slo_block = payload["runtime"]["slo"]
+        assert set(slo_block) == {
+            "scrape_paint",
+            "dashboard_render",
+            "forecast_fit",
+            "transport_connect",
+        }
+        assert all(v in ("ok", "warn", "page") for v in slo_block.values())
 
 
 class TestDemoTransport:

@@ -1,0 +1,379 @@
+"""SLO engine: burn-rate states, registry feeds, fault injection (ADR-016).
+
+The acceptance loop: each declared objective is driven ``ok → warn →
+page`` and back to ``ok`` on the INJECTED monotonic clock by
+fault-injecting through the REAL registry instruments (slow fits,
+failing Prometheus batches, stale-socket storms) — never by poking the
+engine's internals — then the violating request is found pinned in
+/debug/flightz and its /metricsz exemplar trace id resolves at
+/debug/traces. No sleeps anywhere: time advances by mutating a list
+cell.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from headlamp_tpu.obs import slo
+from headlamp_tpu.obs.metrics import registry
+from headlamp_tpu.obs.slo import (
+    PAGE_BURN,
+    SLOEngine,
+    SLOSpec,
+    WARN_BURN,
+    _matches,
+    _WindowCounts,
+    default_specs,
+    set_engine,
+)
+
+
+class FakeMono:
+    """List-cell monotonic clock (the repo's standard test clock)."""
+
+    def __init__(self, start: float = 100_000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+@pytest.fixture
+def engine():
+    """A fresh engine on a fake clock, installed as THE process engine
+    so the registry instrument observers feed it; always restored."""
+    clock = FakeMono()
+    eng = SLOEngine(monotonic=clock)
+    eng.clock = clock  # test-side handle
+    set_engine(eng)
+    try:
+        yield eng
+    finally:
+        set_engine(SLOEngine())
+
+
+def _state(eng, name):
+    return eng.health_block()[name]
+
+
+# ---------------------------------------------------------------------------
+# Window counters
+# ---------------------------------------------------------------------------
+
+
+class TestWindowCounts:
+    def test_totals_window_selects_recent_slots(self):
+        w = _WindowCounts()
+        w.add(1000.0, True)
+        w.add(1000.0, False)
+        w.add(5000.0, True)
+        good, bad = w.totals(5000.0, 300.0)
+        assert (good, bad) == (1, 0)
+        good, bad = w.totals(5000.0, 21600.0)
+        assert (good, bad) == (2, 1)
+
+    def test_count_argument_batches(self):
+        w = _WindowCounts()
+        w.add(1000.0, False, count=7)
+        assert w.totals(1000.0, 300.0) == (0, 7)
+
+    def test_pruning_bounds_slots(self):
+        w = _WindowCounts()
+        for i in range(1000):
+            w.add(i * 60.0, True)
+        assert len(w._slots) <= w.MAX_SLOTS + 1
+
+
+class TestMatchers:
+    def test_empty_where_matches_everything(self):
+        assert _matches({}, {"anything": "x"})
+
+    def test_equality_set(self):
+        where = {"route": ("/tpu", "/nodes")}
+        assert _matches(where, {"route": "/tpu"})
+        assert not _matches(where, {"route": "/other"})
+
+    def test_5xx_sentinel(self):
+        where = {"status": ("5xx",)}
+        assert _matches(where, {"status": "500"})
+        assert _matches(where, {"status": "503"})
+        assert not _matches(where, {"status": "404"})
+        assert not _matches(where, {"status": "200"})
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate evaluation (direct record feed — the math in isolation)
+# ---------------------------------------------------------------------------
+
+
+class TestBurnStates:
+    def test_no_events_is_ok_with_full_budget(self, engine):
+        report = engine.report(include_exemplars=False, include_forecast=False)
+        for s in report["slos"]:
+            assert s["state"] == "ok"
+            assert s["budget_remaining_ratio"] == 1.0
+
+    def test_page_needs_both_fast_windows(self, engine):
+        # 100% bad only in the last 5 minutes of an otherwise-good hour:
+        # burn(5m) huge but burn(1h) diluted below the page line → no
+        # page (the 1h confirmation window is what kills flappy pages).
+        for _ in range(2000):
+            engine.record("scrape_paint", True)
+        engine.clock.advance(3300.0)
+        for _ in range(3):
+            engine.record("scrape_paint", False)
+        s = _state(engine, "scrape_paint")
+        assert s != "page"
+
+    def test_warn_then_page_then_recovery(self, engine):
+        # Sustained ~10% bad: burn 10 on every window for a 99% target
+        # → above WARN (6), below PAGE (14.4).
+        for tick in range(360):  # 6h of one-per-minute traffic
+            engine.record("scrape_paint", tick % 10 != 0)
+            engine.clock.advance(60.0)
+        assert _state(engine, "scrape_paint") == "warn"
+        # Storm: all-bad traffic → every window above 14.4 → page.
+        for _ in range(600):
+            engine.record("scrape_paint", False)
+        assert _state(engine, "scrape_paint") == "page"
+        # Recovery: windows slide past the storm on the injected clock.
+        engine.clock.advance(25_000.0)
+        assert _state(engine, "scrape_paint") == "ok"
+
+    def test_budget_remaining_decreases_with_burn(self, engine):
+        # 1 bad in 400 against a 99.5% target: bad fraction 0.25% =
+        # burn 0.5 — half the window budget spent, half remaining.
+        for _ in range(399):
+            engine.record("dashboard_render", True)
+        engine.record("dashboard_render", False)
+        report = engine.report(include_exemplars=False, include_forecast=False)
+        s = [x for x in report["slos"] if x["name"] == "dashboard_render"][0]
+        assert 0.0 < s["budget_remaining_ratio"] < 1.0
+
+
+# ---------------------------------------------------------------------------
+# Registry-fed fault injection: the instruments drive the engine
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjection:
+    def test_slow_fit_pages_forecast_fit(self, engine):
+        """Fault: the forecast refresher's fits turn slow (20 s against
+        an 8 s threshold), observed through the REAL fit histogram."""
+        fit_hist = registry.histogram(
+            "headlamp_tpu_refresh_fit_duration_seconds", "", labels=("refresher",)
+        )
+        assert _state(engine, "forecast_fit") == "ok"
+        for _ in range(20):
+            fit_hist.observe(20.0, refresher="forecast")
+        assert _state(engine, "forecast_fit") == "page"
+        engine.clock.advance(25_000.0)
+        assert _state(engine, "forecast_fit") == "ok"
+
+    def test_other_refreshers_do_not_feed_forecast_fit(self, engine):
+        fit_hist = registry.histogram(
+            "headlamp_tpu_refresh_fit_duration_seconds", "", labels=("refresher",)
+        )
+        for _ in range(20):
+            fit_hist.observe(20.0, refresher="metrics")
+        assert _state(engine, "forecast_fit") == "ok"
+
+    def test_failing_prometheus_batch_pages_scrape_paint(self, engine):
+        """Fault: the metrics route 500s (scrape chain down) — bad
+        events arrive via the requests counter's 5xx feed."""
+        req_total = registry.counter(
+            "headlamp_tpu_requests_total", "", labels=("route", "status")
+        )
+        assert _state(engine, "scrape_paint") == "ok"
+        for _ in range(20):
+            req_total.inc(route="/tpu/metrics", status="500")
+        assert _state(engine, "scrape_paint") == "page"
+        engine.clock.advance(25_000.0)
+        assert _state(engine, "scrape_paint") == "ok"
+
+    def test_slow_scrape_warns_then_pages(self, engine):
+        """Fault: scrapes complete but slower than the 2 s objective."""
+        req_hist = registry.histogram(
+            "headlamp_tpu_request_duration_seconds", "", labels=("route",)
+        )
+        # ~10% slow sustained across all windows → warn.
+        for tick in range(360):
+            req_hist.observe(5.0 if tick % 10 == 0 else 0.1, route="/tpu/metrics")
+            engine.clock.advance(60.0)
+        assert _state(engine, "scrape_paint") == "warn"
+        for _ in range(600):
+            req_hist.observe(5.0, route="/tpu/metrics")
+        assert _state(engine, "scrape_paint") == "page"
+        engine.clock.advance(25_000.0)
+        assert _state(engine, "scrape_paint") == "ok"
+
+    def test_stale_socket_storm_pages_transport_connect(self, engine):
+        """Fault: every pooled socket turns out peer-closed — the
+        stale-retry counter (unlabeled) is the bad-event feed."""
+        stale = registry.counter("headlamp_tpu_transport_stale_retries_total", "")
+        connect_hist = registry.histogram(
+            "headlamp_tpu_transport_connect_latency_seconds", "", labels=("host",)
+        )
+        # healthy baseline
+        for _ in range(50):
+            connect_hist.observe(0.01, host="h:443")
+        assert _state(engine, "transport_connect") == "ok"
+        for _ in range(60):
+            stale.inc()
+        assert _state(engine, "transport_connect") == "page"
+        engine.clock.advance(25_000.0)
+        assert _state(engine, "transport_connect") == "ok"
+
+    def test_connect_failures_feed_transport_connect(self, engine):
+        failed = registry.counter(
+            "headlamp_tpu_transport_connect_failures_total", "", labels=("host",)
+        )
+        for _ in range(30):
+            failed.inc(host="h:443")
+        assert _state(engine, "transport_connect") == "page"
+
+    def test_slow_dashboard_render_pages(self, engine):
+        req_hist = registry.histogram(
+            "headlamp_tpu_request_duration_seconds", "", labels=("route",)
+        )
+        for _ in range(30):
+            req_hist.observe(2.0, route="/tpu")
+        assert _state(engine, "dashboard_render") == "page"
+
+    def test_unmatched_routes_feed_nothing(self, engine):
+        req_hist = registry.histogram(
+            "headlamp_tpu_request_duration_seconds", "", labels=("route",)
+        )
+        for _ in range(30):
+            req_hist.observe(9.0, route="/healthz")
+        assert all(state == "ok" for state in engine.health_block().values())
+
+
+# ---------------------------------------------------------------------------
+# Request-level violation judgement
+# ---------------------------------------------------------------------------
+
+
+class TestViolations:
+    def test_latency_violation_names_the_slo(self, engine):
+        assert engine.violations("/tpu/metrics", 5.0, 200) == ["scrape_paint"]
+        assert engine.violations("/tpu", 0.9, 200) == ["dashboard_render"]
+
+    def test_5xx_violates_regardless_of_latency(self, engine):
+        assert engine.violations("/tpu/metrics", 0.01, 500) == ["scrape_paint"]
+
+    def test_fast_healthy_request_violates_nothing(self, engine):
+        assert engine.violations("/tpu/metrics", 0.01, 200) == []
+        assert engine.violations("other", 99.0, 200) == []
+
+
+# ---------------------------------------------------------------------------
+# Self-forecast (budget exhaustion projection)
+# ---------------------------------------------------------------------------
+
+
+class TestBudgetForecast:
+    def test_thin_history_reports_reason(self, engine):
+        out = engine.budget_forecast()
+        assert out["projected_exhaustion_windows"] is None
+        assert out["reason"] == "insufficient_history"
+
+    def test_projection_from_predicted_latencies(self, engine, monkeypatch):
+        # The models glue is monkeypatched: this asserts the engine's
+        # plumbing + math, not the MLP (tests/test_forecast.py owns
+        # that). All predictions over the 2 s threshold → burn 100×
+        # against a full budget → exhaustion in ceil(1 / (100/6)) = 1
+        # window... rate = 100 * (1h/6h) = 16.67 per window → 1 window.
+        import headlamp_tpu.models.service as service
+
+        monkeypatch.setattr(
+            service,
+            "forecast_slo_burn",
+            lambda series, state=None, steps=60: ([3.0] * steps, None),
+        )
+        req_hist = registry.histogram(
+            "headlamp_tpu_request_duration_seconds", "", labels=("route",)
+        )
+        for _ in range(60):
+            req_hist.observe(0.1, route="/tpu/metrics")
+        out = engine.budget_forecast()
+        assert out["projected_burn_rate"] == 100.0
+        assert out["projected_exhaustion_windows"] == 1
+
+    def test_healthy_projection_reports_no_burn(self, engine, monkeypatch):
+        import headlamp_tpu.models.service as service
+
+        monkeypatch.setattr(
+            service,
+            "forecast_slo_burn",
+            lambda series, state=None, steps=60: ([0.1] * steps, None),
+        )
+        req_hist = registry.histogram(
+            "headlamp_tpu_request_duration_seconds", "", labels=("route",)
+        )
+        for _ in range(60):
+            req_hist.observe(0.1, route="/tpu/metrics")
+        out = engine.budget_forecast()
+        assert out["projected_exhaustion_windows"] is None
+        assert out["reason"] == "no_projected_burn"
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: gauges on /metricsz, report shape, custom specs
+# ---------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    def test_slo_gauges_render(self, engine):
+        text = registry.render()
+        assert "headlamp_tpu_slo_burn_rate_ratio" in text
+        assert "headlamp_tpu_slo_error_budget_remaining_ratio" in text
+        assert 'headlamp_tpu_slo_state_info{slo="scrape_paint",state="ok"} 1' in text
+
+    def test_state_gauge_follows_engine(self, engine):
+        for _ in range(30):
+            engine.record("dashboard_render", False)
+        text = registry.render()
+        assert (
+            'headlamp_tpu_slo_state_info{slo="dashboard_render",state="page"} 1'
+            in text
+        )
+
+    def test_report_shape(self, engine):
+        report = engine.report(include_forecast=False)
+        assert report["page_burn_threshold"] == PAGE_BURN
+        assert report["warn_burn_threshold"] == WARN_BURN
+        names = [s["name"] for s in report["slos"]]
+        assert names == [s.name for s in default_specs()]
+        for s in report["slos"]:
+            assert set(s["burn_rates"]) == {"5m", "30m", "1h", "6h"}
+            assert "exemplars" in s
+        json.dumps(report)  # must be JSON-serializable as-is
+
+    def test_custom_specs(self):
+        clock = FakeMono()
+        eng = SLOEngine(
+            (
+                SLOSpec(
+                    name="only",
+                    description="d",
+                    target=0.99,
+                    threshold_s=1.0,
+                ),
+            ),
+            monotonic=clock,
+        )
+        eng.feed_latency(
+            "headlamp_tpu_request_duration_seconds", 0.5, {"route": "/x"}
+        )
+        assert eng.health_block() == {"only": "ok"}
+        for _ in range(30):
+            eng.feed_latency(
+                "headlamp_tpu_request_duration_seconds", 2.0, {"route": "/x"}
+            )
+        assert eng.health_block() == {"only": "page"}

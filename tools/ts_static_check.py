@@ -1475,7 +1475,15 @@ def main() -> int:
     for diag in fit_diags:
         print(diag)
     print(f"{len(fit_diags)} inline-fit problem(s)")
-    return 1 if diagnostics or urlopen_diags or fit_diags else 0
+    # Clock-discipline gate (ADR-013/ADR-016): no wall-clock reads in
+    # obs/, runtime/, transport/ — injected monotonic is the contract.
+    import no_wall_clock_check
+
+    wall_diags = no_wall_clock_check.check_tree()
+    for diag in wall_diags:
+        print(diag)
+    print(f"{len(wall_diags)} wall-clock problem(s)")
+    return 1 if diagnostics or urlopen_diags or fit_diags or wall_diags else 0
 
 
 if __name__ == "__main__":
