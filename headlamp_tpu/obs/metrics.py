@@ -39,6 +39,36 @@ from typing import Any, Callable, Iterator
 
 _NAME_RE = re.compile(r"^headlamp_tpu_[a-z0-9_]+$")
 
+#: Content type of the OpenMetrics rendering (the only exposition that
+#: may legally carry exemplar clauses — the classic 0.0.4 text-format
+#: parser treats a trailing ``#`` token as a malformed timestamp and
+#: fails the whole scrape).
+OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0"
+TEXT_CONTENT_TYPE = "text/plain"
+
+
+def negotiate_openmetrics(accept: str | None) -> bool:
+    """True iff the Accept header opts into OpenMetrics exposition.
+    Per-clause media-type match with q=0 treated as a refusal; absent
+    or unparsable headers fall back to the classic text format — the
+    safe default for every scraper that never heard of OpenMetrics."""
+    if not accept:
+        return False
+    for clause in accept.split(","):
+        parts = [p.strip() for p in clause.split(";")]
+        if parts[0].lower() != "application/openmetrics-text":
+            continue
+        q = 1.0
+        for param in parts[1:]:
+            if param.lower().startswith("q="):
+                try:
+                    q = float(param[2:])
+                except ValueError:
+                    q = 0.0
+        if q > 0:
+            return True
+    return False
+
 #: Unit suffix grammar the exposition test (tests/test_metricsz.py)
 #: re-asserts from outside. ``_total`` for counters, base units for
 #: measurements, ``_count`` for cardinalities, ``_ratio`` for 0..1,
@@ -165,7 +195,7 @@ class Counter:
         with self._lock:
             return sorted(self._values.items())
 
-    def render_into(self, out: list[str]) -> None:
+    def render_into(self, out: list[str], openmetrics: bool = False) -> None:
         samples = self.samples() or [((), 0.0)]
         for values, v in samples:
             out.append(f"{self.name}{_label_str(self.labels, values)} {_fmt(v)}")
@@ -204,7 +234,7 @@ class CallbackGauge:
         self.labels: tuple[str, ...] = ()
         self.fn = fn
 
-    def render_into(self, out: list[str]) -> None:
+    def render_into(self, out: list[str], openmetrics: bool = False) -> None:
         try:
             value = self.fn()
         except Exception:  # noqa: BLE001 — scrape survives broken producers
@@ -234,7 +264,7 @@ class MultiCallbackGauge:
         self.labels = tuple(labels)
         self.fn = fn
 
-    def render_into(self, out: list[str]) -> None:
+    def render_into(self, out: list[str], openmetrics: bool = False) -> None:
         try:
             samples = list(self.fn() or ())
         except Exception:  # noqa: BLE001 — scrape survives broken producers
@@ -382,7 +412,7 @@ class Histogram:
                 out.append((values, le, ex[0], ex[1]))
         return out
 
-    def render_into(self, out: list[str]) -> None:
+    def render_into(self, out: list[str], openmetrics: bool = False) -> None:
         with self._lock:
             items = sorted(self._children.items())
         if not items:
@@ -394,7 +424,16 @@ class Histogram:
                 counts = list(child.counts)
                 total = child.count
                 total_sum = child.sum
-                exemplars = list(child.exemplars) if child.exemplars else None
+                # Exemplar clauses are only legal in the OpenMetrics
+                # format — on the classic text format a real Prometheus
+                # parses the trailing '#' token as a malformed timestamp
+                # and fails the ENTIRE scrape, so text/plain renders
+                # must stay exemplar-free.
+                exemplars = (
+                    list(child.exemplars)
+                    if openmetrics and child.exemplars
+                    else None
+                )
             cumulative = 0
             for i, (bound, n) in enumerate(zip(self.buckets, counts)):
                 cumulative += n
@@ -487,14 +526,26 @@ class MetricRegistry:
             metrics = list(self._metrics.values())
         return iter(sorted(metrics, key=lambda m: m.name))
 
-    def render(self) -> str:
-        """Prometheus text exposition format 0.0.4 — the /metricsz
-        body. One HELP + TYPE block per metric, samples after."""
+    def render(self, *, openmetrics: bool = False) -> str:
+        """The /metricsz body. Default: Prometheus text exposition
+        format 0.0.4 (one HELP + TYPE block per metric, samples after,
+        NO exemplars — they are not part of that grammar). With
+        ``openmetrics`` (negotiated from the Accept header): the
+        OpenMetrics 1.0 rendering — counter families named without
+        their ``_total`` sample suffix, exemplar clauses on histogram
+        bucket lines, and the mandatory ``# EOF`` terminator."""
         out: list[str] = []
         for metric in self:
-            out.append(f"# HELP {metric.name} {_escape_help(metric.help)}")
-            out.append(f"# TYPE {metric.name} {metric.kind}")
-            metric.render_into(out)
+            family = metric.name
+            if openmetrics and metric.kind == "counter":
+                # OM names the FAMILY without the suffix; the sample
+                # lines keep their `_total` name unchanged.
+                family = family[: -len("_total")]
+            out.append(f"# HELP {family} {_escape_help(metric.help)}")
+            out.append(f"# TYPE {family} {metric.kind}")
+            metric.render_into(out, openmetrics=openmetrics)
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n"
 
 

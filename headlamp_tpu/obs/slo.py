@@ -56,7 +56,12 @@ STALE_RETRIES = "headlamp_tpu_transport_stale_retries_total"
 
 #: (name, help, labels) for every histogram the engine observes.
 _LATENCY_SOURCES: tuple[tuple[str, str, tuple[str, ...]], ...] = (
-    (REQUEST_DURATION, "End-to-end handle() latency per route template.", ("route",)),
+    (
+        REQUEST_DURATION,
+        "End-to-end handle() latency per route template "
+        "(non-5xx responses; errors count in requests_total).",
+        ("route",),
+    ),
     (
         FIT_DURATION,
         "Wall duration of refresher recomputes (the cost the grace window "
@@ -153,7 +158,12 @@ class SLOSpec:
     latency_where: Mapping[str, tuple[str, ...]] = field(default_factory=dict)
     #: (counter_name, matcher) pairs whose matching incs are bad events
     #: — errors that never reach the latency histogram (5xx responses,
-    #: failed connects, stale-socket retries).
+    #: failed connects, stale-socket retries). The producers uphold the
+    #: disjointness: server/app.py keeps 5xx out of the request-latency
+    #: histogram and a failed connect never observes connect latency,
+    #: so each event lands in exactly ONE feed — a fast 5xx counted
+    #: good-by-latency AND bad-by-status would halve bad_fraction
+    #: during an error storm and delay the page transition.
     error_feeds: tuple[tuple[str, Mapping[str, tuple[str, ...]]], ...] = ()
     #: Feed this SLO's latency stream into the budget self-forecast.
     self_forecast: bool = False
@@ -454,12 +464,13 @@ class SLOEngine:
 
     def budget_forecast(self) -> dict[str, Any] | None:
         """Projected budget exhaustion for the self-forecast SLO: fit
-        the scrape→paint latency series (through the Refresher so a
-        fit never lands on a /sloz request twice), classify the
-        predicted latencies against the threshold, and convert the
-        projected burn rate into "N 1-hour windows until the 6 h budget
-        is gone". Degrades to a named reason — thin history, missing
-        analytics extras, fit errors — never an exception."""
+        the scrape→paint latency series (through the Refresher's
+        non-blocking read, so a fit NEVER runs in the foreground of a
+        /sloz request), classify the predicted latencies against the
+        threshold, and convert the projected burn rate into "N 1-hour
+        windows until the 6 h budget is gone". Degrades to a named
+        reason — thin history, a fit still in flight (``fit_pending``),
+        missing analytics extras, fit errors — never an exception."""
         spec = next((s for s in self.specs if s.self_forecast), None)
         if spec is None:
             return None
@@ -474,11 +485,26 @@ class SLOEngine:
             out["reason"] = "insufficient_history"
             return out
         try:
-            predictions = self._budget_refresher().get(
+            # Non-blocking read: a cold cache (first report after
+            # warmup, or a quiet server whose grace lapsed) kicks the
+            # jax fit in the BACKGROUND and reports fit_pending — a
+            # model fit must never land in the foreground of a /sloz
+            # request.
+            refresher = self._budget_refresher()
+            predictions = refresher.get_nowait(
                 "paint", lambda: self._fit_paint_series(series), epoch=0
             )
         except Exception as exc:  # noqa: BLE001 — /sloz must render regardless
             out["reason"] = type(exc).__name__
+            return out
+        if predictions is None:
+            # Background refit errors are absorbed by design (ADR-015),
+            # so distinguish "first fit still running" from "every fit
+            # so far failed" (e.g. a jax-less host) — the latter would
+            # otherwise read as pending forever.
+            out["reason"] = (
+                "fit_pending" if refresher.refit_errors == 0 else "fit_failed"
+            )
             return out
         if not predictions:
             out["reason"] = "forecast_unavailable"

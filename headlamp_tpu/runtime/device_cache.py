@@ -183,6 +183,12 @@ class DeviceFleetCache:
         total = self.hits + self.misses
         return self.hits / total if total else 0.0
 
+    def counters(self) -> dict[str, int]:
+        """Monotone counters only, lock-free (plain int reads) — the
+        flight recorder's per-request delta view. No hit_rate (a gauge
+        would make deltas noisy) and no entries copy (cost)."""
+        return {"hits": self.hits, "misses": self.misses}
+
     def snapshot(self) -> dict[str, object]:
         """Observability block for /healthz and bench."""
         with self._lock:

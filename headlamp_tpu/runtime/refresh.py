@@ -148,23 +148,7 @@ class Refresher:
                     # Serve stale NOW; exactly one background refresh.
                     self.served_stale += 1
                     _SERVED_STALE.inc(refresher=self.name)
-                    fkey = (key, epoch)
-                    if fkey not in self._flights:
-                        flight = _Flight()
-                        self._flights[fkey] = flight
-                        # Copy the caller's contextvars into the worker
-                        # (same pattern as the transport fan-out,
-                        # transport/pool.py): the background refit's
-                        # ``refresh.fit`` span then attaches to the
-                        # REQUESTING trace instead of orphaning, and
-                        # exemplar capture sees the right trace id.
-                        ctx = contextvars.copy_context()
-                        threading.Thread(
-                            target=ctx.run,
-                            args=(self._background_refit, key, epoch, compute, flight),
-                            name=f"refresh-{self.name}",
-                            daemon=True,
-                        ).start()
+                    self._spawn_refit_locked(key, epoch, compute)
                     return entry.value
             # Cold / past grace / epoch bumped: block (or join a flight).
             fkey = (key, epoch)
@@ -181,6 +165,33 @@ class Refresher:
         if flight.error is not None:
             raise flight.error
         return flight.value
+
+    def get_nowait(
+        self, key: Hashable, compute: Callable[[], Any], *, epoch: int = 0
+    ) -> Any | None:
+        """Non-blocking get: fresh and stale-within-grace values return
+        immediately (stale kicks exactly one background refresh, same
+        as :meth:`get`); a cold / past-grace / epoch-bumped key kicks
+        the single-flight compute in the BACKGROUND and returns None
+        instead of blocking. For surfaces that must render on every
+        request (e.g. /sloz's budget forecast) where "not computed yet"
+        is a renderable state and a foreground model fit is not."""
+        now = self._monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and entry.epoch == epoch:
+                age = now - entry.fetched_mono
+                if age <= self.ttl_s:
+                    self.served_fresh += 1
+                    _SERVED_FRESH.inc(refresher=self.name)
+                    return entry.value
+                if age <= self.grace_s:
+                    self.served_stale += 1
+                    _SERVED_STALE.inc(refresher=self.name)
+                    self._spawn_refit_locked(key, epoch, compute)
+                    return entry.value
+            self._spawn_refit_locked(key, epoch, compute)
+            return None
 
     def peek(
         self, key: Hashable, *, epoch: int = 0, max_age_s: float | None = None
@@ -199,6 +210,29 @@ class Refresher:
             return entry.value
 
     # -- compute paths ---------------------------------------------------
+
+    def _spawn_refit_locked(
+        self, key: Hashable, epoch: int, compute: Callable[[], Any]
+    ) -> None:
+        """Start the single-flight background compute for (key, epoch)
+        unless one is already running. Caller holds ``self._lock``.
+        Copies the caller's contextvars into the worker (same pattern
+        as the transport fan-out, transport/pool.py): the background
+        refit's ``refresh.fit`` span then attaches to the REQUESTING
+        trace instead of orphaning, and exemplar capture sees the right
+        trace id."""
+        fkey = (key, epoch)
+        if fkey in self._flights:
+            return
+        flight = _Flight()
+        self._flights[fkey] = flight
+        ctx = contextvars.copy_context()
+        threading.Thread(
+            target=ctx.run,
+            args=(self._background_refit, key, epoch, compute, flight),
+            name=f"refresh-{self.name}",
+            daemon=True,
+        ).start()
 
     def _run_compute(self, compute: Callable[[], Any]) -> Any:
         """The timed, traced recompute — shared by foreground and
@@ -293,6 +327,18 @@ class Refresher:
         with self._lock:
             self.demotions_to_cold += 1
         _DEMOTIONS.inc(refresher=self.name)
+
+    def counters(self) -> dict[str, int]:
+        """Monotone counters only, lock-free — the flight recorder's
+        per-request delta view (snapshot minus the ``entries`` gauge,
+        and without taking the map lock)."""
+        return {
+            "served_fresh": self.served_fresh,
+            "served_stale": self.served_stale,
+            "refits": self.refits,
+            "refit_errors": self.refit_errors,
+            "demotions_to_cold": self.demotions_to_cold,
+        }
 
     def snapshot(self) -> dict[str, int]:
         """Plain-int view for /healthz (mirrors the registry counters)."""

@@ -81,6 +81,12 @@ class TransferStats:
             "coalesced_trees": self.coalesced_trees,
         }
 
+    def counters(self) -> dict[str, int]:
+        """Monotone counters only — the flight recorder's per-request
+        delta view (here identical to snapshot; the shared name is the
+        contract across runtime/transport components)."""
+        return self.snapshot()
+
 
 transfer_stats = TransferStats()
 

@@ -145,17 +145,37 @@ def _runtime_health(
         return {"error": type(exc).__name__}
 
 
-def _flatten_counters(
-    prefix: str, mapping: dict[str, Any], out: dict[str, float]
-) -> None:
-    """Flatten _runtime_health's nested dicts into dotted numeric keys —
-    the flight recorder's counter-snapshot shape (strings like the slo
-    state block fall out here)."""
-    for key, value in mapping.items():
-        if isinstance(value, dict):
-            _flatten_counters(f"{prefix}{key}.", value, out)
-        elif isinstance(value, (int, float)) and not isinstance(value, bool):
-            out[f"{prefix}{key}"] = value
+def _runtime_counters(
+    transport: Any = None, refreshers: tuple[Refresher, ...] = ()
+) -> dict[str, float]:
+    """Flat dotted monotone-counter snapshot for the flight recorder's
+    before/after delta. Deliberately NOT _runtime_health: this runs
+    twice per recorded request, so it reads each component's dedicated
+    ``counters()`` view — plain int loads, no locks, no SLO window
+    evaluation, and none of the gauge-like floats (RTT EWMAs, budget
+    ratios) that would turn the 'what this request moved' delta into
+    noise."""
+    try:
+        from ..runtime.device_cache import fleet_cache
+        from ..runtime.transfer import transfer_stats
+        from ..transport.pool import pool_of
+    except Exception:  # noqa: BLE001 — recording must never fail a request
+        return {}
+    out: dict[str, float] = {}
+    for prefix, counters in (
+        ("transfer", transfer_stats.counters()),
+        ("fleet_cache", fleet_cache.counters()),
+    ):
+        for key, value in counters.items():
+            out[f"{prefix}.{key}"] = value
+    pool = pool_of(transport)
+    if pool is not None:
+        for key, value in pool.counters().items():
+            out[f"transport.{key}"] = value
+    for refresher in refreshers:
+        for key, value in refresher.counters().items():
+            out[f"refresh.{refresher.name}.{key}"] = value
+    return out
 
 
 def _force_recalibration() -> None:
@@ -289,7 +309,8 @@ class DashboardApp:
         # the registry rather than collide on re-registration.
         self._req_hist = metrics_registry.histogram(
             "headlamp_tpu_request_duration_seconds",
-            "End-to-end handle() latency per route template.",
+            "End-to-end handle() latency per route template "
+            "(non-5xx responses; errors count in requests_total).",
             labels=("route",),
         )
         self._req_total = metrics_registry.counter(
@@ -667,11 +688,15 @@ class DashboardApp:
             return route_path
         return "other"
 
-    def handle(self, path: str) -> tuple[int, str, str]:
+    def handle(
+        self, path: str, *, accept: str | None = None
+    ) -> tuple[int, str, str]:
         """(status, content_type, body) for a GET. Pure enough to test
         without sockets. Never raises: route errors become a 500 page
         (a traceback must not leak into a response, and one broken
-        route must not kill the handler thread).
+        route must not kill the handler thread). ``accept`` is the
+        request's Accept header — only /metricsz consults it (OpenMetrics
+        content negotiation); every other route ignores it.
 
         Every request runs inside its own TransferBatch scope: stages
         that produce device arrays (XLA rollup, forecast, mesh shards)
@@ -695,24 +720,21 @@ class DashboardApp:
         recorded = route_label not in self._RING_EXCLUDED
         counters_before: dict[str, float] | None = None
         if recorded:
-            # Flight-recorder baseline: the same runtime counters
-            # /healthz reports, flattened, snapshotted around the
-            # request so the wide event carries what THIS request moved
-            # (process-wide reads — a concurrent neighbour's activity
-            # can bleed in; accepted for a triage surface, ADR-016).
-            counters_before = {}
-            _flatten_counters(
-                "",
-                _runtime_health(
-                    self._transport,
-                    (self._metrics_refresher, self._forecast_refresher),
-                ),
-                counters_before,
+            # Flight-recorder baseline: monotone runtime counters
+            # snapshotted around the request so the wide event carries
+            # what THIS request moved (process-wide reads — a concurrent
+            # neighbour's activity can bleed in; accepted for a triage
+            # surface, ADR-016). The cheap counters() view, NOT
+            # _runtime_health: evaluating every SLO window twice per
+            # request would dwarf the 5.3 µs slo_eval budget.
+            counters_before = _runtime_counters(
+                self._transport,
+                (self._metrics_refresher, self._forecast_refresher),
             )
         with trace_request(path, enabled=recorded, wall=self._clock) as trace:
             try:
                 with batch.scope():
-                    status, content_type, body = self._handle(path)
+                    status, content_type, body = self._handle(path, accept)
                     return status, content_type, body
             except Exception as e:  # noqa: BLE001 — error boundary
                 body = self._page_html(
@@ -728,7 +750,13 @@ class DashboardApp:
                 duration_s = time.perf_counter() - t0
                 # Observed INSIDE the trace scope so the histogram
                 # bucket's exemplar carries this request's trace id.
-                self._req_hist.observe(duration_s, route=route_label)
+                # 5xx responses stay OUT of the latency histogram: the
+                # SLO engine counts them once as bad events through the
+                # requests_total 5xx feed, and a fast 500 must not also
+                # register as a good latency observation (it would halve
+                # bad_fraction during an error storm and delay paging).
+                if status < 500:
+                    self._req_hist.observe(duration_s, route=route_label)
                 self._req_total.inc(route=route_label, status=str(status))
                 trace_dict = None
                 if trace is not None:
@@ -740,14 +768,9 @@ class DashboardApp:
                     trace_dict = trace.to_dict()
                     trace_ring.record(trace_dict)
                 if recorded:
-                    counters_after: dict[str, float] = {}
-                    _flatten_counters(
-                        "",
-                        _runtime_health(
-                            self._transport,
-                            (self._metrics_refresher, self._forecast_refresher),
-                        ),
-                        counters_after,
+                    counters_after = _runtime_counters(
+                        self._transport,
+                        (self._metrics_refresher, self._forecast_refresher),
                     )
                     violations = slo_mod.engine().violations(
                         route_label, duration_s, status
@@ -766,7 +789,7 @@ class DashboardApp:
                         pinned=bool(violations) or status >= 500,
                     )
 
-    def _handle(self, path: str) -> tuple[int, str, str]:
+    def _handle(self, path: str, accept: str | None = None) -> tuple[int, str, str]:
         parsed = urlparse(path)
         route_path = parsed.path.rstrip("/") or "/tpu"
 
@@ -842,7 +865,19 @@ class DashboardApp:
             # must never block or 500: render() walks lock-light
             # in-memory instruments and callback gauges swallow their
             # own errors, so a scrape is safe at any process state.
-            return 200, "text/plain", metrics_registry.render()
+            # Exemplars only ride the OpenMetrics rendering — a classic
+            # text-format scraper would fail the whole scrape on them
+            # (ADR-016) — so the format is negotiated from Accept.
+            from ..obs.metrics import (
+                OPENMETRICS_CONTENT_TYPE,
+                TEXT_CONTENT_TYPE,
+                negotiate_openmetrics,
+            )
+
+            if negotiate_openmetrics(accept):
+                body = metrics_registry.render(openmetrics=True)
+                return 200, OPENMETRICS_CONTENT_TYPE, body
+            return 200, TEXT_CONTENT_TYPE, metrics_registry.render()
 
         if route_path == "/debug/traces":
             # JSON twin of /debug/traces/html — the ring's raw contents
@@ -1020,7 +1055,9 @@ class DashboardApp:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
-                status, content_type, body = app.handle(self.path)
+                status, content_type, body = app.handle(
+                    self.path, accept=self.headers.get("Accept")
+                )
                 if status == 302:
                     self.send_response(302)
                     self.send_header("Location", content_type)

@@ -270,6 +270,19 @@ class ConnectionPool:
     def rtt_ewma_ms(self) -> float | None:
         return self._rtt_ewma_ms
 
+    def counters(self) -> dict[str, int]:
+        """Monotone counters only, lock-free — the flight recorder's
+        per-request delta view. Deliberately excludes the gauges and
+        EWMAs snapshot() carries (open/idle connections, reuse_rate,
+        RTT) whose movement would show up as noisy or negative
+        'deltas' in a wide event."""
+        return {
+            "connections_opened": self.opened,
+            "connections_reused": self.reused,
+            "idle_evicted": self.evicted,
+            "stale_retries": self.stale_retries,
+        }
+
     def snapshot(self) -> dict[str, Any]:
         """The /healthz transport block: per-pool ints plus the live
         derived numbers an operator reads first (see OPERATIONS.md)."""
@@ -373,10 +386,14 @@ class ConnectionPool:
                     raw = http.client.HTTPConnection(host, port, timeout=timeout_s)
                 try:
                     raw.connect()
-                except BaseException:
+                except Exception:
                     # Failed opens never reach the latency histogram, so
                     # they get their own counter — the transport_connect
                     # SLO's availability arm (ADR-016) feeds off it.
+                    # Exception, not BaseException: a KeyboardInterrupt/
+                    # SystemExit landing mid-connect is not a transport
+                    # failure and must not spend the 0.1% error budget
+                    # (the outer handler still undoes slot accounting).
                     _CONNECT_FAILED.inc(host=host_label)
                     raise
                 self._observe_connect(host_label, time.perf_counter() - t0)

@@ -156,7 +156,11 @@ class TestEndToEnd:
     def test_metricsz_exemplar_resolves_at_debug_traces(self):
         app = make_app()
         app.handle("/tpu/metrics")
-        _, _, exposition = app.handle("/metricsz")
+        # Exemplars only ride the negotiated OpenMetrics rendering;
+        # the classic text format must stay clean for old parsers.
+        _, _, exposition = app.handle(
+            "/metricsz", accept="application/openmetrics-text"
+        )
         exemplar_ids = re.findall(r'# \{trace_id="([0-9a-f]{16})"\}', exposition)
         assert exemplar_ids, "no exemplars on /metricsz after traced traffic"
         _, _, body = app.handle("/debug/traces")

@@ -1,24 +1,32 @@
 """Prometheus exposition-format conformance for /metricsz (ADR-013,
 satellite: the mini text-format parser — strictified for ISSUE r10).
 
-A minimal parser for the 0.0.4 text format (plus OpenMetrics exemplar
-clauses) scrapes the endpoint through the app layer and re-asserts,
-from the OUTSIDE, the invariants the registry promises: a well-formed,
-non-empty ``# HELP`` and ``# TYPE`` pair emitted exactly once per
-family and BEFORE its samples, histogram buckets cumulative and
-monotone with ``+Inf == _count``, every metric name matching the
-``headlamp_tpu_`` grammar with a unit suffix, and exemplars appearing
-only on ``_bucket`` lines, carrying exactly a 16-hex ``trace_id`` and
-a value inside the bucket's bound. The parser knows nothing about the
-registry's internals on purpose — it reads the wire format the way a
-real Prometheus server would.
+A minimal parser for the 0.0.4 text format (and, separately negotiated,
+the OpenMetrics rendering with exemplar clauses) scrapes the endpoint
+through the app layer and re-asserts, from the OUTSIDE, the invariants
+the registry promises: a well-formed, non-empty ``# HELP`` and
+``# TYPE`` pair emitted exactly once per family and BEFORE its samples,
+histogram buckets cumulative and monotone with ``+Inf == _count``,
+every metric name matching the ``headlamp_tpu_`` grammar with a unit
+suffix, and — ONLY on the OpenMetrics rendering, the one format whose
+grammar allows them — exemplars appearing only on ``_bucket`` lines,
+carrying exactly a 16-hex ``trace_id`` and a value inside the bucket's
+bound. The default text/plain body must be exemplar-free: a classic
+text-format parser reads the trailing ``#`` token as a malformed
+timestamp and fails the entire scrape. The parser knows nothing about
+the registry's internals on purpose — it reads the wire format the way
+a real Prometheus server would.
 """
 
 import re
 
 import pytest
 
-from headlamp_tpu.obs.metrics import UNIT_SUFFIXES
+from headlamp_tpu.obs.metrics import (
+    OPENMETRICS_CONTENT_TYPE,
+    UNIT_SUFFIXES,
+    negotiate_openmetrics,
+)
 from headlamp_tpu.server import DashboardApp, make_demo_transport
 
 NAME_RE = re.compile(r"^headlamp_tpu_[a-z0-9_]+$")
@@ -40,21 +48,30 @@ def _float(raw: str) -> float:
     return float("inf") if raw == "+Inf" else float(raw)
 
 
-def parse_exposition(text: str):
+def parse_exposition(text: str, openmetrics: bool = False):
     """(helps, types, samples, exemplars) from Prometheus text format.
 
     Samples are (name, labels dict, float value) in document order;
     exemplars are (sample_name, labels dict, exemplar labels dict,
     exemplar value). STRICT: any malformed HELP/TYPE/sample line, a
     duplicate HELP/TYPE, or a family whose samples precede its metadata
-    is an assertion failure right here in the parser.
+    is an assertion failure right here in the parser. With
+    ``openmetrics`` the body must terminate with the mandatory
+    ``# EOF``; without it, an ``# EOF`` (or any exemplar clause — see
+    the sample-name/family mapping in :func:`base_name`) marks the body
+    as serving OM syntax to a classic scraper, which is the high-sev
+    failure this suite guards against.
     """
     helps: dict[str, str] = {}
     types: dict[str, str] = {}
     samples: list[tuple[str, dict[str, str], float]] = []
     exemplars: list[tuple[str, dict[str, str], dict[str, str], float]] = []
     families_with_samples: set[str] = set()
-    for line in text.splitlines():
+    lines = text.splitlines()
+    if openmetrics:
+        assert lines and lines[-1] == "# EOF", "OpenMetrics body must end in # EOF"
+        lines = lines[:-1]
+    for line in lines:
         if not line.strip():
             continue
         if line.startswith("# HELP "):
@@ -93,25 +110,47 @@ def parse_exposition(text: str):
 
 
 def base_name(sample_name: str, types: dict[str, str]) -> str:
-    """Map a histogram's derived series back to its declared family."""
+    """Map a derived series back to its declared family: histogram
+    ``_bucket``/``_sum``/``_count``, and (OpenMetrics only) counter
+    ``_total`` samples whose family is declared without the suffix."""
     for suffix in ("_bucket", "_sum", "_count"):
         if sample_name.endswith(suffix):
             base = sample_name[: -len(suffix)]
             if types.get(base) == "histogram":
                 return base
+    if sample_name.endswith("_total"):
+        base = sample_name[: -len("_total")]
+        if types.get(base) == "counter":
+            return base
     return sample_name
 
 
 @pytest.fixture(scope="module")
-def exposition() -> str:
-    """One scrape after real traffic across the instrumented routes —
+def scraped_app() -> DashboardApp:
+    """One app after real traffic across the instrumented routes —
     every family asserted below must exist because a REQUEST made it
     exist, not because a test reached into the registry."""
     app = DashboardApp(make_demo_transport("v5p32"), min_sync_interval_s=0.0)
     for path in ("/tpu", "/tpu/nodes", "/tpu/metrics", "/nope", "/healthz"):
         app.handle(path)
-    status, ctype, body = app.handle("/metricsz")
+    return app
+
+
+@pytest.fixture(scope="module")
+def exposition(scraped_app) -> str:
+    """The default scrape: no Accept negotiation, classic text format."""
+    status, ctype, body = scraped_app.handle("/metricsz")
     assert status == 200 and ctype == "text/plain"
+    return body
+
+
+@pytest.fixture(scope="module")
+def om_exposition(scraped_app) -> str:
+    """The scrape a real Prometheus makes when it wants exemplars."""
+    status, ctype, body = scraped_app.handle(
+        "/metricsz", accept="application/openmetrics-text; version=1.0.0"
+    )
+    assert status == 200 and ctype == OPENMETRICS_CONTENT_TYPE
     return body
 
 
@@ -201,34 +240,87 @@ class TestFormat:
                 assert 0 <= value < float("inf"), name
 
 
-class TestExemplars:
-    """OpenMetrics exemplar clauses (ISSUE r10 tentpole): bucket lines
-    may carry ``# {trace_id="<16 hex>"} value``; nothing else may."""
+class TestContentNegotiation:
+    """The high-sev contract: exemplar clauses are only legal in the
+    OpenMetrics format, so the classic text/plain body must never carry
+    one — a real Prometheus without OM negotiation would fail the
+    ENTIRE scrape on the first traced request otherwise."""
 
-    def test_exemplars_only_on_bucket_lines(self, exposition):
+    def test_text_plain_body_is_exemplar_free(self, exposition):
+        assert " # {" not in exposition, (
+            "exemplar clause leaked into the classic text format"
+        )
         _, _, _, exemplars = parse_exposition(exposition)
+        assert exemplars == []
+
+    def test_text_plain_body_has_no_eof_marker(self, exposition):
+        assert "# EOF" not in exposition
+
+    def test_om_body_negotiated_by_accept(self, om_exposition):
+        assert om_exposition.rstrip("\n").endswith("# EOF")
+
+    def test_wildcard_accept_stays_classic(self, scraped_app):
+        _, ctype, body = scraped_app.handle(
+            "/metricsz", accept="text/plain;version=0.0.4;q=0.5,*/*;q=0.1"
+        )
+        assert ctype == "text/plain" and " # {" not in body
+
+    def test_negotiation_grammar(self):
+        assert negotiate_openmetrics("application/openmetrics-text")
+        assert negotiate_openmetrics(
+            "application/openmetrics-text; version=1.0.0; q=0.8, text/plain;q=0.5"
+        )
+        assert not negotiate_openmetrics(None)
+        assert not negotiate_openmetrics("")
+        assert not negotiate_openmetrics("text/plain")
+        assert not negotiate_openmetrics("*/*")
+        assert not negotiate_openmetrics("application/openmetrics-text;q=0")
+
+    def test_om_counter_families_drop_the_total_suffix(self, om_exposition):
+        helps, types, samples, _ = parse_exposition(om_exposition, openmetrics=True)
+        assert types["headlamp_tpu_requests"] == "counter"
+        assert "headlamp_tpu_requests_total" not in types
+        # Sample lines keep the _total name the OM grammar requires.
+        assert any(n == "headlamp_tpu_requests_total" for n, _, _ in samples)
+
+    def test_om_body_is_strictly_well_formed(self, om_exposition):
+        helps, types, samples, _ = parse_exposition(om_exposition, openmetrics=True)
+        assert samples
+        for name, _, _ in samples:
+            base = base_name(name, types)
+            assert base in helps, f"{name} has no # HELP"
+            assert base in types, f"{name} has no # TYPE"
+
+
+class TestExemplars:
+    """OpenMetrics exemplar clauses (ISSUE r10 tentpole), on the
+    NEGOTIATED OM rendering only: bucket lines may carry
+    ``# {trace_id="<16 hex>"} value``; nothing else may."""
+
+    def test_exemplars_only_on_bucket_lines(self, om_exposition):
+        _, _, _, exemplars = parse_exposition(om_exposition, openmetrics=True)
         for name, _, _, _ in exemplars:
             assert name.endswith("_bucket"), (
                 f"exemplar on non-bucket series {name}"
             )
 
-    def test_exemplar_labels_are_exactly_a_trace_id(self, exposition):
-        _, _, _, exemplars = parse_exposition(exposition)
+    def test_exemplar_labels_are_exactly_a_trace_id(self, om_exposition):
+        _, _, _, exemplars = parse_exposition(om_exposition, openmetrics=True)
         for name, _, exlabels, _ in exemplars:
             assert set(exlabels) == {"trace_id"}, (name, exlabels)
             assert TRACE_ID_RE.match(exlabels["trace_id"]), (name, exlabels)
 
-    def test_exemplar_value_within_bucket_bound(self, exposition):
-        _, _, _, exemplars = parse_exposition(exposition)
+    def test_exemplar_value_within_bucket_bound(self, om_exposition):
+        _, _, _, exemplars = parse_exposition(om_exposition, openmetrics=True)
         for name, labels, _, value in exemplars:
             le = labels["le"]
             bound = float("inf") if le == "+Inf" else float(le)
             assert 0 <= value <= bound, (name, labels, value)
 
-    def test_traced_traffic_produces_exemplars(self, exposition):
+    def test_traced_traffic_produces_exemplars(self, om_exposition):
         # The fixture's page requests ran inside trace_request scopes,
         # so the request-duration histogram must carry at least one.
-        _, _, _, exemplars = parse_exposition(exposition)
+        _, _, _, exemplars = parse_exposition(om_exposition, openmetrics=True)
         families = {n for n, _, _, _ in exemplars}
         assert "headlamp_tpu_request_duration_seconds_bucket" in families
 

@@ -205,6 +205,79 @@ def test_entries_capped_by_lru_on_fetch_time():
     assert r.peek("b") == 1 and r.peek("c") == 2
 
 
+def test_get_nowait_cold_returns_none_then_value_after_drain():
+    r, _clock = make()
+    calls = [0]
+
+    def compute():
+        calls[0] += 1
+        return calls[0]
+
+    # Cold key: never block the caller — kick the single-flight compute
+    # in the background and say "not yet".
+    assert r.get_nowait("k", compute) is None
+    assert r.drain()
+    assert calls[0] == 1
+    assert r.get_nowait("k", compute) == 1  # now fresh
+    assert r.snapshot()["served_fresh"] == 1
+
+
+def test_get_nowait_cold_spawns_single_flight():
+    r, _clock = make()
+    release = threading.Event()
+    calls = [0]
+
+    def compute():
+        calls[0] += 1
+        release.wait(5.0)
+        return calls[0]
+
+    for _ in range(5):
+        assert r.get_nowait("k", compute) is None
+    release.set()
+    assert r.drain()
+    assert calls[0] == 1  # one flight, not five
+
+
+def test_get_nowait_stale_serves_and_refits_in_background():
+    r, clock = make(ttl=5.0, grace=60.0)
+    calls = [0]
+
+    def compute():
+        calls[0] += 1
+        return calls[0]
+
+    r.get("k", compute)
+    clock[0] += 6.0  # past ttl, inside grace
+    assert r.get_nowait("k", compute) == 1  # stale value, immediately
+    assert r.drain()
+    assert calls[0] == 2
+    assert r.get_nowait("k", compute) == 2
+    assert r.snapshot()["served_stale"] == 1
+
+
+def test_get_nowait_epoch_bump_goes_back_to_none():
+    r, _clock = make()
+    r.get("k", lambda: "old", epoch=0)
+    assert r.get_nowait("k", lambda: "new", epoch=1) is None
+    assert r.drain()
+    assert r.get_nowait("k", lambda: "new", epoch=1) == "new"
+
+
+def test_get_nowait_background_error_absorbed_and_counted():
+    r, _clock = make()
+
+    def boom():
+        raise RuntimeError("fit exploded")
+
+    assert r.get_nowait("k", boom) is None
+    assert r.drain()
+    assert r.snapshot()["refit_errors"] == 1
+    # Still no value; the caller keeps getting the renderable None.
+    assert r.get_nowait("k", boom) is None
+    assert r.drain()
+
+
 def test_peek_never_computes_and_honors_max_age():
     r, clock = make(ttl=5.0, grace=60.0)
     assert r.peek("k") is None

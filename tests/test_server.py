@@ -637,6 +637,45 @@ class TestObservabilityRoutes:
         }
         assert all(v in ("ok", "warn", "page") for v in slo_block.values())
 
+    def test_5xx_counts_once_not_in_latency_histogram(self, monkeypatch):
+        """A fast 500 must not also register as a good latency sample:
+        the SLO engine counts it as a bad event through the
+        requests_total feed, and a second good-by-latency observation
+        would halve bad_fraction during an error storm and delay
+        paging. The 500 increments requests_total only."""
+        from headlamp_tpu.obs.metrics import registry as metrics_registry
+
+        def sample(text, name, **labels):
+            for line in text.splitlines():
+                if not line.startswith(name + "{"):
+                    continue
+                labelstr = line[len(name) + 1 : line.index("}")]
+                pairs = dict(p.split("=", 1) for p in labelstr.split(","))
+                if all(pairs.get(k) == f'"{v}"' for k, v in labels.items()):
+                    return float(line.rsplit(" ", 1)[1])
+            return 0.0
+
+        app = make_app()
+
+        def boom(path, accept=None):
+            raise RuntimeError("route exploded")
+
+        before = metrics_registry.render()
+        monkeypatch.setattr(app, "_handle", boom)
+        status, _, _ = app.handle("/tpu")
+        assert status == 500
+        after = metrics_registry.render()
+        assert sample(
+            after, "headlamp_tpu_requests_total", route="/tpu", status="500"
+        ) == sample(
+            before, "headlamp_tpu_requests_total", route="/tpu", status="500"
+        ) + 1
+        assert sample(
+            after, "headlamp_tpu_request_duration_seconds_count", route="/tpu"
+        ) == sample(
+            before, "headlamp_tpu_request_duration_seconds_count", route="/tpu"
+        )
+
 
 class TestDemoTransport:
     def test_large_fleet_served(self):

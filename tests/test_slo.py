@@ -301,6 +301,11 @@ class TestBudgetForecast:
         )
         for _ in range(60):
             req_hist.observe(0.1, route="/tpu/metrics")
+        # Cold cache: the first report kicks the fit in the BACKGROUND
+        # and names the pending state — never a foreground fit.
+        out = engine.budget_forecast()
+        assert out["reason"] == "fit_pending"
+        assert engine._budget_refresher().drain()
         out = engine.budget_forecast()
         assert out["projected_burn_rate"] == 100.0
         assert out["projected_exhaustion_windows"] == 1
@@ -318,9 +323,32 @@ class TestBudgetForecast:
         )
         for _ in range(60):
             req_hist.observe(0.1, route="/tpu/metrics")
+        assert engine.budget_forecast()["reason"] == "fit_pending"
+        assert engine._budget_refresher().drain()
         out = engine.budget_forecast()
         assert out["projected_exhaustion_windows"] is None
         assert out["reason"] == "no_projected_burn"
+
+    def test_failed_fits_report_fit_failed(self, engine, monkeypatch):
+        # A jax-less host absorbs every background refit error
+        # (ADR-015); the forecast must say so instead of reading as
+        # pending forever.
+        import headlamp_tpu.models.service as service
+
+        def boom(series, state=None, steps=60):
+            raise RuntimeError("no analytics extras")
+
+        monkeypatch.setattr(service, "forecast_slo_burn", boom)
+        req_hist = registry.histogram(
+            "headlamp_tpu_request_duration_seconds", "", labels=("route",)
+        )
+        for _ in range(60):
+            req_hist.observe(0.1, route="/tpu/metrics")
+        assert engine.budget_forecast()["reason"] == "fit_pending"
+        assert engine._budget_refresher().drain()
+        assert engine.budget_forecast()["reason"] == "fit_failed"
+        # Let the re-kicked refit finish while the monkeypatch is live.
+        assert engine._budget_refresher().drain()
 
 
 # ---------------------------------------------------------------------------

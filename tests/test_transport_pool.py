@@ -270,6 +270,29 @@ class TestStaleRetry:
         assert transport.request("/b") == {"path": "/b"}
         assert transport.pool.stale_retries == 1
 
+    def test_interrupt_mid_connect_spends_no_error_budget(self, monkeypatch):
+        """A KeyboardInterrupt/SystemExit landing mid-connect is not a
+        transport failure: it must not feed the transport_connect SLO's
+        availability arm (the 0.1% budget). Slot accounting is still
+        undone by the outer handler."""
+        import http.client
+
+        pool = ConnectionPool()
+        before = _counter_total("headlamp_tpu_transport_connect_failures_total")
+
+        def interrupted(conn_self):
+            raise KeyboardInterrupt
+
+        monkeypatch.setattr(http.client.HTTPConnection, "connect", interrupted)
+        with pytest.raises(KeyboardInterrupt):
+            pool.request("http://127.0.0.1:9/x", timeout_s=0.5)
+        assert (
+            _counter_total("headlamp_tpu_transport_connect_failures_total")
+            == before
+        )
+        assert pool.open_connections == 0
+        assert pool.opened == 0
+
 
 class TestDualAccounting:
     def test_pool_ints_and_registry_counters_agree(self, server):
