@@ -1,0 +1,48 @@
+"""Request gateway (ADR-017): the admission layer between the socket
+server and ``DashboardApp.handle``.
+
+Composes a bounded priority render pool (pool.py), burn-rate-driven
+load shedding off the ADR-016 SLO engine (shed.py), and whole-page
+render coalescing (coalesce.py) into one front door (gateway.py).
+Outside this package only the server wiring may call the app's render
+path directly — enforced by ``tools/no_direct_render_check.py``.
+"""
+
+from .coalesce import RenderCoalescer
+from .gateway import (
+    OPS_ROUTES,
+    RETRY_AFTER_S,
+    GatewayResponse,
+    RenderGateway,
+    set_active,
+)
+from .pool import (
+    PRIORITY_DEBUG,
+    PRIORITY_INTERACTIVE,
+    PRIORITY_NAMES,
+    PRIORITY_OPS,
+    Job,
+    QueueFull,
+    RenderPool,
+)
+from .shed import Decision, ShedPolicy, degraded_active, degraded_scope
+
+__all__ = [
+    "Decision",
+    "GatewayResponse",
+    "Job",
+    "OPS_ROUTES",
+    "PRIORITY_DEBUG",
+    "PRIORITY_INTERACTIVE",
+    "PRIORITY_NAMES",
+    "PRIORITY_OPS",
+    "QueueFull",
+    "RETRY_AFTER_S",
+    "RenderCoalescer",
+    "RenderGateway",
+    "RenderPool",
+    "ShedPolicy",
+    "degraded_active",
+    "degraded_scope",
+    "set_active",
+]

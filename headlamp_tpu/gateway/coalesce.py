@@ -1,0 +1,85 @@
+"""Whole-page render coalescing: keyed single-flight (ADR-017).
+
+The third extension of the single-flight idea (runtime/transfer.py
+batched device fetches per request, runtime/refresh.py one background
+refit per key) — this one covers the ENTIRE render: 100 identical
+concurrent dashboard requests cost one pool slot and one render, with
+99 followers waiting on the leader's flight and receiving the leader's
+bytes verbatim.
+
+The key carries everything that could change the bytes: route path,
+canonicalized query, the snapshot generation stamped by
+``_build_snapshot`` (ADR-012), the /refresh cache epoch, and the
+degraded flag (a degraded stale-only paint must not be handed to a
+request admitted after the SLO recovered, or vice versa). Anything
+keyed the same IS the same page by construction — which is what makes
+handing followers the leader's bytes honest rather than a cache bug.
+
+Followers do NOT occupy pool slots: they wait on a threading.Event in
+their own request thread. That is the scaling property — under an
+identical-burst load the pool sees one job, not N.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Hashable
+
+
+class Flight:
+    """One in-flight leader render. Followers wait on ``done``."""
+
+    __slots__ = ("done", "result", "error", "followers")
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
+        self.result: Any = None
+        self.error: BaseException | None = None
+        #: How many requests joined this flight (leader excluded) —
+        #: read after completion for the coalesced counter.
+        self.followers = 0
+
+
+class RenderCoalescer:
+    """Keyed single-flight map. The leader MUST call :meth:`finish` (in
+    a finally) or followers would wait out their full timeout."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._flights: dict[Hashable, Flight] = {}
+
+    def join_or_lead(self, key: Hashable) -> tuple[Flight, bool]:
+        """(flight, is_leader). Leaders get a fresh flight registered
+        under ``key``; followers get the existing one, wait on
+        ``flight.done``, and read ``flight.result``."""
+        with self._lock:
+            flight = self._flights.get(key)
+            if flight is not None:
+                flight.followers += 1
+                return flight, False
+            flight = Flight()
+            self._flights[key] = flight
+            return flight, True
+
+    def finish(
+        self,
+        key: Hashable,
+        flight: Flight,
+        *,
+        result: Any = None,
+        error: BaseException | None = None,
+    ) -> None:
+        """Publish the leader's result and release followers. Removes
+        the flight first so requests arriving after completion lead a
+        fresh render (the generation in the key usually rotates them
+        anyway; this covers same-generation re-requests)."""
+        with self._lock:
+            if self._flights.get(key) is flight:
+                del self._flights[key]
+        flight.result = result
+        flight.error = error
+        flight.done.set()
+
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._flights)

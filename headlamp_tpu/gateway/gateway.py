@@ -1,0 +1,416 @@
+"""RenderGateway: the admission layer between sockets and renders.
+
+Every request the socket server accepts flows through
+:meth:`RenderGateway.handle` instead of calling ``DashboardApp.handle``
+directly (enforced by ``tools/no_direct_render_check.py``). The
+gateway composes three policies (ADR-017):
+
+1. **Bounded pool** (pool.py) — renders run on a fixed worker set with
+   strict priority (interactive > ops > debug), per-class queue depth,
+   per-route concurrency caps, and queue-wait deadlines.
+2. **Burn-rate shedding** (shed.py) — when a request-backed SLO pages,
+   debug traffic gets fast 503s and interactive traffic renders
+   degraded (stale-only paints).
+3. **Render coalescing** (coalesce.py) — identical concurrent
+   interactive requests share one render; followers receive the
+   leader's bytes without occupying pool slots.
+
+``/healthz`` BYPASSES all of it: liveness must answer while every
+worker is wedged mid-render — the pool-exhaustion regression test pins
+this. The handler itself already guarantees /healthz never blocks on
+app locks; the gateway extends that guarantee past its own queues.
+
+SLO accounting (the r10-review rule — each request feeds the engine
+exactly once): gateway-synthesized 503s (shed / queue-full / expired /
+timeout) inc ``headlamp_tpu_requests_total{status=503}`` and DO NOT
+observe the request-duration histogram. Coalesced followers inc
+requests_total with the leader's status and observe their own wait as
+request duration when the status is non-5xx — a follower is a real
+served request and must spend real SLO budget, or coalescing would
+make an overloaded dashboard look 100x healthier than its users
+experience.
+
+The gateway holds CALLABLES (handle, route_label, generation, epoch),
+not the app: no import cycle, and tests drive it with fakes.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import weakref
+from typing import Any, Callable, NamedTuple
+from urllib.parse import parse_qsl, urlparse
+
+from ..obs.metrics import registry as _metrics_registry
+from .coalesce import RenderCoalescer
+from .pool import (
+    PRIORITY_DEBUG,
+    PRIORITY_INTERACTIVE,
+    PRIORITY_NAMES,
+    PRIORITY_OPS,
+    QueueFull,
+    RenderPool,
+)
+from .shed import ShedPolicy, degraded_scope
+
+#: Route labels in the ops class — the surfaces an operator triages an
+#: incident WITH; never shed, never coalesced, ahead of debug dumps.
+OPS_ROUTES = frozenset({"/metricsz", "/sloz", "/sloz/html"})
+
+#: Seconds a shed client should back off before retrying — burn windows
+#: are minutes wide, so sub-5s retries would re-shed anyway.
+RETRY_AFTER_S = 5
+
+_REQUESTS = _metrics_registry.counter(
+    "headlamp_tpu_gateway_requests_total",
+    "Requests through the render gateway, by priority class and outcome "
+    "(rendered/coalesced/shed/queue_full/expired/timeout/bypass/failed).",
+    labels=("priority", "outcome"),
+)
+_SHED = _metrics_registry.counter(
+    "headlamp_tpu_gateway_shed_total",
+    "Gateway 503s, by route template and reason (burn_rate/queue_full/"
+    "queue_deadline/gateway_timeout).",
+    labels=("route", "reason"),
+)
+_QUEUE_WAIT = _metrics_registry.histogram(
+    "headlamp_tpu_gateway_queue_wait_seconds",
+    "Admission-to-execution wait in the render pool, by priority class.",
+    labels=("priority",),
+)
+
+#: The serving gateway, for the queue-depth callback gauges. A weakref
+#: set by set_active(): tests build many gateways per process and the
+#: gauges must follow the one actually serving, not pin the first.
+_ACTIVE: weakref.ref | None = None
+
+
+def set_active(gateway: "RenderGateway | None") -> None:
+    global _ACTIVE
+    _ACTIVE = weakref.ref(gateway) if gateway is not None else None
+
+
+def _queue_depth_samples() -> list[tuple[tuple[str], float]]:
+    gw = _ACTIVE() if _ACTIVE is not None else None
+    if gw is None:
+        return []
+    return [
+        ((name,), float(depth)) for name, depth in gw.pool.queue_depths().items()
+    ]
+
+
+def _inflight_sample() -> float | None:
+    gw = _ACTIVE() if _ACTIVE is not None else None
+    return float(gw.pool.inflight()) if gw is not None else None
+
+
+_metrics_registry.gauge_samples_fn(
+    "headlamp_tpu_gateway_queue_depth_count",
+    "Jobs waiting in the render pool, by priority class.",
+    ("priority",),
+    _queue_depth_samples,
+)
+_metrics_registry.gauge_fn(
+    "headlamp_tpu_gateway_inflight_renders_count",
+    "Renders currently executing on pool workers.",
+    _inflight_sample,
+)
+
+
+class GatewayResponse(NamedTuple):
+    """handle()'s 4-part response: the app's 3-tuple plus response
+    headers (Retry-After on shed 503s). 302s keep the app convention of
+    the Location riding in ``content_type``."""
+
+    status: int
+    content_type: str
+    body: str
+    headers: tuple[tuple[str, str], ...] = ()
+
+
+class RenderGateway:
+    def __init__(
+        self,
+        handle: Callable[..., tuple[int, str, str]],
+        *,
+        route_label: Callable[[str], str],
+        generation: Callable[[], int] | None = None,
+        epoch: Callable[[], int] | None = None,
+        engine: Callable[[], Any] | None = None,
+        workers: int = 4,
+        queue_depth: dict[int, int] | None = None,
+        queue_deadline_s: dict[int, float] | None = None,
+        route_limit: int | None = None,
+        request_timeout_s: float = 30.0,
+        shed_ttl_s: float = 1.0,
+        monotonic: Callable[[], float] | None = None,
+    ) -> None:
+        self._handle = handle
+        self._route_label = route_label
+        self._generation = generation or (lambda: 0)
+        self._epoch = epoch or (lambda: 0)
+        self._monotonic = monotonic or time.monotonic
+        self.request_timeout_s = request_timeout_s
+        self.pool = RenderPool(
+            workers=workers,
+            queue_depth=queue_depth,
+            queue_deadline_s=queue_deadline_s,
+            route_limit=route_limit,
+            monotonic=self._monotonic,
+        )
+        self.coalescer = RenderCoalescer()
+        self.shed_policy = ShedPolicy(
+            engine=engine, ttl_s=shed_ttl_s, monotonic=self._monotonic
+        )
+        # SLO feed instruments — get-or-create resolves to the SAME
+        # process counters/histograms DashboardApp registered, so the
+        # engine's observers see gateway 503s and follower latencies
+        # with no extra wiring.
+        self._req_total = _metrics_registry.counter(
+            "headlamp_tpu_requests_total",
+            "Requests served, by route template and status code.",
+            labels=("route", "status"),
+        )
+        self._req_hist = _metrics_registry.histogram(
+            "headlamp_tpu_request_duration_seconds",
+            "End-to-end handle() latency per route template "
+            "(non-5xx responses; errors count in requests_total).",
+            labels=("route",),
+        )
+        # Monotone per-instance ints (/healthz block + flight-recorder
+        # deltas; the labeled registry counters are the fleet view).
+        self.admitted = 0
+        self.rendered = 0
+        self.coalesced_followers = 0
+        self.shed_burn = 0
+        self.shed_queue_full = 0
+        self.expired = 0
+        self.timeouts = 0
+        self.degraded_renders = 0
+        self.bypassed = 0
+
+    # -- classification --------------------------------------------------
+
+    @staticmethod
+    def classify(route: str) -> int:
+        """Priority class for a route label. Unknown routes ('other',
+        404s) ride interactive: they're cheap, and starving them would
+        punish typos harder than debug dumps."""
+        if route in OPS_ROUTES:
+            return PRIORITY_OPS
+        if route.startswith("/debug"):
+            return PRIORITY_DEBUG
+        return PRIORITY_INTERACTIVE
+
+    def _coalesce_key(self, path: str, route: str, degraded: bool) -> tuple | None:
+        """Single-flight key, or None when this request must not
+        coalesce. /refresh is side-effectful (epoch bump + sync wake) —
+        each click must run. Ops/debug surfaces change per-request
+        (live rings, negotiated formats) and are cheap, so only
+        interactive page renders coalesce."""
+        if route == "/refresh" or self.classify(route) != PRIORITY_INTERACTIVE:
+            return None
+        parsed = urlparse(path)
+        query = tuple(sorted(parse_qsl(parsed.query, keep_blank_values=True)))
+        return (
+            parsed.path.rstrip("/") or "/tpu",
+            query,
+            self._generation(),
+            self._epoch(),
+            degraded,
+        )
+
+    # -- responses -------------------------------------------------------
+
+    def _shed_response(
+        self, route: str, reason: str, burn_state: dict[str, str]
+    ) -> GatewayResponse:
+        """The machine-readable overload 503. Counted into requests_total
+        (the SLO engine's 5xx error feed) but NEVER into the duration
+        histogram — the r10-review exactly-once rule; a microsecond shed
+        observed as a good latency would halve bad_fraction exactly when
+        the engine must page."""
+        self._req_total.inc(route=route, status="503")
+        _SHED.inc(route=route, reason=reason)
+        body = json.dumps(
+            {
+                "shed": reason != "gateway_timeout",
+                "route": route,
+                "reason": reason,
+                "burn_state": burn_state,
+                "retry_after_s": RETRY_AFTER_S,
+            }
+        )
+        return GatewayResponse(
+            503,
+            "application/json",
+            body,
+            (("Retry-After", str(RETRY_AFTER_S)),),
+        )
+
+    # -- the request path ------------------------------------------------
+
+    def handle(self, path: str, *, accept: str | None = None) -> GatewayResponse:
+        route = self._route_label(path)
+        if route == "/healthz":
+            # Liveness bypass: no queue, no shed, no coalesce. A wedged
+            # pool must not fail a kubelet probe — the probe is how the
+            # operator learns the pool is wedged.
+            self.bypassed += 1
+            _REQUESTS.inc(priority="ops", outcome="bypass")
+            return GatewayResponse(*self._handle(path, accept=accept))
+        priority = self.classify(route)
+        pname = PRIORITY_NAMES[priority]
+        decision = self.shed_policy.decide(route, priority)
+        if decision.shed:
+            self.shed_burn += 1
+            _REQUESTS.inc(priority=pname, outcome="shed")
+            return self._shed_response(route, "burn_rate", decision.burn_state)
+
+        key = self._coalesce_key(path, route, decision.degraded)
+        if key is not None:
+            flight, leader = self.coalescer.join_or_lead(key)
+            if not leader:
+                return self._follow(flight, route, pname, decision.burn_state)
+            try:
+                response = self._render(
+                    path, route, priority, pname, accept, decision
+                )
+            except BaseException as exc:
+                self.coalescer.finish(key, flight, error=exc)
+                raise
+            self.coalescer.finish(key, flight, result=response)
+            return response
+        return self._render(path, route, priority, pname, accept, decision)
+
+    def _follow(
+        self,
+        flight: Any,
+        route: str,
+        pname: str,
+        burn_state: dict[str, str],
+    ) -> GatewayResponse:
+        """Wait for the leader's bytes. Followers are real requests: they
+        inc requests_total with the leader's status and observe their
+        own wait as request latency (non-5xx only) so the SLO engine
+        sees every user-perceived outcome, not one per render."""
+        t0 = self._monotonic()
+        if not flight.done.wait(self.request_timeout_s):
+            self.timeouts += 1
+            _REQUESTS.inc(priority=pname, outcome="timeout")
+            return self._shed_response(route, "gateway_timeout", burn_state)
+        if flight.error is not None or flight.result is None:
+            # Leader failed before publishing: report an honest 503
+            # rather than re-running the render (the next request leads
+            # a fresh flight).
+            self.timeouts += 1
+            _REQUESTS.inc(priority=pname, outcome="timeout")
+            return self._shed_response(route, "gateway_timeout", burn_state)
+        response: GatewayResponse = flight.result
+        self.coalesced_followers += 1
+        _REQUESTS.inc(priority=pname, outcome="coalesced")
+        self._req_total.inc(route=route, status=str(response.status))
+        if response.status < 500:
+            self._req_hist.observe(self._monotonic() - t0, route=route)
+        return response
+
+    def _render(
+        self,
+        path: str,
+        route: str,
+        priority: int,
+        pname: str,
+        accept: str | None,
+        decision: Any,
+    ) -> GatewayResponse:
+        """Admit into the pool and wait. All the 503 paths below are
+        gateway-synthesized: requests_total only, no histogram (the
+        handler never ran, so there is no render latency to observe)."""
+        degraded = bool(decision.degraded)
+        admitted_mono = self._monotonic()
+
+        def run() -> tuple[int, str, str]:
+            wait_s = self._monotonic() - admitted_mono
+            _QUEUE_WAIT.observe(wait_s, priority=pname)
+            info = {
+                "priority": pname,
+                "queue_wait_ms": round(wait_s * 1e3, 3),
+                "degraded": degraded,
+            }
+            with degraded_scope(degraded):
+                return self._handle(path, accept=accept, gateway_info=info)
+
+        try:
+            job = self.pool.submit(route, priority, run)
+        except QueueFull:
+            self.shed_queue_full += 1
+            _REQUESTS.inc(priority=pname, outcome="queue_full")
+            return self._shed_response(route, "queue_full", decision.burn_state)
+        self.admitted += 1
+        if not job.done.wait(self.request_timeout_s):
+            # Render still running; its result is abandoned. The worker
+            # completes it harmlessly (nobody reads job.result).
+            self.timeouts += 1
+            _REQUESTS.inc(priority=pname, outcome="timeout")
+            return self._shed_response(route, "gateway_timeout", decision.burn_state)
+        if job.outcome == "expired":
+            self.expired += 1
+            _REQUESTS.inc(priority=pname, outcome="expired")
+            return self._shed_response(route, "queue_deadline", decision.burn_state)
+        if job.outcome == "failed":
+            # handle() has its own error boundary (500 page), so a
+            # worker-level failure is gateway plumbing breaking — still
+            # answer, still feed the SLO once.
+            _REQUESTS.inc(priority=pname, outcome="failed")
+            self._req_total.inc(route=route, status="503")
+            return GatewayResponse(
+                503, "text/plain", f"gateway error: {type(job.error).__name__}"
+            )
+        self.rendered += 1
+        if degraded:
+            self.degraded_renders += 1
+        _REQUESTS.inc(priority=pname, outcome="rendered")
+        return GatewayResponse(*job.result)
+
+    # -- observability / lifecycle --------------------------------------
+
+    def counters(self) -> dict[str, int]:
+        """Monotone ints, lock-free — flight-recorder delta view."""
+        out = {
+            "admitted": self.admitted,
+            "rendered": self.rendered,
+            "coalesced_followers": self.coalesced_followers,
+            "shed_burn": self.shed_burn,
+            "shed_queue_full": self.shed_queue_full,
+            "expired": self.expired,
+            "timeouts": self.timeouts,
+            "degraded_renders": self.degraded_renders,
+            "bypassed": self.bypassed,
+        }
+        for key, value in self.pool.counters().items():
+            out[f"pool_{key}"] = value
+        return out
+
+    def snapshot(self) -> dict[str, Any]:
+        """The /healthz ``runtime.gateway`` block: counters plus live
+        queue/inflight gauges and the current shed states."""
+        out: dict[str, Any] = dict(self.counters())
+        out["queue_depth"] = self.pool.queue_depths()
+        out["inflight_renders"] = self.pool.inflight()
+        out["coalesce_inflight"] = self.coalescer.inflight()
+        out["workers"] = self.pool.workers
+        out["burn_state"] = self.shed_policy.states()
+        return out
+
+    def close(self) -> None:
+        self.pool.close()
+
+
+__all__ = [
+    "GatewayResponse",
+    "RenderGateway",
+    "OPS_ROUTES",
+    "RETRY_AFTER_S",
+    "set_active",
+]

@@ -1,0 +1,289 @@
+"""Bounded render worker pool with priority admission (ADR-017).
+
+ThreadingHTTPServer is thread-per-request: 500 concurrent page loads
+mean 500 threads racing GIL-bound renders, and the 501st kubelet probe
+queues behind all of them. The pool inverts that: request threads
+become cheap waiters, renders run on a FIXED number of workers, and
+admission is where policy lives — per-class queue depth (reject, don't
+buffer unboundedly), per-route concurrency caps (one route's stampede
+must not occupy every worker), and a queue-wait deadline (a render
+nobody is still waiting for must not run).
+
+Priority is strict: interactive pages (class 0) always pop before ops
+surfaces (/metricsz, /sloz — class 1), which pop before /debug/*
+(class 2). Starvation of class 2 under sustained interactive load is
+the INTENDED behavior — debug dumps are the first thing to brown out.
+
+Clock discipline (ADR-013): queue-wait ages run on the injected
+``monotonic``; tests drive deadline expiry by advancing a list cell.
+Expiry is evaluated lazily at pop time — a job discovered past its
+deadline completes as ``expired`` without running, which is exactly
+when the answer matters (a worker just became free and must not spend
+itself on an abandoned wait).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Mapping
+
+#: Priority classes, lowest number pops first.
+PRIORITY_INTERACTIVE = 0
+PRIORITY_OPS = 1
+PRIORITY_DEBUG = 2
+
+PRIORITY_NAMES: dict[int, str] = {
+    PRIORITY_INTERACTIVE: "interactive",
+    PRIORITY_OPS: "ops",
+    PRIORITY_DEBUG: "debug",
+}
+
+#: Default queue depth per class. Interactive gets the deep queue (real
+#: users, worth buffering a burst); debug gets almost none (a /debug
+#: stampede should hit queue-full 503s immediately).
+DEFAULT_QUEUE_DEPTH: dict[int, int] = {
+    PRIORITY_INTERACTIVE: 64,
+    PRIORITY_OPS: 32,
+    PRIORITY_DEBUG: 8,
+}
+
+#: Default queue-wait deadline per class (seconds). Past this, the
+#: client has given up (browser timeout) or the answer is too old to
+#: matter — running the render anyway would only steal a worker from a
+#: live request.
+DEFAULT_QUEUE_DEADLINE_S: dict[int, float] = {
+    PRIORITY_INTERACTIVE: 10.0,
+    PRIORITY_OPS: 5.0,
+    PRIORITY_DEBUG: 2.0,
+}
+
+
+class QueueFull(Exception):
+    """Admission rejected: the priority class's queue is at depth."""
+
+    def __init__(self, priority: int, depth: int) -> None:
+        self.priority = priority
+        self.depth = depth
+        super().__init__(
+            f"{PRIORITY_NAMES.get(priority, priority)} queue full (depth {depth})"
+        )
+
+
+class Job:
+    """One admitted render. The request thread waits on ``done``; the
+    worker fills ``result``/``error`` and an ``outcome``."""
+
+    __slots__ = (
+        "route",
+        "priority",
+        "fn",
+        "enqueued_mono",
+        "done",
+        "result",
+        "error",
+        "outcome",
+        "queue_wait_s",
+    )
+
+    def __init__(
+        self, route: str, priority: int, fn: Callable[[], Any], enqueued_mono: float
+    ) -> None:
+        self.route = route
+        self.priority = priority
+        self.fn = fn
+        self.enqueued_mono = enqueued_mono
+        self.done = threading.Event()
+        self.result: Any = None
+        self.error: BaseException | None = None
+        #: "rendered" | "failed" | "expired" (None while pending).
+        self.outcome: str | None = None
+        self.queue_wait_s: float = 0.0
+
+
+class RenderPool:
+    """Fixed worker threads over strict-priority bounded queues.
+
+    ``route_limit`` caps how many workers one route label may occupy
+    simultaneously; a job whose route is saturated is SKIPPED (not
+    popped) so later jobs on other routes aren't head-of-line blocked
+    behind it. Per-route FIFO order is traded away deliberately —
+    coalescing upstream means same-route jobs are rarely identical
+    anyway.
+    """
+
+    def __init__(
+        self,
+        *,
+        workers: int = 4,
+        queue_depth: Mapping[int, int] | None = None,
+        queue_deadline_s: Mapping[int, float] | None = None,
+        route_limit: int | None = None,
+        monotonic: Callable[[], float] | None = None,
+    ) -> None:
+        if workers < 1:
+            raise ValueError("workers must be >= 1")
+        self.workers = workers
+        self.queue_depth = dict(DEFAULT_QUEUE_DEPTH)
+        if queue_depth:
+            self.queue_depth.update(queue_depth)
+        self.queue_deadline_s = dict(DEFAULT_QUEUE_DEADLINE_S)
+        if queue_deadline_s:
+            self.queue_deadline_s.update(queue_deadline_s)
+        # Leave one worker for other routes even when a single route
+        # stampedes; a 1-worker pool necessarily allows that route the
+        # whole pool.
+        self.route_limit = route_limit if route_limit else max(1, workers - 1)
+        self._monotonic = monotonic or time.monotonic
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: dict[int, deque[Job]] = {
+            p: deque() for p in sorted(PRIORITY_NAMES)
+        }
+        self._inflight_by_route: dict[str, int] = {}
+        self._inflight = 0
+        self._stopping = False
+        # Monotone counters (per-instance ints — the /healthz and
+        # flight-recorder view; the gateway dual-accounts the registry).
+        self.submitted = 0
+        self.executed = 0
+        self.expired = 0
+        self.failed = 0
+        self._threads = [
+            threading.Thread(target=self._worker, name=f"gw-render-{i}", daemon=True)
+            for i in range(workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- admission -------------------------------------------------------
+
+    def submit(self, route: str, priority: int, fn: Callable[[], Any]) -> Job:
+        """Admit a render or raise :class:`QueueFull`. Returns the Job;
+        the caller waits on ``job.done``."""
+        if priority not in self._queues:
+            raise ValueError(f"unknown priority class {priority!r}")
+        job = Job(route, priority, fn, self._monotonic())
+        with self._cond:
+            if self._stopping:
+                raise QueueFull(priority, 0)
+            depth = self.queue_depth[priority]
+            if len(self._queues[priority]) >= depth:
+                raise QueueFull(priority, depth)
+            self._queues[priority].append(job)
+            self.submitted += 1
+            self._cond.notify()
+        return job
+
+    # -- worker loop -----------------------------------------------------
+
+    def _pop_locked(self) -> Job | None:
+        """Next runnable or expired job, strict priority order. Caller
+        holds the lock. Expired jobs are returned too (marked) so the
+        worker can complete them without running the render."""
+        now = self._monotonic()
+        for priority in sorted(self._queues):
+            queue = self._queues[priority]
+            deadline = self.queue_deadline_s[priority]
+            skipped: list[Job] = []
+            taken: Job | None = None
+            while queue:
+                job = queue.popleft()
+                job.queue_wait_s = now - job.enqueued_mono
+                if job.queue_wait_s > deadline:
+                    job.outcome = "expired"
+                    self.expired += 1
+                    taken = job
+                    break
+                if (
+                    self._inflight_by_route.get(job.route, 0) >= self.route_limit
+                    and self._inflight < self.workers
+                ):
+                    # Route saturated: skip, try the next job. (If every
+                    # worker is busy anyway the cap is moot — don't skip.)
+                    skipped.append(job)
+                    continue
+                self._inflight_by_route[job.route] = (
+                    self._inflight_by_route.get(job.route, 0) + 1
+                )
+                self._inflight += 1
+                taken = job
+                break
+            # Reinstate skipped jobs at the head, original order.
+            for job in reversed(skipped):
+                queue.appendleft(job)
+            if taken is not None:
+                return taken
+        return None
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                job = self._pop_locked()
+                while job is None:
+                    if self._stopping:
+                        return
+                    self._cond.wait()
+                    job = self._pop_locked()
+            if job.outcome == "expired":
+                # Never ran: no inflight bookkeeping to unwind.
+                job.done.set()
+                continue
+            try:
+                job.result = job.fn()
+                job.outcome = "rendered"
+            except BaseException as exc:  # noqa: BLE001 — worker must survive
+                job.error = exc
+                job.outcome = "failed"
+            finally:
+                with self._cond:
+                    self.executed += 1
+                    if job.outcome == "failed":
+                        self.failed += 1
+                    count = self._inflight_by_route.get(job.route, 1) - 1
+                    if count <= 0:
+                        self._inflight_by_route.pop(job.route, None)
+                    else:
+                        self._inflight_by_route[job.route] = count
+                    self._inflight -= 1
+                    self._cond.notify_all()
+                job.done.set()
+
+    # -- observability / lifecycle --------------------------------------
+
+    def queue_depths(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                PRIORITY_NAMES[p]: len(q) for p, q in sorted(self._queues.items())
+            }
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def counters(self) -> dict[str, int]:
+        """Monotone ints, lock-free reads (GIL-atomic) — flight-recorder
+        delta view, mirroring Refresher.counters()."""
+        return {
+            "submitted": self.submitted,
+            "executed": self.executed,
+            "expired": self.expired,
+            "failed": self.failed,
+        }
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Stop workers (tests build many pools per process). Queued
+        jobs are completed as expired so no waiter hangs."""
+        with self._cond:
+            self._stopping = True
+            pending = [job for q in self._queues.values() for job in q]
+            for q in self._queues.values():
+                q.clear()
+            self._cond.notify_all()
+        for job in pending:
+            job.outcome = "expired"
+            job.done.set()
+        deadline = time.monotonic() + timeout_s
+        for t in self._threads:
+            t.join(max(0.0, deadline - time.monotonic()))

@@ -1,0 +1,153 @@
+"""Burn-rate-driven load shedding policy (ADR-017).
+
+r10's SLO engine (ADR-016) detects overload — multi-window burn rate
+pages when the error budget is burning ≥14.4x. This module ACTS on it.
+When a request-backed SLO pages:
+
+- **debug traffic sheds**: /debug/* gets a fast 503 with Retry-After
+  and a machine-readable body. A trace dump is the cheapest thing to
+  sacrifice and the most expensive to serve (full-ring JSON).
+- **interactive traffic degrades, never sheds**: pages for routes the
+  paging SLO governs render in degraded mode — stale-only cache reads
+  (Refresher.peek), forecast panel skipped — via a contextvar scope the
+  render worker enters around the handler. A slightly stale paint
+  beats a 503 for a human.
+- **ops traffic is untouchable**: /metricsz, /sloz, /healthz are the
+  triage surfaces an operator needs DURING the incident; shedding them
+  would blind the response to the overload.
+
+Engine state is cached for ``ttl_s`` (default 1 s) on the injected
+monotonic: health_block() sums sliding windows per spec, which is
+microseconds, but the gateway sits on every request and the shed
+decision doesn't need sub-second reactivity — burn windows are minutes
+wide.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Any, Callable, Iterator
+
+from ..obs import slo as slo_mod
+
+#: True inside a render the gateway admitted in degraded mode. Read by
+#: DashboardApp's cache accessors (stale-only peek instead of blocking
+#: fetch/fit). A contextvar, not a flag on the app: degradation is
+#: per-REQUEST (decided at admission, sealed into the coalesce key),
+#: and concurrent renders on other worker threads must not see it.
+_DEGRADED: ContextVar[bool] = ContextVar("headlamp_tpu_gateway_degraded", default=False)
+
+
+def degraded_active() -> bool:
+    """Is the current render running in gateway-degraded mode?"""
+    return _DEGRADED.get()
+
+
+@contextmanager
+def degraded_scope(active: bool = True) -> Iterator[None]:
+    """Mark the enclosed render degraded (entered by the pool worker
+    around the handler, so the flag travels with the render, not the
+    admission thread)."""
+    token = _DEGRADED.set(active)
+    try:
+        yield
+    finally:
+        _DEGRADED.reset(token)
+
+
+class Decision:
+    """One admission ruling: shed it, degrade it, or serve it normally.
+    ``burn_state`` is the engine's health block at decision time — it
+    rides into the shed response body so a 503'd client (and the test
+    suite) can see WHY."""
+
+    __slots__ = ("shed", "degraded", "burn_state")
+
+    def __init__(
+        self, *, shed: bool = False, degraded: bool = False,
+        burn_state: dict[str, str] | None = None,
+    ) -> None:
+        self.shed = shed
+        self.degraded = degraded
+        self.burn_state = burn_state or {}
+
+
+class ShedPolicy:
+    """Maps (route label, priority class) + engine state to a Decision.
+
+    ``engine`` is a zero-arg callable returning the SLOEngine (defaults
+    to the ``slo_mod.engine()`` accessor so ``set_engine`` swaps
+    re-point the gateway atomically, same as the observer wiring)."""
+
+    def __init__(
+        self,
+        *,
+        engine: Callable[[], Any] | None = None,
+        ttl_s: float = 1.0,
+        monotonic: Callable[[], float] | None = None,
+    ) -> None:
+        self._engine = engine or slo_mod.engine
+        self.ttl_s = ttl_s
+        self._monotonic = monotonic or time.monotonic
+        self._cached_at: float | None = None
+        self._cached_states: dict[str, str] = {}
+        #: Route labels governed by a currently-PAGING request-backed
+        #: SLO, refreshed alongside the states cache.
+        self._paging_routes: set[str] = set()
+        # Monotone per-instance ints (gateway dual-accounts the registry).
+        self.evaluations = 0
+
+    # -- engine state ----------------------------------------------------
+
+    def states(self) -> dict[str, str]:
+        """health_block(), cached for ttl_s. Engine errors read as
+        all-ok: the shed path must never 500 a request over a broken
+        evaluator (same never-fail stance as /healthz's runtime block)."""
+        now = self._monotonic()
+        if self._cached_at is not None and now - self._cached_at <= self.ttl_s:
+            return self._cached_states
+        try:
+            eng = self._engine()
+            states = dict(eng.health_block())
+            paging_routes: set[str] = set()
+            for spec in getattr(eng, "specs", ()):
+                if spec.latency_metric != slo_mod.REQUEST_DURATION:
+                    continue
+                if states.get(spec.name) != "page":
+                    continue
+                paging_routes.update(spec.latency_where.get("route", ()))
+            self._paging_routes = paging_routes
+        except Exception:  # noqa: BLE001 — shed eval must never fail a request
+            states = {}
+            self._paging_routes = set()
+        self.evaluations += 1
+        self._cached_at = now
+        self._cached_states = states
+        return states
+
+    # -- ruling ----------------------------------------------------------
+
+    def decide(self, route: str, priority: int) -> Decision:
+        from .pool import PRIORITY_DEBUG, PRIORITY_INTERACTIVE
+
+        states = self.states()
+        paging_routes: set[str] = getattr(self, "_paging_routes", set())
+        if not paging_routes:
+            return Decision(burn_state=states)
+        if priority == PRIORITY_DEBUG:
+            # ANY request-backed SLO paging sheds debug traffic — the
+            # overload is process-wide (shared GIL, shared pool), so the
+            # cheap capacity recovered helps whichever route is burning.
+            return Decision(shed=True, burn_state=states)
+        if priority == PRIORITY_INTERACTIVE and route in paging_routes:
+            # Degrade only the routes the paging SLO actually governs:
+            # /tpu/metrics stays full-fidelity while dashboard_render
+            # pages, and vice versa.
+            return Decision(degraded=True, burn_state=states)
+        return Decision(burn_state=states)
+
+    def invalidate(self) -> None:
+        """Drop the TTL cache (tests flip engine state mid-scenario)."""
+        self._cached_at = None

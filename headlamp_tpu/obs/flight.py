@@ -70,6 +70,7 @@ def wide_event(
     violations: tuple[str, ...] | list[str] = (),
     counters_before: Mapping[str, Any] | None = None,
     counters_after: Mapping[str, Any] | None = None,
+    gateway: Mapping[str, Any] | None = None,
 ) -> dict[str, Any]:
     """Collapse one request into its flight-recorder event. ``trace``
     is the already-frozen trace dict (the same one the trace ring
@@ -95,6 +96,11 @@ def wide_event(
     }
     if counters_before is not None and counters_after is not None:
         event["counters"] = counters_delta(counters_before, counters_after)
+    if gateway is not None:
+        # Admission-side context (ADR-017): priority class, queue wait,
+        # degraded flag — the triage question "was this slow render
+        # actually a slow QUEUE" answered without opening the trace.
+        event["gateway"] = dict(gateway)
     return event
 
 

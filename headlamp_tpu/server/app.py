@@ -38,6 +38,7 @@ from typing import Any
 from urllib.parse import parse_qs, urlparse
 
 from ..context.accelerator_context import AcceleratorDataContext, ClusterSnapshot
+from ..gateway.shed import degraded_active
 from ..metrics.client import fetch_tpu_metrics
 from ..obs import slo as slo_mod
 from ..obs.flight import flight_recorder, wide_event
@@ -110,7 +111,9 @@ def _analytics_health() -> dict[str, Any]:
 
 
 def _runtime_health(
-    transport: Any = None, refreshers: tuple[Refresher, ...] = ()
+    transport: Any = None,
+    refreshers: tuple[Refresher, ...] = (),
+    gateway: Any = None,
 ) -> dict[str, Any]:
     """Transfer-funnel, device-cache, transport-pool, and refresher
     counters for /healthz: how many blocking device_gets the process
@@ -135,6 +138,11 @@ def _runtime_health(
             out["transport"] = pool.snapshot()
         if refreshers:
             out["refresh"] = {r.name: r.snapshot() for r in refreshers}
+        if gateway is not None:
+            # Admission-layer view (ADR-017): queue depths, in-flight
+            # renders, shed/coalesce counters, and the burn states the
+            # shed policy last acted on.
+            out["gateway"] = gateway.snapshot()
         # Burn-rate states per declared SLO (ADR-016): the one-line
         # answer a probe reader wants before opening /sloz.
         out["slo"] = slo_mod.engine().health_block()
@@ -146,7 +154,9 @@ def _runtime_health(
 
 
 def _runtime_counters(
-    transport: Any = None, refreshers: tuple[Refresher, ...] = ()
+    transport: Any = None,
+    refreshers: tuple[Refresher, ...] = (),
+    gateway: Any = None,
 ) -> dict[str, float]:
     """Flat dotted monotone-counter snapshot for the flight recorder's
     before/after delta. Deliberately NOT _runtime_health: this runs
@@ -175,6 +185,9 @@ def _runtime_counters(
     for refresher in refreshers:
         for key, value in refresher.counters().items():
             out[f"refresh.{refresher.name}.{key}"] = value
+    if gateway is not None:
+        for key, value in gateway.counters().items():
+            out[f"gateway.{key}"] = value
     return out
 
 
@@ -322,10 +335,28 @@ class DashboardApp:
             "headlamp_tpu_sync_failures_total",
             "Cluster syncs that raised or produced an errors-bearing snapshot.",
         )
+        #: The admission layer (ADR-017), created lazily by serve() (or
+        #: injected by tests/bench). None for direct handle() callers —
+        #: the CLI and unit tests measure the handler, not admission.
+        self.gateway: Any = None
 
     @property
     def registry(self) -> Registry:
         return self._registry
+
+    def snapshot_generation(self) -> int:
+        """The ADR-012 generation stamp of the last published snapshot
+        (0 before any sync) — one ingredient of the gateway's coalesce
+        key: requests spanning a snapshot change must not share bytes.
+        Reads the atomically-published reference, never locks."""
+        snap = self._last_snapshot
+        if snap is None:
+            return 0
+        for state in snap.providers.values():
+            version = getattr(state.view, "version", None)
+            if version:
+                return int(version)
+        return 0
 
     def start_background_sync(self, interval_s: float | None = None) -> threading.Event:
         """Periodic cluster sync off the request path — the closest
@@ -452,6 +483,16 @@ class DashboardApp:
         # tick spans the bounded watch windows (seconds against a real
         # apiserver) — a page view must never stall behind that.
         with span("sync.snapshot") as node:
+            if degraded_active() and self._last_snapshot is not None:
+                # Gateway-degraded render (ADR-017): serve the last
+                # published snapshot without syncing — under a paging
+                # burn rate a stale paint beats queueing a cluster sync
+                # behind the overload. Falls through to the normal path
+                # only when no snapshot exists yet (first-ever request
+                # mid-incident still needs SOME data).
+                if node is not None:
+                    node.attrs["source"] = "degraded-stale"
+                return self._last_snapshot
             if self._background_live():
                 snap = self._last_snapshot
                 if snap is not None:
@@ -543,6 +584,11 @@ class DashboardApp:
         r = self._metrics_refresher
         r.ttl_s = self.METRICS_TTL_S
         r.grace_s = max(self.METRICS_GRACE_S, self.METRICS_TTL_S)
+        if degraded_active():
+            # Gateway-degraded (ADR-017): stale-only. peek never
+            # computes, so the Prometheus probe chain stays off the
+            # overloaded path; a cold cache renders the no-data state.
+            return r.peek("metrics", epoch=self._cache_epoch)
         return r.get(
             "metrics",
             lambda: fetch_tpu_metrics(self._transport, clock=self._clock),
@@ -597,6 +643,12 @@ class DashboardApp:
         r = self._forecast_refresher
         r.ttl_s = self.FORECAST_TTL_S
         r.grace_s = max(self.FORECAST_GRACE_S, self.FORECAST_TTL_S)
+        if degraded_active():
+            # Gateway-degraded (ADR-017): a cached forecast still
+            # renders, but a cold key returns None — the page draws
+            # without the forecast panel rather than paying a jax fit
+            # while the burn rate pages.
+            return r.peek(key, epoch=self._cache_epoch)
         return r.get(
             key,
             lambda: self._compute_forecast(metrics),
@@ -689,7 +741,11 @@ class DashboardApp:
         return "other"
 
     def handle(
-        self, path: str, *, accept: str | None = None
+        self,
+        path: str,
+        *,
+        accept: str | None = None,
+        gateway_info: dict[str, Any] | None = None,
     ) -> tuple[int, str, str]:
         """(status, content_type, body) for a GET. Pure enough to test
         without sockets. Never raises: route errors become a 500 page
@@ -730,9 +786,20 @@ class DashboardApp:
             counters_before = _runtime_counters(
                 self._transport,
                 (self._metrics_refresher, self._forecast_refresher),
+                gateway=self.gateway,
             )
         with trace_request(path, enabled=recorded, wall=self._clock) as trace:
             try:
+                if gateway_info:
+                    # Marker span carrying the admission-side story
+                    # (priority class, queue wait, degraded flag). Zero
+                    # duration by design: the wait already happened on
+                    # the request thread before this worker ran; only
+                    # its ATTRS matter to the waterfall. Opened here —
+                    # not in the gateway — because trace_request's
+                    # contextvar scope starts on this (worker) thread.
+                    with span("gateway.admission", **gateway_info):
+                        pass
                 with batch.scope():
                     status, content_type, body = self._handle(path, accept)
                     return status, content_type, body
@@ -771,6 +838,7 @@ class DashboardApp:
                     counters_after = _runtime_counters(
                         self._transport,
                         (self._metrics_refresher, self._forecast_refresher),
+                        gateway=self.gateway,
                     )
                     violations = slo_mod.engine().violations(
                         route_label, duration_s, status
@@ -785,6 +853,7 @@ class DashboardApp:
                             violations=violations,
                             counters_before=counters_before,
                             counters_after=counters_after,
+                            gateway=gateway_info,
                         ),
                         pinned=bool(violations) or status >= 500,
                     )
@@ -820,6 +889,7 @@ class DashboardApp:
                         "runtime": _runtime_health(
                             self._transport,
                             (self._metrics_refresher, self._forecast_refresher),
+                            gateway=self.gateway,
                         ),
                     }
                 )
@@ -855,6 +925,7 @@ class DashboardApp:
                     "runtime": _runtime_health(
                         self._transport,
                         (self._metrics_refresher, self._forecast_refresher),
+                        gateway=self.gateway,
                     ),
                 }
             )
@@ -1050,14 +1121,37 @@ class DashboardApp:
     # Socket server
     # ------------------------------------------------------------------
 
+    def ensure_gateway(self, **overrides: Any) -> Any:
+        """The app's RenderGateway (ADR-017), created on first use.
+        Socket serving ALWAYS routes through it — serve() calls this —
+        so admission policy (bounded pool, burn-rate shed, coalescing)
+        can never be skipped by a wiring mistake; direct ``handle()``
+        calls remain the unit-test/CLI seam. ``overrides`` forward to
+        the RenderGateway constructor (bench/test knobs: workers, queue
+        depths, timeouts)."""
+        if self.gateway is None:
+            from ..gateway import RenderGateway, set_active
+
+            self.gateway = RenderGateway(
+                self.handle,
+                route_label=self._route_label,
+                generation=self.snapshot_generation,
+                epoch=lambda: self._cache_epoch,
+                monotonic=self._mono,
+                **overrides,
+            )
+            set_active(self.gateway)
+        return self.gateway
+
     def serve(self, host: str = "127.0.0.1", port: int = 8631) -> ThreadingHTTPServer:
-        app = self
+        gateway = self.ensure_gateway()
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
-                status, content_type, body = app.handle(
+                response = gateway.handle(
                     self.path, accept=self.headers.get("Accept")
                 )
+                status, content_type, body = response[:3]
                 if status == 302:
                     self.send_response(302)
                     self.send_header("Location", content_type)
@@ -1067,6 +1161,8 @@ class DashboardApp:
                 self.send_response(status)
                 self.send_header("Content-Type", f"{content_type}; charset=utf-8")
                 self.send_header("Content-Length", str(len(data)))
+                for name, value in response.headers:
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(data)
 

@@ -1,0 +1,618 @@
+"""Gateway tests (ADR-017): bounded pool, priority admission,
+queue-wait deadlines, burn-rate shedding, and render coalescing.
+
+Clock discipline: pool deadlines and shed-state TTLs run on an
+injected monotonic (a mutable FakeMono), and the shed scenarios drive
+a REAL SLOEngine on the same fake clock ok→page→recovery — no sleeps
+anywhere in the policy assertions; real threads only carry execution.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from headlamp_tpu.gateway import (
+    PRIORITY_DEBUG,
+    PRIORITY_INTERACTIVE,
+    PRIORITY_OPS,
+    QueueFull,
+    RenderCoalescer,
+    RenderGateway,
+    RenderPool,
+    degraded_active,
+    degraded_scope,
+)
+from headlamp_tpu.obs.metrics import registry as metrics_registry
+from headlamp_tpu.obs.slo import SLOEngine
+from headlamp_tpu.server import DashboardApp, make_demo_transport
+
+
+class FakeMono:
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def _route_label(path: str) -> str:
+    """Test-side stand-in for DashboardApp._route_label: the bare path
+    (query stripped), which is exactly what the fakes key on."""
+    return path.split("?", 1)[0].rstrip("/") or "/tpu"
+
+
+def make_gateway(handle, **kwargs):
+    kwargs.setdefault("route_label", _route_label)
+    kwargs.setdefault("workers", 2)
+    kwargs.setdefault("request_timeout_s", 10.0)
+    # A fresh all-ok engine by default: the PROCESS engine accumulates
+    # the 5xx events other tests feed requests_total (this suite sheds
+    # 503s on dashboard routes on purpose), and a polluted burn state
+    # must not leak shed decisions into unrelated assertions.
+    kwargs.setdefault("engine", lambda: SLOEngine())
+    return RenderGateway(handle, **kwargs)
+
+
+def ok_handle(path, *, accept=None, gateway_info=None):
+    return 200, "text/html", f"page:{path}"
+
+
+# ---------------------------------------------------------------------------
+# RenderPool
+# ---------------------------------------------------------------------------
+
+
+class TestRenderPool:
+    def test_submit_runs_and_returns_result(self):
+        pool = RenderPool(workers=1)
+        try:
+            job = pool.submit("/tpu", PRIORITY_INTERACTIVE, lambda: "bytes")
+            assert job.done.wait(5.0)
+            assert job.outcome == "rendered"
+            assert job.result == "bytes"
+            assert pool.counters()["executed"] == 1
+        finally:
+            pool.close()
+
+    def test_priority_ordering_under_full_queue(self):
+        # One worker, blocked: everything else queues. Enqueued in
+        # WORST order (debug, ops, interactive) — execution must come
+        # out in strict class order regardless.
+        started = threading.Event()
+        release = threading.Event()
+        order: list[str] = []
+        lock = threading.Lock()
+
+        def blocker():
+            started.set()
+            release.wait(5.0)
+
+        def runner(name):
+            def fn():
+                with lock:
+                    order.append(name)
+
+            return fn
+
+        pool = RenderPool(workers=1)
+        try:
+            pool.submit("/block", PRIORITY_INTERACTIVE, blocker)
+            assert started.wait(5.0)
+            jobs = [
+                pool.submit("/debug/traces", PRIORITY_DEBUG, runner("debug")),
+                pool.submit("/metricsz", PRIORITY_OPS, runner("ops")),
+                pool.submit("/tpu", PRIORITY_INTERACTIVE, runner("interactive")),
+            ]
+            release.set()
+            for job in jobs:
+                assert job.done.wait(5.0)
+            assert order == ["interactive", "ops", "debug"]
+        finally:
+            pool.close()
+
+    def test_queue_depth_rejects_with_queue_full(self):
+        started = threading.Event()
+        release = threading.Event()
+        pool = RenderPool(
+            workers=1, queue_depth={PRIORITY_INTERACTIVE: 1}
+        )
+        try:
+            pool.submit(
+                "/block",
+                PRIORITY_INTERACTIVE,
+                lambda: (started.set(), release.wait(5.0)),
+            )
+            assert started.wait(5.0)
+            pool.submit("/tpu", PRIORITY_INTERACTIVE, lambda: None)  # fills depth 1
+            with pytest.raises(QueueFull):
+                pool.submit("/tpu", PRIORITY_INTERACTIVE, lambda: None)
+        finally:
+            release.set()
+            pool.close()
+
+    def test_queue_wait_deadline_expires_on_fake_clock(self):
+        clock = FakeMono()
+        started = threading.Event()
+        release = threading.Event()
+        ran: list[bool] = []
+        pool = RenderPool(workers=1, monotonic=clock)
+        try:
+            pool.submit(
+                "/block",
+                PRIORITY_INTERACTIVE,
+                lambda: (started.set(), release.wait(5.0)),
+            )
+            assert started.wait(5.0)
+            job = pool.submit(
+                "/tpu", PRIORITY_INTERACTIVE, lambda: ran.append(True)
+            )
+            # Past the interactive deadline while still queued: the
+            # freed worker must discard it WITHOUT running the render.
+            clock.advance(pool.queue_deadline_s[PRIORITY_INTERACTIVE] + 1.0)
+            release.set()
+            assert job.done.wait(5.0)
+            assert job.outcome == "expired"
+            assert ran == []
+            assert pool.counters()["expired"] == 1
+        finally:
+            pool.close()
+
+    def test_per_route_concurrency_cap(self):
+        # Two workers, route cap 1: two same-route renders may not run
+        # simultaneously, while a different route takes the idle worker.
+        release = threading.Event()
+        running = []
+        lock = threading.Lock()
+
+        def tracked(route):
+            def fn():
+                with lock:
+                    running.append(route)
+                release.wait(5.0)
+
+            return fn
+
+        pool = RenderPool(workers=2, route_limit=1)
+        try:
+            a1 = pool.submit("/tpu", PRIORITY_INTERACTIVE, tracked("/tpu"))
+            a2 = pool.submit("/tpu", PRIORITY_INTERACTIVE, tracked("/tpu"))
+            b = pool.submit("/nodes", PRIORITY_INTERACTIVE, tracked("/nodes"))
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with lock:
+                    if sorted(running) == ["/nodes", "/tpu"]:
+                        break
+                time.sleep(0.01)
+            with lock:
+                # The second /tpu job must still be queued.
+                assert sorted(running) == ["/nodes", "/tpu"]
+            release.set()
+            for job in (a1, a2, b):
+                assert job.done.wait(5.0)
+        finally:
+            pool.close()
+
+
+# ---------------------------------------------------------------------------
+# Coalescer
+# ---------------------------------------------------------------------------
+
+
+class TestCoalescer:
+    def test_single_flight_semantics(self):
+        c = RenderCoalescer()
+        flight, leader = c.join_or_lead(("k",))
+        assert leader
+        f2, leader2 = c.join_or_lead(("k",))
+        assert not leader2 and f2 is flight
+        c.finish(("k",), flight, result="bytes")
+        assert f2.done.is_set() and f2.result == "bytes"
+        # After finish, the key leads a fresh flight.
+        _, leader3 = c.join_or_lead(("k",))
+        assert leader3
+
+    def test_concurrent_same_key_requests_cost_one_render(self):
+        n = 25
+        calls: list[str] = []
+        started = threading.Event()
+        release = threading.Event()
+        lock = threading.Lock()
+
+        def slow_handle(path, *, accept=None, gateway_info=None):
+            with lock:
+                calls.append(path)
+            started.set()
+            release.wait(10.0)
+            return 200, "text/html", f"render#{len(calls)}"
+
+        gw = make_gateway(slow_handle)
+        try:
+            results: list = [None] * n
+            threads = [
+                threading.Thread(
+                    target=lambda i=i: results.__setitem__(i, gw.handle("/tpu"))
+                )
+                for i in range(n)
+            ]
+            for t in threads:
+                t.start()
+            assert started.wait(5.0)
+            # Wait until every other request has joined the leader's
+            # flight, then let the single render finish.
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                flights = list(gw.coalescer._flights.values())
+                if flights and flights[0].followers >= n - 1:
+                    break
+                time.sleep(0.01)
+            release.set()
+            for t in threads:
+                t.join(10.0)
+            assert len(calls) == 1
+            bodies = {r.body for r in results}
+            statuses = {r.status for r in results}
+            assert bodies == {"render#1"} and statuses == {200}
+            assert gw.rendered == 1
+            assert gw.coalesced_followers == n - 1
+        finally:
+            gw.close()
+
+    def test_real_app_coalesced_bytes_identical(self):
+        # Same property against the REAL handler: N concurrent /tpu
+        # requests through the gateway produce byte-identical full HTML
+        # from ONE DashboardApp.handle call. The wrapper gates the
+        # render so overlap is deterministic, not scheduler luck.
+        app = DashboardApp(make_demo_transport("v5p32"), min_sync_interval_s=3600.0)
+        calls = []
+        started = threading.Event()
+        release = threading.Event()
+
+        def gated_handle(path, *, accept=None, gateway_info=None):
+            calls.append(path)
+            started.set()
+            release.wait(10.0)
+            return app.handle(path, accept=accept, gateway_info=gateway_info)
+
+        gw = make_gateway(
+            gated_handle,
+            generation=app.snapshot_generation,
+            epoch=lambda: app._cache_epoch,
+        )
+        try:
+            n = 8
+            results: list = [None] * n
+            threads = [
+                threading.Thread(
+                    target=lambda i=i: results.__setitem__(i, gw.handle("/tpu"))
+                )
+                for i in range(n)
+            ]
+            for t in threads:
+                t.start()
+            assert started.wait(5.0)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                flights = list(gw.coalescer._flights.values())
+                if flights and flights[0].followers >= n - 1:
+                    break
+                time.sleep(0.01)
+            release.set()
+            for t in threads:
+                t.join(30.0)
+            assert len(calls) == 1
+            assert {r.status for r in results} == {200}
+            assert len({r.body for r in results}) == 1
+            assert "<html>" in results[0].body
+        finally:
+            gw.close()
+
+    def test_different_query_not_coalesced(self):
+        gw = make_gateway(ok_handle)
+        try:
+            k1 = gw._coalesce_key("/tpu/nodes?page=1", "/tpu/nodes", False)
+            k2 = gw._coalesce_key("/tpu/nodes?page=2", "/tpu/nodes", False)
+            assert k1 != k2
+            # Query order canonicalizes: ?a=1&b=2 is ?b=2&a=1.
+            assert gw._coalesce_key(
+                "/tpu/nodes?a=1&b=2", "/tpu/nodes", False
+            ) == gw._coalesce_key("/tpu/nodes?b=2&a=1", "/tpu/nodes", False)
+        finally:
+            gw.close()
+
+    def test_side_effectful_and_non_interactive_never_coalesce(self):
+        gw = make_gateway(ok_handle)
+        try:
+            assert gw._coalesce_key("/refresh?back=/tpu", "/refresh", False) is None
+            assert gw._coalesce_key("/metricsz", "/metricsz", False) is None
+            assert gw._coalesce_key("/debug/traces", "/debug/traces", False) is None
+        finally:
+            gw.close()
+
+    def test_generation_rotates_coalesce_key(self):
+        generation = [1]
+        gw = make_gateway(ok_handle, generation=lambda: generation[0])
+        try:
+            k1 = gw._coalesce_key("/tpu", "/tpu", False)
+            generation[0] = 2
+            assert gw._coalesce_key("/tpu", "/tpu", False) != k1
+        finally:
+            gw.close()
+
+
+# ---------------------------------------------------------------------------
+# Shedding
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def paged_engine():
+    """A real SLOEngine on a fake clock, driven into page on
+    dashboard_render (the storm idiom from test_slo.py)."""
+    clock = FakeMono()
+    eng = SLOEngine(monotonic=clock)
+    eng.clock = clock
+    for _ in range(600):
+        eng.record("dashboard_render", False)
+    assert eng.health_block()["dashboard_render"] == "page"
+    return eng
+
+
+class TestShedding:
+    def _gateway(self, engine, handle=ok_handle):
+        return make_gateway(
+            handle, engine=lambda: engine, monotonic=engine.clock, shed_ttl_s=1.0
+        )
+
+    def test_debug_sheds_with_retry_after_and_json_body(self, paged_engine):
+        gw = self._gateway(paged_engine)
+        try:
+            resp = gw.handle("/debug/traces")
+            assert resp.status == 503
+            assert dict(resp.headers)["Retry-After"] == "5"
+            body = json.loads(resp.body)
+            assert body["shed"] is True
+            assert body["route"] == "/debug/traces"
+            assert body["reason"] == "burn_rate"
+            assert body["burn_state"]["dashboard_render"] == "page"
+        finally:
+            gw.close()
+
+    def test_ops_surfaces_never_shed(self, paged_engine):
+        gw = self._gateway(paged_engine)
+        try:
+            for path in ("/metricsz", "/sloz"):
+                assert gw.handle(path).status == 200
+        finally:
+            gw.close()
+
+    def test_interactive_degrades_not_sheds(self, paged_engine):
+        seen: dict[str, bool] = {}
+
+        def recording_handle(path, *, accept=None, gateway_info=None):
+            seen[path] = degraded_active()
+            return 200, "text/html", "ok"
+
+        gw = self._gateway(paged_engine, recording_handle)
+        try:
+            assert gw.handle("/tpu").status == 200
+            # /tpu is governed by the paging dashboard_render SLO →
+            # degraded render; /tpu/metrics belongs to scrape_paint
+            # (not paging) → full fidelity.
+            assert gw.handle("/tpu/metrics").status == 200
+            assert seen["/tpu"] is True
+            assert seen["/tpu/metrics"] is False
+            assert gw.degraded_renders == 1
+        finally:
+            gw.close()
+
+    def test_shed_then_restore_on_recovery(self, paged_engine):
+        gw = self._gateway(paged_engine)
+        try:
+            assert gw.handle("/debug/traces").status == 503
+            # Windows slide past the storm on the injected clock; the
+            # advance also expires the policy's 1 s state cache.
+            paged_engine.clock.advance(25_000.0)
+            assert paged_engine.health_block()["dashboard_render"] == "ok"
+            assert gw.handle("/debug/traces").status == 200
+        finally:
+            gw.close()
+
+    def test_shed_503_feeds_requests_total_once_no_histogram(self, paged_engine):
+        # The r10-review exactly-once rule, now for gateway 503s: the
+        # requests_total 5xx feed moves by exactly one, the duration
+        # histogram not at all.
+        req_total = metrics_registry.counter(
+            "headlamp_tpu_requests_total", "", labels=("route", "status")
+        )
+        req_hist = metrics_registry.histogram(
+            "headlamp_tpu_request_duration_seconds", "", labels=("route",)
+        )
+        route = "/debug/traces"
+        before_total = req_total.value_for(route=route, status="503")
+        before_count = req_hist.count_for(route=route)
+        gw = self._gateway(paged_engine)
+        try:
+            assert gw.handle(route).status == 503
+            assert req_total.value_for(route=route, status="503") == before_total + 1
+            assert req_hist.count_for(route=route) == before_count
+        finally:
+            gw.close()
+
+    def test_shed_state_cached_for_ttl(self, paged_engine):
+        gw = self._gateway(paged_engine)
+        try:
+            gw.handle("/debug/traces")
+            evals = gw.shed_policy.evaluations
+            gw.handle("/debug/traces")  # within TTL: cached states
+            assert gw.shed_policy.evaluations == evals
+            paged_engine.clock.advance(2.0)
+            gw.handle("/debug/traces")
+            assert gw.shed_policy.evaluations == evals + 1
+        finally:
+            gw.close()
+
+
+# ---------------------------------------------------------------------------
+# Gateway request-path plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestGatewayPlumbing:
+    def test_healthz_answers_while_pool_saturated(self):
+        # THE pool-exhaustion regression: every worker wedged mid-render
+        # and the interactive queue full — a liveness probe must still
+        # answer immediately (bypass, no queue, no pool slot).
+        release = threading.Event()
+
+        def handle(path, *, accept=None, gateway_info=None):
+            if path != "/healthz":
+                release.wait(10.0)
+            return 200, "application/json", "{}"
+
+        gw = make_gateway(
+            handle, workers=1, queue_depth={PRIORITY_INTERACTIVE: 1}
+        )
+        try:
+            threading.Thread(target=lambda: gw.handle("/tpu"), daemon=True).start()
+            deadline = time.monotonic() + 5.0
+            while gw.pool.inflight() == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            threading.Thread(target=lambda: gw.handle("/nodes"), daemon=True).start()
+            deadline = time.monotonic() + 5.0
+            while gw.pool.queue_depths()["interactive"] == 0 and (
+                time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            t0 = time.monotonic()
+            resp = gw.handle("/healthz")
+            assert resp.status == 200
+            assert time.monotonic() - t0 < 2.0
+            assert gw.bypassed == 1
+        finally:
+            release.set()
+            gw.close()
+
+    def test_queue_full_returns_shed_503(self):
+        release = threading.Event()
+
+        def handle(path, *, accept=None, gateway_info=None):
+            release.wait(10.0)
+            return 200, "text/html", "ok"
+
+        gw = make_gateway(
+            handle, workers=1, queue_depth={PRIORITY_INTERACTIVE: 1}
+        )
+        try:
+            threading.Thread(target=lambda: gw.handle("/tpu"), daemon=True).start()
+            deadline = time.monotonic() + 5.0
+            while gw.pool.inflight() == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # Fill the depth-1 queue with a second route, then a third
+            # route must be rejected at admission. Distinct paths —
+            # coalescing would absorb an identical request, and
+            # admission itself is what's tested.
+            threading.Thread(target=lambda: gw.handle("/nodes"), daemon=True).start()
+            deadline = time.monotonic() + 5.0
+            while gw.pool.queue_depths()["interactive"] == 0 and (
+                time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            resp = gw.handle("/tpu/pods")
+            assert resp.status == 503
+            body = json.loads(resp.body)
+            assert body["reason"] == "queue_full" and body["shed"] is True
+            assert gw.shed_queue_full == 1
+        finally:
+            release.set()
+            gw.close()
+
+    def test_expired_queue_wait_returns_503(self):
+        clock = FakeMono()
+        started = threading.Event()
+        release = threading.Event()
+
+        def handle(path, *, accept=None, gateway_info=None):
+            started.set()
+            release.wait(10.0)
+            return 200, "text/html", "ok"
+
+        gw = make_gateway(handle, workers=1, monotonic=clock)
+        try:
+            threading.Thread(target=lambda: gw.handle("/tpu"), daemon=True).start()
+            assert started.wait(5.0)
+            result: list = [None]
+            t = threading.Thread(
+                target=lambda: result.__setitem__(0, gw.handle("/nodes"))
+            )
+            t.start()
+            deadline = time.monotonic() + 5.0
+            while gw.pool.queue_depths()["interactive"] == 0 and (
+                time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            clock.advance(60.0)
+            release.set()
+            t.join(10.0)
+            resp = result[0]
+            assert resp.status == 503
+            assert json.loads(resp.body)["reason"] == "queue_deadline"
+            assert gw.expired == 1
+        finally:
+            gw.close()
+
+    def test_gateway_info_reaches_handler(self):
+        infos = []
+
+        def handle(path, *, accept=None, gateway_info=None):
+            infos.append(gateway_info)
+            return 200, "text/html", "ok"
+
+        gw = make_gateway(handle)
+        try:
+            assert gw.handle("/tpu").status == 200
+            assert infos[0]["priority"] == "interactive"
+            assert infos[0]["degraded"] is False
+            assert "queue_wait_ms" in infos[0]
+        finally:
+            gw.close()
+
+    def test_degraded_scope_contextvar(self):
+        assert degraded_active() is False
+        with degraded_scope(True):
+            assert degraded_active() is True
+        assert degraded_active() is False
+
+    def test_counters_and_snapshot_shapes(self):
+        gw = make_gateway(ok_handle)
+        try:
+            gw.handle("/tpu")
+            counters = gw.counters()
+            assert counters["rendered"] == 1
+            assert counters["pool_executed"] == 1
+            snap = gw.snapshot()
+            assert snap["workers"] == 2
+            assert set(snap["queue_depth"]) == {"interactive", "ops", "debug"}
+            assert "burn_state" in snap
+        finally:
+            gw.close()
+
+    def test_serving_app_reports_gateway_in_healthz(self):
+        app = DashboardApp(make_demo_transport("v5p32"), min_sync_interval_s=3600.0)
+        gw = app.ensure_gateway(workers=2)
+        try:
+            resp = gw.handle("/tpu")
+            assert resp.status == 200
+            health = gw.handle("/healthz")
+            block = json.loads(health.body)["runtime"]["gateway"]
+            assert block["rendered"] >= 1
+            assert block["bypassed"] >= 1
+            assert "queue_depth" in block
+        finally:
+            gw.close()

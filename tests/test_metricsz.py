@@ -171,9 +171,13 @@ class TestFormat:
     def test_metadata_only_families_are_the_known_quiet_set(self, exposition):
         # A family rendering HELP/TYPE but zero samples is legitimate
         # only when the instrument genuinely had nothing to report in
-        # this scenario: calibration gauges before any run, and the
+        # this scenario: calibration gauges before any run, the
         # connect-latency histogram (the demo transport never dials a
-        # socket). Anything else going silent is a rendering bug.
+        # socket), and the gateway queue/inflight callback gauges (the
+        # fixture calls handle() directly — no RenderGateway is
+        # serving, so there are no queues to report and the queue-wait
+        # histogram never observes). Anything else going silent is a
+        # rendering bug.
         _, types, samples, _ = parse_exposition(exposition)
         emitted = {base_name(n, types) for n, _, _ in samples}
         quiet = {name for name in types if name not in emitted}
@@ -181,6 +185,9 @@ class TestFormat:
             "headlamp_tpu_calibration_python_per_node_seconds",
             "headlamp_tpu_calibration_xla_seconds",
             "headlamp_tpu_transport_connect_latency_seconds",
+            "headlamp_tpu_gateway_queue_depth_count",
+            "headlamp_tpu_gateway_inflight_renders_count",
+            "headlamp_tpu_gateway_queue_wait_seconds",
         }, f"unexpected sample-free families: {sorted(quiet)}"
 
     def test_name_grammar_and_unit_suffixes(self, exposition):

@@ -1,0 +1,102 @@
+"""Tier-1 mirror of ``tools/no_direct_render_check.py`` (ADR-017):
+the repo must be clean, and the checker must actually catch the
+bypasses it claims to — mutation coverage on synthetic sources, same
+discipline as the urlopen/inline-fit/wall-clock gate tests.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+sys.path.insert(0, _TOOLS)
+
+import no_direct_render_check as checker  # noqa: E402
+
+
+class TestRepoIsClean:
+    def test_repo_scope_has_no_direct_render_calls(self):
+        diags = checker.check_tree()
+        assert diags == [], "\n".join(str(d) for d in diags)
+
+    def test_main_exit_code_clean(self, capsys):
+        assert checker.main() == 0
+        assert "0 direct-render problem(s)" in capsys.readouterr().out
+
+    def test_sanctioned_sites_are_exempt(self, tmp_path):
+        # The wiring file may call handle; a sibling module may not.
+        root = tmp_path
+        server = root / "headlamp_tpu" / "server"
+        server.mkdir(parents=True)
+        (server / "app.py").write_text("resp = app.handle('/tpu')\n")
+        (server / "other.py").write_text("resp = app.handle('/tpu')\n")
+        gateway = root / "headlamp_tpu" / "gateway"
+        gateway.mkdir(parents=True)
+        (gateway / "gateway.py").write_text("resp = self._app.handle('/tpu')\n")
+        diags = checker.check_tree(str(root))
+        assert len(diags) == 1
+        assert diags[0].path.endswith("other.py")
+
+
+class TestMutations:
+    """_check_source must flag each bypass form and stay quiet on the
+    sanctioned idioms."""
+
+    def _diags(self, src: str):
+        return checker._check_source("synthetic.py", src)
+
+    def test_attribute_handle_call_flagged(self):
+        assert self._diags("status, ctype, body = app.handle('/tpu')\n")
+
+    def test_nested_receiver_handle_call_flagged(self):
+        assert self._diags("self.app.handle(path, accept=a)\n")
+
+    def test_render_html_import_flagged(self):
+        assert self._diags("from headlamp_tpu.ui import render_html\n")
+
+    def test_render_html_attribute_flagged(self):
+        assert self._diags("body = ui.render_html(el)\n")
+
+    def test_render_html_bare_name_flagged(self):
+        assert self._diags("renderer = render_html\n")
+
+    def test_native_pages_flagged(self):
+        assert self._diags("from headlamp_tpu.pages.native import native_node_page\n")
+        assert self._diags("el = pages.native_pod_page(snap, 'ns', 'p')\n")
+
+    def test_other_attribute_calls_allowed(self):
+        assert self._diags("gw = RenderGateway(app._handle)\n") == []
+        assert self._diags("resp = gateway.dispatch('/tpu')\n") == []
+        assert self._diags("h = logging.Handler()\n") == []
+
+    def test_handle_as_string_or_comment_allowed(self):
+        # AST-based: prose and string literals never trip the gate.
+        assert self._diags("# app.handle('/tpu') is gated\nx = 'render_html'\n") == []
+
+    def test_underscore_handle_allowed(self):
+        # The gateway's injected callable is stored as _handle — the
+        # sanctioned internal seam.
+        assert self._diags("result = self._handle(path, accept=accept)\n") == []
+
+    def test_unparseable_file_reported(self):
+        diags = self._diags("def broken(:\n")
+        assert len(diags) == 1 and "unparseable" in diags[0].message
+
+    def test_wired_into_static_check_entry_point(self):
+        # The gate must ride tools/ts_static_check.py main() — a gate
+        # that exists but never runs protects nothing.
+        with open(os.path.join(_TOOLS, "ts_static_check.py"), encoding="utf-8") as f:
+            src = f.read()
+        assert "no_direct_render_check" in src
+        assert "render_diags" in src
+
+
+def test_checker_importable_as_script():
+    # main() accepts an explicit root argument (CI calls it that way).
+    argv = sys.argv
+    try:
+        sys.argv = ["no_direct_render_check.py"]
+        assert checker.main() == 0
+    finally:
+        sys.argv = argv

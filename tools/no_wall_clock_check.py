@@ -33,8 +33,9 @@ What is deliberately ALLOWED:
   when given an argument; argless ``time.localtime()`` does, and is
   flagged.
 
-Scope: ``headlamp_tpu/obs/``, ``headlamp_tpu/runtime/``,
-``headlamp_tpu/transport/``. The app/server layer is exempt — it is
+Scope: ``headlamp_tpu/gateway/``, ``headlamp_tpu/obs/``,
+``headlamp_tpu/runtime/``, ``headlamp_tpu/transport/``. The
+app/server layer is exempt — it is
 where wall clocks legitimately enter (as injected defaults), and
 ``tests/`` drives both kinds of clock explicitly.
 """
@@ -150,6 +151,7 @@ def _repo_root() -> str:
 
 #: The injected-clock subtrees (relative to the repo root).
 SCOPE = (
+    os.path.join("headlamp_tpu", "gateway"),
     os.path.join("headlamp_tpu", "obs"),
     os.path.join("headlamp_tpu", "runtime"),
     os.path.join("headlamp_tpu", "transport"),

@@ -1483,7 +1483,18 @@ def main() -> int:
     for diag in wall_diags:
         print(diag)
     print(f"{len(wall_diags)} wall-clock problem(s)")
-    return 1 if diagnostics or urlopen_diags or fit_diags or wall_diags else 0
+    # Gateway-funnel gate (ADR-017): serving code reaches the render
+    # path only through RenderGateway — no direct .handle()/render
+    # calls outside gateway/ and the sanctioned wiring.
+    import no_direct_render_check
+
+    render_diags = no_direct_render_check.check_tree()
+    for diag in render_diags:
+        print(diag)
+    print(f"{len(render_diags)} direct-render problem(s)")
+    return 1 if (
+        diagnostics or urlopen_diags or fit_diags or wall_diags or render_diags
+    ) else 0
 
 
 if __name__ == "__main__":
