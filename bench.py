@@ -85,6 +85,14 @@ Extras reported alongside (same JSON line, `extra` object):
   check (acceptance: < 50 µs), per-observe cost of exemplar capture
   under an active trace, a full flight ring's resident size, and the
   /sloz/html evaluation+render latency.
+- ``history_capture_ns_per_point`` / ``history_trend_read_ms_1024nodes_6h``
+  / ``history_memory_mb_1024nodes`` / ``replay_deterministic`` — the
+  ADR-018 history-tier budget: per-point capture cost (spent on the
+  background refit path, never the request path), windowed-read and
+  forecast-read latency with every ring full at the 1024-node x 6 h
+  bound, resident ring memory at that bound, and whether two replay
+  rounds of one in-run demo recording agreed byte-for-byte (also
+  runnable standalone: ``python bench.py --replay PATH [--rate N]``).
 - ``prev_round_regressions`` — fail-soft round-over-round comparator:
   shared numeric metrics >25% worse than the latest committed
   ``BENCH_r*.json`` are named here (details on stderr), direction-aware
@@ -1226,6 +1234,205 @@ def bench_paint_1024() -> tuple[float, str]:
     return statistics.median(samples), backend
 
 
+class _ScriptedClock:
+    """Deterministic injectable clock: advances only when told. Both
+    replay rounds drive the app AND the ReplaySource from one of these,
+    so every TTL decision, history stamp, and pacing comparison lands
+    on identical instants — the precondition for byte-parity."""
+
+    def __init__(self, start: float = 0.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+#: The request script both the recording and every replay round run:
+#: (path, seconds to advance the scripted clocks afterwards). The 601 s
+#: steps land past the metrics TTL+grace (5 s + 60 s) AND the forecast
+#: grace (600 s), so each /tpu/metrics recompute happens FOREGROUND in
+#: the handling thread — a background refit racing the replay cursor
+#: would be the one source of ordering nondeterminism.
+REPLAY_SCRIPT: tuple[tuple[str, float], ...] = (
+    ("/tpu/metrics", 601.0),
+    ("/tpu", 61.0),
+    ("/tpu/metrics", 601.0),
+    ("/healthz", 1.0),
+    ("/tpu/metrics", 601.0),
+)
+
+
+def record_demo_traffic(path: str, *, fleet: str = "v5p32", note: str = "") -> int:
+    """Drive the demo app through REPLAY_SCRIPT with a RecordingTransport
+    teeing every exchange to ``path``. Returns exchanges recorded."""
+    from headlamp_tpu.history import Recorder, RecordingTransport
+    from headlamp_tpu.server import DashboardApp, make_demo_transport
+
+    mono = _ScriptedClock(1000.0)
+    wall = _ScriptedClock(1_700_000_000.0)
+    with open(path, "w", encoding="utf-8") as sink:
+        recorder = Recorder(sink, monotonic=mono, wall=wall, note=note)
+        transport = RecordingTransport(make_demo_transport(fleet), recorder)
+        app = DashboardApp(
+            transport, min_sync_interval_s=0.0, clock=wall, monotonic=mono
+        )
+        for route, dt in REPLAY_SCRIPT:
+            status, _, _ = app.handle(route)
+            assert status == 200, f"recording {route} -> {status}"
+            mono.advance(dt)
+            wall.advance(dt)
+    return recorder.exchanges
+
+
+def replay_round(path: str, *, rate: float | None = None) -> dict:
+    """ONE deterministic replay round: a fresh DashboardApp over a
+    ReplaySource of ``path``, driven through REPLAY_SCRIPT on scripted
+    clocks. Returns the rendered /tpu/trends HTML plus the round's
+    metric values — everything two rounds of the same artifact must
+    reproduce byte-for-byte.
+
+    ``rate=None`` replays sequentially (the bench mode); a number uses
+    timed pacing on the SAME scripted clock, so even "replay at 3x"
+    stays deterministic. Locally measured durations (snapshot.fetch_ms)
+    are excluded from capture: the determinism contract covers replayed
+    data, not this host's perf_counter (ADR-018)."""
+    from headlamp_tpu.history import ReplaySource, load_recording
+    from headlamp_tpu.server import DashboardApp
+
+    recording = load_recording(path)
+    mono = _ScriptedClock(1000.0)
+    wall = _ScriptedClock(1_700_000_000.0)
+    if rate is None:
+        source = ReplaySource(recording)
+    else:
+        source = ReplaySource(recording, clock=mono, rate=rate)
+    app = DashboardApp(source, min_sync_interval_s=0.0, clock=wall, monotonic=mono)
+    app.history.capture_timings = False
+    statuses = []
+    for route, dt in REPLAY_SCRIPT:
+        status, _, _ = app.handle(route)
+        statuses.append((route, status))
+        mono.advance(dt)
+        wall.advance(dt)
+    trend_status, _, trends_html = app.handle("/tpu/trends")
+    _, mean_util = app.history.series("fleet.mean_tensorcore_utilization")
+    _, generations = app.history.series("sync.generation")
+    metrics = {
+        "statuses": statuses,
+        "trend_status": trend_status,
+        "history_counters": app.history.counters(),
+        "mean_tensorcore_utilization": [round(v, 6) for v in mean_util],
+        "sync_generation": [round(v, 6) for v in generations],
+        "replay_requests_served": source.requests_served,
+        "replay_requests_unknown": source.requests_unknown,
+    }
+    return {"trends_html": trends_html, "metrics": metrics}
+
+
+def bench_history() -> dict:
+    """ADR-018 acceptance numbers: capture cost per point (the budget
+    the on_store hook spends OFF the request path), windowed-read
+    latency at the 1024-node x 6 h bound, resident ring memory at that
+    bound, and the replay determinism flag (two rounds of one in-run
+    demo recording must agree byte-for-byte)."""
+    import tempfile
+
+    from headlamp_tpu.history import HistoryStore
+    from headlamp_tpu.metrics.client import TpuChipMetrics, TpuMetricsSnapshot
+
+    n_nodes, chips_per_node = 1024, 4
+    chips = [
+        TpuChipMetrics(
+            node=f"node-{i:04d}",
+            accelerator_id=str(c),
+            tensorcore_utilization=0.5 + 0.3 * ((i * chips_per_node + c) % 7) / 7,
+            duty_cycle=0.9,
+        )
+        for i in range(n_nodes)
+        for c in range(chips_per_node)
+    ]
+    snapshot = TpuMetricsSnapshot(
+        namespace="bench", service="prom", chips=chips, fetched_at=0.0, fetch_ms=1.0
+    )
+
+    # Capture overhead: repeated full-fleet scrapes into a fresh store.
+    mono = _ScriptedClock(0.0)
+    store = HistoryStore(monotonic=mono)
+    iterations = 10
+    t0 = time.perf_counter()
+    for _ in range(iterations):
+        store.record_scrape(snapshot)
+        mono.advance(75.0)
+    capture_s = time.perf_counter() - t0
+    ns_per_point = capture_s * 1e9 / max(store.points, 1)
+
+    # Windowed read at the full bound: rings filled to capacity, spans
+    # exactly the 6 h retention (288 points x 75 s).
+    fill = HistoryStore(monotonic=mono)
+    mono.now = 0.0
+    for _ in range(fill.shard_capacity):
+        fill.record_scrape(snapshot)
+        mono.advance(75.0)
+    fill.trend_view(window_s=fill.retention_s)  # warm: analytics import
+    t0 = time.perf_counter()
+    view = fill.trend_view(window_s=fill.retention_s)
+    trend_read_ms = (time.perf_counter() - t0) * 1000
+    t0 = time.perf_counter()
+    captured = fill.utilization_history(clock=lambda: 0.0, min_points=40)
+    util_read_ms = (time.perf_counter() - t0) * 1000
+    assert view["groups"] and captured is not None
+
+    with tempfile.TemporaryDirectory() as tmp:
+        recording_path = os.path.join(tmp, "bench.jsonl")
+        exchanges = record_demo_traffic(recording_path, note="bench_history")
+        first = replay_round(recording_path)
+        second = replay_round(recording_path)
+    return {
+        "history_capture_ns_per_point": round(ns_per_point, 1),
+        "history_trend_read_ms_1024nodes_6h": round(trend_read_ms, 2),
+        "history_forecast_read_ms_1024nodes_6h": round(util_read_ms, 2),
+        "history_memory_mb_1024nodes": round(fill.memory_bytes() / 1e6, 2),
+        "history_window_span_s_1024nodes": round(fill.window_span_s(), 1),
+        "replay_recording_exchanges": exchanges,
+        "replay_deterministic": first == second,
+    }
+
+
+def replay_main(argv: list[str]) -> None:
+    """``python bench.py --replay PATH [--rate N]``: run TWO replay
+    rounds of one artifact and print one JSON line. Exits 1 when the
+    rounds disagree — the byte-stability acceptance, executable against
+    any recorded incident."""
+    from headlamp_tpu.history import load_recording
+
+    path = argv[argv.index("--replay") + 1]
+    rate = float(argv[argv.index("--rate") + 1]) if "--rate" in argv else None
+    first = replay_round(path, rate=rate)
+    second = replay_round(path, rate=rate)
+    deterministic = first == second
+    recording = load_recording(path)
+    print(
+        json.dumps(
+            {
+                "replay": path,
+                "rate": rate,
+                "recorded_note": recording.note,
+                "exchanges": len(recording.exchanges),
+                "span_s": recording.span_s,
+                "deterministic": deterministic,
+                "metrics": first["metrics"],
+            },
+            ensure_ascii=False,
+            sort_keys=True,
+        )
+    )
+    if not deterministic:
+        raise SystemExit(1)
+
+
 def main() -> None:
     fleet = build_fleet()
     rtt = measure_tunnel_rtt()
@@ -1269,6 +1476,7 @@ def main() -> None:
     slo = bench_slo(fleet)
     transport_pool = bench_transport_pool(fleet)
     gateway = bench_gateway(fleet)
+    history = bench_history()
     record = {
         "metric": (
             "metrics scrape→paint p50 (Prometheus fetch + forecast "
@@ -1311,6 +1519,7 @@ def main() -> None:
             **slo,
             **transport_pool,
             **gateway,
+            **history,
         },
     }
     record["extra"]["prev_round_regressions"] = compare_prev_round(record)
@@ -1318,4 +1527,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--replay" in sys.argv:
+        replay_main(sys.argv)
+    else:
+        main()

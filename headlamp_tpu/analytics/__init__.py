@@ -12,6 +12,7 @@ over a device mesh for multi-host fleets (``parallel.mesh``).
 
 from .encode import FleetArrays, GENERATION_IDS, PHASE_IDS, encode_fleet
 from .fleet_jax import fleet_rollup, rollup_to_dict
+from .trends import series_stats
 
 __all__ = [
     "FleetArrays",
@@ -20,4 +21,5 @@ __all__ = [
     "encode_fleet",
     "fleet_rollup",
     "rollup_to_dict",
+    "series_stats",
 ]

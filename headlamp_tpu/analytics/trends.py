@@ -1,0 +1,71 @@
+"""Windowed-series statistics over the ADR-018 history tier.
+
+``HistoryStore`` hands series out as ``jnp`` arrays; this module is the
+analytics-layer consumer — one fused reduction per series (min/max/mean
+and a least-squares slope) instead of five Python passes. On a jax-less
+host the same numbers come from the pure-Python fallback, so the trends
+page degrades gracefully rather than 500ing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+
+def _stats_jax(values: Any) -> dict[str, float] | None:
+    try:
+        import jax.numpy as jnp
+    except Exception:  # noqa: BLE001 — fall through to pure Python
+        return None
+    v = jnp.asarray(values, dtype=jnp.float32)
+    n = int(v.shape[0])
+    if n == 0:
+        return None
+    # Slope by least squares on the step index: with x centered,
+    # slope = sum(x * (v - mean)) / sum(x^2).
+    x = jnp.arange(n, dtype=jnp.float32) - (n - 1) / 2.0
+    denom = jnp.sum(x * x)
+    slope = jnp.where(denom > 0, jnp.sum(x * (v - jnp.mean(v))) / jnp.maximum(denom, 1.0), 0.0)
+    return {
+        "n": float(n),
+        "latest": float(v[-1]),
+        "min": float(jnp.min(v)),
+        "max": float(jnp.max(v)),
+        "mean": float(jnp.mean(v)),
+        "slope_per_step": float(slope),
+    }
+
+
+def series_stats(values: Sequence[float] | Any) -> dict[str, float]:
+    """min/max/mean/latest plus a per-step least-squares slope for one
+    windowed series. Empty input is a zeroed record, never an error —
+    trend pages render during warm-up."""
+    out = _stats_jax(values)
+    if out is not None:
+        return out
+    vals = [float(v) for v in values]
+    if not vals:
+        return {
+            "n": 0.0,
+            "latest": 0.0,
+            "min": 0.0,
+            "max": 0.0,
+            "mean": 0.0,
+            "slope_per_step": 0.0,
+        }
+    n = len(vals)
+    mean = sum(vals) / n
+    num = 0.0
+    denom = 0.0
+    for i, v in enumerate(vals):
+        x = i - (n - 1) / 2.0
+        num += x * (v - mean)
+        denom += x * x
+    return {
+        "n": float(n),
+        "latest": vals[-1],
+        "min": min(vals),
+        "max": max(vals),
+        "mean": mean,
+        "slope_per_step": num / denom if denom > 0 else 0.0,
+    }

@@ -1,0 +1,40 @@
+"""In-process history tier + record-and-replay harness (ADR-018).
+
+Every other observability surface answers "what is happening now";
+this package makes the dashboard answer "how did it move":
+
+- :mod:`.store` — :class:`HistoryStore`, a bounded columnar store of
+  per-metric ring-buffer shards fed off the request path (the ADR-015
+  refresher's store hook and the cluster-sync loop), read by the
+  ``/tpu/trends`` page, the forecaster, and ``/healthz``.
+- :mod:`.record` — :class:`RecordingTransport` serializes live
+  transport traffic to a versioned JSONL artifact;
+  :class:`ReplaySource` replays it deterministically behind the
+  transport seam (``bench.py --replay``).
+
+Clock discipline (ADR-013): the whole package is inside the
+``no_wall_clock_check`` scope — retention, window, and replay-pacing
+math run on injected monotonic clocks only.
+"""
+
+from .record import (
+    RECORDING_VERSION,
+    Recorder,
+    Recording,
+    RecordingTransport,
+    ReplaySource,
+    load_recording,
+)
+from .store import HistoryStore, active_store, set_active_store
+
+__all__ = [
+    "HistoryStore",
+    "active_store",
+    "set_active_store",
+    "Recorder",
+    "Recording",
+    "RecordingTransport",
+    "ReplaySource",
+    "RECORDING_VERSION",
+    "load_recording",
+]

@@ -1,0 +1,533 @@
+"""Bounded columnar history store — the capture half of ADR-018.
+
+One :class:`HistoryStore` holds a map of per-series ring-buffer shards.
+Each shard is two preallocated columns — ``float32`` values and
+``float64`` monotonic stamps (``array.array``, so the core works on a
+jax-less host) — and appending is an index write plus a ring-head bump.
+Everything is bounded up front: a shard never grows past its capacity
+(overwrites count as evictions) and the shard map never grows past
+``max_shards`` (least-recently-appended shard dropped, counted), so a
+soak can run for weeks without the history tier becoming the leak.
+
+Who writes: the ADR-015 refresher's ``on_store`` hook (every successful
+scrape, on the BACKGROUND refit path — capture never extends the
+request critical path) and the cluster-sync loop (one row per snapshot
+generation). Who reads: the ``/tpu/trends`` page, the forecaster
+(:meth:`HistoryStore.utilization_history` — real history instead of a
+synthetic window once one training window has accumulated), ``/healthz``
+(:meth:`snapshot`), ``/metricsz`` (module gauges below), and the flight
+recorder (:meth:`counters` — monotone ints, no locks, the r10-review
+rule).
+
+Clock discipline (ADR-013): stamps are INJECTED monotonic readings;
+retention and window math never touch the wall clock. Wall time enters
+only where a caller hands one in (``utilization_history(clock=...)``
+maps stamps onto epoch seconds for the Prometheus-shaped output).
+"""
+
+from __future__ import annotations
+
+import array
+import threading
+import time
+import weakref
+from typing import Any, Callable, Iterable
+
+from ..obs.metrics import registry as _metrics_registry
+
+#: Points each shard retains. 288 points at a 60 s scrape cadence is
+#: 4.8 h — roughly the default retention window; a faster cadence
+#: trades span for resolution inside the same fixed memory.
+SHARD_CAPACITY = 288
+#: Oldest age served by windowed reads (6 h — the trend question the
+#: ISSUE names). Points older than this still sit in the ring until
+#: overwritten; reads filter them out.
+RETENTION_S = 6 * 3600.0
+#: Shard-map bound: 1024 nodes x 4 chips x 2 per-chip metrics plus the
+#: fleet/sync/slo aggregate series fits with headroom. Past it, the
+#: least-recently-appended shard is evicted (counted, never silent).
+MAX_SHARDS = 8704
+
+# Registry instruments (ADR-013 get-or-create). Counters dual-account
+# with the per-store ints (same transition writes both) — the registry
+# is the fleet view, the instance ints are the /healthz + test view.
+_POINTS_TOTAL = _metrics_registry.counter(
+    "headlamp_tpu_history_points_total",
+    "Samples appended to the in-process history tier.",
+)
+_EVICTED_TOTAL = _metrics_registry.counter(
+    "headlamp_tpu_history_evicted_total",
+    "History samples dropped by the memory bound (ring overwrites plus "
+    "points lost with evicted shards).",
+)
+
+
+class _Shard:
+    """One series: fixed-capacity float32 value / float64 monotonic-stamp
+    ring columns. Mutated only under the owning store's lock."""
+
+    __slots__ = ("capacity", "values", "stamps", "size", "head", "last_mono")
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self.values = array.array("f", bytes(4 * capacity))
+        self.stamps = array.array("d", bytes(8 * capacity))
+        self.size = 0
+        self.head = 0  # next write slot
+        self.last_mono = float("-inf")
+
+    def append(self, mono: float, value: float) -> int:
+        """Write one point; returns how many points were overwritten."""
+        evicted = 1 if self.size == self.capacity else 0
+        self.values[self.head] = value
+        self.stamps[self.head] = mono
+        self.head = (self.head + 1) % self.capacity
+        if self.size < self.capacity:
+            self.size += 1
+        self.last_mono = mono
+        return evicted
+
+    def ordered(self) -> tuple[array.array, array.array]:
+        """(stamps, values) oldest→newest, as fresh arrays (two C-level
+        slice copies — no per-point Python loop)."""
+        if self.size < self.capacity:
+            return self.stamps[: self.size], self.values[: self.size]
+        return (
+            self.stamps[self.head:] + self.stamps[: self.head],
+            self.values[self.head:] + self.values[: self.head],
+        )
+
+    def oldest_mono(self) -> float:
+        if self.size == 0:
+            return float("inf")
+        if self.size < self.capacity:
+            return self.stamps[0]
+        return self.stamps[self.head]
+
+    def memory_bytes(self) -> int:
+        return 4 * self.capacity + 8 * self.capacity
+
+
+def _jnp() -> Any | None:
+    """jax.numpy when importable — the store core stays stdlib-only."""
+    try:
+        import jax.numpy as jnp
+
+        return jnp
+    except Exception:  # noqa: BLE001 — jax-less host: lists still serve
+        return None
+
+
+class HistoryStore:
+    """Bounded in-process history tier. Lock-light by construction: one
+    plain lock guards the shard map, taken once per *batch* (a scrape
+    appends every chip row under a single acquisition), and the
+    flight-recorder counter view reads ints without it."""
+
+    def __init__(
+        self,
+        *,
+        shard_capacity: int = SHARD_CAPACITY,
+        retention_s: float = RETENTION_S,
+        max_shards: int = MAX_SHARDS,
+        monotonic: Callable[[], float] | None = None,
+    ) -> None:
+        if shard_capacity < 2:
+            raise ValueError("shard_capacity must be >= 2")
+        self.shard_capacity = shard_capacity
+        self.retention_s = retention_s
+        self.max_shards = max_shards
+        self._monotonic = monotonic or time.monotonic
+        #: Whether locally MEASURED durations (snapshot.fetch_ms) are
+        #: captured. Replay harnesses set this False: the determinism
+        #: contract covers replayed data, and a perf_counter reading
+        #: taken on the replaying host is environment noise that would
+        #: break byte-parity between two runs of the same artifact.
+        self.capture_timings = True
+        self._lock = threading.Lock()
+        self._shards: dict[tuple[str, tuple[str, ...]], _Shard] = {}
+        # Monotone ints (flight-recorder counters view; registry
+        # counters mirror the same transitions).
+        self.points = 0
+        self.points_evicted = 0
+        self.shards_evicted = 0
+        self.scrapes = 0
+        self.syncs = 0
+
+    # -- write path ------------------------------------------------------
+
+    def append(
+        self, metric: str, value: float, *, labels: Iterable[str] = ()
+    ) -> None:
+        self.append_many(((metric, tuple(labels), value),))
+
+    def append_many(
+        self, rows: Iterable[tuple[str, tuple[str, ...], float]]
+    ) -> int:
+        """Append a batch of ``(metric, labels, value)`` rows stamped at
+        one monotonic instant (a scrape is one instant — per-chip rows
+        must land on the same grid point). Returns rows appended."""
+        now = self._monotonic()
+        appended = 0
+        overwritten = 0
+        dropped = 0
+        with self._lock:
+            for metric, labels, value in rows:
+                key = (metric, labels)
+                shard = self._shards.get(key)
+                created = shard is None
+                if created:
+                    shard = self._shards[key] = _Shard(self.shard_capacity)
+                overwritten += shard.append(now, float(value))
+                appended += 1
+                if created:
+                    # Enforce AFTER the first append: the new shard now
+                    # carries a current stamp, so the LRU pick can never
+                    # evict the series being written.
+                    dropped += self._enforce_shard_bound_locked()
+            self.points += appended
+            self.points_evicted += overwritten + dropped
+        if appended:
+            _POINTS_TOTAL.inc(appended)
+        if overwritten + dropped:
+            _EVICTED_TOTAL.inc(overwritten + dropped)
+        return appended
+
+    def _enforce_shard_bound_locked(self) -> int:
+        """Drop least-recently-appended shards past ``max_shards``;
+        returns live points lost. Caller holds the lock."""
+        dropped = 0
+        while len(self._shards) > self.max_shards:
+            victim = min(
+                self._shards, key=lambda k: self._shards[k].last_mono
+            )
+            dropped += self._shards[victim].size
+            del self._shards[victim]
+            self.shards_evicted += 1
+        return dropped
+
+    # -- capture adapters ------------------------------------------------
+
+    def record_scrape(self, snapshot: Any) -> int:
+        """Capture one successful TPU metrics scrape
+        (``TpuMetricsSnapshot``): per-chip utilization/duty-cycle shards
+        plus fleet aggregates, all on one grid stamp. Returns rows
+        appended; any malformed snapshot is worth 0 rows, never an
+        exception (capture must not break serving)."""
+        try:
+            chips = snapshot.chips
+        except AttributeError:
+            return 0
+        rows: list[tuple[str, tuple[str, ...], float]] = []
+        util_sum, util_n = 0.0, 0
+        for chip in chips:
+            chip_key = (str(chip.node), str(chip.accelerator_id))
+            util = chip.tensorcore_utilization
+            if util is not None:
+                rows.append(("chip.tensorcore_utilization", chip_key, util))
+                util_sum += util
+                util_n += 1
+            duty = chip.duty_cycle
+            if duty is not None:
+                rows.append(("chip.duty_cycle", chip_key, duty))
+        rows.append(("fleet.chips_reporting", (), float(len(chips))))
+        if util_n:
+            rows.append(
+                ("fleet.mean_tensorcore_utilization", (), util_sum / util_n)
+            )
+        fetch_ms = getattr(snapshot, "fetch_ms", None)
+        if fetch_ms is not None and self.capture_timings:
+            rows.append(("fleet.scrape_ms", (), float(fetch_ms)))
+        appended = self.append_many(rows)
+        self.scrapes += 1
+        return appended
+
+    def record_sync(
+        self, *, generation: int, nodes: int, errors: int = 0
+    ) -> None:
+        """Capture one cluster-sync snapshot generation."""
+        self.append_many(
+            (
+                ("sync.generation", (), float(generation)),
+                ("sync.nodes", (), float(nodes)),
+                ("sync.errors", (), float(errors)),
+            )
+        )
+        self.syncs += 1
+
+    # -- read paths ------------------------------------------------------
+
+    def series(
+        self,
+        metric: str,
+        labels: Iterable[str] = (),
+        *,
+        window_s: float | None = None,
+    ) -> tuple[list[float], list[float]]:
+        """(ages_s, values) oldest→newest for one series, windowed to
+        ``window_s`` (default: full retention). Ages are seconds before
+        "now" on the injected monotonic — display layers render them
+        relative ("3m ago"), which no NTP step can corrupt."""
+        now = self._monotonic()
+        cutoff = now - min(
+            self.retention_s, window_s if window_s is not None else self.retention_s
+        )
+        with self._lock:
+            shard = self._shards.get((metric, tuple(labels)))
+            if shard is None:
+                return [], []
+            stamps, values = shard.ordered()
+        ages: list[float] = []
+        vals: list[float] = []
+        for stamp, value in zip(stamps, values):
+            if stamp >= cutoff:
+                ages.append(now - stamp)
+                vals.append(value)
+        return ages, vals
+
+    def window_arrays(
+        self,
+        metric: str,
+        labels: Iterable[str] = (),
+        *,
+        window_s: float | None = None,
+    ) -> tuple[Any, Any]:
+        """(ages, values) as ``jnp`` arrays (float32 values) so
+        analytics/ and models/ consume history without a Python-loop
+        copy; plain lists on a jax-less host."""
+        ages, vals = self.series(metric, labels, window_s=window_s)
+        jnp = _jnp()
+        if jnp is None:
+            return ages, vals
+        return (
+            jnp.asarray(ages, dtype=jnp.float32),
+            jnp.asarray(vals, dtype=jnp.float32),
+        )
+
+    def utilization_history(
+        self,
+        *,
+        clock: Callable[[], float],
+        min_points: int,
+        max_chips: int = 256,
+    ) -> Any | None:
+        """The forecaster's input, built from CAPTURED per-chip
+        utilization instead of a live range query: a
+        ``UtilizationHistory`` when at least one chip shard holds
+        ``min_points`` retained points, else None (caller falls back to
+        the live window — the store must fill one training window
+        before it may claim to be the data source). ``clock`` (wall) is
+        used ONLY to stamp the output's display ``end``; alignment runs
+        on the scrape grid itself: every chip row of one scrape shares
+        one monotonic stamp, so "last N points per qualifying shard" IS
+        the aligned grid."""
+        from ..metrics.client import UtilizationHistory
+
+        now = self._monotonic()
+        cutoff = now - self.retention_s
+        picked: list[tuple[tuple[str, str], list[float], list[float]]] = []
+        with self._lock:
+            for (metric, labels), shard in self._shards.items():
+                if metric != "chip.tensorcore_utilization" or len(labels) != 2:
+                    continue
+                if shard.size < min_points:
+                    continue
+                stamps, values = shard.ordered()
+                if stamps[-min_points] < cutoff:
+                    continue  # window would reach past retention
+                picked.append(
+                    (
+                        (labels[0], labels[1]),
+                        stamps[-min_points:].tolist(),
+                        values[-min_points:].tolist(),
+                    )
+                )
+                if len(picked) >= max_chips:
+                    break
+        if not picked:
+            return None
+        picked.sort(key=lambda row: row[0])
+        stamps = picked[0][1]
+        deltas = [b - a for a, b in zip(stamps, stamps[1:])]
+        deltas = [d for d in deltas if d > 0]
+        step_s = max(1, round(sorted(deltas)[len(deltas) // 2])) if deltas else 1
+        return UtilizationHistory(
+            keys=[key for key, _, _ in picked],
+            series=[values for _, _, values in picked],
+            step_s=step_s,
+            end=clock(),
+            resolved_query="history:chip.tensorcore_utilization",
+        )
+
+    def trend_view(
+        self, *, window_s: float, max_series_per_metric: int = 8
+    ) -> dict[str, Any]:
+        """Page-ready view for ``/tpu/trends``: per-metric groups of
+        windowed series with stats, plus the store's own health numbers.
+        Plain data — the page stays a pure function of this dict.
+
+        Two passes, so the page path is O(shards + rendered points),
+        not O(total points): a cheap scan picks each metric's busiest
+        ``max_series_per_metric`` series by NEWEST value (stamps only
+        grow, so a shard has in-window points iff its newest stamp
+        does), then only the winners materialize point lists and stats
+        — at 8k full shards this is the difference between ~10 ms and
+        ~10 s for one render."""
+        window_s = min(max(window_s, 1.0), self.retention_s)
+        now = self._monotonic()
+        cutoff = now - window_s
+        candidates: dict[str, list[tuple[float, tuple[str, ...], _Shard]]] = {}
+        with self._lock:
+            for (metric, labels), shard in self._shards.items():
+                if shard.size == 0 or shard.last_mono < cutoff:
+                    continue
+                newest = shard.values[shard.head - 1]
+                candidates.setdefault(metric, []).append(
+                    (newest, labels, shard)
+                )
+        groups = []
+        for metric in sorted(candidates):
+            rows = candidates[metric]
+            # Busiest series first; the cap keeps a 4096-chip fleet's
+            # trend page a page, not a dump.
+            rows.sort(key=lambda r: (-r[0], r[1]))
+            series = []
+            for _newest, labels, shard in rows[:max_series_per_metric]:
+                with self._lock:
+                    stamps, values = shard.ordered()
+                points = [
+                    (now - stamp, value)
+                    for stamp, value in zip(stamps, values)
+                    if stamp >= cutoff
+                ]
+                if not points:
+                    continue  # evicted between the passes
+                series.append(
+                    {
+                        "label": "/".join(labels) or "fleet",
+                        "points": points,
+                        "stats": self._stats([v for _, v in points]),
+                    }
+                )
+            if series:
+                groups.append(
+                    {
+                        "metric": metric,
+                        "series": series,
+                        "series_total": len(rows),
+                    }
+                )
+        return {
+            "window_s": window_s,
+            "retention_s": self.retention_s,
+            "groups": groups,
+            "store": self.snapshot(),
+        }
+
+    @staticmethod
+    def _stats(values: list[float]) -> dict[str, float]:
+        """min/max/mean/latest/slope for one windowed series — through
+        the analytics helper (jnp-fused at fleet sizes) when available,
+        else the plain-Python fallback it shares."""
+        try:
+            from ..analytics.trends import series_stats
+
+            return series_stats(values)
+        except Exception:  # noqa: BLE001 — stats are an enhancement
+            latest = values[-1] if values else 0.0
+            return {
+                "n": float(len(values)),
+                "latest": latest,
+                "min": min(values) if values else 0.0,
+                "max": max(values) if values else 0.0,
+                "mean": sum(values) / len(values) if values else 0.0,
+                "slope_per_step": 0.0,
+            }
+
+    # -- observability ---------------------------------------------------
+
+    def memory_bytes(self) -> int:
+        with self._lock:
+            return sum(s.memory_bytes() for s in self._shards.values())
+
+    def window_span_s(self) -> float:
+        """Age of the oldest retained point — how far back a trend
+        question can currently be answered."""
+        now = self._monotonic()
+        with self._lock:
+            oldest = min(
+                (s.oldest_mono() for s in self._shards.values() if s.size),
+                default=None,
+            )
+        if oldest is None:
+            return 0.0
+        return min(max(now - oldest, 0.0), self.retention_s)
+
+    def counters(self) -> dict[str, int]:
+        """Monotone ints only, lock-free — the flight recorder's
+        per-request delta view (r10-review rule: no gauges, no locks)."""
+        return {
+            "points": self.points,
+            "points_evicted": self.points_evicted,
+            "shards_evicted": self.shards_evicted,
+            "scrapes": self.scrapes,
+            "syncs": self.syncs,
+        }
+
+    def snapshot(self) -> dict[str, Any]:
+        """/healthz ``runtime.history`` block."""
+        with self._lock:
+            shards = len(self._shards)
+        return {
+            "points": self.points,
+            "points_evicted": self.points_evicted,
+            "shards": shards,
+            "shards_evicted": self.shards_evicted,
+            "scrapes": self.scrapes,
+            "syncs": self.syncs,
+            "memory_bytes": self.memory_bytes(),
+            "window_span_s": round(self.window_span_s(), 3),
+            "retention_s": self.retention_s,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Active-store registry gauges (the ADR-017 weakref pattern: the LATEST
+# store a host wires is the one /metricsz describes; a dropped store
+# must not be kept alive by its own gauges).
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Any | None = None
+
+
+def set_active_store(store: HistoryStore) -> None:
+    global _ACTIVE
+    _ACTIVE = weakref.ref(store)
+
+
+def active_store() -> HistoryStore | None:
+    return _ACTIVE() if _ACTIVE is not None else None
+
+
+def _memory_sample() -> float | None:
+    store = active_store()
+    return float(store.memory_bytes()) if store is not None else None
+
+
+def _span_sample() -> float | None:
+    store = active_store()
+    return float(store.window_span_s()) if store is not None else None
+
+
+_metrics_registry.gauge_fn(
+    "headlamp_tpu_history_memory_bytes",
+    "Bytes held by the history tier's ring columns (bounded by "
+    "shard capacity x max shards; see ADR-018's retention table).",
+    _memory_sample,
+)
+_metrics_registry.gauge_fn(
+    "headlamp_tpu_history_window_span_seconds",
+    "Age of the oldest retained history point — how far back /tpu/trends "
+    "can currently answer.",
+    _span_sample,
+)

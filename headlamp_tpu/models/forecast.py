@@ -174,6 +174,12 @@ class InferenceDispatch(NamedTuple):
     #: never-silent half of the demotion policy (same contract as the
     #: Pallas ``fallback_reason``). None unless a demotion happened.
     warm_demotion_reason: str | None = None
+    #: What the fit trained on (ADR-018 auditability): "live-window"
+    #: for a fresh Prometheus range query, "history" for the captured
+    #: in-process tier. Stamped by the service layer (the fit itself is
+    #: source-blind); defaulted here so every construction site and
+    #: pickled carry stays valid.
+    data_source: str = "live-window"
 
     @property
     def used_pallas(self) -> bool:

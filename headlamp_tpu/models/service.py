@@ -57,6 +57,11 @@ class ForecastView:
     #: Why a warm refinement self-demoted to a cold refit — never-silent
     #: demotion record, mirrored from the InferenceDispatch.
     warm_demotion_reason: str | None = None
+    #: What the fit trained on, mirrored from the dispatch (ADR-018):
+    #: "history" once the in-process tier holds a full training window,
+    #: else "live-window" — the metrics page and /sloz surface it so
+    #: every forecast is auditable back to its data.
+    data_source: str = "live-window"
 
     @property
     def at_risk(self) -> list[ChipForecast]:
@@ -177,6 +182,7 @@ def _summarize(
         fit_mse=fit_mse,
         carried_from_generation=dispatch.carried_from_generation,
         warm_demotion_reason=dispatch.warm_demotion_reason,
+        data_source=dispatch.data_source,
     )
 
 
@@ -187,11 +193,15 @@ def forecast_from_history_incremental(
     state: WarmState | None = None,
     steps: int = 60,
     warm_steps: int = WARM_STEPS,
+    data_source: str = "live-window",
 ) -> tuple[ForecastView, WarmState | None]:
     """Warm-start variant of :func:`forecast_from_history`: refines the
     carried :class:`WarmState` (ADR-015) and returns the new carry with
     the view. The incremental entry already materializes predictions +
-    MSE in one host fetch, so no transfer-funnel round-trip here."""
+    MSE in one host fetch, so no transfer-funnel round-trip here.
+    ``data_source`` names what ``history`` is (ADR-018: "history" for
+    the captured tier, "live-window" for a fresh range query) and is
+    stamped into the dispatch record the view mirrors."""
     import time
 
     import numpy as np
@@ -205,8 +215,10 @@ def forecast_from_history_incremental(
             np.asarray(history.series), cfg,
             state=state, steps=steps, warm_steps=warm_steps,
         )
+        dispatch = dispatch._replace(data_source=data_source)
         if fit_span is not None:
             fit_span.attrs["inference_path"] = dispatch.path
+            fit_span.attrs["data_source"] = data_source
     fit_ms = round((time.perf_counter() - t0) * 1000, 1)
     fit_mse = None if dispatch.fit_mse is None else float(dispatch.fit_mse)
     view = _summarize(history, cfg, np.asarray(preds), dispatch, fit_ms, fit_mse)
@@ -251,11 +263,18 @@ def compute_forecast_incremental(
     *,
     state: WarmState | None = None,
     clock: Callable[[], float] | None = None,
+    history_store: Any = None,
 ) -> tuple[ForecastView | None, WarmState | None]:
     """:func:`compute_forecast` with the ADR-015 warm-start carry:
     returns ``(view, new_state)``; any failure degrades to ``(None,
     state)`` — the carry survives a flaky scrape so the next attempt
-    can still warm-start."""
+    can still warm-start.
+
+    With a ``history_store`` (ADR-018), the captured in-process tier is
+    consulted FIRST: once it holds at least one full training window of
+    aligned per-chip scrapes, the fit trains on real history — no range
+    query at all — and the view's ``data_source`` says so. A thin or
+    absent store falls through to the live range query unchanged."""
     import time as _time
 
     from ..metrics.client import fetch_utilization_history
@@ -263,6 +282,20 @@ def compute_forecast_incremental(
     if metrics is None or not metrics.chips:
         return None, state
     try:
+        cfg = ForecastConfig()
+        if history_store is not None:
+            captured = history_store.utilization_history(
+                clock=clock or _time.time,
+                # length >= window + horizon is the fit's hard floor
+                # (below it the incremental entry serves persistence);
+                # requiring it here keeps "history" meaning "really
+                # trained on history".
+                min_points=cfg.window + cfg.horizon,
+            )
+            if captured is not None:
+                return forecast_from_history_incremental(
+                    captured, cfg, state=state, data_source="history"
+                )
         with _span("forecast.history"):
             history = fetch_utilization_history(
                 transport,

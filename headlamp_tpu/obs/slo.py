@@ -317,6 +317,26 @@ class SLOEngine:
         self._paint_series: deque[float] = deque(maxlen=SELF_FORECAST_SERIES_MAX)
         self._refresher: Any = None
         self._warm_state: Any = None
+        #: ADR-018 seam: a HistoryStore the paint observer mirrors into
+        #: and budget_forecast prefers as its training series (wired by
+        #: the serving host; None — e.g. bare unit tests — keeps the
+        #: in-engine deque as the only source). Weakref, like the
+        #: /metricsz gauge wiring: the process engine outlives any one
+        #: app and must not keep a dropped app's store alive.
+        self._history_store_ref: Any = None
+
+    @property
+    def history_store(self) -> Any:
+        ref = self._history_store_ref
+        return ref() if ref is not None else None
+
+    @history_store.setter
+    def history_store(self, store: Any) -> None:
+        import weakref
+
+        self._history_store_ref = (
+            weakref.ref(store) if store is not None else None
+        )
 
     # -- feeds (hot path: called from instrument observers) ------------
 
@@ -334,6 +354,17 @@ class SLOEngine:
                 self.record(spec.name, value_f <= spec.threshold_s)
                 if spec.self_forecast:
                     self._paint_series.append(value_f)
+                    store = self.history_store
+                    # capture_timings gates MEASURED durations out of
+                    # replay harnesses (ADR-018 determinism contract).
+                    if store is not None and getattr(store, "capture_timings", True):
+                        try:
+                            # Mirror into the history tier (ADR-018):
+                            # /tpu/trends charts the same series the
+                            # budget forecast trains on — auditable.
+                            store.append("slo.paint_latency_s", value_f)
+                        except Exception:  # noqa: BLE001 — observer hot path
+                            pass
 
     def feed_error(self, metric: str, amount: float, labels: Mapping[str, Any]) -> None:
         count = max(int(amount), 1)
@@ -475,10 +506,24 @@ class SLOEngine:
         if spec is None:
             return None
         series = list(self._paint_series)
+        data_source = "live-window"
+        store = self.history_store
+        if store is not None:
+            try:
+                # ADR-018: once the mirrored history shard holds a full
+                # series, train on the retention-windowed captured data
+                # — the same points /tpu/trends charts — and say so.
+                _ages, captured = store.series("slo.paint_latency_s")
+                if len(captured) >= SELF_FORECAST_MIN_POINTS:
+                    series = list(captured)
+                    data_source = "history"
+            except Exception:  # noqa: BLE001 — fall back to the deque
+                pass
         out: dict[str, Any] = {
             "slo": spec.name,
             "points": len(series),
             "window": "1h",
+            "data_source": data_source,
             "projected_exhaustion_windows": None,
         }
         if len(series) < SELF_FORECAST_MIN_POINTS:

@@ -14,6 +14,7 @@ from .pods import pods_page
 from .device_plugins import device_plugins_page
 from .metrics_page import metrics_page
 from .topology_page import topology_page
+from .trends_page import trends_page
 
 __all__ = [
     "overview_page",
@@ -22,4 +23,5 @@ __all__ = [
     "device_plugins_page",
     "metrics_page",
     "topology_page",
+    "trends_page",
 ]

@@ -197,8 +197,9 @@ def forecast_section(view: Any) -> Element:
         h(
             "p",
             {"class_": "hl-hint"},
-            f"Model fit on the last {round(view.window_s / 60)} min of history "
-            f"in {view.fit_ms:g} ms (online MLP, deterministic seed"
+            f"Model fit on the last {round(view.window_s / 60)} min of "
+            + _data_source_label(view)
+            + f" in {view.fit_ms:g} ms (online MLP, deterministic seed"
             + (
                 # :g keeps tiny well-fit MSEs legible (1.2e-06, not
                 # the indistinguishable 0.0000).
@@ -209,6 +210,16 @@ def forecast_section(view: Any) -> Element:
             + f"); inference via {_inference_label(view)}.",
         ),
     )
+
+
+def _data_source_label(view: Any) -> str:
+    """ADR-018 auditability: say what the fit trained on — the captured
+    in-process tier (/tpu/trends' data) or a live Prometheus range
+    query — so an operator can trace any forecast back to its input."""
+    source = getattr(view, "data_source", "live-window")
+    if source == "history":
+        return "captured history"
+    return "live-window history"
 
 
 def _inference_label(view: Any) -> str:

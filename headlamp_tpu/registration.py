@@ -31,6 +31,7 @@ from .pages import (
     overview_page,
     pods_page,
     topology_page,
+    trends_page,
 )
 from .pages.native import native_nodes_page
 from .pages.intel import (
@@ -124,6 +125,7 @@ def register_plugin(registry: Registry | None = None) -> Registry:
         ),
         SidebarEntry("tpu-topology", "Topology", "/tpu/topology", parent=SIDEBAR_ROOT),
         SidebarEntry("tpu-metrics", "Metrics", "/tpu/metrics", parent=SIDEBAR_ROOT),
+        SidebarEntry("tpu-trends", "Trends", "/tpu/trends", parent=SIDEBAR_ROOT),
     ]
     reg.sidebar_entries.extend(entries)
 
@@ -162,6 +164,11 @@ def register_plugin(registry: Registry | None = None) -> Registry:
             Route("/tpu/deviceplugins", "tpu-deviceplugins", device_plugins_page),
             Route("/tpu/topology", "tpu-topology", topology_page, kind="topology"),
             Route("/tpu/metrics", "tpu-metrics", metrics_page, kind="metrics"),
+            # History-tier trend surface (ADR-018): a normal sidebar
+            # page, but its kind dispatch hands it the store's windowed
+            # view instead of a cluster snapshot — like the trace/SLO
+            # pages it must paint mid-incident.
+            Route("/tpu/trends", "tpu-trends", trends_page, kind="trends"),
             Route("/intel", "intel-overview", intel_overview_page),
             Route("/intel/nodes", "intel-nodes", intel_nodes_page, paged=True),
             Route("/intel/pods", "intel-pods", intel_pods_page),

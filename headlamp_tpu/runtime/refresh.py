@@ -127,6 +127,13 @@ class Refresher:
         self.refits = 0
         self.refit_errors = 0
         self.demotions_to_cold = 0
+        # Capture seam (ADR-018): called with (key, value) after every
+        # successful store, outside the map lock. Runs on the refit
+        # thread for background refreshes and on the requesting thread
+        # only for cold foreground fills, so a hook costs the
+        # steady-state request path nothing. Hook failures are absorbed
+        # — history capture must never poison the cache it observes.
+        self.on_store: Callable[[Hashable, Any], None] | None = None
 
     # -- read paths ------------------------------------------------------
 
@@ -254,6 +261,12 @@ class Refresher:
                 )
                 del self._entries[oldest]
         _REFITS.inc(refresher=self.name)
+        hook = self.on_store
+        if hook is not None:
+            try:
+                hook(key, value)
+            except Exception:  # noqa: BLE001 — capture never breaks caching
+                pass
 
     def _foreground_fill(
         self,

@@ -39,6 +39,7 @@ from urllib.parse import parse_qs, urlparse
 
 from ..context.accelerator_context import AcceleratorDataContext, ClusterSnapshot
 from ..gateway.shed import degraded_active
+from ..history import HistoryStore, set_active_store
 from ..metrics.client import fetch_tpu_metrics
 from ..obs import slo as slo_mod
 from ..obs.flight import flight_recorder, wide_event
@@ -114,6 +115,7 @@ def _runtime_health(
     transport: Any = None,
     refreshers: tuple[Refresher, ...] = (),
     gateway: Any = None,
+    history: Any = None,
 ) -> dict[str, Any]:
     """Transfer-funnel, device-cache, transport-pool, and refresher
     counters for /healthz: how many blocking device_gets the process
@@ -143,6 +145,10 @@ def _runtime_health(
             # renders, shed/coalesce counters, and the burn states the
             # shed policy last acted on.
             out["gateway"] = gateway.snapshot()
+        if history is not None:
+            # History-tier view (ADR-018): points/evictions/memory and
+            # how far back /tpu/trends can currently answer.
+            out["history"] = history.snapshot()
         # Burn-rate states per declared SLO (ADR-016): the one-line
         # answer a probe reader wants before opening /sloz.
         out["slo"] = slo_mod.engine().health_block()
@@ -157,6 +163,7 @@ def _runtime_counters(
     transport: Any = None,
     refreshers: tuple[Refresher, ...] = (),
     gateway: Any = None,
+    history: Any = None,
 ) -> dict[str, float]:
     """Flat dotted monotone-counter snapshot for the flight recorder's
     before/after delta. Deliberately NOT _runtime_health: this runs
@@ -188,6 +195,9 @@ def _runtime_counters(
     if gateway is not None:
         for key, value in gateway.counters().items():
             out[f"gateway.{key}"] = value
+    if history is not None:
+        for key, value in history.counters().items():
+            out[f"history.{key}"] = value
     return out
 
 
@@ -273,6 +283,21 @@ class DashboardApp:
             grace_s=self.FORECAST_GRACE_S,
             monotonic=monotonic,
         )
+        #: History tier (ADR-018): per-app so tests and replay runs
+        #: never share series; the module-level active-store weakref
+        #: only feeds the /metricsz gauges (latest app wins).
+        self.history = HistoryStore(monotonic=monotonic)
+        set_active_store(self.history)
+        # The process SLO engine mirrors its paint-latency series into
+        # (and trains its budget forecast from) this app's store —
+        # weakref inside, latest app wins, same as the gauges above.
+        slo_mod.engine().history_store = self.history
+        # Capture seam: every successful scrape the metrics refresher
+        # stores — background refits AND cold foreground fills — lands
+        # in the history store. The hook runs after _store releases the
+        # refresher lock, and in steady state on the refit worker, so
+        # capture never extends the request critical path.
+        self._metrics_refresher.on_store = self._capture_metrics_store
         #: Warm-start carries per forecast key (ADR-015): fitted params
         #: + optimizer state handed back to the next (re)fit for the
         #: same fleet. Guarded by its own lock — entries are written
@@ -461,12 +486,36 @@ class DashboardApp:
         except Exception:  # noqa: BLE001 — warm is an optimization only
             pass
 
+    def _capture_metrics_store(self, key: Any, value: Any) -> None:
+        """Refresher on_store hook: record each successfully fetched
+        metrics snapshot into the history tier. A cached failure (None —
+        Prometheus down) appends nothing: gaps in history ARE the record
+        of the outage."""
+        if value is not None and getattr(value, "chips", None):
+            self.history.record_scrape(value)
+
     def _record_sync(self, snap: Any) -> None:
-        """Track consecutive failing syncs for /healthz. A sync counts as
-        failed when it raised (snap is None) or when its snapshot carries
-        reactive-track errors — transport failures never raise out of
-        ``ctx.sync()`` (they degrade into ``snapshot.errors``), so the
-        error streams ARE the failure signal."""
+        """Track consecutive failing syncs for /healthz, and capture the
+        generation/node-count/error-count of every completed sync into
+        the history tier (ADR-018) — both capture points (this and the
+        metrics refresher hook) run on sync/refit threads, off the
+        request path. A sync counts as failed when it raised (snap is
+        None) or when its snapshot carries reactive-track errors —
+        transport failures never raise out of ``ctx.sync()`` (they
+        degrade into ``snapshot.errors``), so the error streams ARE the
+        failure signal."""
+        if snap is not None:
+            generation = 0
+            for state in snap.providers.values():
+                version = getattr(state.view, "version", None)
+                if version:
+                    generation = int(version)
+                    break
+            self.history.record_sync(
+                generation=generation,
+                nodes=len(snap.all_nodes or []),
+                errors=len(snap.errors),
+            )
         if snap is not None and not snap.errors:
             self._sync_failures = 0
         else:
@@ -681,7 +730,14 @@ class DashboardApp:
         with self._warm_lock:
             state = self._warm_forecast_states.get(key)
         view, new_state = compute_forecast_incremental(
-            self._transport, metrics, state=state, clock=self._clock
+            self._transport,
+            metrics,
+            state=state,
+            clock=self._clock,
+            # ADR-018: once the captured tier holds a full training
+            # window, fits train on real history (and say so in the
+            # view's data_source) instead of the live range query.
+            history_store=self.history,
         )
         with self._warm_lock:
             if new_state is not None:
@@ -787,6 +843,7 @@ class DashboardApp:
                 self._transport,
                 (self._metrics_refresher, self._forecast_refresher),
                 gateway=self.gateway,
+                history=self.history,
             )
         with trace_request(path, enabled=recorded, wall=self._clock) as trace:
             try:
@@ -839,6 +896,7 @@ class DashboardApp:
                         self._transport,
                         (self._metrics_refresher, self._forecast_refresher),
                         gateway=self.gateway,
+                        history=self.history,
                     )
                     violations = slo_mod.engine().violations(
                         route_label, duration_s, status
@@ -890,6 +948,7 @@ class DashboardApp:
                             self._transport,
                             (self._metrics_refresher, self._forecast_refresher),
                             gateway=self.gateway,
+                            history=self.history,
                         ),
                     }
                 )
@@ -926,6 +985,7 @@ class DashboardApp:
                         self._transport,
                         (self._metrics_refresher, self._forecast_refresher),
                         gateway=self.gateway,
+                        history=self.history,
                     ),
                 }
             )
@@ -1094,6 +1154,19 @@ class DashboardApp:
                 # page: renders the engine's report, never the cluster
                 # snapshot, so it paints even mid-incident.
                 el = route.component(slo_mod.engine().report())
+            elif route.kind == "trends":
+                # Pure function of the store's windowed view (ADR-018):
+                # no snapshot, no sync — trends must paint even when
+                # the cluster sync is the thing being investigated.
+                # ?window= selects the lookback; the store clamps it to
+                # [1 s, retention], so a hostile query can only change
+                # how much retained data renders, never how much exists.
+                params = parse_qs(parsed.query)
+                try:
+                    window_s = float(params.get("window", ["3600"])[0])
+                except ValueError:
+                    window_s = 3600.0
+                el = route.component(self.history.trend_view(window_s=window_s))
             else:
                 el = route.component(snap, now=now, **paging)
         with span("render.html"):

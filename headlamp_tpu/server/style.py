@@ -131,4 +131,26 @@ main { max-width:1100px; margin:0 auto; padding:16px; }
 .hl-slo-exemplars a { margin-right:8px;
                       font-family:ui-monospace,monospace; }
 .hl-slo-forecast { font-style:italic; }
+/* Trend strips (/tpu/trends, ADR-018): fixed-bucket bar strips per
+   captured series — newest at the right edge, gaps rendered as faint
+   cells so an outage reads as an outage. */
+.hl-trend-windows { display:flex; align-items:baseline; gap:8px;
+                    margin-bottom:10px; font-size:13px;
+                    color:var(--muted); }
+.hl-trend-window { padding:2px 8px; border:1px solid var(--line);
+                   border-radius:4px; text-decoration:none; }
+.hl-trend-window.active { background:#1565c0; color:#fff;
+                          border-color:#1565c0; }
+.hl-trend-series { margin:8px 0 14px; }
+.hl-trend-series-head { display:flex; align-items:baseline; gap:10px;
+                        margin-bottom:4px; }
+.hl-trend-series-head .hl-hint { margin-left:auto; font-size:12px;
+                                 font-variant-numeric:tabular-nums; }
+.hl-trend-strip { display:flex; align-items:flex-end; gap:1px;
+                  height:36px; background:var(--bg);
+                  border:1px solid var(--line); border-radius:4px;
+                  padding:2px; }
+.hl-trend-cell { flex:1; background:#1565c0; opacity:0.85;
+                 border-radius:1px; min-height:1px; }
+.hl-trend-gap { height:100%; background:var(--line); opacity:0.25; }
 """

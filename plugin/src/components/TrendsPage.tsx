@@ -1,0 +1,160 @@
+/**
+ * TrendsPage — in-browser history tier over the TPU telemetry scrape.
+ *
+ * Headlamp-native rendering of `headlamp_tpu/pages/trends_page.py`
+ * (ADR-018): the dashboard server keeps its bounded columnar history
+ * store in-process; this page keeps the browser-side analogue — a
+ * fixed-capacity ring of per-scrape fleet aggregates, filled by
+ * re-scraping on an interval while the page is mounted — and draws the
+ * same strip-chart trend surface. Bounded exactly like the server tier:
+ * the ring never grows past its capacity, so a tab left open for a
+ * week holds the same memory as one opened a minute ago.
+ */
+
+import { ApiProxy } from '@kinvolk/headlamp-plugin/lib';
+import {
+  Loader,
+  NameValueTable,
+  SectionBox,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React, { useEffect, useRef, useState } from 'react';
+import { fetchTpuMetricsCached, formatPercent } from '../api/metrics';
+import { PageHeader } from './common';
+
+/** Scrape cadence while the page is mounted. */
+const SCRAPE_INTERVAL_MS = 15000;
+/** Ring capacity — mirrors the server store's per-shard bound. */
+const RING_CAPACITY = 288;
+
+interface TrendPoint {
+  at: number; // Date.now() of the scrape, for the age axis
+  meanUtilization: number | null;
+  chipsReporting: number;
+  scrapeMs: number;
+}
+
+function Strip({
+  points,
+  value,
+}: {
+  points: TrendPoint[];
+  value: (p: TrendPoint) => number | null;
+}) {
+  const present = points.map(value).filter((v): v is number => v !== null);
+  if (!present.length) return <p>No samples yet.</p>;
+  const lo = Math.min(...present);
+  const hi = Math.max(...present);
+  const scale = hi - lo;
+  return (
+    <div
+      style={{
+        display: 'flex',
+        alignItems: 'flex-end',
+        gap: 1,
+        height: 36,
+        padding: 2,
+        border: '1px solid rgba(128,128,128,0.4)',
+        borderRadius: 4,
+      }}
+    >
+      {points.map((p, i) => {
+        const v = value(p);
+        const frac = v === null ? 0 : scale > 0 ? (v - lo) / scale : 0.5;
+        return (
+          <span
+            key={i}
+            title={v === null ? 'no sample' : String(v)}
+            style={{
+              flex: 1,
+              minHeight: 1,
+              height: `${8 + frac * 92}%`,
+              borderRadius: 1,
+              background: v === null ? 'rgba(128,128,128,0.25)' : '#1565c0',
+            }}
+          />
+        );
+      })}
+    </div>
+  );
+}
+
+export default function TrendsPage() {
+  const [points, setPoints] = useState<TrendPoint[]>([]);
+  const [scrapes, setScrapes] = useState(0);
+  const ring = useRef<TrendPoint[]>([]);
+
+  useEffect(() => {
+    let cancelled = false;
+    async function scrape() {
+      const snap = await fetchTpuMetricsCached(path => ApiProxy.request(path));
+      if (cancelled || !snap) return;
+      const utils = snap.chips
+        .map(c => c.tensorcore_utilization)
+        .filter((v): v is number => v !== null);
+      ring.current.push({
+        at: Date.now(),
+        meanUtilization: utils.length
+          ? utils.reduce((a, b) => a + b, 0) / utils.length
+          : null,
+        chipsReporting: snap.chips.length,
+        scrapeMs: snap.fetchMs,
+      });
+      if (ring.current.length > RING_CAPACITY) {
+        ring.current = ring.current.slice(-RING_CAPACITY);
+      }
+      setPoints([...ring.current]);
+      setScrapes(n => n + 1);
+    }
+    void scrape();
+    const timer = setInterval(() => void scrape(), SCRAPE_INTERVAL_MS);
+    return () => {
+      cancelled = true;
+      clearInterval(timer);
+    };
+  }, []);
+
+  if (!points.length) {
+    return <Loader title="Capturing first trend point" />;
+  }
+
+  const spanMin = (Date.now() - points[0].at) / 60000;
+  const latest = points[points.length - 1];
+  return (
+    <>
+      <PageHeader title="TPU Trends" />
+      <SectionBox title="Mean TensorCore utilization">
+        <Strip points={points} value={p => p.meanUtilization} />
+        <p>
+          {latest.meanUtilization !== null
+            ? `Latest ${formatPercent(latest.meanUtilization)}`
+            : 'No utilization samples in the latest scrape'}{' '}
+          — newest at the right edge.
+        </p>
+      </SectionBox>
+      <SectionBox title="Chips reporting">
+        <Strip points={points} value={p => p.chipsReporting} />
+      </SectionBox>
+      <SectionBox title="Scrape latency (ms)">
+        <Strip points={points} value={p => p.scrapeMs} />
+      </SectionBox>
+      <SectionBox title="History">
+        <NameValueTable
+          rows={[
+            { name: 'Points captured', value: points.length },
+            { name: 'Scrapes', value: scrapes },
+            { name: 'Span', value: `${spanMin.toFixed(1)} min` },
+            {
+              name: 'Capacity',
+              value: `${RING_CAPACITY} points (~${((RING_CAPACITY * SCRAPE_INTERVAL_MS) / 3600000).toFixed(1)} h at this cadence)`,
+            },
+          ]}
+        />
+        <p>
+          The dashboard server keeps the authoritative bounded history store (hours of
+          retention, replayable recordings); this page keeps a browser-side ring filled
+          while it is open.
+        </p>
+      </SectionBox>
+    </>
+  );
+}

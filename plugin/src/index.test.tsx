@@ -2,7 +2,7 @@
  * Registration-surface test: importing the plugin entry must register
  * BOTH provider surfaces the Python registry declares
  * (`headlamp_tpu/registration.py`, checked structurally by
- * `tests/test_ts_parity.py`): 7 TPU + 6 Intel sidebar entries, 6 TPU +
+ * `tests/test_ts_parity.py`): 8 TPU + 6 Intel sidebar entries, 7 TPU +
  * 5 Intel routes, 4 kind-guarded detail sections, and the
  * 'headlamp-nodes' column processor carrying both providers' columns.
  */
@@ -28,6 +28,7 @@ describe('plugin registration surface', () => {
       ['tpu-deviceplugins', '/tpu/deviceplugins'],
       ['tpu-topology', '/tpu/topology'],
       ['tpu-metrics', '/tpu/metrics'],
+      ['tpu-trends', '/tpu/trends'],
       ['intel', '/intel'],
       ['intel-overview', '/intel'],
       ['intel-deviceplugins', '/intel/deviceplugins'],
@@ -37,11 +38,11 @@ describe('plugin registration surface', () => {
     ]);
     // TPU registers first: first-class provider, Intel compatibility.
     expect(captured.sidebarEntries[0].parent).toBeNull();
-    expect(captured.sidebarEntries[7].parent).toBeNull();
-    for (const child of captured.sidebarEntries.slice(1, 7)) {
+    expect(captured.sidebarEntries[8].parent).toBeNull();
+    for (const child of captured.sidebarEntries.slice(1, 8)) {
       expect(child.parent).toBe('tpu');
     }
-    for (const child of captured.sidebarEntries.slice(8)) {
+    for (const child of captured.sidebarEntries.slice(9)) {
       expect(child.parent).toBe('intel');
     }
   });
@@ -54,6 +55,7 @@ describe('plugin registration surface', () => {
       '/tpu/deviceplugins',
       '/tpu/topology',
       '/tpu/metrics',
+      '/tpu/trends',
       '/intel',
       '/intel/deviceplugins',
       '/intel/nodes',

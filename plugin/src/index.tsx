@@ -49,6 +49,7 @@ import OverviewPage from './components/OverviewPage';
 import PodDetailSection from './components/PodDetailSection';
 import PodsPage from './components/PodsPage';
 import TopologyPage from './components/TopologyPage';
+import TrendsPage from './components/TrendsPage';
 
 // ---------------------------------------------------------------------------
 // Sidebar entries (registration.py:116-127)
@@ -108,6 +109,14 @@ registerSidebarEntry({
   label: 'Metrics',
   url: '/tpu/metrics',
   icon: 'mdi:chart-line',
+});
+
+registerSidebarEntry({
+  parent: 'tpu',
+  name: 'tpu-trends',
+  label: 'Trends',
+  url: '/tpu/trends',
+  icon: 'mdi:chart-timeline-variant',
 });
 
 // ---------------------------------------------------------------------------
@@ -182,6 +191,16 @@ registerRoute({
   // MetricsPage fetches through ApiProxy directly (the reference's
   // MetricsPage also runs its own fetch cycle); no provider needed.
   component: () => <MetricsPage />,
+});
+
+registerRoute({
+  path: '/tpu/trends',
+  sidebar: 'tpu-trends',
+  name: 'tpu-trends',
+  exact: true,
+  // TrendsPage runs its own scrape cycle into a browser-side ring
+  // (the client analogue of the server's ADR-018 history store).
+  component: () => <TrendsPage />,
 });
 
 // ---------------------------------------------------------------------------

@@ -1,0 +1,591 @@
+"""History tier + record-and-replay (ADR-018, ISSUE r12 acceptance).
+
+Four layers, matching the subsystem's seams:
+
+1. Store core: fixed-capacity ring shards (overwrites counted), the
+   shard-map bound (LRU eviction, counted), batch-stamped appends, the
+   retention/window read paths, and the monotone counters/snapshot
+   views the flight recorder and /healthz consume.
+2. Capture through a real app: one /tpu/metrics request must land a
+   scrape in the store via the refresher's ``on_store`` hook, every
+   sync must land a generation row, and the /tpu/trends, /healthz,
+   /metricsz surfaces must all tell the same story.
+3. Forecast honesty: the forecaster consults the captured tier FIRST
+   once it holds a full training window — and the dispatched view says
+   ``data_source="history"`` — without touching the live transport.
+4. Record-and-replay: artifact round-trip (responses AND errors), the
+   version gate, sequential/timed/rate pacing, and the headline parity
+   pin — two ``--replay`` rounds of one recording produce a
+   byte-identical /tpu/trends page and identical bench metric values.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from headlamp_tpu.history import (
+    RECORDING_VERSION,
+    HistoryStore,
+    Recorder,
+    RecordingTransport,
+    ReplaySource,
+    active_store,
+    load_recording,
+    set_active_store,
+)
+from headlamp_tpu.history.record import _parse_recording
+from headlamp_tpu.metrics.client import TpuChipMetrics, TpuMetricsSnapshot
+from headlamp_tpu.server import DashboardApp, make_demo_transport
+from headlamp_tpu.transport import ApiError
+
+
+class Clock:
+    """Scripted monotonic: advances only when told."""
+
+    def __init__(self, start: float = 100.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+def make_store(**kwargs) -> tuple[HistoryStore, Clock]:
+    clk = Clock()
+    kwargs.setdefault("monotonic", clk)
+    return HistoryStore(**kwargs), clk
+
+
+def snapshot_of(chips: list[tuple[str, str, float]], fetch_ms: float = 2.0):
+    return TpuMetricsSnapshot(
+        namespace="ns",
+        service="prom",
+        chips=[
+            TpuChipMetrics(
+                node=node,
+                accelerator_id=acc,
+                tensorcore_utilization=util,
+                duty_cycle=0.9,
+            )
+            for node, acc, util in chips
+        ],
+        fetched_at=0.0,
+        fetch_ms=fetch_ms,
+    )
+
+
+# ---------------------------------------------------------------------------
+# 1. Store core
+# ---------------------------------------------------------------------------
+
+class TestStoreCore:
+    def test_ring_overwrites_oldest_and_counts_eviction(self):
+        store, clk = make_store(shard_capacity=4)
+        for i in range(6):
+            store.append("m", float(i))
+            clk.advance(1.0)
+        ages, values = store.series("m")
+        assert values == [2.0, 3.0, 4.0, 5.0]  # oldest two overwritten
+        assert ages == sorted(ages, reverse=True)  # oldest→newest
+        assert store.points == 6
+        assert store.points_evicted == 2
+
+    def test_shard_bound_evicts_least_recently_appended(self):
+        store, clk = make_store(shard_capacity=8, max_shards=2)
+        store.append("a", 1.0)
+        clk.advance(1.0)
+        store.append("b", 2.0)
+        clk.advance(1.0)
+        store.append("c", 3.0)  # third shard: "a" (stalest) must go
+        assert store.series("a") == ([], [])
+        assert store.series("b")[1] == [2.0]
+        assert store.series("c")[1] == [3.0]
+        assert store.shards_evicted == 1
+        # The live point lost with shard "a" counts as evicted too.
+        assert store.points_evicted == 1
+
+    def test_labels_split_series(self):
+        store, _ = make_store()
+        store.append("m", 1.0, labels=("node-a", "0"))
+        store.append("m", 2.0, labels=("node-b", "0"))
+        assert store.series("m", ("node-a", "0"))[1] == [1.0]
+        assert store.series("m", ("node-b", "0"))[1] == [2.0]
+
+    def test_append_many_shares_one_grid_stamp(self):
+        # A scrape is ONE instant: per-chip rows of the same batch must
+        # land on the same grid point (utilization_history depends on it).
+        store, clk = make_store()
+        store.append_many(
+            (("m", ("a",), 1.0), ("m", ("b",), 2.0))
+        )
+        clk.advance(5.0)
+        ages_a, _ = store.series("m", ("a",))
+        ages_b, _ = store.series("m", ("b",))
+        assert ages_a == ages_b == [5.0]
+
+    def test_window_filters_and_retention_caps(self):
+        store, clk = make_store(retention_s=100.0)
+        store.append("m", 1.0)
+        clk.advance(60.0)
+        store.append("m", 2.0)
+        clk.advance(30.0)
+        # Full retention sees both; a 40 s window only the newer point.
+        assert store.series("m")[1] == [1.0, 2.0]
+        assert store.series("m", window_s=40.0)[1] == [2.0]
+        clk.advance(30.0)  # first point now 120 s old — past retention
+        assert store.series("m")[1] == [2.0]
+
+    def test_window_arrays_are_jnp(self):
+        jnp = pytest.importorskip("jax.numpy")
+        store, clk = make_store()
+        store.append("m", 1.5)
+        clk.advance(1.0)
+        ages, values = store.window_arrays("m")
+        assert values.dtype == jnp.float32
+        assert float(values[0]) == 1.5
+        assert float(ages[0]) == 1.0
+
+    def test_record_scrape_rows_and_malformed_absorbed(self):
+        store, _ = make_store()
+        snap = snapshot_of([("n1", "0", 0.5), ("n1", "1", 0.7)])
+        rows = store.record_scrape(snap)
+        # 2 util + 2 duty + chips_reporting + mean + scrape_ms
+        assert rows == 7
+        assert store.scrapes == 1
+        assert store.series("fleet.mean_tensorcore_utilization")[1] == [
+            pytest.approx(0.6)
+        ]
+        assert store.record_scrape(object()) == 0  # malformed: absorbed
+        assert store.scrapes == 1
+
+    def test_capture_timings_false_drops_measured_durations(self):
+        # ADR-018 determinism contract: replay harnesses exclude
+        # perf_counter-derived values from capture.
+        store, _ = make_store()
+        store.capture_timings = False
+        store.record_scrape(snapshot_of([("n1", "0", 0.5)], fetch_ms=3.0))
+        assert store.series("fleet.scrape_ms") == ([], [])
+        assert store.series("fleet.chips_reporting")[1] == [1.0]
+
+    def test_record_sync_rows(self):
+        store, _ = make_store()
+        store.record_sync(generation=7, nodes=4, errors=1)
+        assert store.series("sync.generation")[1] == [7.0]
+        assert store.series("sync.nodes")[1] == [4.0]
+        assert store.series("sync.errors")[1] == [1.0]
+        assert store.syncs == 1
+
+    def test_counters_monotone_and_snapshot_shape(self):
+        store, clk = make_store(shard_capacity=2)
+        seen = [dict(store.counters())]
+        for i in range(4):
+            store.append("m", float(i))
+            clk.advance(1.0)
+            seen.append(dict(store.counters()))
+        for before, after in zip(seen, seen[1:]):
+            assert all(after[k] >= before[k] for k in before)
+        snap = store.snapshot()
+        assert set(snap) == {
+            "points",
+            "points_evicted",
+            "shards",
+            "shards_evicted",
+            "scrapes",
+            "syncs",
+            "memory_bytes",
+            "window_span_s",
+            "retention_s",
+        }
+        assert snap["memory_bytes"] == store.memory_bytes() > 0
+
+    def test_window_span_tracks_oldest_retained_point(self):
+        store, clk = make_store(retention_s=50.0)
+        assert store.window_span_s() == 0.0
+        store.append("m", 1.0)
+        clk.advance(30.0)
+        assert store.window_span_s() == pytest.approx(30.0)
+        clk.advance(100.0)  # older than retention: span clamps
+        assert store.window_span_s() == pytest.approx(50.0)
+
+    def test_active_store_is_weak(self):
+        store, _ = make_store()
+        set_active_store(store)
+        assert active_store() is store
+        del store
+        import gc
+
+        gc.collect()
+        assert active_store() is None
+
+    def test_trend_view_groups_caps_and_store_block(self):
+        store, clk = make_store()
+        for i in range(12):
+            store.append("m", float(i), labels=(f"n{i:02d}", "0"))
+        clk.advance(1.0)
+        view = store.trend_view(window_s=3600.0, max_series_per_metric=8)
+        assert view["window_s"] == 3600.0
+        (group,) = view["groups"]
+        assert group["metric"] == "m"
+        assert len(group["series"]) == 8
+        assert group["series_total"] == 12
+        # Busiest (highest latest) first.
+        latests = [row["stats"]["latest"] for row in group["series"]]
+        assert latests == sorted(latests, reverse=True)
+        assert view["store"]["points"] == 12
+
+    def test_trend_view_clamps_window_to_retention(self):
+        store, _ = make_store(retention_s=600.0)
+        assert store.trend_view(window_s=1e9)["window_s"] == 600.0
+
+
+class TestUtilizationHistory:
+    def fill(self, store: HistoryStore, clk: Clock, scrapes: int):
+        snap = snapshot_of([("n1", "0", 0.5), ("n1", "1", 0.6)])
+        for _ in range(scrapes):
+            store.record_scrape(snap)
+            clk.advance(60.0)
+
+    def test_none_until_a_full_training_window(self):
+        store, clk = make_store()
+        self.fill(store, clk, 10)
+        assert (
+            store.utilization_history(clock=lambda: 0.0, min_points=40) is None
+        )
+
+    def test_aligned_history_once_filled(self):
+        store, clk = make_store()
+        self.fill(store, clk, 45)
+        hist = store.utilization_history(clock=lambda: 1234.5, min_points=40)
+        assert hist is not None
+        assert hist.keys == [("n1", "0"), ("n1", "1")]
+        assert all(len(row) == 40 for row in hist.series)
+        assert hist.step_s == 60
+        assert hist.end == 1234.5
+        assert hist.resolved_query == "history:chip.tensorcore_utilization"
+
+
+# ---------------------------------------------------------------------------
+# 2. Capture through a real app + the three surfaces
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def captured_app() -> DashboardApp:
+    """One demo app after real traffic: capture below is the refresher
+    hook + sync loop doing their jobs, not a test reaching in."""
+    app = DashboardApp(make_demo_transport("v5p32"), min_sync_interval_s=0.0)
+    app.handle("/tpu/metrics")
+    app.handle("/tpu")
+    return app
+
+
+class TestCaptureThroughApp:
+    def test_scrape_and_sync_landed(self, captured_app):
+        store = captured_app.history
+        assert store.scrapes >= 1
+        assert store.syncs >= 2  # one per handled request (min_sync 0)
+        assert store.series("fleet.chips_reporting")[1]
+        assert store.series("sync.generation")[1]
+        # Per-chip shards carry (node, accelerator_id) labels.
+        assert any(
+            metric == "chip.tensorcore_utilization" and len(labels) == 2
+            for metric, labels in store._shards
+        )
+
+    def test_healthz_carries_history_block(self, captured_app):
+        status, _, body = captured_app.handle("/healthz")
+        assert status == 200
+        block = json.loads(body)["runtime"]["history"]
+        assert block["scrapes"] >= 1
+        assert block["points"] > 0
+        assert block["memory_bytes"] > 0
+
+    def test_trends_page_serves_captured_series(self, captured_app):
+        status, ctype, body = captured_app.handle("/tpu/trends")
+        assert status == 200 and "html" in ctype
+        assert "hl-trend-strip" in body  # at least one chart rendered
+        assert "History store" in body
+        assert "fleet.mean_tensorcore_utilization" in body
+
+    def test_trends_window_param(self, captured_app):
+        status, _, body = captured_app.handle("/tpu/trends?window=900")
+        assert status == 200
+        # The 15m choice renders as the active window link.
+        assert "hl-trend-window active" in body and "15m" in body
+
+    def test_metricsz_exports_history_families(self, captured_app):
+        _, _, body = captured_app.handle("/metricsz")
+        assert "headlamp_tpu_history_points_total" in body
+        assert "headlamp_tpu_history_evicted_total" in body
+
+    def test_flight_counters_include_history(self, captured_app):
+        from headlamp_tpu.server.app import _runtime_counters
+
+        counters = _runtime_counters(history=captured_app.history)
+        assert counters["history.points"] > 0
+        assert counters["history.scrapes"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# 3. Forecast trains on captured history once the window fills
+# ---------------------------------------------------------------------------
+
+class _BoomTransport:
+    def request(self, path, timeout_s=2.0):
+        raise AssertionError(f"live transport touched during history fit: {path}")
+
+
+class TestForecastFromHistory:
+    def test_history_source_skips_live_fetch(self):
+        pytest.importorskip("jax")
+        from headlamp_tpu.models.service import compute_forecast_incremental
+
+        store, clk = make_store()
+        snap = snapshot_of([("n1", "0", 0.5), ("n1", "1", 0.7)])
+        for _ in range(45):  # > window(32) + horizon(8)
+            store.record_scrape(snap)
+            clk.advance(60.0)
+        view, state = compute_forecast_incremental(
+            _BoomTransport(),
+            snap,
+            clock=lambda: 1000.0,
+            history_store=store,
+        )
+        assert view is not None
+        assert view.data_source == "history"
+        assert state is not None
+
+    def test_thin_store_falls_through_to_live_window(self):
+        pytest.importorskip("jax")
+        from headlamp_tpu.models.service import compute_forecast_incremental
+
+        store, clk = make_store()
+        store.record_scrape(snapshot_of([("n1", "0", 0.5)]))
+        clk.advance(60.0)
+        app = DashboardApp(make_demo_transport("v5p32"), min_sync_interval_s=0.0)
+        status, _, body = app.handle("/tpu/metrics")
+        assert status == 200
+        # The page says which source the fit used — live-window here.
+        assert "live-window history" in body
+
+
+# ---------------------------------------------------------------------------
+# 4. Record-and-replay
+# ---------------------------------------------------------------------------
+
+def make_recording_text(exchanges_fn) -> str:
+    """Recorder → JSONL text, driving ``exchanges_fn(transport)``."""
+    from headlamp_tpu.transport import MockTransport
+
+    sink = io.StringIO()
+    clk = Clock()
+    recorder = Recorder(sink, monotonic=clk, wall=lambda: 1.7e9, note="t")
+    inner = MockTransport()
+    inner.add("/ok", {"value": 1})
+    transport = RecordingTransport(inner, recorder)
+    exchanges_fn(transport, inner, clk)
+    return sink.getvalue()
+
+
+class TestRecording:
+    def test_round_trips_responses_and_errors(self):
+        def drive(transport, inner, clk):
+            transport.request("/ok")
+            clk.advance(2.0)
+            with pytest.raises(ApiError):
+                transport.request("/missing")
+
+        text = make_recording_text(drive)
+        rec = _parse_recording(io.StringIO(text))
+        assert rec.version == RECORDING_VERSION
+        assert rec.note == "t"
+        assert [ex.path for ex in rec.exchanges] == ["/ok", "/missing"]
+        ok, err = rec.exchanges
+        assert ok.response == {"value": 1} and ok.error is None
+        # The "path: " prefix str(ApiError) adds was stripped before
+        # recording, so replay re-raises the exact original message.
+        assert err.error is not None
+        assert not err.error[0].startswith("/missing")
+        assert rec.span_s == 2.0
+        assert rec.paths() == ["/ok", "/missing"]
+
+    def test_version_gate(self, tmp_path):
+        p = tmp_path / "future.jsonl"
+        p.write_text(
+            json.dumps(
+                {
+                    "v": RECORDING_VERSION + 1,
+                    "kind": "header",
+                    "format": "headlamp-tpu-recording",
+                    "recorded_unix": 0.0,
+                    "note": "",
+                }
+            )
+            + "\n"
+        )
+        with pytest.raises(ValueError, match="version"):
+            load_recording(str(p))
+
+    def test_non_recording_file_rejected(self, tmp_path):
+        p = tmp_path / "junk.jsonl"
+        p.write_text('{"kind": "something-else"}\n')
+        with pytest.raises(ValueError, match="not a"):
+            load_recording(str(p))
+
+    def test_unknown_kinds_skipped_not_fatal(self):
+        text = (
+            json.dumps(
+                {
+                    "v": 1,
+                    "kind": "header",
+                    "format": "headlamp-tpu-recording",
+                    "recorded_unix": 0.0,
+                    "note": "",
+                }
+            )
+            + "\n"
+            + json.dumps({"kind": "annotation", "text": "from the future"})
+            + "\n"
+            + json.dumps(
+                {
+                    "kind": "request",
+                    "t": 0.0,
+                    "path": "/a",
+                    "status": "ok",
+                    "response": 1,
+                }
+            )
+            + "\n"
+        )
+        rec = _parse_recording(io.StringIO(text))
+        assert [ex.path for ex in rec.exchanges] == ["/a"]
+
+
+def timeline_recording():
+    """Three generations of /a at t=0,10,20 plus one recorded error."""
+    header = {
+        "v": 1,
+        "kind": "header",
+        "format": "headlamp-tpu-recording",
+        "recorded_unix": 0.0,
+        "note": "",
+    }
+    lines = [json.dumps(header)]
+    for i, t in enumerate((0.0, 10.0, 20.0)):
+        lines.append(
+            json.dumps(
+                {
+                    "kind": "request",
+                    "t": t,
+                    "path": "/a",
+                    "status": "ok",
+                    "response": {"gen": i},
+                }
+            )
+        )
+    lines.append(
+        json.dumps(
+            {
+                "kind": "request",
+                "t": 5.0,
+                "path": "/down",
+                "status": "error",
+                "error": {"message": "boom", "status": 503},
+            }
+        )
+    )
+    return _parse_recording(io.StringIO("\n".join(lines) + "\n"))
+
+
+class TestReplay:
+    def test_sequential_advances_and_sticks_at_last(self):
+        source = ReplaySource(timeline_recording())
+        gens = [source.request("/a")["gen"] for _ in range(5)]
+        assert gens == [0, 1, 2, 2, 2]
+        assert source.requests_served == 5
+
+    def test_unknown_path_is_a_404_not_invented_data(self):
+        source = ReplaySource(timeline_recording())
+        with pytest.raises(ApiError) as e:
+            source.request("/never-recorded")
+        assert e.value.status == 404
+        assert source.requests_unknown == 1
+
+    def test_recorded_error_re_raises(self):
+        source = ReplaySource(timeline_recording())
+        with pytest.raises(ApiError) as e:
+            source.request("/down")
+        assert e.value.status == 503
+        assert "boom" in str(e.value)
+
+    def test_timed_mode_follows_the_injected_clock(self):
+        clk = Clock()
+        source = ReplaySource(timeline_recording(), clock=clk)
+        assert source.request("/a")["gen"] == 0  # t0: earliest serves
+        clk.advance(10.0)
+        assert source.request("/a")["gen"] == 1
+        clk.advance(5.0)  # 15 s: gen 2 (t=20) not yet visible
+        assert source.request("/a")["gen"] == 1
+        clk.advance(100.0)
+        assert source.request("/a")["gen"] == 2
+
+    def test_rate_compresses_the_timeline(self):
+        clk = Clock()
+        source = ReplaySource(timeline_recording(), clock=clk, rate=10.0)
+        assert source.request("/a")["gen"] == 0
+        clk.advance(2.0)  # 2 s real = 20 s recorded at 10x
+        assert source.request("/a")["gen"] == 2
+
+    def test_rate_must_be_positive(self):
+        with pytest.raises(ValueError):
+            ReplaySource(timeline_recording(), rate=0.0)
+
+    def test_responses_are_mutation_isolated(self):
+        source = ReplaySource(timeline_recording(), clock=Clock())
+        first = source.request("/a")
+        first["gen"] = 999
+        assert source.request("/a")["gen"] == 0
+
+
+class TestReplayParity:
+    """The ISSUE's headline acceptance: two --replay rounds of the same
+    recording are byte-identical — same /tpu/trends vdom, same bench
+    metric values. Runs THROUGH bench.py's harness, so the pinned
+    property is exactly what ``python bench.py --replay`` measures."""
+
+    @pytest.fixture(scope="class")
+    def recording_path(self, tmp_path_factory):
+        import bench
+
+        path = str(tmp_path_factory.mktemp("replay") / "demo.jsonl")
+        exchanges = bench.record_demo_traffic(path, note="parity test")
+        assert exchanges > 0
+        return path
+
+    def test_two_replay_rounds_are_byte_identical(self, recording_path):
+        import bench
+
+        first = bench.replay_round(recording_path)
+        second = bench.replay_round(recording_path)
+        assert first["trends_html"] == second["trends_html"]
+        assert first["metrics"] == second["metrics"]
+        # And the trends page actually charts replayed capture.
+        assert "hl-trend-strip" in first["trends_html"]
+        assert first["metrics"]["history_counters"]["scrapes"] >= 1
+
+    def test_timed_replay_on_scripted_clock_is_deterministic(
+        self, recording_path
+    ):
+        import bench
+
+        first = bench.replay_round(recording_path, rate=3.0)
+        second = bench.replay_round(recording_path, rate=3.0)
+        assert first == second

@@ -188,6 +188,12 @@ class TestFormat:
             "headlamp_tpu_gateway_queue_depth_count",
             "headlamp_tpu_gateway_inflight_renders_count",
             "headlamp_tpu_gateway_queue_wait_seconds",
+            # History-tier callback gauges (ADR-018): quiet whenever the
+            # weakref'd active store belongs to an app another test
+            # created later and dropped — same latest-producer-wins
+            # wiring as the gateway gauges above.
+            "headlamp_tpu_history_memory_bytes",
+            "headlamp_tpu_history_window_span_seconds",
         }, f"unexpected sample-free families: {sorted(quiet)}"
 
     def test_name_grammar_and_unit_suffixes(self, exposition):

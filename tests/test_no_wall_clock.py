@@ -1,9 +1,10 @@
 """Wall-clock gate (tools/no_wall_clock_check.py, ADR-013/ADR-016).
 
 Two halves, mirroring tests/test_no_raw_urlopen.py:
-  1. The gate itself: the live ``obs/``/``runtime/``/``transport/``
-     trees must be clean — every TTL/age/burn computation runs on an
-     injected monotonic clock; wall-clock reads never happen inline.
+  1. The gate itself: the live ``gateway/``/``history/``/``obs/``/
+     ``runtime/``/``transport/`` trees must be clean — every
+     TTL/age/burn/retention/replay computation runs on an injected
+     monotonic clock; wall-clock reads never happen inline.
   2. Mutation coverage: sources that read the wall clock
      (``time.time()``, module-aliased, ``from time import time``,
      argless ``datetime.now()``/``utcnow()``, argless
@@ -116,13 +117,31 @@ class TestMutations:
         )
         assert diags == []
 
-    def test_scope_is_the_three_subtrees(self, tmp_path):
+    def test_scope_covers_history_and_skips_server(self, tmp_path):
         inside = tmp_path / "headlamp_tpu" / "obs"
         inside.mkdir(parents=True)
         (inside / "bad.py").write_text("import time\nnow = time.time()\n")
+        # ADR-018: the history tier's retention/replay math is in scope.
+        history = tmp_path / "headlamp_tpu" / "history"
+        history.mkdir(parents=True)
+        (history / "bad_store.py").write_text("import time\nnow = time.time()\n")
         outside = tmp_path / "headlamp_tpu" / "server"
         outside.mkdir(parents=True)
         (outside / "app.py").write_text("import time\nnow = time.time()\n")
         diags = check_tree(str(tmp_path))
+        assert len(diags) == 2
+        assert {os.path.basename(d.path) for d in diags} == {
+            "bad.py",
+            "bad_store.py",
+        }
+
+    def test_replay_pacing_on_wall_clock_flagged(self):
+        # The exact mistake the history scope exists to catch: pacing a
+        # replay on the wall clock instead of an injected monotonic.
+        diags = self._diags(
+            "import time\n"
+            "def _elapsed(self):\n"
+            "    return (time.time() - self._t0) * self.rate\n"
+        )
         assert len(diags) == 1
-        assert "bad.py" in diags[0].path
+        assert diags[0].line == 3

@@ -152,6 +152,7 @@ def _repo_root() -> str:
 #: The injected-clock subtrees (relative to the repo root).
 SCOPE = (
     os.path.join("headlamp_tpu", "gateway"),
+    os.path.join("headlamp_tpu", "history"),
     os.path.join("headlamp_tpu", "obs"),
     os.path.join("headlamp_tpu", "runtime"),
     os.path.join("headlamp_tpu", "transport"),
