@@ -93,6 +93,16 @@ Extras reported alongside (same JSON line, `extra` object):
   bound, resident ring memory at that bound, and whether two replay
   rounds of one in-run demo recording agreed byte-for-byte (also
   runnable standalone: ``python bench.py --replay PATH [--rate N]``).
+- ``stage_medians_ms`` — per-request-stage medians (flight-recorder
+  wide-event stages) over the SAME iterations as the headline: the
+  join key ``python bench.py --attribute OLD.json NEW.json`` uses to
+  rank which stage paid a cross-round drift (ADR-019).
+- ``profiler_overhead_ns_per_sample`` / ``profiler_hot_hit_rate`` /
+  ``replay_deterministic_with_profiler`` — the ADR-019 sampling
+  profiler budget (real ``sys._current_frames`` walks vs the declared
+  budget), fidelity against a known-hot worker thread (≥0.8), and
+  byte-parity of a profiled replay round; plus an in-run
+  ``--attribute`` smoke over the committed r01/r07 rounds.
 - ``prev_round_regressions`` — fail-soft round-over-round comparator:
   shared numeric metrics >25% worse than the latest committed
   ``BENCH_r*.json`` are named here (details on stderr), direction-aware
@@ -220,16 +230,28 @@ def bench_metrics_scrape_paint(fleet) -> tuple[float, dict]:
     in-run tunnel-variance yardstick round-over-round drift must be
     judged against (VERDICT r3 weak #4 / r4 task #1: a p50 move inside
     one run's spread is noise, not a regression)."""
+    from headlamp_tpu.obs.flight import flight_recorder
+
     for _ in range(WARMUP):
         status, _, body = make_app(fleet).handle("/tpu/metrics")
         assert status == 200 and "Fleet Telemetry" in body
     samples = []
+    stage_samples: dict[str, list[float]] = {}
     for _ in range(METRICS_ITERATIONS):
         app = make_app(fleet)
         t0 = time.perf_counter()
         status, _, body = app.handle("/tpu/metrics")
         samples.append((time.perf_counter() - t0) * 1000)
         assert status == 200 and body
+        # Per-stage attribution feed (ADR-019): the flight recorder's
+        # wide event flattens this request's trace into stage→ms.
+        # Harvesting it from the SAME iterations that produce the
+        # headline lets ``--attribute`` join two rounds stage-by-stage
+        # instead of guessing from the total.
+        recent = flight_recorder.snapshot()["recent"]
+        if recent and recent[0].get("route") == "/tpu/metrics":
+            for name, ms in (recent[0].get("stages") or {}).items():
+                stage_samples.setdefault(name, []).append(float(ms))
     samples.sort()
     spread = {
         "metrics_scrape_paint_samples_n": len(samples),
@@ -238,6 +260,10 @@ def bench_metrics_scrape_paint(fleet) -> tuple[float, dict]:
             samples[int(0.9 * (len(samples) - 1))], 2
         ),
         "metrics_scrape_paint_max_ms": round(samples[-1], 2),
+        "stage_medians_ms": {
+            name: round(statistics.median(vals), 2)
+            for name, vals in sorted(stage_samples.items())
+        },
     }
     return statistics.median(samples), spread
 
@@ -1287,7 +1313,9 @@ def record_demo_traffic(path: str, *, fleet: str = "v5p32", note: str = "") -> i
     return recorder.exchanges
 
 
-def replay_round(path: str, *, rate: float | None = None) -> dict:
+def replay_round(
+    path: str, *, rate: float | None = None, profile: bool = False
+) -> dict:
     """ONE deterministic replay round: a fresh DashboardApp over a
     ReplaySource of ``path``, driven through REPLAY_SCRIPT on scripted
     clocks. Returns the rendered /tpu/trends HTML plus the round's
@@ -1298,7 +1326,13 @@ def replay_round(path: str, *, rate: float | None = None) -> dict:
     timed pacing on the SAME scripted clock, so even "replay at 3x"
     stays deterministic. Locally measured durations (snapshot.fetch_ms)
     are excluded from capture: the determinism contract covers replayed
-    data, not this host's perf_counter (ADR-018)."""
+    data, not this host's perf_counter (ADR-018).
+
+    ``profile=True`` runs a real :class:`SamplingProfiler` sample after
+    every replayed request — the ADR-019 parity pin: the sampler's
+    locally measured overhead series must be swallowed by the
+    ``capture_timings`` gate, leaving replay output byte-identical to a
+    profiler-less round."""
     from headlamp_tpu.history import ReplaySource, load_recording
     from headlamp_tpu.server import DashboardApp
 
@@ -1311,10 +1345,17 @@ def replay_round(path: str, *, rate: float | None = None) -> dict:
         source = ReplaySource(recording, clock=mono, rate=rate)
     app = DashboardApp(source, min_sync_interval_s=0.0, clock=wall, monotonic=mono)
     app.history.capture_timings = False
+    prof = None
+    if profile:
+        from headlamp_tpu.obs.profiler import SamplingProfiler
+
+        prof = SamplingProfiler(monotonic=mono)
     statuses = []
     for route, dt in REPLAY_SCRIPT:
         status, _, _ = app.handle(route)
         statuses.append((route, status))
+        if prof is not None:
+            prof.sample_once()
         mono.advance(dt)
         wall.advance(dt)
     trend_status, _, trends_html = app.handle("/tpu/trends")
@@ -1390,6 +1431,10 @@ def bench_history() -> dict:
         exchanges = record_demo_traffic(recording_path, note="bench_history")
         first = replay_round(recording_path)
         second = replay_round(recording_path)
+        # ADR-019 parity pin: a round that ALSO runs the stack sampler
+        # must replay byte-identically — its overhead timings go through
+        # the capture_timings gate, never into the compared output.
+        profiled = replay_round(recording_path, profile=True)
     return {
         "history_capture_ns_per_point": round(ns_per_point, 1),
         "history_trend_read_ms_1024nodes_6h": round(trend_read_ms, 2),
@@ -1398,7 +1443,249 @@ def bench_history() -> dict:
         "history_window_span_s_1024nodes": round(fill.window_span_s(), 1),
         "replay_recording_exchanges": exchanges,
         "replay_deterministic": first == second,
+        "replay_deterministic_with_profiler": profiled == first,
     }
+
+
+def _synthetic_hot(stop) -> None:
+    """Known-hot workload for the profiler fidelity check: a worker
+    thread spends ~all its time in THIS frame, so a faithful sampler
+    must see it in (nearly) every stack it interns for that thread."""
+    x = 0
+    while not stop.is_set():
+        for i in range(2000):
+            x = (x * 31 + i) % 1_000_003
+
+
+def bench_profiler() -> dict:
+    """ADR-019 profiler acceptance numbers: per-sample overhead of a
+    REAL ``sys._current_frames()`` walk against the declared budget
+    (``PROFILER_SAMPLE_BUDGET_NS``), sampling fidelity against a
+    known-hot synthetic workload (the ``_synthetic_hot`` worker must
+    appear in ≥80% of the stacks sampled for its route), and an in-run
+    smoke of the ``--attribute`` cross-round joiner over the two
+    committed rounds bracketing the 125→275 ms paint regression.
+
+    The hot loop runs on a WORKER thread because ``sample_once``
+    excludes the calling thread (a sampler never profiles itself);
+    fidelity is read from the folded output so the number exercises the
+    same serialization operators consume."""
+    import threading
+
+    from headlamp_tpu.obs.profiler import (
+        PROFILER_SAMPLE_BUDGET_NS,
+        SamplingProfiler,
+        attribution,
+    )
+
+    prof = SamplingProfiler()
+    stop = threading.Event()
+    route = "bench.synthetic_hot"
+
+    def run() -> None:
+        with attribution(route):
+            _synthetic_hot(stop)
+
+    worker = threading.Thread(target=run, name="bench-hot", daemon=True)
+    worker.start()
+    try:
+        for _ in range(200):
+            prof.sample_once()
+            time.sleep(0.001)  # let the worker's leaf position vary
+    finally:
+        stop.set()
+        worker.join(timeout=5.0)
+
+    overhead = prof.overhead_ns_per_sample() or 0.0
+    hot_total = route_total = 0
+    for line in prof.folded().splitlines():
+        path, _, count = line.rpartition(" ")
+        if path.startswith(route + ";"):
+            route_total += int(count)
+            if "_synthetic_hot" in path:
+                hot_total += int(count)
+    fidelity = hot_total / route_total if route_total else 0.0
+
+    out = {
+        "profiler_overhead_ns_per_sample": round(overhead, 1),
+        "profiler_overhead_budget_ns": PROFILER_SAMPLE_BUDGET_NS,
+        "profiler_overhead_within_budget": overhead <= PROFILER_SAMPLE_BUDGET_NS,
+        # "hit rate" so the round-over-round comparator treats it as
+        # higher-is-better (it is: 1.0 = every sampled stack saw the
+        # hot frame).
+        "profiler_hot_hit_rate": round(fidelity, 3),
+        "profiler_fidelity_stacks": route_total,
+        "profiler_call_tree_nodes": prof.node_count(),
+    }
+
+    # --attribute smoke (the CI/tooling satellite): the joiner must
+    # produce a ranked table from the committed rounds in-run, not only
+    # under its own CLI.
+    here = os.path.dirname(os.path.abspath(__file__))
+    old_p = os.path.join(here, "BENCH_r01.json")
+    new_p = os.path.join(here, "BENCH_r07.json")
+    if os.path.exists(old_p) and os.path.exists(new_p):
+        try:
+            report = attribute_rounds(_load_round(old_p), _load_round(new_p))
+            out["attribution_smoke_basis"] = report["basis"]
+            out["attribution_smoke_rows"] = len(report["stages"])
+        except Exception as exc:  # smoke must never sink the bench
+            out["attribution_smoke_basis"] = f"error: {exc!r}"
+            out["attribution_smoke_rows"] = 0
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Cross-round regression attribution (ADR-019)
+# ---------------------------------------------------------------------------
+
+
+def _load_round(path: str) -> dict:
+    """One committed round, unwrapped from the driver's envelope
+    (``{"n": …, "parsed": {bench line}}``) when present."""
+    with open(path, "r", encoding="utf-8") as f:
+        raw = json.load(f)
+    return raw.get("parsed", raw)
+
+
+def attribute_rounds(old: dict, new: dict) -> dict:
+    """Join two bench records stage-by-stage and rank what moved — the
+    answer to "the paint p50 drifted: WHICH stage paid it?". Tiered by
+    what the rounds actually recorded, and never silent about the
+    basis:
+
+    - both rounds carry ``stage_medians_ms`` (recorded per paint
+      iteration since ADR-019) → true request-stage deltas, ranked by
+      magnitude, plus the **unattributed residual** (headline delta
+      minus the sum of stage deltas — tunnel noise, render glue, or a
+      stage the trace does not cover);
+    - else both carry numeric ``*_ms`` extras → those sub-bench numbers
+      join as stage PROXIES (they are separately-measured benches, not
+      phases of one request — the table says so);
+    - else (e.g. round 1 predates ``extra`` entirely) the new round's
+      stages rank by magnitude alone with basis
+      ``new-round-only`` — a shape of the regression, not a diff.
+    """
+    old_value = float(old.get("value") or 0.0)
+    new_value = float(new.get("value") or 0.0)
+    old_extra = old.get("extra") or {}
+    new_extra = new.get("extra") or {}
+    old_stages = old_extra.get("stage_medians_ms") or {}
+    new_stages = new_extra.get("stage_medians_ms") or {}
+
+    def ms_proxies(extra: dict) -> dict[str, float]:
+        return {
+            k: float(v)
+            for k, v in extra.items()
+            if k.endswith("_ms")
+            and not k.startswith(_COMPARE_SKIP_PREFIXES)
+            and isinstance(v, (int, float))
+            and not isinstance(v, bool)
+        }
+
+    rows: list[dict] = []
+    residual = None
+    if old_stages and new_stages:
+        basis = "stage-medians"
+        names = sorted(set(old_stages) | set(new_stages))
+        for name in names:
+            ov = float(old_stages.get(name, 0.0))
+            nv = float(new_stages.get(name, 0.0))
+            rows.append(
+                {
+                    "stage": name,
+                    "old_ms": round(ov, 2),
+                    "new_ms": round(nv, 2),
+                    "delta_ms": round(nv - ov, 2),
+                }
+            )
+        attributed = sum(r["delta_ms"] for r in rows)
+        residual = round((new_value - old_value) - attributed, 2)
+    elif ms_proxies(old_extra) and ms_proxies(new_extra):
+        basis = "extra-ms-proxies (sub-bench numbers, not request stages)"
+        op, np_ = ms_proxies(old_extra), ms_proxies(new_extra)
+        for name in sorted(set(op) & set(np_)):
+            rows.append(
+                {
+                    "stage": name,
+                    "old_ms": round(op[name], 2),
+                    "new_ms": round(np_[name], 2),
+                    "delta_ms": round(np_[name] - op[name], 2),
+                }
+            )
+    else:
+        basis = "new-round-only (old round has no stage data)"
+        source = new_stages or ms_proxies(new_extra)
+        for name, val in source.items():
+            rows.append(
+                {
+                    "stage": name,
+                    "old_ms": None,
+                    "new_ms": round(float(val), 2),
+                    "delta_ms": None,
+                }
+            )
+    # Biggest mover first; None-delta rows (tier 3) rank by magnitude.
+    rows.sort(
+        key=lambda r: -abs(
+            r["delta_ms"] if r["delta_ms"] is not None else r["new_ms"]
+        )
+    )
+    return {
+        "old_metric": old.get("metric"),
+        "new_metric": new.get("metric"),
+        "old_value_ms": round(old_value, 2),
+        "new_value_ms": round(new_value, 2),
+        "headline_delta_ms": round(new_value - old_value, 2),
+        "basis": basis,
+        "stages": rows,
+        "unattributed_residual_ms": residual,
+    }
+
+
+def attribute_main(argv: list[str]) -> None:
+    """``python bench.py --attribute OLD.json NEW.json``: the drift
+    runbook's second step (OPERATIONS.md "When paint p50 drifts") —
+    print the ranked stage-level drift table, then ONE machine-readable
+    JSON line (the table is for the operator; the line is for tooling).
+    """
+    i = argv.index("--attribute")
+    try:
+        old_path, new_path = argv[i + 1], argv[i + 2]
+    except IndexError:
+        raise SystemExit("usage: python bench.py --attribute OLD.json NEW.json")
+    report = attribute_rounds(_load_round(old_path), _load_round(new_path))
+
+    print(
+        f"# regression attribution: {os.path.basename(old_path)} -> "
+        f"{os.path.basename(new_path)}",
+        file=sys.stderr,
+    )
+    print(
+        f"# headline: {report['old_value_ms']} -> {report['new_value_ms']} ms "
+        f"({report['headline_delta_ms']:+} ms)   basis: {report['basis']}",
+        file=sys.stderr,
+    )
+    width = max([len(r["stage"]) for r in report["stages"]] + [5])
+    print(
+        f"# {'stage'.ljust(width)}  {'old_ms':>9}  {'new_ms':>9}  {'delta_ms':>9}",
+        file=sys.stderr,
+    )
+    for r in report["stages"]:
+        old_s = "-" if r["old_ms"] is None else f"{r['old_ms']:.2f}"
+        delta_s = "-" if r["delta_ms"] is None else f"{r['delta_ms']:+.2f}"
+        print(
+            f"# {r['stage'].ljust(width)}  {old_s:>9}  "
+            f"{r['new_ms']:>9.2f}  {delta_s:>9}",
+            file=sys.stderr,
+        )
+    if report["unattributed_residual_ms"] is not None:
+        print(
+            f"# {'(unattributed residual)'.ljust(width)}  {'':>9}  {'':>9}  "
+            f"{report['unattributed_residual_ms']:>+9.2f}",
+            file=sys.stderr,
+        )
+    print(json.dumps(report, ensure_ascii=False, sort_keys=True))
 
 
 def replay_main(argv: list[str]) -> None:
@@ -1477,6 +1764,7 @@ def main() -> None:
     transport_pool = bench_transport_pool(fleet)
     gateway = bench_gateway(fleet)
     history = bench_history()
+    profiler_numbers = bench_profiler()
     record = {
         "metric": (
             "metrics scrape→paint p50 (Prometheus fetch + forecast "
@@ -1520,6 +1808,7 @@ def main() -> None:
             **transport_pool,
             **gateway,
             **history,
+            **profiler_numbers,
         },
     }
     record["extra"]["prev_round_regressions"] = compare_prev_round(record)
@@ -1529,5 +1818,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--replay" in sys.argv:
         replay_main(sys.argv)
+    elif "--attribute" in sys.argv:
+        attribute_main(sys.argv)
     else:
         main()

@@ -151,17 +151,25 @@ def fleet_rollup(
 
 
 def rollup_arrays(fleet: FleetArrays) -> dict[str, jax.Array]:
-    return fleet_rollup(
-        jnp.asarray(fleet.node_capacity),
-        jnp.asarray(fleet.node_allocatable),
-        jnp.asarray(fleet.node_ready),
-        jnp.asarray(fleet.node_generation),
-        jnp.asarray(fleet.node_valid),
-        jnp.asarray(fleet.pod_request),
-        jnp.asarray(fleet.pod_phase),
-        jnp.asarray(fleet.pod_node_idx),
-        jnp.asarray(fleet.pod_valid),
-    )
+    from ..obs.jaxcost import track as _jax_track
+
+    # ADR-019 cost ledger: padded column shapes are the recompile key
+    # (static args are defaulted constants here).
+    with _jax_track(
+        "analytics.fleet_rollup",
+        (tuple(fleet.node_capacity.shape), tuple(fleet.pod_request.shape)),
+    ):
+        return fleet_rollup(
+            jnp.asarray(fleet.node_capacity),
+            jnp.asarray(fleet.node_allocatable),
+            jnp.asarray(fleet.node_ready),
+            jnp.asarray(fleet.node_generation),
+            jnp.asarray(fleet.node_valid),
+            jnp.asarray(fleet.pod_request),
+            jnp.asarray(fleet.pod_phase),
+            jnp.asarray(fleet.pod_node_idx),
+            jnp.asarray(fleet.pod_valid),
+        )
 
 
 def rollup_to_dict(fleet: FleetArrays) -> dict[str, Any]:

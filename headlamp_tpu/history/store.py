@@ -242,6 +242,20 @@ class HistoryStore:
         self.scrapes += 1
         return appended
 
+    def record_timing(
+        self, metric: str, value: float, *, labels: Iterable[str] = ()
+    ) -> bool:
+        """Capture one locally MEASURED duration/overhead series (the
+        ADR-019 profiler and JAX cost ledger write through here). Gated
+        by ``capture_timings`` like ``fleet.scrape_ms``: a perf_counter
+        reading taken on the replaying host is environment noise that
+        would break two-round byte-parity, so replay harnesses drop
+        these rows wholesale. Returns whether the row was captured."""
+        if not self.capture_timings:
+            return False
+        self.append(metric, float(value), labels=labels)
+        return True
+
     def record_sync(
         self, *, generation: int, nodes: int, errors: int = 0
     ) -> None:

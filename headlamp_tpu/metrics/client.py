@@ -41,6 +41,7 @@ from typing import Any, Callable, Mapping
 from ..obs.trace import span as _span
 from ..transport.api_proxy import ApiError, Transport
 from ..transport.pool import fanout, pool_of
+from .timing import FetchTimer
 
 # ---------------------------------------------------------------------------
 # Service discovery
@@ -444,7 +445,7 @@ def fetch_tpu_metrics(
     per candidate with ``batched=False`` (the escape hatch and the
     parity baseline) — and join into per-chip rows. None when no
     Prometheus answers."""
-    t_start = time.perf_counter()
+    timer = FetchTimer(clock)
     # ADR-013 stage spans: discovery (the candidate-chain probe — the
     # whole chain times out serially against a dark cluster, which is
     # the pathological latency this span exists to expose; `cached`
@@ -536,14 +537,15 @@ def fetch_tpu_metrics(
             setattr(row, logical, value)
 
     ordered = sorted(chips.values(), key=lambda c: (c.node, c.accelerator_id))
+    fetched_at, fetch_ms = timer.stamp()
     return TpuMetricsSnapshot(
         namespace=namespace,
         service=service,
         chips=ordered,
         availability=availability,
         resolved_series=resolved,
-        fetched_at=clock(),
-        fetch_ms=round((time.perf_counter() - t_start) * 1000, 1),
+        fetched_at=fetched_at,
+        fetch_ms=fetch_ms,
     )
 
 

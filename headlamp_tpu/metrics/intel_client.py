@@ -27,6 +27,7 @@ from .client import (
     _vector_result,
     resolve_prometheus,
 )
+from .timing import FetchTimer
 
 #: The reference's PromQL set (`metrics.ts:101-116`). The power rate
 #: needs ≥5m of scrape history before it returns data — the UI hint at
@@ -100,7 +101,7 @@ def fetch_intel_gpu_metrics(
     the 4 queries in parallel over the transport's connection pool and
     join per (node, chip). None when no Prometheus answers
     (`metrics.ts:97-98`)."""
-    t_start = time.perf_counter()
+    timer = FetchTimer(clock)
     found = prometheus or resolve_prometheus(transport, timeout_s)
     if found is None:
         return None
@@ -146,14 +147,13 @@ def fetch_intel_gpu_metrics(
             row = chips.setdefault(key, GpuChipMetrics(node=key[0], chip=key[1]))
             setattr(row, field_name, value)
 
+    # Clock discipline (wall stamp vs perf_counter duration) lives in
+    # the shared FetchTimer — see metrics/timing.py.
+    fetched_at, fetch_ms = timer.stamp()
     return IntelMetricsSnapshot(
         namespace=namespace,
         service=service,
         chips=sorted(chips.values(), key=lambda c: (c.node, c.chip)),
-        # Wall clock for the DISPLAYED fetch stamp, perf_counter for the
-        # MEASURED fetch duration — never mix the two (ADR-013 clock
-        # audit): an NTP step mid-fetch would corrupt a wall-clock
-        # elapsed but can only relabel a display timestamp.
-        fetched_at=clock(),
-        fetch_ms=round((time.perf_counter() - t_start) * 1000, 1),
+        fetched_at=fetched_at,
+        fetch_ms=fetch_ms,
     )

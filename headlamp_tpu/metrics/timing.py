@@ -1,0 +1,44 @@
+"""Shared fetch-stamp discipline for the metrics clients (ADR-019).
+
+Both Prometheus clients (:mod:`.client`, :mod:`.intel_client`) used to
+open-code the same pair: wall clock for the DISPLAYED ``fetched_at``
+stamp, ``perf_counter`` for the MEASURED ``fetch_ms`` duration — never
+mixed, because an NTP step mid-fetch would corrupt a wall-clock elapsed
+but can only relabel a display timestamp (ADR-013 clock audit). This
+helper is that pair in one place, and it additionally tags the active
+request span with the measured duration so span waterfalls, flight
+events, and profiler attribution all see the same fetch number the
+snapshot reports.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from ..obs.trace import annotate
+
+
+class FetchTimer:
+    """Started at construction; :meth:`stamp` closes the measurement.
+
+    >>> timer = FetchTimer(clock)
+    >>> ...  # discovery + fan-out + join
+    >>> fetched_at, fetch_ms = timer.stamp()
+    """
+
+    __slots__ = ("_clock", "_t0")
+
+    def __init__(self, clock: Callable[[], float]) -> None:
+        self._clock = clock
+        self._t0 = time.perf_counter()
+
+    def stamp(self) -> tuple[float, float]:
+        """(fetched_at, fetch_ms) — wall stamp from the injected clock,
+        duration from perf_counter, rounded the way every snapshot
+        field is. Also annotates the innermost open ADR-013 span (a
+        no-op outside a trace) so the trace and the snapshot can never
+        disagree about what the fetch cost."""
+        fetch_ms = round((time.perf_counter() - self._t0) * 1000, 1)
+        annotate(fetch_ms=fetch_ms)
+        return self._clock(), fetch_ms

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs.jaxcost import track as _jax_track
+
 Params = dict[str, jax.Array]
 
 
@@ -411,15 +413,26 @@ def fit_and_forecast_with_dispatch(
             from .pallas_forward import check_single_tile, pallas_batch_p
 
             check_single_tile(cfg.window, cfg.hidden, cfg.horizon)
-            out, mse = _fit_forecast_program(
-                series, key, cfg, steps, "pallas", pallas_batch_p(n_chips)
-            )
+            batch_p = pallas_batch_p(n_chips)
+            # ADR-019 cost ledger: the signature is jax's recompile key
+            # (input shape + every static arg) so first-call compiles
+            # and warm dispatches classify exactly.
+            with _jax_track(
+                "forecast.fit_forecast",
+                (series.shape, cfg, steps, "pallas", batch_p),
+            ):
+                out, mse = _fit_forecast_program(
+                    series, key, cfg, steps, "pallas", batch_p
+                )
             return out, InferenceDispatch("pallas", fit_mse=mse)
         except Exception as exc:  # noqa: BLE001 — optimization, not a dependency
             # Memoize: a kernel that failed to lower/compile would
             # otherwise re-pay the failed compile on EVERY forecast.
             _record_pallas_broken(f"{type(exc).__name__}: {exc}"[:200])
-    out, mse = _fit_forecast_program(series, key, cfg, steps, "xla", 0)
+    with _jax_track(
+        "forecast.fit_forecast", (series.shape, cfg, steps, "xla", 0)
+    ):
+        out, mse = _fit_forecast_program(series, key, cfg, steps, "xla", 0)
     return out, InferenceDispatch("xla", _pallas_broken_reason, fit_mse=mse)
 
 
@@ -542,14 +555,32 @@ def fit_and_forecast_incremental(
         optimization-never-dependency policy as the cold entry), so
         only genuine training failures escape to the caller."""
         nonlocal inference, batch_p, fallback
+        # ADR-019 cost ledger: name from the program, signature from
+        # jax's recompile key (input shape + hashable static args).
+        name = "forecast." + getattr(program, "__name__", "program").lstrip("_")
+
+        def sig(inf: str, bp: int) -> tuple:
+            return (
+                tuple(head[0].shape),
+                *(
+                    h
+                    for h in head[1:]
+                    if isinstance(h, (int, float, str, ForecastConfig))
+                ),
+                inf,
+                bp,
+            )
+
         try:
-            return program(*head, inference, batch_p)
+            with _jax_track(name, sig(inference, batch_p)):
+                return program(*head, inference, batch_p)
         except Exception as exc:  # noqa: BLE001
             if inference != "pallas":
                 raise
             _record_pallas_broken(f"{type(exc).__name__}: {exc}"[:200])
             inference, batch_p, fallback = "xla", 0, _pallas_broken_reason
-            return program(*head, "xla", 0)
+            with _jax_track(name, sig("xla", 0)):
+                return program(*head, "xla", 0)
 
     demotion: str | None = None
     carried_gen: int | None = None

@@ -12,6 +12,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from ..metrics.client import UtilizationHistory
+from ..obs.jaxcost import track as _jax_track
 from ..obs.trace import span as _span
 from .forecast import (
     WARM_STEPS,
@@ -245,13 +246,24 @@ def forecast_slo_burn(
         return None, state
     try:
         with _span("slo.budget_fit", series=len(series), steps=steps):
-            preds, _dispatch, new_state = fit_and_forecast_incremental(
-                np.asarray(series, dtype=float),
-                ForecastConfig(),
-                state=state,
-                steps=steps,
-            )
-        return [float(p) for p in np.asarray(preds)], new_state
+            # ADR-019 cost ledger: the burn self-forecast is its own
+            # program row (the incremental entry also records the
+            # underlying fused program — nested tracks are additive).
+            with _jax_track(
+                "slo.burn_forecast", (len(series), steps, state is not None)
+            ):
+                # The fused entry is (n_chips, length); the latency
+                # series is one "chip". (Pre-ADR-019 this passed the
+                # bare 1-D array, so the shape unpack raised and every
+                # self-forecast silently degraded to None — the cost
+                # ledger made the missing program row visible.)
+                preds, _dispatch, new_state = fit_and_forecast_incremental(
+                    np.asarray(series, dtype=float)[None, :],
+                    ForecastConfig(),
+                    state=state,
+                    steps=steps,
+                )
+        return [float(p) for p in np.asarray(preds).ravel()], new_state
     except Exception:
         # Same progressive-enhancement posture as the page forecast.
         return None, state

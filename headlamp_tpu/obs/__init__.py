@@ -17,6 +17,11 @@ Six pieces, one package:
   the /healthz ``runtime.slo`` block, and per-SLO /metricsz gauges.
 - :mod:`.flight` — the always-on flight recorder: one wide event per
   request, errored/SLO-violating ones pinned, dumped at /debug/flightz.
+- :mod:`.profiler` — the bounded sampling wall-clock profiler behind
+  /debug/profilez: always-on low-rate stack sampling into an interned
+  call tree, route-attributed via the trace contextvar (ADR-019).
+- :mod:`.jaxcost` — the JAX cost ledger: per-program compile vs warm
+  dispatch accounting plus host<->device payload bytes (ADR-019).
 - :mod:`.debug_pages` — the waterfall + SLO status pages over the
   rings; their JSON twins are served by the app layer.
 
@@ -49,6 +54,19 @@ from .trace import (
 from . import exemplars as _exemplars
 from .flight import FlightRecorder, flight_recorder, wide_event
 from .slo import SLOEngine, SLOSpec, default_specs, engine as slo_engine, set_engine as set_slo_engine
+from .profiler import (
+    PROFILER_SAMPLE_BUDGET_NS,
+    SamplingProfiler,
+    attribution,
+    profiler,
+    set_profiler,
+)
+from .jaxcost import (
+    JaxCostLedger,
+    ledger as jax_ledger,
+    set_ledger as set_jax_ledger,
+    track as jax_track,
+)
 
 _exemplars.install()
 
@@ -84,4 +102,13 @@ __all__ = [
     "default_specs",
     "slo_engine",
     "set_slo_engine",
+    "PROFILER_SAMPLE_BUDGET_NS",
+    "SamplingProfiler",
+    "attribution",
+    "profiler",
+    "set_profiler",
+    "JaxCostLedger",
+    "jax_ledger",
+    "set_jax_ledger",
+    "jax_track",
 ]

@@ -1,11 +1,13 @@
-"""Trace waterfall + SLO status pages — the HTML faces of obs/.
+"""Trace waterfall, SLO status, and profiler flame pages — the HTML
+faces of obs/.
 
 Built from the same ``ui/vdom.py`` components as every other page and
-registered as normal routes (``/debug/traces/html`` and ``/sloz/html``,
-registration.py), so the host renders them through the standard
-nav/chrome and the "all registered routes render" test covers them for
-free. The JSON twins live at ``/debug/traces`` and ``/sloz`` (served
-directly by the app layer — they are data, not pages).
+registered as normal routes (``/debug/traces/html``, ``/sloz/html``,
+``/debug/profilez/html``, registration.py), so the host renders them
+through the standard nav/chrome and the "all registered routes render"
+test covers them for free. The JSON twins live at ``/debug/traces``,
+``/sloz``, and ``/debug/profilez`` (served directly by the app layer —
+they are data, not pages).
 
 Waterfall layout: traces sorted slowest-first (the page exists to
 answer "what were the slowest recent requests"), each with a per-span
@@ -223,6 +225,127 @@ def _slo_section(slo: dict[str, Any], page_burn: float, warn_burn: float) -> Ele
         h("div", {"class_": "hl-slo-burns"}, burn_rows),
         BudgetBar(slo["budget_remaining_ratio"]),
         _exemplar_links(slo.get("exemplars", [])),
+    )
+
+
+def _flame_rows(
+    node: dict[str, Any], scale: float, offset: float, depth: int
+) -> list[Element]:
+    """Flatten one call-tree subtree into flame rows, depth-first: the
+    bar spans the node's share of its root's samples, positioned at the
+    cumulative offset of its elder siblings — the classic flamegraph
+    geometry, one row per tree position (same row kit as the trace
+    waterfall so style.py themes both)."""
+    left = min(offset / scale * 100.0, 100.0)
+    width = max(min(node["total"] / scale * 100.0, 100.0 - left), 0.5)
+    rows = [
+        h(
+            "div",
+            {"class_": "hl-span-row hl-flame-row"},
+            h(
+                "span",
+                {
+                    "class_": "hl-span-label",
+                    "style": f"padding-left:{depth * 16}px",
+                },
+                node["name"],
+            ),
+            h(
+                "span",
+                {"class_": "hl-span-track"},
+                h(
+                    "span",
+                    {
+                        "class_": "hl-span-bar",
+                        "style": f"margin-left:{left:.2f}%;width:{width:.2f}%",
+                    },
+                ),
+            ),
+            h(
+                "span",
+                {"class_": "hl-span-ms"},
+                f"{node['total']} ({node['self']} self)",
+            ),
+        )
+    ]
+    child_offset = offset
+    for child in node["children"]:
+        rows.extend(_flame_rows(child, scale, child_offset, depth + 1))
+        child_offset += child["total"]
+    return rows
+
+
+def _route_flame_section(root: dict[str, Any]) -> Element:
+    """One section per attribution root (the route segment the sampled
+    thread published, or ``(untracked)``)."""
+    scale = max(float(root["total"]), 1.0)
+    return h(
+        "section",
+        {"class_": "hl-section hl-flame", "data-route": root["name"]},
+        h(
+            "header",
+            {"class_": "hl-trace-header"},
+            h("strong", None, root["name"]),
+            h(
+                "span",
+                {"class_": "hl-hint"},
+                f"{root['total']} sampled stack(s)",
+            ),
+        ),
+        [
+            row
+            for child in root["children"]
+            for row in _flame_rows(child, scale, 0.0, 0)
+        ]
+        or h("p", {"class_": "hl-hint"}, "No frames recorded yet."),
+    )
+
+
+def profile_page(snapshot: dict[str, Any]) -> Element:
+    """The flame view over ``SamplingProfiler.snapshot()`` (ADR-019).
+    Routes sort by sampled weight — the page exists to answer "where is
+    Python time going", so the heaviest attribution root leads.
+
+    Reading caveat (OPERATIONS.md runbook): a sampler sees *time*, not
+    calls, and charges device/C waits to the Python frame blocking on
+    them — cross-check compile storms on the /healthz jax ledger."""
+    tree = snapshot.get("tree", {})
+    roots = sorted(
+        tree.get("children", []), key=lambda n: -n["total"]
+    )
+    overhead = snapshot.get("overhead_ns_per_sample")
+    status = (
+        f"{snapshot.get('samples', 0)} sample(s) · "
+        f"{snapshot.get('stacks', 0)} stack(s) · "
+        f"{snapshot.get('nodes', 0)}/{snapshot.get('max_nodes', 0)} node(s)"
+        + (
+            f" · {snapshot.get('collapsed_stacks', 0)} collapsed"
+            if snapshot.get("collapsed_stacks")
+            else ""
+        )
+        + (f" · {overhead:.0f} ns/sample" if overhead is not None else "")
+        + (" · BURSTING" if snapshot.get("bursting") else "")
+    )
+    return h(
+        "div",
+        {"class_": "hl-flames"},
+        h("h1", None, "Continuous Profile"),
+        h(
+            "p",
+            {"class_": "hl-hint"},
+            status + ". Raw JSON: /debug/profilez · folded stacks: "
+            "/debug/profilez/folded · burst: /debug/profilez?burst=30 · "
+            "samples measure wall time, not call counts (OPERATIONS.md "
+            "runbook).",
+        ),
+        [_route_flame_section(r) for r in roots]
+        if roots
+        else h(
+            "div",
+            {"class_": "hl-empty-content"},
+            "No samples captured yet — the sampler starts with serve(), "
+            "or POST a burst via /debug/profilez?burst=30.",
+        ),
     )
 
 

@@ -1,0 +1,223 @@
+"""JAX cost ledger — the "which program" half of ADR-019's
+self-diagnosis tier.
+
+The profiler (:mod:`.profiler`) sees Python time; everything XLA does
+hides behind whichever frame blocks on it. This ledger makes the device
+side first-class: every jitted entry point in the repo (fleet rollup,
+cold/warm forecast fit, the SLO burn self-forecast, the sharded mesh
+rollup) wraps its dispatch in :func:`track`, which classifies each call
+as a **compile** (first sighting of the ``(program, signature)`` pair —
+jax traces and compiles exactly then) or a **warm dispatch**, and
+records the elapsed seconds per class. Host←device bytes dual-account
+with the ADR-012 ``TransferStats`` counters: the transfer funnel's
+counted ``device_get`` feeds :func:`note_transfer` with the fetched
+tree's leaf bytes, so `blocking_gets` (round-trips) and
+``transfer_bytes`` (payload) describe the same transitions.
+
+Stdlib-only on purpose: the ledger must import on a jax-less host (the
+server imports obs unconditionally), so compile detection is the
+signature-memo above, not jax internals. A signature is whatever the
+call site says drives recompilation — static args plus input shapes —
+which is exactly jax's own cache key modulo dtype edge cases.
+
+Surfaces: ``headlamp_tpu_jax_*`` families on ``/metricsz`` (the
+acceptance family ``headlamp_tpu_jax_compiles_total`` splits first-call
+compiles from warm dispatches per program), a ``runtime.jax`` block in
+``/healthz``, and a ``jax.*`` counters block in flight-recorder wide
+events — the before/after evidence the AOT-compile roadmap item needs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Iterator
+
+from .metrics import registry as _registry
+
+_COMPILES = _registry.counter(
+    "headlamp_tpu_jax_compiles_total",
+    "First-call compilations per jitted program: a (program, signature) "
+    "pair seen for the first time paid trace+compile, not just dispatch "
+    "(ADR-019).",
+    labels=("program",),
+)
+_DISPATCHES = _registry.counter(
+    "headlamp_tpu_jax_dispatches_total",
+    "Warm dispatches per jitted program (signature already compiled).",
+    labels=("program",),
+)
+_COMPILE_SECONDS = _registry.histogram(
+    "headlamp_tpu_jax_compile_seconds",
+    "Wall-clock cost of first-call compiles per program (perf_counter "
+    "around the dispatching call).",
+    labels=("program",),
+)
+_TRANSFER_BYTES = _registry.counter(
+    "headlamp_tpu_jax_transfer_bytes_total",
+    "Host<->device payload bytes through the counted transfer funnel, "
+    "dual-accounting with headlamp_tpu_transfer_blocking_gets_total "
+    "(round-trips there, bytes here).",
+    labels=("direction",),
+)
+
+
+class JaxCostLedger:
+    """Per-process compile/dispatch/transfer accounting. Thread-safe;
+    all serving threads share one instance. ``perf`` is an injectable
+    duration seam (tests script it; perf_counter is the sanctioned
+    default — ADR-013 clock audit)."""
+
+    def __init__(self, *, perf: Callable[[], float] = time.perf_counter) -> None:
+        self._perf = perf
+        self._lock = threading.Lock()
+        self._seen: set[tuple[str, Any]] = set()
+        self._programs: dict[str, dict[str, Any]] = {}
+        # Monotone ints (flight/healthz counters view — r10-review rule).
+        self.compiles = 0
+        self.dispatches = 0
+        self.transfers = 0
+        self.transfer_bytes = 0
+
+    @contextmanager
+    def track(self, program: str, signature: Any = None) -> Iterator[None]:
+        """Wrap one jitted call. ``signature`` is whatever drives
+        recompilation for this program (shapes + static args); the
+        first successful call per (program, signature) is a compile,
+        every later one a dispatch. A raising call records nothing —
+        the next attempt still counts as the compile."""
+        t0 = self._perf()
+        yield
+        self._record(program, signature, self._perf() - t0)
+
+    def _record(self, program: str, signature: Any, elapsed_s: float) -> None:
+        key = (program, signature)
+        with self._lock:
+            first = key not in self._seen
+            if first:
+                self._seen.add(key)
+            row = self._programs.setdefault(
+                program,
+                {
+                    "compiles": 0,
+                    "dispatches": 0,
+                    "compile_s": 0.0,
+                    "dispatch_s": 0.0,
+                    "signatures": 0,
+                },
+            )
+            if first:
+                row["compiles"] += 1
+                row["compile_s"] += elapsed_s
+                row["signatures"] += 1
+                self.compiles += 1
+            else:
+                row["dispatches"] += 1
+                row["dispatch_s"] += elapsed_s
+                self.dispatches += 1
+        if first:
+            _COMPILES.inc(program=program)
+            _COMPILE_SECONDS.observe(elapsed_s, program=program)
+            # ADR-018: a locally measured duration — gated through
+            # capture_timings so replay rounds stay byte-stable.
+            store = _history_store()
+            if store is not None:
+                store.record_timing(
+                    "jax.compile_ms", elapsed_s * 1000.0, labels=(program,)
+                )
+        else:
+            _DISPATCHES.inc(program=program)
+
+    def note_transfer(
+        self, n_bytes: int, *, direction: str = "d2h", chunks: int = 1
+    ) -> None:
+        """Account one funnel fetch's payload. Called by
+        ``runtime.transfer._counted_device_get`` — the same transition
+        that increments ``TransferStats.blocking_gets``."""
+        n_bytes = int(n_bytes)
+        with self._lock:
+            self.transfers += int(chunks)
+            self.transfer_bytes += n_bytes
+        if n_bytes > 0:
+            _TRANSFER_BYTES.inc(n_bytes, direction=direction)
+
+    # -- read surfaces ---------------------------------------------------
+
+    def counters(self) -> dict[str, int]:
+        """Monotone ints, lock-free — the flight recorder's per-request
+        delta view (r10-review rule)."""
+        return {
+            "compiles": self.compiles,
+            "dispatches": self.dispatches,
+            "transfers": self.transfers,
+            "transfer_bytes": self.transfer_bytes,
+        }
+
+    def snapshot(self) -> dict[str, Any]:
+        """``/healthz`` ``runtime.jax`` block: totals plus a
+        per-program table (compiles, warm dispatches, cumulative
+        milliseconds per class, distinct signatures compiled)."""
+        with self._lock:
+            programs = {
+                name: {
+                    "compiles": row["compiles"],
+                    "dispatches": row["dispatches"],
+                    "compile_ms": round(row["compile_s"] * 1000.0, 1),
+                    "dispatch_ms": round(row["dispatch_s"] * 1000.0, 1),
+                    "signatures": row["signatures"],
+                }
+                for name, row in sorted(self._programs.items())
+            }
+        return {
+            "compiles": self.compiles,
+            "dispatches": self.dispatches,
+            "transfers": self.transfers,
+            "transfer_bytes": self.transfer_bytes,
+            "programs": programs,
+        }
+
+
+def _history_store() -> Any | None:
+    """Lazy active-store lookup (history imports obs; a module-level
+    import here would cycle through the package init)."""
+    try:
+        from ..history.store import active_store
+
+        return active_store()
+    except Exception:  # noqa: BLE001 — capture is an enhancement
+        return None
+
+
+# The process ledger. set_ledger swaps it for tests; module-level
+# convenience wrappers read through the accessor so call sites stay a
+# one-liner and always hit the live instance.
+_LEDGER = JaxCostLedger()
+
+
+def ledger() -> JaxCostLedger:
+    return _LEDGER
+
+
+def set_ledger(instance: JaxCostLedger) -> JaxCostLedger:
+    """Install ``instance`` as the process ledger; returns the one it
+    replaced so tests can restore."""
+    global _LEDGER
+    previous, _LEDGER = _LEDGER, instance
+    return previous
+
+
+@contextmanager
+def track(program: str, signature: Any = None) -> Iterator[None]:
+    """Module-level :meth:`JaxCostLedger.track` against the live
+    ledger — what the jitted call sites import."""
+    with _LEDGER.track(program, signature):
+        yield
+
+
+def note_transfer(
+    n_bytes: int, *, direction: str = "d2h", chunks: int = 1
+) -> None:
+    """Module-level :meth:`JaxCostLedger.note_transfer` against the
+    live ledger."""
+    _LEDGER.note_transfer(n_bytes, direction=direction, chunks=chunks)

@@ -1,0 +1,464 @@
+"""Bounded sampling wall-clock profiler — the "which code" half of
+ADR-019's self-diagnosis tier.
+
+A stack sampler walks ``sys._current_frames()`` and interns each
+thread's stack into a bounded call tree, so `/debug/profilez` can say
+*where Python time goes* without per-call instrumentation. Design
+rules, in the repo's house discipline:
+
+- **Injected-clock scheduling** (ADR-013): *when to sample* is decided
+  on an injected monotonic via :meth:`SamplingProfiler.tick`, so tests
+  script the cadence deterministically. Only *how long a sample took*
+  reads ``perf_counter`` (a measured duration, the sanctioned form).
+- **Bounded always**: the call tree never grows past ``max_nodes``;
+  overflow stacks collapse into a per-parent ``(other)`` bucket and are
+  COUNTED (``collapsed_stacks``), never silent. Stack walks cap at
+  ``max_depth`` frames.
+- **Attribution via the ADR-013 contextvar**: the sampler thread cannot
+  see a request thread's ContextVar, so the request thread *publishes*
+  its route + ``current_trace_id()`` into a thread-ident registry on
+  entry (:func:`attribution`, wired in ``DashboardApp.handle``). Each
+  sampled stack is rooted at its thread's published route — the flame
+  view partitions by route for free.
+- **Always-on low rate, on-demand burst**: the default ~7 Hz costs one
+  frame-dict walk per period; :meth:`burst` raises the rate to ~97 Hz
+  for a bounded window when an operator is actively chasing a drift
+  (``GET /debug/profilez?burst=SECONDS``).
+
+Sampling-bias caveats (also in the OPERATIONS.md runbook): a sampler
+sees time, not calls — fast functions called often and slow functions
+called once look identical at equal total time; code that runs only
+between samples (shorter than one period) is invisible; C extensions
+and jitted device work charge their whole wait to the Python frame
+blocking on them (``transfer.flush`` shows up, the XLA program inside
+it does not — that is :mod:`.jaxcost`'s job).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Iterator, Mapping
+
+from .metrics import registry as _registry
+from .trace import current_trace_id
+
+#: Always-on sampling rate. ~7 Hz is deliberately prime-ish and slow:
+#: ~0.1 ms of walk per period is unmeasurable against a 16 ms paint,
+#: and a phase-locked rate (10 Hz vs a 100 ms poller) would alias.
+PROFILER_IDLE_HZ = 7.0
+#: Burst rate for on-demand windows (``?burst=SECONDS``). Prime, so it
+#: cannot phase-lock with millisecond-round loops.
+PROFILER_BURST_HZ = 97.0
+#: Longest burst one request may schedule.
+PROFILER_MAX_BURST_S = 60.0
+#: Call-tree bound: at ~40 bytes/node this is <100 KiB resident. Past
+#: it, new stacks collapse into per-parent ``(other)`` buckets.
+PROFILER_MAX_NODES = 2048
+#: Deepest stack interned; deeper walks keep the leaf-most frames.
+PROFILER_MAX_DEPTH = 64
+#: Per-``sample_once`` overhead budget (bench_profiler acceptance):
+#: one frame-dict walk + interning across every live thread.
+PROFILER_SAMPLE_BUDGET_NS = 500_000
+
+#: Root segment for stacks on threads that published no route.
+UNATTRIBUTED = "(untracked)"
+#: Name of the per-parent collapse bucket once the tree is full.
+OTHER_FRAME = "(other)"
+
+# Thread-ident → (route, trace_id): the bridge from the request
+# thread's ContextVar world into the sampler thread's frame walk. A
+# plain dict mutated only by the OWNING thread (publish on entry, pop
+# on exit) and read by the sampler — per-key races are benign (one
+# stale stack lands on the previous route).
+_THREAD_ROUTES: dict[int, tuple[str, str | None]] = {}
+
+
+@contextmanager
+def attribution(route: str) -> Iterator[None]:
+    """Publish the calling thread's route + active trace id for the
+    sampler. Entered by ``DashboardApp.handle`` INSIDE the request's
+    trace scope, so ``current_trace_id()`` (the ADR-013 contextvar)
+    resolves on the thread that owns it."""
+    ident = threading.get_ident()
+    prev = _THREAD_ROUTES.get(ident)
+    _THREAD_ROUTES[ident] = (route, current_trace_id())
+    try:
+        yield
+    finally:
+        if prev is None:
+            _THREAD_ROUTES.pop(ident, None)
+        else:
+            _THREAD_ROUTES[ident] = prev
+
+
+def _frame_key(frame: Any) -> str:
+    """Interned segment for one frame: ``func (path:line)`` with the
+    path shortened to the repo-relative tail — stable across hosts, so
+    folded output diffs cleanly between machines."""
+    code = frame.f_code
+    filename = code.co_filename.replace("\\", "/")
+    for marker in ("/headlamp_tpu/", "/tests/"):
+        idx = filename.rfind(marker)
+        if idx >= 0:
+            filename = filename[idx + 1:]
+            break
+    else:
+        filename = filename.rsplit("/", 1)[-1]
+    return f"{code.co_name} ({filename}:{code.co_firstlineno})"
+
+
+class _Node:
+    """One interned call-tree position. ``self_samples`` counts stacks
+    that ENDED here, ``total_samples`` stacks that passed through."""
+
+    __slots__ = ("key", "self_samples", "total_samples", "children")
+
+    def __init__(self, key: str) -> None:
+        self.key = key
+        self.self_samples = 0
+        self.total_samples = 0
+        self.children: dict[str, "_Node"] = {}
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.key,
+            "self": self.self_samples,
+            "total": self.total_samples,
+            "children": [
+                c.to_dict()
+                for c in sorted(
+                    self.children.values(), key=lambda n: -n.total_samples
+                )
+            ],
+        }
+
+
+class _CallTree:
+    """Interned, bounded call tree. ``node_count`` excludes the root;
+    once it reaches ``max_nodes`` new positions collapse into their
+    parent's ``(other)`` bucket (at most one per parent, so the hard
+    ceiling is ``2 x max_nodes`` — still bounded, still counted)."""
+
+    def __init__(self, max_nodes: int) -> None:
+        self.max_nodes = max_nodes
+        self.root = _Node("(root)")
+        self.node_count = 0
+
+    def intern(self, path: tuple[str, ...]) -> bool:
+        """Add one stack (root→leaf segments); returns True when any
+        part of it collapsed into an ``(other)`` bucket."""
+        node = self.root
+        node.total_samples += 1
+        collapsed = False
+        for key in path:
+            child = node.children.get(key)
+            if child is None:
+                if self.node_count >= self.max_nodes:
+                    child = node.children.get(OTHER_FRAME)
+                    if child is None:
+                        child = node.children[OTHER_FRAME] = _Node(OTHER_FRAME)
+                        self.node_count += 1
+                    child.total_samples += 1
+                    collapsed = True
+                    node = child
+                    break  # (other) is terminal: the tail is collapsed
+                child = node.children[key] = _Node(key)
+                self.node_count += 1
+            child.total_samples += 1
+            node = child
+        node.self_samples += 1
+        return collapsed
+
+    def fold(self) -> list[str]:
+        """Flamegraph folded-stack lines: ``seg;seg;... count`` — one
+        line per tree position with self samples (the standard input of
+        every flamegraph renderer)."""
+        lines: list[str] = []
+
+        def walk(node: _Node, prefix: str) -> None:
+            path = f"{prefix};{node.key}" if prefix else node.key
+            if node.self_samples:
+                lines.append(f"{path} {node.self_samples}")
+            for child in sorted(node.children.values(), key=lambda n: n.key):
+                walk(child, path)
+
+        for child in sorted(self.root.children.values(), key=lambda n: n.key):
+            walk(child, "")
+        return lines
+
+
+class SamplingProfiler:
+    """The sampler. Scheduling (what *decides* a sample is due) runs on
+    the injected ``monotonic``; tests drive :meth:`tick` with a scripted
+    clock and feed :meth:`sample_once` duck-typed frame dicts. The
+    production daemon thread (:meth:`start`) is started lazily by
+    ``DashboardApp.serve()`` only — constructing an app must never spawn
+    threads (tests build hundreds)."""
+
+    def __init__(
+        self,
+        *,
+        monotonic: Callable[[], float] = time.monotonic,
+        idle_hz: float = PROFILER_IDLE_HZ,
+        burst_hz: float = PROFILER_BURST_HZ,
+        max_nodes: int = PROFILER_MAX_NODES,
+        max_depth: int = PROFILER_MAX_DEPTH,
+    ) -> None:
+        self._monotonic = monotonic
+        self.idle_interval_s = 1.0 / idle_hz
+        self.burst_interval_s = 1.0 / burst_hz
+        self.max_depth = max_depth
+        self._lock = threading.Lock()
+        self._tree = _CallTree(max_nodes)
+        self._next_due = float("-inf")  # first tick always samples
+        self._burst_until = float("-inf")
+        self._routes: dict[str, dict[str, Any]] = {}
+        # Monotone ints (flight/healthz counters view — r10-review rule).
+        self.samples = 0          # sample_once invocations
+        self.stacks = 0           # thread stacks interned
+        self.collapsed_stacks = 0
+        self.last_thread_count = 0
+        self._overhead_ns_total = 0
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # -- scheduling (injected clock) -------------------------------------
+
+    def interval_s(self, now: float | None = None) -> float:
+        now = self._monotonic() if now is None else now
+        return (
+            self.burst_interval_s
+            if now < self._burst_until
+            else self.idle_interval_s
+        )
+
+    def bursting(self) -> bool:
+        return self._monotonic() < self._burst_until
+
+    def burst(self, seconds: float) -> float:
+        """Raise the rate to burst_hz for ``seconds`` (clamped to
+        ``PROFILER_MAX_BURST_S``); returns the granted window."""
+        granted = max(0.0, min(float(seconds), PROFILER_MAX_BURST_S))
+        self._burst_until = self._monotonic() + granted
+        return granted
+
+    def tick(self) -> bool:
+        """One scheduler step: sample iff a period has elapsed on the
+        injected clock. Returns whether a sample ran."""
+        now = self._monotonic()
+        if now < self._next_due:
+            return False
+        self.sample_once()
+        self._next_due = now + self.interval_s(now)
+        return True
+
+    # -- sampling --------------------------------------------------------
+
+    def sample_once(
+        self, frames: Mapping[int, Any] | None = None
+    ) -> int:
+        """Walk one frame snapshot (``sys._current_frames()`` unless a
+        test injects duck-typed frames) into the call tree. Returns the
+        stacks interned. perf_counter here measures the sampler's OWN
+        overhead — the bench_profiler budget number."""
+        t0 = time.perf_counter()
+        if frames is None:
+            frames = sys._current_frames()
+        me = threading.get_ident()
+        own = self._thread.ident if self._thread is not None else None
+        interned = 0
+        route_rows: list[tuple[str, str | None]] = []
+        with self._lock:
+            for ident, frame in frames.items():
+                if ident == me or ident == own:
+                    continue
+                keys: list[str] = []
+                f = frame
+                while f is not None and len(keys) < self.max_depth:
+                    keys.append(_frame_key(f))
+                    f = f.f_back
+                if not keys:
+                    continue
+                keys.reverse()  # root→leaf
+                route, trace_id = _THREAD_ROUTES.get(
+                    ident, (UNATTRIBUTED, None)
+                )
+                if self._tree.intern((route, *keys)):
+                    self.collapsed_stacks += 1
+                    _COLLAPSED_TOTAL.inc()
+                interned += 1
+                row = self._routes.setdefault(
+                    route, {"stacks": 0, "last_trace_id": None}
+                )
+                row["stacks"] += 1
+                if trace_id is not None:
+                    row["last_trace_id"] = trace_id
+                route_rows.append((route, trace_id))
+            self.samples += 1
+            self.stacks += interned
+            self.last_thread_count = interned
+        for route, _tid in route_rows:
+            _STACKS_TOTAL.inc(route=route)
+        _SAMPLES_TOTAL.inc()
+        overhead_ns = int((time.perf_counter() - t0) * 1e9)
+        self._overhead_ns_total += overhead_ns
+        # ADR-018: a locally measured duration — the history write goes
+        # through the capture_timings gate so replay stays byte-stable.
+        store = _history_store()
+        if store is not None:
+            store.record_timing("profiler.sample_overhead_ns", float(overhead_ns))
+        return interned
+
+    # -- always-on daemon (production only; never in tests) --------------
+
+    def start(self) -> None:
+        """Start the always-on low-rate sampler thread. Idempotent."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="headlamp-profiler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=1.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        # The poll period only bounds burst-activation latency; WHETHER
+        # a sample is due is still tick()'s injected-clock decision.
+        while not self._stop.wait(self.burst_interval_s):
+            self.tick()
+
+    # -- read surfaces ---------------------------------------------------
+
+    def overhead_ns_per_sample(self) -> float | None:
+        if not self.samples:
+            return None
+        return self._overhead_ns_total / self.samples
+
+    def node_count(self) -> int:
+        return self._tree.node_count
+
+    def folded(self) -> str:
+        """``GET /debug/profilez/folded`` body — flamegraph folded-stack
+        text, newline-terminated, empty string before any sample."""
+        with self._lock:
+            lines = self._tree.fold()
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> dict[str, Any]:
+        """``GET /debug/profilez`` JSON body."""
+        with self._lock:
+            tree = self._tree.root.to_dict()
+            routes = {
+                route: dict(row) for route, row in sorted(self._routes.items())
+            }
+        overhead = self.overhead_ns_per_sample()
+        return {
+            "samples": self.samples,
+            "stacks": self.stacks,
+            "collapsed_stacks": self.collapsed_stacks,
+            "nodes": self.node_count(),
+            "max_nodes": self._tree.max_nodes,
+            "last_thread_count": self.last_thread_count,
+            "running": self._thread is not None and self._thread.is_alive(),
+            "bursting": self.bursting(),
+            "interval_s": round(self.interval_s(), 4),
+            "overhead_ns_per_sample": (
+                round(overhead, 1) if overhead is not None else None
+            ),
+            "overhead_budget_ns": PROFILER_SAMPLE_BUDGET_NS,
+            "routes": routes,
+            "tree": tree,
+        }
+
+    def counters(self) -> dict[str, int]:
+        """Monotone ints, lock-free — the flight recorder's per-request
+        delta view (r10-review rule)."""
+        return {
+            "samples": self.samples,
+            "stacks": self.stacks,
+            "collapsed_stacks": self.collapsed_stacks,
+        }
+
+
+def _history_store() -> Any | None:
+    """The weakref'd active history store, lazily (history imports obs;
+    a module-level import here would cycle through the package init)."""
+    try:
+        from ..history.store import active_store
+
+        return active_store()
+    except Exception:  # noqa: BLE001 — capture is an enhancement
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Registry families (ADR-013 get-or-create; module import registers once)
+# ---------------------------------------------------------------------------
+
+_SAMPLES_TOTAL = _registry.counter(
+    "headlamp_tpu_profiler_samples_total",
+    "Sampler wake-ups that walked the process frame snapshot.",
+)
+_STACKS_TOTAL = _registry.counter(
+    "headlamp_tpu_profiler_stacks_total",
+    "Thread stacks interned into the profiler call tree, by the "
+    "route the owning thread published (ADR-019 attribution).",
+    labels=("route",),
+)
+_COLLAPSED_TOTAL = _registry.counter(
+    "headlamp_tpu_profiler_collapsed_stacks_total",
+    "Stacks that hit the call-tree node bound and collapsed into a "
+    "per-parent (other) bucket — counted, never silent.",
+)
+
+# The process-wide profiler. set_profiler swaps it (tests, scripted
+# clocks); the registry callbacks read through the accessor so the
+# latest instance is always the one /metricsz describes.
+_PROFILER = SamplingProfiler()
+
+
+def profiler() -> SamplingProfiler:
+    return _PROFILER
+
+
+def set_profiler(instance: SamplingProfiler) -> SamplingProfiler:
+    """Install ``instance`` as the process profiler; returns the one it
+    replaced so tests can restore."""
+    global _PROFILER
+    previous, _PROFILER = _PROFILER, instance
+    return previous
+
+
+def _nodes_sample() -> float:
+    return float(_PROFILER.node_count())
+
+
+def _overhead_sample() -> float | None:
+    """Mean per-sample overhead in SECONDS; None (a quiet family)
+    before the first sample."""
+    overhead = _PROFILER.overhead_ns_per_sample()
+    return overhead / 1e9 if overhead is not None else None
+
+
+_registry.gauge_fn(
+    "headlamp_tpu_profiler_nodes_count",
+    "Interned call-tree nodes held by the profiler (bounded by "
+    f"{PROFILER_MAX_NODES} plus per-parent collapse buckets).",
+    _nodes_sample,
+)
+_registry.gauge_fn(
+    "headlamp_tpu_profiler_overhead_seconds",
+    "Mean sampler overhead per wake-up (perf_counter around the frame "
+    "walk; budget " + str(PROFILER_SAMPLE_BUDGET_NS) + " ns).",
+    _overhead_sample,
+)

@@ -132,7 +132,22 @@ def _rollup_with_reducer(
         with _span(
             "mesh.rollup", reducer=reducer, hosts=mesh.devices.size
         ):
-            out = transfer.fetch(rollup_shard(*node_cols, *pod_cols))
+            from ..obs.jaxcost import track as _jax_track
+
+            # ADR-019 cost ledger: mesh shape + padded columns are the
+            # recompile key; the blocking fetch stays OUTSIDE the track
+            # so dispatch time is not conflated with transfer time.
+            with _jax_track(
+                "mesh.rollup",
+                (
+                    reducer,
+                    tuple(mesh.devices.shape),
+                    tuple(node_cols[0].shape),
+                    tuple(pod_cols[0].shape),
+                ),
+            ):
+                dispatched = rollup_shard(*node_cols, *pod_cols)
+            out = transfer.fetch(dispatched)
     return aggregates_to_host_dict(out, fleet.n_nodes)
 
 

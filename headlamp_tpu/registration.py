@@ -15,7 +15,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
-from .obs.debug_pages import slo_page, traces_page
+from .obs.debug_pages import profile_page, slo_page, traces_page
 from .integrations import (
     build_node_intel_columns,
     build_node_tpu_columns,
@@ -211,6 +211,15 @@ def register_plugin(registry: Registry | None = None) -> Registry:
                 "slo-status",
                 slo_page,
                 kind="slo",
+            ),
+            # Profiler flame view (ADR-019): same operator-tool posture;
+            # its JSON twin is /debug/profilez, folded stacks at
+            # /debug/profilez/folded.
+            Route(
+                "/debug/profilez/html",
+                "debug-profile",
+                profile_page,
+                kind="profile",
             ),
         ]
     )

@@ -105,7 +105,40 @@ def _counted_device_get(tree: Any, batch: "TransferBatch | None") -> Any:
     transfer_stats.record_blocking_get()
     if batch is not None:
         batch.blocking_gets += 1
-    return jax.device_get(tree)
+    values = jax.device_get(tree)
+    _note_transfer_bytes(values)
+    return values
+
+
+def _tree_nbytes(values: Any) -> int:
+    """Payload bytes of a fetched host tree: sum of leaf ``nbytes``
+    (numpy arrays), with plain Python scalars costed at 8 — the
+    device-side float64/int64 a bare scalar fetch materializes."""
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(values)
+    except Exception:  # noqa: BLE001 — jax-less caller: nothing fetched
+        return 0
+    total = 0
+    for leaf in leaves:
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            nbytes = 8 if isinstance(leaf, (int, float, complex)) else 0
+        total += int(nbytes)
+    return total
+
+
+def _note_transfer_bytes(values: Any) -> None:
+    """Dual-account the fetched payload into the ADR-019 JAX cost
+    ledger — the SAME transition that just incremented
+    ``blocking_gets``, so round-trips and bytes can never disagree
+    about which fetches happened."""
+    try:
+        from ..obs.jaxcost import note_transfer
+    except Exception:  # noqa: BLE001 — ledger is an enhancement
+        return
+    note_transfer(_tree_nbytes(values), direction="d2h")
 
 
 def device_get(tree: Any) -> Any:

@@ -43,7 +43,9 @@ from ..history import HistoryStore, set_active_store
 from ..metrics.client import fetch_tpu_metrics
 from ..obs import slo as slo_mod
 from ..obs.flight import flight_recorder, wide_event
+from ..obs.jaxcost import ledger as jax_ledger
 from ..obs.metrics import registry as metrics_registry
+from ..obs.profiler import attribution, profiler
 from ..obs.trace import annotate, span, trace_request, trace_ring
 from ..runtime.refresh import Refresher
 from ..runtime.transfer import TransferBatch
@@ -152,6 +154,21 @@ def _runtime_health(
         # Burn-rate states per declared SLO (ADR-016): the one-line
         # answer a probe reader wants before opening /sloz.
         out["slo"] = slo_mod.engine().health_block()
+        # JAX cost ledger (ADR-019): compiles vs warm dispatches per
+        # jitted program, plus counted host↔device bytes — the "is the
+        # device path recompiling?" answer without opening a profile.
+        out["jax"] = jax_ledger().snapshot()
+        # Profiler vitals only (counters + overhead) — the call tree
+        # itself lives at /debug/profilez, far too big for a probe.
+        prof = profiler()
+        overhead = prof.overhead_ns_per_sample()
+        out["profiler"] = {
+            **prof.counters(),
+            "nodes": prof.node_count(),
+            "overhead_ns_per_sample": (
+                round(overhead, 1) if overhead is not None else None
+            ),
+        }
         return out
     except Exception as exc:  # noqa: BLE001 — health must never 500 on analytics
         # An empty block read as "no runtime telemetry wired"; a named
@@ -198,6 +215,12 @@ def _runtime_counters(
     if history is not None:
         for key, value in history.counters().items():
             out[f"history.{key}"] = value
+    # ADR-019: process-wide singletons (ledger + profiler), same
+    # bleed-between-neighbours caveat as every other counter here.
+    for key, value in jax_ledger().counters().items():
+        out[f"jax.{key}"] = value
+    for key, value in profiler().counters().items():
+        out[f"profiler.{key}"] = value
     return out
 
 
@@ -770,6 +793,9 @@ class DashboardApp:
             "/sloz",
             "/sloz/html",
             "/debug/flightz",
+            "/debug/profilez",
+            "/debug/profilez/folded",
+            "/debug/profilez/html",
         }
     )
 
@@ -786,6 +812,8 @@ class DashboardApp:
             "/debug/traces",
             "/sloz",
             "/debug/flightz",
+            "/debug/profilez",
+            "/debug/profilez/folded",
         ):
             return route_path
         if _NODE_DETAIL_RE.match(route_path):
@@ -845,7 +873,12 @@ class DashboardApp:
                 gateway=self.gateway,
                 history=self.history,
             )
-        with trace_request(path, enabled=recorded, wall=self._clock) as trace:
+        # attribution() publishes this thread's route + trace id for the
+        # sampling profiler (ADR-019). Entered AFTER trace_request so
+        # current_trace_id() resolves to this request's trace.
+        with trace_request(
+            path, enabled=recorded, wall=self._clock
+        ) as trace, attribution(route_label):
             try:
                 if gateway_info:
                     # Marker span carrying the admission-side story
@@ -1040,6 +1073,29 @@ class DashboardApp:
             )
             return 200, "application/json", body
 
+        if route_path == "/debug/profilez":
+            # Sampling-profiler state (ADR-019): counters, per-route
+            # stack attribution, and the bounded call tree. ?burst=N
+            # raises the sampling rate for N seconds (clamped) so an
+            # operator chasing a live drift gets resolution on demand.
+            prof = profiler()
+            query = parse_qs(parsed.query)
+            granted: float | None = None
+            if "burst" in query:
+                try:
+                    granted = prof.burst(float(query["burst"][0]))
+                except ValueError:
+                    granted = None
+            out = prof.snapshot()
+            if granted is not None:
+                out["burst_granted_s"] = granted
+            return 200, "application/json", json.dumps(out)
+
+        if route_path == "/debug/profilez/folded":
+            # Flamegraph folded-stack text — pipe straight into any
+            # flamegraph renderer.
+            return 200, "text/plain", profiler().folded()
+
         if route_path == "/refresh":
             # With background sync live, waking the loop covers BOTH
             # tracks (its sync() runs reactive + imperative) and the
@@ -1154,6 +1210,10 @@ class DashboardApp:
                 # page: renders the engine's report, never the cluster
                 # snapshot, so it paints even mid-incident.
                 el = route.component(slo_mod.engine().report())
+            elif route.kind == "profile":
+                # Flame view over the profiler snapshot — no cluster
+                # snapshot either, for the same reason.
+                el = route.component(profiler().snapshot())
             elif route.kind == "trends":
                 # Pure function of the store's windowed view (ADR-018):
                 # no snapshot, no sync — trends must paint even when
@@ -1218,6 +1278,10 @@ class DashboardApp:
 
     def serve(self, host: str = "127.0.0.1", port: int = 8631) -> ThreadingHTTPServer:
         gateway = self.ensure_gateway()
+        # Always-on low-rate sampler (ADR-019). Here, not in __init__:
+        # constructing an app must never spawn threads (tests build
+        # hundreds of apps); only a socket-serving host profiles itself.
+        profiler().start()
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)

@@ -589,3 +589,19 @@ class TestReplayParity:
         first = bench.replay_round(recording_path, rate=3.0)
         second = bench.replay_round(recording_path, rate=3.0)
         assert first == second
+
+    def test_replay_with_profiler_enabled_keeps_byte_parity(
+        self, recording_path
+    ):
+        # ADR-019 parity pin: a round that runs the stack sampler after
+        # every request replays byte-identically to a profiler-less
+        # round — the sampler's locally measured overhead series goes
+        # through the capture_timings gate and never reaches the
+        # compared output.
+        import bench
+
+        plain = bench.replay_round(recording_path)
+        profiled = bench.replay_round(recording_path, profile=True)
+        profiled_again = bench.replay_round(recording_path, profile=True)
+        assert profiled == plain
+        assert profiled == profiled_again

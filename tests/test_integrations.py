@@ -121,9 +121,10 @@ class TestRegistration:
             "/intel/metrics",
         }
         native_paths = {"/nodes"}
-        # ADR-013/016: the trace waterfall and the SLO page register as
-        # routes (styling + registry dispatch) but add no sidebar entry.
-        debug_paths = {"/debug/traces/html", "/sloz/html"}
+        # ADR-013/016/019: the trace waterfall, the SLO page, and the
+        # profiler flame view register as routes (styling + registry
+        # dispatch) but add no sidebar entry.
+        debug_paths = {"/debug/traces/html", "/sloz/html", "/debug/profilez/html"}
         expected = tpu_paths | intel_paths | native_paths | debug_paths
         assert {r.path for r in reg.routes} == expected
         # Both providers inject into Node and Pod detail views.

@@ -1,0 +1,141 @@
+"""JAX cost ledger (ADR-019): compile-vs-dispatch classification on a
+scripted duration seam, never-silent failure semantics, and the
+transfer-byte dual-accounting contract with the ADR-012 TransferStats
+funnel.
+
+Every test builds its own :class:`JaxCostLedger` (the singleton swap is
+exercised once, restoratively) — the ledger is plain bookkeeping, so
+nothing here needs a device; only the funnel test imports jax, through
+the same ``transfer.fetch`` path the serving code uses.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from headlamp_tpu.obs.jaxcost import (
+    JaxCostLedger,
+    ledger,
+    set_ledger,
+    track,
+)
+
+
+class _Perf:
+    """Scripted perf_counter: each read advances by ``step`` seconds,
+    so every tracked call 'lasts' exactly one step."""
+
+    def __init__(self, step: float = 1.0) -> None:
+        self.now = 0.0
+        self.step = step
+
+    def __call__(self) -> float:
+        value = self.now
+        self.now += self.step
+        return value
+
+
+class TestCompileVsDispatch:
+    def test_first_sighting_is_a_compile_then_dispatches_only(self):
+        led = JaxCostLedger(perf=_Perf())
+        for _ in range(3):
+            with led.track("forecast.fit", ((256, 96), 60)):
+                pass
+        assert led.compiles == 1
+        assert led.dispatches == 2
+        row = led.snapshot()["programs"]["forecast.fit"]
+        assert row["compiles"] == 1 and row["dispatches"] == 2
+        assert row["signatures"] == 1
+
+    def test_new_signature_is_a_new_compile(self):
+        led = JaxCostLedger(perf=_Perf())
+        with led.track("forecast.fit", ((256, 96), 60)):
+            pass
+        with led.track("forecast.fit", ((512, 96), 60)):
+            pass
+        with led.track("forecast.fit", ((256, 96), 60)):
+            pass
+        assert led.compiles == 2
+        assert led.dispatches == 1
+        assert led.snapshot()["programs"]["forecast.fit"]["signatures"] == 2
+
+    def test_programs_account_independently(self):
+        led = JaxCostLedger(perf=_Perf())
+        with led.track("a", 1):
+            pass
+        with led.track("b", 1):
+            pass
+        programs = led.snapshot()["programs"]
+        assert programs["a"]["compiles"] == 1
+        assert programs["b"]["compiles"] == 1
+        assert led.compiles == 2
+
+    def test_elapsed_seconds_split_by_class(self):
+        led = JaxCostLedger(perf=_Perf(step=0.5))
+        for _ in range(3):
+            with led.track("p", "sig"):
+                pass
+        row = led.snapshot()["programs"]["p"]
+        # Scripted seam: every call lasts exactly 0.5 s — one compile,
+        # two warm dispatches.
+        assert row["compile_ms"] == pytest.approx(500.0)
+        assert row["dispatch_ms"] == pytest.approx(1000.0)
+
+    def test_raising_call_records_nothing(self):
+        # A failed call never reached the device cache — the NEXT
+        # attempt still pays (and must be classified as) the compile.
+        led = JaxCostLedger(perf=_Perf())
+        with pytest.raises(RuntimeError):
+            with led.track("p", "sig"):
+                raise RuntimeError("trace failed")
+        assert led.compiles == 0 and led.dispatches == 0
+        assert led.snapshot()["programs"] == {}
+        with led.track("p", "sig"):
+            pass
+        assert led.compiles == 1
+
+
+class TestTransferAccounting:
+    def test_note_transfer_accumulates_bytes_and_chunks(self):
+        led = JaxCostLedger(perf=_Perf())
+        led.note_transfer(400)
+        led.note_transfer(100, direction="h2d", chunks=2)
+        assert led.transfers == 3
+        assert led.transfer_bytes == 500
+        assert led.counters()["transfer_bytes"] == 500
+
+    def test_funnel_fetch_dual_accounts_with_transfer_stats(self):
+        # THE dual-accounting contract: one transfer.fetch pays exactly
+        # one blocking_gets round-trip in TransferStats AND the fetched
+        # tree's leaf bytes in the ledger — same transition, two axes.
+        np = pytest.importorskip("numpy")
+        pytest.importorskip("jax")
+        from headlamp_tpu.runtime import transfer
+
+        led = JaxCostLedger(perf=_Perf())
+        previous = set_ledger(led)
+        try:
+            before = transfer.transfer_stats.blocking_gets
+            value = transfer.fetch(np.zeros(100, dtype=np.float32))
+        finally:
+            set_ledger(previous)
+        assert value.shape == (100,)
+        assert transfer.transfer_stats.blocking_gets == before + 1
+        assert led.transfers == 1
+        assert led.transfer_bytes == 400  # 100 x float32
+
+
+class TestProcessSingleton:
+    def test_set_ledger_swaps_and_module_track_follows(self):
+        replacement = JaxCostLedger(perf=_Perf())
+        previous = set_ledger(replacement)
+        untouched = previous.compiles
+        try:
+            assert ledger() is replacement
+            with track("p", "sig"):
+                pass
+            assert replacement.compiles == 1
+            assert previous.compiles == untouched
+        finally:
+            set_ledger(previous)
+        assert ledger() is previous

@@ -194,6 +194,13 @@ class TestFormat:
             # wiring as the gateway gauges above.
             "headlamp_tpu_history_memory_bytes",
             "headlamp_tpu_history_window_span_seconds",
+            # ADR-019 self-diagnosis tier: the compile-seconds histogram
+            # is quiet until a jitted program actually compiles in this
+            # process (jax-less hosts never do), and the profiler
+            # overhead gauge reports None before its first sample (the
+            # sampler thread only starts with serve(), never handle()).
+            "headlamp_tpu_jax_compile_seconds",
+            "headlamp_tpu_profiler_overhead_seconds",
         }, f"unexpected sample-free families: {sorted(quiet)}"
 
     def test_name_grammar_and_unit_suffixes(self, exposition):

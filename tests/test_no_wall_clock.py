@@ -135,6 +135,33 @@ class TestMutations:
             "bad_store.py",
         }
 
+    def test_profiler_scheduling_on_wall_clock_flagged(self):
+        # The ADR-019 mistake the obs scope guards in profiler.py:
+        # deciding WHEN a sample is due on the wall clock instead of
+        # the injected monotonic (a scripted test could never drive it).
+        diags = self._diags(
+            "import time\n"
+            "def tick(self):\n"
+            "    now = time.time()\n"
+            "    return now >= self._next_due\n"
+        )
+        assert len(diags) == 1
+        assert diags[0].line == 3
+
+    def test_profiler_sanctioned_forms_allowed(self):
+        # The real profiler/jaxcost shape: injected-monotonic seam
+        # default for scheduling, perf_counter strictly as a measured
+        # duration (sampler overhead, compile seconds).
+        diags = self._diags(
+            "import time\n"
+            "def __init__(self, *, monotonic=time.monotonic):\n"
+            "    self._monotonic = monotonic\n"
+            "def sample_once(self):\n"
+            "    t0 = time.perf_counter()\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert diags == []
+
     def test_replay_pacing_on_wall_clock_flagged(self):
         # The exact mistake the history scope exists to catch: pacing a
         # replay on the wall clock instead of an injected monotonic.

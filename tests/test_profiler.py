@@ -1,0 +1,274 @@
+"""Sampling profiler (ADR-019): scheduling on scripted clocks, bounded
+call-tree interning, route attribution, and the folded-stack format.
+
+No sampler thread anywhere in here — tests drive :meth:`tick` on a
+scripted monotonic and feed :meth:`sample_once` duck-typed frames, the
+exact seams the module documents. The one real-frames test publishes a
+route from a worker thread parked on an Event, so it is deterministic
+too: the thread's stack cannot change while it waits.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from headlamp_tpu.obs.profiler import (
+    OTHER_FRAME,
+    PROFILER_MAX_BURST_S,
+    UNATTRIBUTED,
+    SamplingProfiler,
+    attribution,
+    profiler,
+    set_profiler,
+)
+
+
+class _Clock:
+    def __init__(self, start: float = 0.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+class _Code:
+    def __init__(self, name: str, filename: str, line: int) -> None:
+        self.co_name = name
+        self.co_filename = filename
+        self.co_firstlineno = line
+
+
+class _Frame:
+    """Duck-typed frame: what ``sample_once`` walks via ``f_back``."""
+
+    def __init__(self, name: str, back: "._Frame | None" = None, *,
+                 filename: str = "/x/y/headlamp_tpu/fake/mod.py",
+                 line: int = 1) -> None:
+        self.f_code = _Code(name, filename, line)
+        self.f_back = back
+
+
+def _stack(*names: str) -> _Frame:
+    """Build a root→leaf chain from ``names``; returns the LEAF frame
+    (the shape ``sys._current_frames`` hands out)."""
+    frame = None
+    for name in names:
+        frame = _Frame(name, back=frame)
+    return frame
+
+
+#: A thread ident that is never the calling thread's.
+_FAKE_IDENT = 1 << 40
+
+
+class TestScheduling:
+    def test_first_tick_always_samples(self):
+        clock = _Clock(100.0)
+        prof = SamplingProfiler(monotonic=clock)
+        assert prof.tick() is True
+        assert prof.samples == 1
+
+    def test_tick_waits_one_idle_period(self):
+        clock = _Clock()
+        prof = SamplingProfiler(monotonic=clock, idle_hz=10.0)
+        assert prof.tick() is True
+        assert prof.tick() is False  # same instant: not due
+        clock.advance(0.05)
+        assert prof.tick() is False  # half a period
+        clock.advance(0.06)
+        assert prof.tick() is True
+
+    def test_burst_raises_rate_then_expires(self):
+        clock = _Clock()
+        prof = SamplingProfiler(monotonic=clock, idle_hz=10.0, burst_hz=100.0)
+        assert prof.burst(2.0) == 2.0
+        assert prof.bursting()
+        assert prof.interval_s() == pytest.approx(0.01)
+        clock.advance(2.5)
+        assert not prof.bursting()
+        assert prof.interval_s() == pytest.approx(0.1)
+
+    def test_burst_clamped_to_max_window(self):
+        prof = SamplingProfiler(monotonic=_Clock())
+        assert prof.burst(10_000) == PROFILER_MAX_BURST_S
+        assert prof.burst(-5) == 0.0
+
+    def test_tick_at_burst_rate_samples_more(self):
+        clock = _Clock()
+        prof = SamplingProfiler(monotonic=clock, idle_hz=1.0, burst_hz=10.0)
+        prof.burst(1.0)
+        ran = 0
+        for _ in range(10):
+            ran += 1 if prof.tick() else 0
+            clock.advance(0.1)
+        assert ran == 10  # every 100 ms step is a due burst period
+
+
+class TestCallTree:
+    def test_interning_counts_self_and_total(self):
+        prof = SamplingProfiler(monotonic=_Clock())
+        frames = {_FAKE_IDENT: _stack("serve", "handle", "render")}
+        assert prof.sample_once(frames) == 1
+        assert prof.sample_once(frames) == 1
+        snap = prof.snapshot()
+        root = snap["tree"]
+        assert root["total"] == 2
+        route_node = root["children"][0]
+        assert route_node["name"] == UNATTRIBUTED
+        serve = route_node["children"][0]
+        assert serve["name"].startswith("serve (headlamp_tpu/fake/mod.py:")
+        leaf = serve["children"][0]["children"][0]
+        assert leaf["name"].startswith("render")
+        assert leaf["self"] == 2 and leaf["total"] == 2
+        assert serve["self"] == 0 and serve["total"] == 2
+
+    def test_calling_thread_is_never_sampled(self):
+        prof = SamplingProfiler(monotonic=_Clock())
+        frames = {threading.get_ident(): _stack("me")}
+        assert prof.sample_once(frames) == 0
+        assert prof.samples == 1 and prof.stacks == 0
+
+    def test_depth_is_capped_keeping_leafmost_frames(self):
+        prof = SamplingProfiler(monotonic=_Clock(), max_depth=3)
+        frames = {_FAKE_IDENT: _stack("a", "b", "c", "d", "e")}
+        prof.sample_once(frames)
+        lines = prof.folded().splitlines()
+        assert len(lines) == 1
+        path = lines[0].rsplit(" ", 1)[0]
+        # Walks leaf-up, so the deepest 3 frames survive the cap.
+        assert ";".join(s.split(" ")[0] for s in path.split(";")) == (
+            f"{UNATTRIBUTED};c;d;e"
+        )
+
+    def test_node_bound_collapses_into_counted_other_bucket(self):
+        prof = SamplingProfiler(monotonic=_Clock(), max_nodes=4)
+        for i in range(10):
+            prof.sample_once({_FAKE_IDENT: _stack(f"fn_{i}")})
+        snap = prof.snapshot()
+        # Bounded: route node + real nodes + at most one (other) per
+        # parent — the documented hard ceiling of 2 x max_nodes.
+        assert snap["nodes"] <= 2 * 4
+        assert snap["collapsed_stacks"] > 0
+        route_node = snap["tree"]["children"][0]
+        others = [c for c in route_node["children"] if c["name"] == OTHER_FRAME]
+        assert len(others) == 1
+        # Nothing lost: collapsed stacks are counted IN the bucket.
+        assert snap["tree"]["total"] == 10
+
+    def test_overhead_is_measured_after_first_sample(self):
+        prof = SamplingProfiler(monotonic=_Clock())
+        assert prof.overhead_ns_per_sample() is None
+        prof.sample_once({_FAKE_IDENT: _stack("a")})
+        assert prof.overhead_ns_per_sample() is not None
+        assert prof.counters() == {
+            "samples": 1,
+            "stacks": 1,
+            "collapsed_stacks": 0,
+        }
+
+
+class TestAttribution:
+    def test_unpublished_thread_roots_at_untracked(self):
+        prof = SamplingProfiler(monotonic=_Clock())
+        prof.sample_once({_FAKE_IDENT: _stack("loose")})
+        routes = prof.snapshot()["routes"]
+        assert routes == {UNATTRIBUTED: {"stacks": 1, "last_trace_id": None}}
+
+    def test_worker_published_route_roots_its_stacks(self):
+        # The real wiring: the OWNING thread publishes via attribution()
+        # (DashboardApp.handle does this); the sampler walks real frames
+        # and roots that thread's stack at the published route.
+        prof = SamplingProfiler(monotonic=_Clock())
+        parked = threading.Event()
+        release = threading.Event()
+
+        def work() -> None:
+            with attribution("/tpu/metrics"):
+                parked.set()
+                release.wait(timeout=10.0)
+
+        worker = threading.Thread(target=work, daemon=True)
+        worker.start()
+        try:
+            assert parked.wait(timeout=10.0)
+            prof.sample_once()
+        finally:
+            release.set()
+            worker.join(timeout=10.0)
+        routes = prof.snapshot()["routes"]
+        assert "/tpu/metrics" in routes
+        assert routes["/tpu/metrics"]["stacks"] >= 1
+        assert any(
+            line.startswith("/tpu/metrics;")
+            for line in prof.folded().splitlines()
+        )
+
+    def test_attribution_restores_previous_publication(self):
+        # Nested CMs (a route handler entering a sub-scope) must
+        # restore the OUTER route on exit: the sampler sees "inner"
+        # while the inner scope is open and "outer" again afterwards.
+        prof = SamplingProfiler(monotonic=_Clock())
+        at_inner = threading.Event()
+        leave_inner = threading.Event()
+        at_outer_again = threading.Event()
+        release = threading.Event()
+
+        def work() -> None:
+            with attribution("outer"):
+                with attribution("inner"):
+                    at_inner.set()
+                    leave_inner.wait(timeout=10.0)
+                at_outer_again.set()
+                release.wait(timeout=10.0)
+
+        worker = threading.Thread(target=work, daemon=True)
+        worker.start()
+        try:
+            assert at_inner.wait(timeout=10.0)
+            prof.sample_once()
+            leave_inner.set()
+            assert at_outer_again.wait(timeout=10.0)
+            prof.sample_once()
+        finally:
+            leave_inner.set()
+            release.set()
+            worker.join(timeout=10.0)
+        routes = prof.snapshot()["routes"]
+        assert routes.get("inner", {}).get("stacks", 0) >= 1
+        assert routes.get("outer", {}).get("stacks", 0) >= 1
+
+
+class TestFolded:
+    def test_empty_profiler_folds_to_empty_string(self):
+        assert SamplingProfiler(monotonic=_Clock()).folded() == ""
+
+    def test_folded_lines_are_semicolon_paths_with_counts(self):
+        prof = SamplingProfiler(monotonic=_Clock())
+        prof.sample_once({_FAKE_IDENT: _stack("a", "b")})
+        prof.sample_once({_FAKE_IDENT: _stack("a", "b")})
+        prof.sample_once({_FAKE_IDENT: _stack("a")})
+        out = prof.folded()
+        assert out.endswith("\n")
+        lines = out.splitlines()
+        assert len(lines) == 2  # one per position with self samples
+        for line in lines:
+            path, _, count = line.rpartition(" ")
+            assert path.startswith(f"{UNATTRIBUTED};a (")
+            assert int(count) in (1, 2)
+
+
+class TestProcessSingleton:
+    def test_set_profiler_swaps_and_returns_previous(self):
+        replacement = SamplingProfiler(monotonic=_Clock())
+        previous = set_profiler(replacement)
+        try:
+            assert profiler() is replacement
+        finally:
+            restored = set_profiler(previous)
+            assert restored is replacement
+        assert profiler() is previous
