@@ -151,25 +151,43 @@ def fleet_rollup(
 
 
 def rollup_arrays(fleet: FleetArrays) -> dict[str, jax.Array]:
+    from ..models.aot import registry as _aot_registry
     from ..obs.jaxcost import track as _jax_track
 
+    cols = (
+        jnp.asarray(fleet.node_capacity),
+        jnp.asarray(fleet.node_allocatable),
+        jnp.asarray(fleet.node_ready),
+        jnp.asarray(fleet.node_generation),
+        jnp.asarray(fleet.node_valid),
+        jnp.asarray(fleet.pod_request),
+        jnp.asarray(fleet.pod_phase),
+        jnp.asarray(fleet.pod_node_idx),
+        jnp.asarray(fleet.pod_valid),
+    )
     # ADR-019 cost ledger: padded column shapes are the recompile key
-    # (static args are defaulted constants here).
-    with _jax_track(
-        "analytics.fleet_rollup",
-        (tuple(fleet.node_capacity.shape), tuple(fleet.pod_request.shape)),
-    ):
-        return fleet_rollup(
-            jnp.asarray(fleet.node_capacity),
-            jnp.asarray(fleet.node_allocatable),
-            jnp.asarray(fleet.node_ready),
-            jnp.asarray(fleet.node_generation),
-            jnp.asarray(fleet.node_valid),
-            jnp.asarray(fleet.pod_request),
-            jnp.asarray(fleet.pod_phase),
-            jnp.asarray(fleet.pod_node_idx),
-            jnp.asarray(fleet.pod_valid),
-        )
+    # (static args are defaulted constants here). ADR-020: the same key
+    # looks up the startup-compiled executable, so a registry hit makes
+    # this call a warm dispatch with zero request-path compiles.
+    ledger_key = (
+        tuple(fleet.node_capacity.shape), tuple(fleet.pod_request.shape)
+    )
+    reg = _aot_registry()
+    exe = (
+        reg.executable("analytics.fleet_rollup", ledger_key)
+        if reg.ready()
+        else None
+    )
+    with _jax_track("analytics.fleet_rollup", ledger_key):
+        if exe is not None:
+            try:
+                return exe(*cols)
+            except Exception as exc:  # noqa: BLE001 — AOT is an optimization
+                reg.note_exec_failure(
+                    "analytics.fleet_rollup",
+                    f"{type(exc).__name__}: {exc}"[:200],
+                )
+        return fleet_rollup(*cols)
 
 
 def rollup_to_dict(fleet: FleetArrays) -> dict[str, Any]:
@@ -188,7 +206,15 @@ def rollup_to_dict(fleet: FleetArrays) -> dict[str, Any]:
     from ..runtime import transfer
 
     out = transfer.fetch(rollup_arrays(fleet))
-    result = aggregates_to_host_dict(out, fleet.n_nodes)
+    return rollup_host_view(out, fleet.n_nodes)
+
+
+def rollup_host_view(out: Mapping[str, Any], n_nodes: int) -> dict[str, Any]:
+    """Finalize an ALREADY-FETCHED rollup tree into the serving dict —
+    shared by :func:`rollup_to_dict` and the fused rollup+forecast path
+    (ADR-020), which fetches the rollup together with the forecast in
+    one coalesced device_get and must produce the identical key set."""
+    result = aggregates_to_host_dict(out, n_nodes)
     result.update(
         {
             "utilization_pct": (

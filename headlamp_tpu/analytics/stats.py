@@ -451,10 +451,20 @@ def _calibrate(view: FleetView) -> dict[str, Any]:
 
 
 def _xla_stats(view: FleetView) -> dict[str, Any]:
-    from ..runtime.device_cache import fleet_cache
+    from ..runtime.device_cache import fleet_cache, rollup_results
     from .fleet_jax import rollup_to_dict
 
     _annotate(backend="xla")
+    # ADR-020: when the fused rollup+forecast program already computed
+    # this snapshot's rollup (same provider, same version), serve the
+    # parked host dict — zero device work for this call.
+    cached = rollup_results.get(
+        view.provider.name, getattr(view, "version", None)
+    )
+    if cached is not None:
+        _annotate(rollup_source="fused")
+        cached["generation_counts"] = _generation_counts(view.nodes)
+        return cached
     # Versioned views (server snapshots) hit the device-resident cache:
     # a warm request re-uses the columns already living on device and
     # pays dispatch + one coalesced device_get only — the host→device

@@ -1,0 +1,479 @@
+"""AOT program registry — startup absorbs every hot compile (ADR-020).
+
+The ADR-019 cost ledger proved where the first-request latency spike
+comes from: each hot jitted program (fleet rollup, cold/warm forecast
+fit, the fused rollup+forecast, the sharded mesh rollup) pays
+trace+compile on its FIRST call per shape — 0.25–1.1 s each on the CI
+host, stacked onto whichever request arrives first. This registry moves
+those compiles off the request path: at ``serve()`` startup a daemon
+thread lowers and compiles each program at a small set of canonical
+bucketed shapes via ``jit(...).lower(...).compile()``, tracking each
+one in the cost ledger with ``phase="startup"``. Request-side call
+sites look up the compiled executable by the EXACT ``(name, key)`` the
+startup thread registered (the same pair they hand the ledger), so a
+hit classifies as a warm dispatch and the post-warmup request-compile
+count — the acceptance number — stays zero.
+
+Shape policy: arbitrary fleet sizes are padded UP to the next bucket —
+chip counts to :data:`CHIP_BUCKETS` (with a per-chip weight vector so
+padding never leaks into the fit; see ``forecast.pad_series_to_bucket``)
+and rollup columns to the power-of-two node/pod buckets the encoder
+already produces (:data:`ROLLUP_BUCKETS` covers the at-scale fixtures;
+``ensure_rollup_shapes`` backfills observed shapes in the background).
+A shape no bucket covers is a MISS, never an error: the caller runs the
+plain jitted path (counted by the ledger as a request-phase compile)
+and the miss is visible on ``/healthz`` and ``/metricsz``.
+
+Import-safe on jax-less hosts by design: the server imports this module
+unconditionally (serve/healthz wiring), so module scope is stdlib-only
+and jax enters lazily inside the compile thread. A host whose jax
+import fails parks the registry in the "unavailable" state — lookups
+all miss, serving degrades to exactly the pre-registry behavior.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from ..obs import jaxcost as _jaxcost
+from ..obs.metrics import registry as _metrics_registry
+
+#: Chip-axis buckets the forecast programs precompile at. 8 covers the
+#: SLO burn self-forecast (1 series) and toy fleets, 64 the demo fleet
+#: (16 nodes × 4 chips — also what the bench's Prometheus fixture
+#: serves), 256 headroom for larger scrapes. Chip counts above the top
+#: bucket fall back to the plain jitted path (counted miss).
+CHIP_BUCKETS: tuple[int, ...] = (8, 64, 256)
+
+#: (node_pad, pod_pad) column buckets precompiled for the rollup and
+#: the fused rollup+forecast — the encoder's power-of-two padding for
+#: the 256-node bench fleet and the 1024-node large fixture, i.e. the
+#: at-scale shapes (below ``XLA_ROLLUP_MIN_NODES`` Python serves the
+#: rollup anyway). The TPU view's pod list pads to the SAME power of
+#: two as its node list at both fixture sizes (measured: 248 nodes/180
+#: pods → (256, 256); 991/704 → (1024, 1024)), hence the square pairs.
+#: Other observed shapes arrive via
+#: :meth:`AotProgramRegistry.ensure_rollup_shapes`.
+ROLLUP_BUCKETS: tuple[tuple[int, int], ...] = ((256, 256), (1024, 1024))
+
+#: History length of the live-window range query (window_s=3600,
+#: step_s=60 → 61 samples) — THE page-forecast series length.
+LIVE_WINDOW_SAMPLES = 61
+
+#: Steady-state length of the SLO burn self-forecast series (the paint
+#: ring's maxlen). While the ring is still filling, lengths 48..511 are
+#: bucket misses on purpose — padding the TIME axis would train on
+#: fabricated samples.
+SLO_SERIES_STEADY = 512
+
+
+def chip_bucket_for(n_chips: int) -> int | None:
+    """Smallest chip bucket holding ``n_chips``, or None above the top."""
+    for bucket in CHIP_BUCKETS:
+        if n_chips <= bucket:
+            return bucket
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Executable builders (lazy jax — only the compile thread runs these)
+# ---------------------------------------------------------------------------
+
+
+def _build_fleet_rollup(key: Any) -> Any:
+    import jax
+    import jax.numpy as jnp
+
+    from ..analytics.fleet_jax import fleet_rollup
+
+    node_shape, pod_shape = key
+    node = jax.ShapeDtypeStruct(tuple(node_shape), jnp.int32)
+    pod = jax.ShapeDtypeStruct(tuple(pod_shape), jnp.int32)
+    return fleet_rollup.lower(
+        node, node, node, node, node, pod, pod, pod, pod
+    ).compile()
+
+
+def _forecast_avals(bucket: int, length: int, cfg: Any) -> tuple[Any, ...]:
+    """(series, weights, prng-key avals, params avals, opt_state avals)
+    for the bucketed programs. Params/opt_state come from
+    ``jax.eval_shape`` over the real init — the registry can never
+    drift from what the model actually carries."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from .forecast import init_params
+
+    series = jax.ShapeDtypeStruct((bucket, length), jnp.float32)
+    weights = jax.ShapeDtypeStruct((bucket,), jnp.float32)
+    prng = jax.random.PRNGKey(0)
+    key_aval = jax.ShapeDtypeStruct(prng.shape, prng.dtype)
+    params = jax.eval_shape(lambda k: init_params(k, cfg), prng)
+    opt_state = jax.eval_shape(
+        lambda p: optax.adam(cfg.learning_rate).init(p), params
+    )
+    return series, weights, key_aval, params, opt_state
+
+
+def _build_bucketed_forecast(name: str, key: Any) -> Any:
+    from . import forecast as fc
+
+    bucket, length, cfg, steps, inference, batch_p = key
+    series, weights, key_aval, params, opt_state = _forecast_avals(
+        bucket, length, cfg
+    )
+    if name == "forecast.aot_fit_forecast_state":
+        lowered = fc._bucketed_fit_forecast_state_program.lower(
+            series, weights, key_aval, cfg, steps, inference, batch_p
+        )
+    else:
+        lowered = fc._bucketed_warm_fit_forecast_program.lower(
+            series, weights, params, opt_state, cfg, steps, inference, batch_p
+        )
+    return lowered.compile()
+
+
+def _build_fused(key: Any) -> Any:
+    import jax
+    import jax.numpy as jnp
+
+    from . import forecast as fc
+
+    node_shape, pod_shape, bucket, length, cfg, steps, inference, batch_p = key
+    node = jax.ShapeDtypeStruct(tuple(node_shape), jnp.int32)
+    pod = jax.ShapeDtypeStruct(tuple(pod_shape), jnp.int32)
+    series, weights, _key_aval, params, opt_state = _forecast_avals(
+        bucket, length, cfg
+    )
+    lowered = fc.rollup_and_forecast_program.lower(
+        node, node, node, node, node, pod, pod, pod, pod,
+        series, weights, params, opt_state,
+        cfg, steps, inference, batch_p,
+    )
+    return lowered.compile()
+
+
+def _build_mesh_rollup(key: Any) -> Any:
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel import mesh as mesh_mod
+
+    reducer, dev_shape, node_shape, pod_shape = key
+    mesh = mesh_mod.fleet_mesh()
+    if tuple(mesh.devices.shape) != tuple(dev_shape):
+        raise ValueError(
+            f"device topology {tuple(mesh.devices.shape)} != spec {dev_shape}"
+        )
+    shard = mesh_mod.build_rollup_shard(mesh, reducer, int(node_shape[0]))
+    node = jax.ShapeDtypeStruct(tuple(node_shape), jnp.int32)
+    pod = jax.ShapeDtypeStruct(tuple(pod_shape), jnp.int32)
+    with mesh:
+        lowered = jax.jit(shard).lower(
+            node, node, node, node, node, pod, pod, pod, pod
+        )
+        return lowered.compile()
+
+
+_BUILDERS: dict[str, Callable[[Any], Any]] = {
+    "analytics.fleet_rollup": _build_fleet_rollup,
+    "forecast.aot_fit_forecast_state": lambda key: _build_bucketed_forecast(
+        "forecast.aot_fit_forecast_state", key
+    ),
+    "forecast.aot_warm_fit_forecast": lambda key: _build_bucketed_forecast(
+        "forecast.aot_warm_fit_forecast", key
+    ),
+    "fused.rollup_and_forecast": _build_fused,
+    "mesh.rollup": _build_mesh_rollup,
+}
+
+
+def default_specs() -> list[tuple[str, Any]]:
+    """The canonical startup set — every hot program at the shapes the
+    demo, the bench fixtures, and the SLO engine actually serve. Built
+    lazily (imports jax through forecast) so module import stays
+    jax-free. ~9 programs, ≈4–6 s of background compile on the CI host
+    (measured r14) — absorbed before the first at-scale request in any
+    realistic startup."""
+    import jax
+
+    from .forecast import WARM_STEPS, ForecastConfig
+
+    cfg = ForecastConfig()
+    specs: list[tuple[str, Any]] = []
+    for node, pod in ROLLUP_BUCKETS:
+        specs.append(("analytics.fleet_rollup", ((node,), (pod,))))
+    for bucket, length in ((64, LIVE_WINDOW_SAMPLES), (8, SLO_SERIES_STEADY)):
+        specs.append(
+            ("forecast.aot_fit_forecast_state",
+             (bucket, length, cfg, 60, "xla", 0))
+        )
+        specs.append(
+            ("forecast.aot_warm_fit_forecast",
+             (bucket, length, cfg, WARM_STEPS, "xla", 0))
+        )
+    for node, pod in ROLLUP_BUCKETS:
+        specs.append(
+            ("fused.rollup_and_forecast",
+             ((node,), (pod,), 64, LIVE_WINDOW_SAMPLES, cfg, WARM_STEPS,
+              "xla", 0))
+        )
+    specs.append(
+        ("mesh.rollup",
+         ("psum", (len(jax.devices()),), (256,), (256,)))
+    )
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+class AotProgramRegistry:
+    """Compiled-executable store keyed ``(program name, signature)`` —
+    the signature IS the ledger's recompile key, so startup compiles
+    and request dispatches land on the same ledger row.
+
+    Thread-safety: the lock guards the program dict and counters;
+    compiles happen outside it (a compile is seconds, a lookup must be
+    nanoseconds). ``perf`` is the injectable duration seam (ADR-013
+    clock audit); ``specs`` overrides the startup set for tests."""
+
+    def __init__(
+        self,
+        *,
+        specs: list[tuple[str, Any]] | None = None,
+        perf: Callable[[], float] = time.perf_counter,
+    ) -> None:
+        self._lock = threading.Lock()
+        self._perf = perf
+        self._specs = specs
+        self._programs: dict[tuple[str, Any], Any] = {}
+        self._pending: set[tuple[str, Any]] = set()
+        self._state = "idle"  # idle | compiling | ready | unavailable
+        self._ready_event = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.last_error: str | None = None
+        # Monotone ints (flight/healthz counters view — r10-review rule).
+        self.programs_compiled = 0
+        self.compile_errors = 0
+        self.exec_failures = 0
+        self.bucket_hits = 0
+        self.bucket_misses = 0
+        self.donation_saved_bytes = 0
+        self.compile_ms_total = 0.0
+
+    # -- startup ---------------------------------------------------------
+
+    def compile_startup(self, *, block: bool = False) -> None:
+        """Kick off (or, idempotently, skip) the startup compile pass.
+        ``block=True`` runs it inline — tests and the bench's warmup
+        use it; ``serve()`` uses the default daemon thread so listening
+        starts immediately and early requests just miss (plain path)."""
+        with self._lock:
+            if self._state != "idle":
+                return
+            self._state = "compiling"
+        if block:
+            self._compile_all()
+            return
+        self._thread = threading.Thread(
+            target=self._compile_all, name="aot-startup-compile", daemon=True
+        )
+        self._thread.start()
+
+    def _compile_all(self) -> None:
+        try:
+            specs = self._specs if self._specs is not None else default_specs()
+        except Exception as exc:  # noqa: BLE001 — jax-less host
+            self.last_error = f"{type(exc).__name__}: {exc}"[:200]
+            with self._lock:
+                self._state = "unavailable"
+            self._ready_event.set()
+            return
+        for name, key in specs:
+            self._compile_one(name, key)
+        with self._lock:
+            self._state = "ready"
+        self._ready_event.set()
+
+    def _compile_one(self, name: str, key: Any) -> None:
+        """lower+compile one program, ledger-tracked as a STARTUP-phase
+        compile under the exact (name, key) the request path will use.
+        A failed build is recorded (never raised): the corresponding
+        request-side lookups miss and the plain jitted path serves."""
+        builder = _BUILDERS.get(name)
+        if builder is None:
+            self.compile_errors += 1
+            self.last_error = f"no builder for {name!r}"
+            return
+        t0 = self._perf()
+        try:
+            with _jaxcost.track(name, key, phase="startup"):
+                exe = builder(key)
+        except Exception as exc:  # noqa: BLE001 — a miss, never an error
+            self.compile_errors += 1
+            self.last_error = f"{name}: {type(exc).__name__}: {exc}"[:200]
+            return
+        elapsed_ms = (self._perf() - t0) * 1000.0
+        with self._lock:
+            self._programs[(name, key)] = exe
+            self.programs_compiled += 1
+            self.compile_ms_total += elapsed_ms
+
+    # -- request-side lookups --------------------------------------------
+
+    def ready(self) -> bool:
+        return self._state == "ready"
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def wait_ready(self, timeout: float | None = None) -> bool:
+        """Block until the startup pass finished (either outcome).
+        Benches and tests use it; serving never does."""
+        return self._ready_event.wait(timeout)
+
+    def executable(self, name: str, key: Any) -> Any | None:
+        """The compiled executable for exactly ``(name, key)``, or None
+        (a counted bucket miss). Callers gate on :meth:`ready` first so
+        the miss counters mean "no bucket covers this shape", not
+        "startup hasn't finished"."""
+        with self._lock:
+            exe = self._programs.get((name, key))
+            if exe is None:
+                self.bucket_misses += 1
+            else:
+                self.bucket_hits += 1
+        return exe
+
+    def note_bucket_miss(self, name: str) -> None:  # noqa: ARG002 — name kept for future per-program split
+        """A shape no bucket can hold (e.g. chip count above the top
+        bucket) — counted without a dict lookup."""
+        with self._lock:
+            self.bucket_misses += 1
+
+    def note_donation(self, n_bytes: int) -> None:
+        """Account bytes a donated call let XLA reuse in place."""
+        with self._lock:
+            self.donation_saved_bytes += int(n_bytes)
+
+    def note_exec_failure(self, name: str, reason: str) -> None:
+        """A compiled executable raised at call time (shape drift,
+        deleted donated buffer). The caller falls back to the plain
+        path; the failure is surfaced, never silent."""
+        with self._lock:
+            self.exec_failures += 1
+            self.last_error = f"{name}: {reason}"[:200]
+
+    # -- background backfill ---------------------------------------------
+
+    def ensure(self, name: str, key: Any) -> bool:
+        """Schedule a background compile for ``(name, key)`` unless it
+        is already compiled or in flight. Returns True when a compile
+        was scheduled. Serving never blocks on it: the current request
+        misses (plain path), later ones hit."""
+        with self._lock:
+            if self._state in ("idle", "unavailable"):
+                return False
+            pair = (name, key)
+            if pair in self._programs or pair in self._pending:
+                return False
+            self._pending.add(pair)
+
+        def _run() -> None:
+            try:
+                self._compile_one(name, key)
+            finally:
+                with self._lock:
+                    self._pending.discard((name, key))
+
+        threading.Thread(
+            target=_run, name="aot-backfill-compile", daemon=True
+        ).start()
+        return True
+
+    def ensure_rollup_shapes(self, node_pad: int, pod_pad: int) -> None:
+        """Observed-shape backfill hook, called from the device-cache
+        warm path: whatever (node, pod) buckets the live fleet actually
+        encodes to get their rollup executable compiled off the request
+        path, even when they match no default spec."""
+        self.ensure("analytics.fleet_rollup", ((node_pad,), (pod_pad,)))
+
+    # -- read surfaces ---------------------------------------------------
+
+    def counters(self) -> dict[str, int]:
+        """Monotone ints, lock-free — the flight recorder's per-request
+        delta view (r10-review rule)."""
+        return {
+            "programs_compiled": self.programs_compiled,
+            "compile_errors": self.compile_errors,
+            "exec_failures": self.exec_failures,
+            "bucket_hits": self.bucket_hits,
+            "bucket_misses": self.bucket_misses,
+            "donation_saved_bytes": self.donation_saved_bytes,
+        }
+
+    def snapshot(self) -> dict[str, Any]:
+        """``/healthz`` ``runtime.jax.aot`` block."""
+        with self._lock:
+            programs = sorted(name for name, _key in self._programs)
+        return {
+            "state": self._state,
+            "programs_compiled": self.programs_compiled,
+            "compile_errors": self.compile_errors,
+            "exec_failures": self.exec_failures,
+            "bucket_hits": self.bucket_hits,
+            "bucket_misses": self.bucket_misses,
+            "donation_saved_bytes": self.donation_saved_bytes,
+            "compile_ms_total": round(self.compile_ms_total, 1),
+            "last_error": self.last_error,
+            "programs": programs,
+        }
+
+
+#: The process registry. set_registry swaps it for tests; call sites
+#: read through the accessor so they always hit the live instance.
+_REGISTRY = AotProgramRegistry()
+
+
+def registry() -> AotProgramRegistry:
+    return _REGISTRY
+
+
+def set_registry(instance: AotProgramRegistry) -> AotProgramRegistry:
+    """Install ``instance`` as the process registry; returns the one it
+    replaced so tests can restore."""
+    global _REGISTRY
+    previous, _REGISTRY = _REGISTRY, instance
+    return previous
+
+
+# AOT registry state as scrapeable gauges (ADR-013): callback views
+# through the accessor — /metricsz and /healthz read the SAME counters,
+# and a test-swapped registry is reflected everywhere at once.
+_metrics_registry.gauge_fn(
+    "headlamp_tpu_aot_programs_compiled_count",
+    "Executables the AOT registry holds (startup specs + backfills)",
+    lambda: float(registry().programs_compiled),
+)
+_metrics_registry.gauge_fn(
+    "headlamp_tpu_aot_bucket_hits_total",
+    "Request-path lookups served by a precompiled bucketed executable",
+    lambda: float(registry().bucket_hits),
+)
+_metrics_registry.gauge_fn(
+    "headlamp_tpu_aot_bucket_misses_total",
+    "Request-path lookups no bucket covered (plain jit fallback ran)",
+    lambda: float(registry().bucket_misses),
+)
+_metrics_registry.gauge_fn(
+    "headlamp_tpu_aot_donation_saved_bytes_total",
+    "Buffer bytes donated calls let XLA reuse in place",
+    lambda: float(registry().donation_saved_bytes),
+)

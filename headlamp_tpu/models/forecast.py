@@ -429,6 +429,16 @@ def fit_and_forecast_with_dispatch(
             # Memoize: a kernel that failed to lower/compile would
             # otherwise re-pay the failed compile on EVERY forecast.
             _record_pallas_broken(f"{type(exc).__name__}: {exc}"[:200])
+    # ADR-020: the cold XLA fit serves from a registry-precompiled
+    # bucketed executable when one covers this shape (fitted state is
+    # simply dropped — this entry doesn't carry it); a miss runs the
+    # plain jitted program exactly as before.
+    aot_result = _try_aot_forecast(
+        _fit_forecast_state_program, (series, key, cfg, steps), "xla", 0
+    )
+    if aot_result is not None:
+        out, _params, _opt_state, mse = aot_result
+        return out, InferenceDispatch("xla", _pallas_broken_reason, fit_mse=mse)
     with _jax_track(
         "forecast.fit_forecast", (series.shape, cfg, steps, "xla", 0)
     ):
@@ -571,6 +581,12 @@ def fit_and_forecast_incremental(
                 bp,
             )
 
+        # ADR-020: a registry-precompiled bucketed executable serves
+        # first when one covers this shape; a miss (None) runs the
+        # plain jitted program exactly as before.
+        aot_result = _try_aot_forecast(program, head, inference, batch_p)
+        if aot_result is not None:
+            return aot_result
         try:
             with _jax_track(name, sig(inference, batch_p)):
                 return program(*head, inference, batch_p)
@@ -636,3 +652,235 @@ def fit_and_forecast_incremental(
         warm_demotion_reason=demotion,
     )
     return preds_host, dispatch, new_state
+
+
+# ---------------------------------------------------------------------------
+# Bucketed programs for the AOT registry (ADR-020)
+# ---------------------------------------------------------------------------
+#
+# The plain fused programs above recompile per exact (n_chips, length)
+# shape, so the first request at any new fleet size pays trace+compile
+# on the request path. The bucketed twins below take the chip axis at a
+# small set of canonical sizes (``models.aot.CHIP_BUCKETS``) with a
+# per-chip weight vector masking the padding rows, so the AOT registry
+# can lower+compile them once at startup and arbitrary fleet sizes hit
+# a precompiled executable. The masked loss is analytically identical
+# to the plain mean when every weight is 1 (each chip contributes the
+# same number of sliding-window examples), and padded rows contribute
+# exactly zero gradient — pinned by tests/test_aot.py.
+
+
+def _masked_loss_fn(
+    params: Params, x: jax.Array, y: jax.Array, w: jax.Array
+) -> jax.Array:
+    """:func:`loss_fn` with a per-example weight vector: padded chips
+    carry weight 0 so they never leak into the fit."""
+    pred = forward(params, x)
+    per_example = jnp.mean((pred - y) ** 2, axis=1)
+    return jnp.sum(per_example * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def _bucketed_fit_body(
+    series: jax.Array,
+    chip_weights: jax.Array,
+    params: Params,
+    opt_state: Any,
+    cfg: ForecastConfig,
+    steps: int,
+    inference: str,
+    batch_p: int,
+) -> tuple[jax.Array, Params, Any, jax.Array]:
+    """Masked twin of :func:`_warm_fit_forecast_program`'s body:
+    windowing → weighted refinement scan → inference over the PADDED
+    chip axis. ``chip_weights[c]`` is 1.0 for real chips, 0.0 for
+    padding; each chip's ``n_pos`` sliding examples inherit its weight
+    (make_windows flattens series-major, so ``repeat`` lines up)."""
+    x, y = make_windows(series, cfg.window, cfg.horizon)
+    n_pos = x.shape[0] // series.shape[0]
+    w = jnp.repeat(chip_weights, n_pos)
+    optimizer = optax.adam(cfg.learning_rate)
+
+    def body(
+        carry: tuple[Params, Any], _: None
+    ) -> tuple[tuple[Params, Any], jax.Array]:
+        p, s = carry
+        loss, grads = jax.value_and_grad(_masked_loss_fn)(p, x, y, w)
+        updates, s = optimizer.update(grads, s, p)
+        p = optax.apply_updates(p, updates)
+        return (p, s), loss
+
+    (params, opt_state), _ = jax.lax.scan(
+        body, (params, opt_state), None, length=steps
+    )
+    out = _infer_recent(params, series, cfg, inference, batch_p)
+    return out, params, opt_state, _masked_loss_fn(params, x, y, w)
+
+
+#: Warm refinement at a canonical bucket, with the (params, opt_state)
+#: carry DONATED: the caller replaces the carry with the returned pair,
+#: so XLA overwrites the optimizer state in place instead of allocating
+#: fresh outputs. The padded series is NOT donated — no output shares
+#: its [bucket, T] shape, so XLA could never alias it (donating it just
+#: trips the unusable-donation warning) — and the shared device-cache
+#: fleet arrays are deliberately not donated anywhere: concurrent
+#: requests read them (ADR-020).
+_bucketed_warm_fit_forecast_program = jax.jit(
+    _bucketed_fit_body,
+    static_argnames=("cfg", "steps", "inference", "batch_p"),
+    donate_argnums=(2, 3),
+)
+
+
+def _bucketed_cold_fit_body(
+    series: jax.Array,
+    chip_weights: jax.Array,
+    key: jax.Array,
+    cfg: ForecastConfig,
+    steps: int,
+    inference: str,
+    batch_p: int,
+) -> tuple[jax.Array, Params, Any, jax.Array]:
+    """Masked twin of :func:`_fit_forecast_state_program`: fresh init →
+    the SAME weighted scan body — cold and warm bucketed fits cannot
+    train different models."""
+    params = init_params(key, cfg)
+    opt_state = optax.adam(cfg.learning_rate).init(params)
+    return _bucketed_fit_body(
+        series, chip_weights, params, opt_state, cfg, steps, inference, batch_p
+    )
+
+
+_bucketed_fit_forecast_state_program = jax.jit(
+    _bucketed_cold_fit_body,
+    static_argnames=("cfg", "steps", "inference", "batch_p"),
+)
+
+
+def _rollup_forecast_body(
+    node_capacity: jax.Array,
+    node_allocatable: jax.Array,
+    node_ready: jax.Array,
+    node_generation: jax.Array,
+    node_valid: jax.Array,
+    pod_request: jax.Array,
+    pod_phase: jax.Array,
+    pod_node_idx: jax.Array,
+    pod_valid: jax.Array,
+    series: jax.Array,
+    chip_weights: jax.Array,
+    params: Params,
+    opt_state: Any,
+    cfg: ForecastConfig,
+    steps: int,
+    inference: str,
+    batch_p: int,
+) -> tuple[dict[str, jax.Array], jax.Array, Params, Any, jax.Array]:
+    """THE fused request path (ADR-020): fleet rollup + warm forecast
+    refinement + inference as ONE XLA program and ONE dispatch. The
+    fleet columns arrive straight from the ADR-012 device cache, so
+    nothing round-trips host↔device between the stages; the caller
+    fetches (rollup, predictions, mse) through the transfer funnel in
+    one coalesced device_get."""
+    from ..analytics.fleet_jax import fleet_rollup  # lazy: import cycle
+
+    rollup = fleet_rollup(
+        node_capacity, node_allocatable, node_ready, node_generation,
+        node_valid, pod_request, pod_phase, pod_node_idx, pod_valid,
+    )
+    out, params, opt_state, mse = _bucketed_fit_body(
+        series, chip_weights, params, opt_state, cfg, steps, inference, batch_p
+    )
+    return rollup, out, params, opt_state, mse
+
+
+#: Donates the params/opt_state carry (11, 12) — the request-private,
+#: output-aliasable inputs. The padded series (9) is skipped for the
+#: same no-matching-output-shape reason as the warm program, and the
+#: nine fleet columns (0-8) are the shared device-cache entry and MUST
+#: survive the call (see ADR-020 for why the ISSUE's "donate fleet
+#: buffers" is deliberately narrowed).
+rollup_and_forecast_program = jax.jit(
+    _rollup_forecast_body,
+    static_argnames=("cfg", "steps", "inference", "batch_p"),
+    donate_argnums=(11, 12),
+)
+
+
+def pad_series_to_bucket(
+    series: jax.Array, bucket: int
+) -> tuple[jax.Array, jax.Array]:
+    """(padded [bucket, T] series, [bucket] float32 weights): zero rows
+    beyond the real chip count with weight 0.0, so the masked programs
+    train on exactly the real chips; callers slice predictions back to
+    ``series.shape[0]`` rows."""
+    n_chips = series.shape[0]
+    padded = (
+        jnp.zeros((bucket, series.shape[1]), jnp.float32)
+        .at[:n_chips]
+        .set(series.astype(jnp.float32))
+    )
+    weights = jnp.zeros((bucket,), jnp.float32).at[:n_chips].set(1.0)
+    return padded, weights
+
+
+#: Plain jitted program → AOT registry name for its bucketed twin.
+_AOT_FORECAST_NAMES = {
+    "_warm_fit_forecast_program": "forecast.aot_warm_fit_forecast",
+    "_fit_forecast_state_program": "forecast.aot_fit_forecast_state",
+}
+
+
+def _try_aot_forecast(
+    program: Callable[..., Any], head: tuple[Any, ...],
+    inference: str, batch_p: int,
+) -> tuple[jax.Array, Params, Any, jax.Array] | None:
+    """Serve a fused fit+forecast from a registry-precompiled bucketed
+    executable (ADR-020). Returns the plain program's result tuple with
+    predictions sliced back to the real chip count, or ``None`` when no
+    precompiled executable covers the call — registry absent or still
+    compiling, chip count above every bucket, or unregistered statics —
+    in which case the caller's plain jitted path runs (the ledger then
+    counts its compile as request-phase; a miss is never an error).
+
+    The ledger signature here is EXACTLY the key the registry's startup
+    thread tracked with ``phase="startup"``, so the request-side call
+    classifies as a warm dispatch and the post-warmup request-compile
+    count stays zero."""
+    kind = _AOT_FORECAST_NAMES.get(getattr(program, "__name__", ""))
+    if kind is None:
+        return None
+    from . import aot
+
+    reg = aot.registry()
+    if reg is None or not reg.ready():
+        return None
+    series = head[0]
+    cfg, steps = head[-2], head[-1]
+    n_chips, length = series.shape
+    bucket = aot.chip_bucket_for(n_chips)
+    if bucket is None:
+        # Above the top bucket: a counted miss, never an error.
+        reg.note_bucket_miss(kind)
+        return None
+    sig = (bucket, length, cfg, steps, inference, batch_p)
+    exe = reg.executable(kind, sig)
+    if exe is None:
+        return None
+    padded, weights = pad_series_to_bucket(series, bucket)
+    donated = 0
+    if kind == "forecast.aot_warm_fit_forecast":
+        # params + opt_state buffers the donation lets XLA reuse in
+        # place (the registry's savings counter).
+        donated = sum(
+            int(leaf.nbytes)
+            for leaf in jax.tree_util.tree_leaves(head[1:3])
+        )
+    try:
+        with _jax_track(kind, sig):
+            out, params, opt_state, mse = exe(padded, weights, *head[1:-2])
+    except Exception as exc:  # noqa: BLE001 — AOT is an optimization
+        reg.note_exec_failure(kind, f"{type(exc).__name__}: {exc}"[:200])
+        return None
+    if donated:
+        reg.note_donation(donated)
+    return out[:n_chips], params, opt_state, mse

@@ -15,7 +15,9 @@ from ..metrics.client import UtilizationHistory
 from ..obs.jaxcost import track as _jax_track
 from ..obs.trace import span as _span
 from .forecast import (
+    COLD_MSE_TOLERANCE,
     WARM_STEPS,
+    _DEMOTION_MSE_FLOOR,
     ForecastConfig,
     InferenceDispatch,
     WarmState,
@@ -269,6 +271,158 @@ def forecast_slo_burn(
         return None, state
 
 
+def _fused_rollup_forecast(
+    history: UtilizationHistory,
+    cfg: ForecastConfig,
+    state: WarmState | None,
+    fleet_view: Any,
+    data_source: str,
+) -> tuple[ForecastView, WarmState | None] | None:
+    """Serve the fleet rollup AND the warm forecast refinement from the
+    single donated ``fused.rollup_and_forecast`` program (ADR-020): the
+    ADR-012 device-cached fleet columns feed the rollup stage directly,
+    the params/opt_state carry is donated, and ONE coalesced
+    device_get materializes (rollup, predictions, mse). The finalized
+    rollup dict is parked in :data:`~headlamp_tpu.runtime.device_cache.
+    rollup_results` so the overview's ``fleet_stats`` for the same
+    snapshot version does zero device work.
+
+    Returns ``(view, new_state)``, or ``None`` whenever the fused path
+    can't serve — no warm carry, carry/cfg mismatch, unversioned or
+    small fleet, registry cold, or no precompiled bucket — and the
+    caller runs the classic split path unchanged. A novel at-scale
+    fleet shape schedules a background backfill compile so the NEXT
+    request hits."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from ..analytics.stats import XLA_ROLLUP_MIN_NODES
+    from ..runtime import transfer
+    from ..runtime.device_cache import fleet_cache, rollup_results
+    from . import aot
+    from .forecast import _platform_and_pallas, pad_series_to_bucket
+
+    reg = aot.registry()
+    if reg is None or not reg.ready():
+        return None
+    if fleet_view is None or getattr(fleet_view, "version", None) is None:
+        return None
+    if getattr(fleet_view.provider, "name", None) != "tpu":
+        return None
+    if len(fleet_view.nodes) < XLA_ROLLUP_MIN_NODES:
+        # Below the crossover the Python rollup wins anyway — fusing
+        # would force device work the measured policy avoids.
+        return None
+    series = np.asarray(history.series, dtype=np.float32)
+    n_chips, length = series.shape
+    if length < cfg.window + cfg.horizon:
+        return None
+    if state is None or state.cfg != cfg or state.n_chips != n_chips:
+        return None
+    bucket = aot.chip_bucket_for(n_chips)
+    if bucket is None:
+        reg.note_bucket_miss("fused.rollup_and_forecast")
+        return None
+    inference, batch_p, fallback = _platform_and_pallas(cfg, n_chips)
+    try:
+        fleet = fleet_cache.fleet_for(fleet_view)
+    except Exception:  # noqa: BLE001 — broken backend → classic path
+        return None
+    ledger_key = (
+        tuple(fleet.node_capacity.shape),
+        tuple(fleet.pod_request.shape),
+        bucket, length, cfg, WARM_STEPS, inference, batch_p,
+    )
+    exe = reg.executable("fused.rollup_and_forecast", ledger_key)
+    if exe is None:
+        # Novel at-scale shape: compile it in the background so the
+        # next request at this fleet size hits.
+        reg.ensure("fused.rollup_and_forecast", ledger_key)
+        return None
+
+    t0 = time.perf_counter()
+    import jax.numpy as jnp
+
+    padded, weights = pad_series_to_bucket(jnp.asarray(series), bucket)
+    # Only the (params, opt_state) carry is donated — the padded series
+    # has no output to alias and the fleet columns are shared (ADR-020).
+    donated = sum(
+        int(leaf.nbytes)
+        for leaf in jax.tree_util.tree_leaves((state.params, state.opt_state))
+    )
+    try:
+        with _span(
+            "forecast.fused", nodes=len(fleet_view.nodes), chips=n_chips
+        ):
+            with _jax_track("fused.rollup_and_forecast", ledger_key):
+                rollup_dev, out, params, opt_state, mse_dev = exe(
+                    fleet.node_capacity, fleet.node_allocatable,
+                    fleet.node_ready, fleet.node_generation,
+                    fleet.node_valid, fleet.pod_request, fleet.pod_phase,
+                    fleet.pod_node_idx, fleet.pod_valid,
+                    padded, weights, state.params, state.opt_state,
+                )
+            # ONE coalesced round-trip for all three stages' outputs
+            # (ADR-012 funnel discipline).
+            rollup_host, preds, warm_mse = transfer.fetch(
+                (rollup_dev, out[:n_chips], mse_dev)
+            )
+    except Exception as exc:  # noqa: BLE001 — AOT is an optimization
+        # NOTE: the donated carry may already be consumed; the classic
+        # fallback's warm attempt will then demote to a cold refit —
+        # degraded, never wrong.
+        reg.note_exec_failure(
+            "fused.rollup_and_forecast", f"{type(exc).__name__}: {exc}"[:200]
+        )
+        return None
+    reg.note_donation(donated)
+
+    from ..analytics.fleet_jax import rollup_host_view
+
+    rollup_results.store(
+        fleet_view.provider.name,
+        fleet_view.version,
+        rollup_host_view(rollup_host, fleet.n_nodes),
+    )
+
+    warm_mse = float(warm_mse)
+    bound = COLD_MSE_TOLERANCE * max(state.cold_mse, _DEMOTION_MSE_FLOOR)
+    if warm_mse > bound:
+        # Same never-silent demotion contract as the classic warm path:
+        # the refinement is thrown away, a cold refit runs (the rollup
+        # half above is untouched — it never depended on the carry),
+        # and the lineage is stitched so the dispatch record still says
+        # which generation was consulted and why it was rejected.
+        reason = (
+            f"warm mse {warm_mse:.3g} > {COLD_MSE_TOLERANCE:g}x "
+            f"cold {state.cold_mse:.3g}"
+        )
+        view, new_state = forecast_from_history_incremental(
+            history, cfg, state=None, data_source=data_source
+        )
+        if new_state is not None:
+            new_state = new_state._replace(generation=state.generation + 1)
+        view.carried_from_generation = state.generation
+        view.warm_demotion_reason = reason
+        return view, new_state
+
+    new_state = WarmState(
+        params, opt_state, state.cold_mse, state.generation, cfg, n_chips
+    )
+    dispatch = InferenceDispatch(
+        f"{inference}-warm", fallback, fit_mse=warm_mse,
+        carried_from_generation=state.generation,
+        data_source=data_source,
+    )
+    fit_ms = round((time.perf_counter() - t0) * 1000, 1)
+    view = _summarize(
+        history, cfg, np.asarray(preds), dispatch, fit_ms, warm_mse
+    )
+    return view, new_state
+
+
 def compute_forecast_incremental(
     transport: Any,
     metrics: Any,
@@ -276,6 +430,7 @@ def compute_forecast_incremental(
     state: WarmState | None = None,
     clock: Callable[[], float] | None = None,
     history_store: Any = None,
+    fleet_view: Any = None,
 ) -> tuple[ForecastView | None, WarmState | None]:
     """:func:`compute_forecast` with the ADR-015 warm-start carry:
     returns ``(view, new_state)``; any failure degrades to ``(None,
@@ -305,6 +460,11 @@ def compute_forecast_incremental(
                 min_points=cfg.window + cfg.horizon,
             )
             if captured is not None:
+                fused = _fused_rollup_forecast(
+                    captured, cfg, state, fleet_view, "history"
+                )
+                if fused is not None:
+                    return fused
                 return forecast_from_history_incremental(
                     captured, cfg, state=state, data_source="history"
                 )
@@ -317,6 +477,11 @@ def compute_forecast_incremental(
             )
         if history is None:
             return None, state
+        fused = _fused_rollup_forecast(
+            history, cfg, state, fleet_view, "live-window"
+        )
+        if fused is not None:
+            return fused
         return forecast_from_history_incremental(history, state=state)
     except Exception:
         # Forecast is a progressive enhancement — any failure costs the

@@ -48,6 +48,13 @@ _DISPATCHES = _registry.counter(
     "Warm dispatches per jitted program (signature already compiled).",
     labels=("program",),
 )
+_STARTUP_COMPILES = _registry.counter(
+    "headlamp_tpu_jax_startup_compiles_total",
+    "Compilations paid by the AOT registry's startup thread (ADR-020) — "
+    "the complement of request-path compiles, which must drop to zero "
+    "once the registry is warm.",
+    labels=("program",),
+)
 _COMPILE_SECONDS = _registry.histogram(
     "headlamp_tpu_jax_compile_seconds",
     "Wall-clock cost of first-call compiles per program (perf_counter "
@@ -77,22 +84,39 @@ class JaxCostLedger:
         # Monotone ints (flight/healthz counters view — r10-review rule).
         self.compiles = 0
         self.dispatches = 0
+        self.startup_compiles = 0
         self.transfers = 0
         self.transfer_bytes = 0
 
     @contextmanager
-    def track(self, program: str, signature: Any = None) -> Iterator[None]:
+    def track(
+        self, program: str, signature: Any = None, *, phase: str = "request"
+    ) -> Iterator[None]:
         """Wrap one jitted call. ``signature`` is whatever drives
         recompilation for this program (shapes + static args); the
         first successful call per (program, signature) is a compile,
         every later one a dispatch. A raising call records nothing —
-        the next attempt still counts as the compile."""
+        the next attempt still counts as the compile.
+
+        ``phase`` labels WHERE a compile was paid (ADR-020): the AOT
+        registry's startup thread tracks its lower+compile calls with
+        ``phase="startup"``, so the ledger can answer "did any REQUEST
+        pay a compile after warmup?" — the number that must be zero —
+        without conflating it with the compiles startup absorbed on
+        purpose. Dispatches are phase-blind (warm is warm)."""
         t0 = self._perf()
         yield
-        self._record(program, signature, self._perf() - t0)
+        self._record(program, signature, self._perf() - t0, phase)
 
-    def _record(self, program: str, signature: Any, elapsed_s: float) -> None:
+    def _record(
+        self,
+        program: str,
+        signature: Any,
+        elapsed_s: float,
+        phase: str = "request",
+    ) -> None:
         key = (program, signature)
+        startup = phase == "startup"
         with self._lock:
             first = key not in self._seen
             if first:
@@ -102,6 +126,7 @@ class JaxCostLedger:
                 {
                     "compiles": 0,
                     "dispatches": 0,
+                    "startup_compiles": 0,
                     "compile_s": 0.0,
                     "dispatch_s": 0.0,
                     "signatures": 0,
@@ -112,12 +137,17 @@ class JaxCostLedger:
                 row["compile_s"] += elapsed_s
                 row["signatures"] += 1
                 self.compiles += 1
+                if startup:
+                    row["startup_compiles"] += 1
+                    self.startup_compiles += 1
             else:
                 row["dispatches"] += 1
                 row["dispatch_s"] += elapsed_s
                 self.dispatches += 1
         if first:
             _COMPILES.inc(program=program)
+            if startup:
+                _STARTUP_COMPILES.inc(program=program)
             _COMPILE_SECONDS.observe(elapsed_s, program=program)
             # ADR-018: a locally measured duration — gated through
             # capture_timings so replay rounds stay byte-stable.
@@ -128,6 +158,11 @@ class JaxCostLedger:
                 )
         else:
             _DISPATCHES.inc(program=program)
+
+    def request_compiles(self) -> int:
+        """Compiles paid OUTSIDE the startup phase — the request-path
+        number the AOT acceptance criterion pins at zero after warmup."""
+        return self.compiles - self.startup_compiles
 
     def note_transfer(
         self, n_bytes: int, *, direction: str = "d2h", chunks: int = 1
@@ -150,6 +185,8 @@ class JaxCostLedger:
         return {
             "compiles": self.compiles,
             "dispatches": self.dispatches,
+            "startup_compiles": self.startup_compiles,
+            "request_compiles": self.request_compiles(),
             "transfers": self.transfers,
             "transfer_bytes": self.transfer_bytes,
         }
@@ -163,6 +200,7 @@ class JaxCostLedger:
                 name: {
                     "compiles": row["compiles"],
                     "dispatches": row["dispatches"],
+                    "startup_compiles": row["startup_compiles"],
                     "compile_ms": round(row["compile_s"] * 1000.0, 1),
                     "dispatch_ms": round(row["dispatch_s"] * 1000.0, 1),
                     "signatures": row["signatures"],
@@ -172,6 +210,8 @@ class JaxCostLedger:
         return {
             "compiles": self.compiles,
             "dispatches": self.dispatches,
+            "startup_compiles": self.startup_compiles,
+            "request_compiles": self.request_compiles(),
             "transfers": self.transfers,
             "transfer_bytes": self.transfer_bytes,
             "programs": programs,
@@ -208,10 +248,12 @@ def set_ledger(instance: JaxCostLedger) -> JaxCostLedger:
 
 
 @contextmanager
-def track(program: str, signature: Any = None) -> Iterator[None]:
+def track(
+    program: str, signature: Any = None, *, phase: str = "request"
+) -> Iterator[None]:
     """Module-level :meth:`JaxCostLedger.track` against the live
     ledger — what the jitted call sites import."""
-    with _LEDGER.track(program, signature):
+    with _LEDGER.track(program, signature, phase=phase):
         yield
 
 

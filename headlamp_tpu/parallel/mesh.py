@@ -62,31 +62,13 @@ def _pad_to_multiple(a: jnp.ndarray, multiple: int, fill: int = 0) -> jnp.ndarra
     return jnp.concatenate([a, jnp.full((pad,), fill, a.dtype)])
 
 
-def _rollup_with_reducer(
-    fleet: FleetArrays, mesh: Mesh, reducer: str
-) -> dict[str, Any]:
-    """Shared body of the sharded rollups: column assembly + padding +
-    per-shard local_aggregates, with the cross-host reduction chosen by
-    ``reducer`` ("psum" | "ring"). One definition so the two reduction
-    schedules can never drift on what they reduce."""
+def build_rollup_shard(mesh: Mesh, reducer: str, n_nodes_pad: int) -> Any:
+    """The shard_mapped rollup callable for ``mesh``: per-shard
+    local_aggregates with the cross-host reduction chosen by ``reducer``
+    ("psum" | "ring"). Extracted so the serving path and the AOT
+    registry (ADR-020) lower THE SAME body — ``n_nodes_pad`` is the
+    global padded node-row count the segment-sums index into."""
     n_hosts = mesh.shape["hosts"]
-
-    node_cols = [
-        jnp.asarray(fleet.node_capacity),
-        jnp.asarray(fleet.node_allocatable),
-        jnp.asarray(fleet.node_ready),
-        jnp.asarray(fleet.node_generation),
-        jnp.asarray(fleet.node_valid),
-    ]
-    pod_cols = [
-        jnp.asarray(fleet.pod_request),
-        jnp.asarray(fleet.pod_phase),
-        jnp.asarray(fleet.pod_node_idx),
-        jnp.asarray(fleet.pod_valid),
-    ]
-    node_cols = [_pad_to_multiple(c, n_hosts) for c in node_cols]
-    pod_cols = [_pad_to_multiple(c, n_hosts) for c in pod_cols]
-    n_nodes_pad = int(node_cols[0].shape[0])
 
     def rollup_body(
         cap: jax.Array,
@@ -120,11 +102,39 @@ def _rollup_with_reducer(
         out_specs=P(),  # fully replicated aggregates
     )
     # The ring's replicated-in-value output can't be statically inferred.
-    rollup_shard = (
+    return (
         shard_map_unchecked(rollup_body, **specs)
         if reducer == "ring"
         else shard_map(rollup_body, **specs)
     )
+
+
+def _rollup_with_reducer(
+    fleet: FleetArrays, mesh: Mesh, reducer: str
+) -> dict[str, Any]:
+    """Shared body of the sharded rollups: column assembly + padding +
+    the :func:`build_rollup_shard` program. One definition so the two
+    reduction schedules can never drift on what they reduce."""
+    n_hosts = mesh.shape["hosts"]
+
+    node_cols = [
+        jnp.asarray(fleet.node_capacity),
+        jnp.asarray(fleet.node_allocatable),
+        jnp.asarray(fleet.node_ready),
+        jnp.asarray(fleet.node_generation),
+        jnp.asarray(fleet.node_valid),
+    ]
+    pod_cols = [
+        jnp.asarray(fleet.pod_request),
+        jnp.asarray(fleet.pod_phase),
+        jnp.asarray(fleet.pod_node_idx),
+        jnp.asarray(fleet.pod_valid),
+    ]
+    node_cols = [_pad_to_multiple(c, n_hosts) for c in node_cols]
+    pod_cols = [_pad_to_multiple(c, n_hosts) for c in pod_cols]
+    n_nodes_pad = int(node_cols[0].shape[0])
+
+    rollup_shard = build_rollup_shard(mesh, reducer, n_nodes_pad)
     with mesh:
         # Funnel fetch: coalesces with the request's other pending
         # device reads when a TransferBatch is active, and is the same
@@ -132,21 +142,38 @@ def _rollup_with_reducer(
         with _span(
             "mesh.rollup", reducer=reducer, hosts=mesh.devices.size
         ):
+            from ..models.aot import registry as _aot_registry
             from ..obs.jaxcost import track as _jax_track
 
             # ADR-019 cost ledger: mesh shape + padded columns are the
             # recompile key; the blocking fetch stays OUTSIDE the track
             # so dispatch time is not conflated with transfer time.
-            with _jax_track(
-                "mesh.rollup",
-                (
-                    reducer,
-                    tuple(mesh.devices.shape),
-                    tuple(node_cols[0].shape),
-                    tuple(pod_cols[0].shape),
-                ),
-            ):
-                dispatched = rollup_shard(*node_cols, *pod_cols)
+            # ADR-020: the key doubles as the AOT registry lookup — a
+            # hit serves the startup-compiled executable (the ledger
+            # then classifies this call as a warm dispatch).
+            ledger_key = (
+                reducer,
+                tuple(mesh.devices.shape),
+                tuple(node_cols[0].shape),
+                tuple(pod_cols[0].shape),
+            )
+            reg = _aot_registry()
+            exe = (
+                reg.executable("mesh.rollup", ledger_key)
+                if reg.ready()
+                else None
+            )
+            with _jax_track("mesh.rollup", ledger_key):
+                if exe is not None:
+                    try:
+                        dispatched = exe(*node_cols, *pod_cols)
+                    except Exception as exc:  # noqa: BLE001 — AOT is an optimization
+                        reg.note_exec_failure(
+                            "mesh.rollup", f"{type(exc).__name__}: {exc}"[:200]
+                        )
+                        dispatched = rollup_shard(*node_cols, *pod_cols)
+                else:
+                    dispatched = rollup_shard(*node_cols, *pod_cols)
             out = transfer.fetch(dispatched)
     return aggregates_to_host_dict(out, fleet.n_nodes)
 

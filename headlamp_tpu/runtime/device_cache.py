@@ -203,3 +203,161 @@ class DeviceFleetCache:
 
 #: Process-wide cache instance — one device, one resident fleet set.
 fleet_cache = DeviceFleetCache()
+
+
+class RollupResultCache:
+    """Host-side rollup dicts the fused rollup+forecast program already
+    computed (ADR-020), keyed ``(provider, snapshot version)`` with one
+    entry per provider — the same invalidation contract as
+    :class:`DeviceFleetCache` (the generation IS the key, so a stale
+    entry can never serve a newer fleet).
+
+    The fused request path computes the rollup and the forecast in ONE
+    donated device program and fetches both in one device_get; parking
+    the finalized rollup dict here lets the overview's ``fleet_stats``
+    call for the same snapshot serve it with ZERO device work instead
+    of re-dispatching the standalone rollup. Entries are stored
+    finalized (post ``rollup_host_view``) and handed out as copies so
+    the per-request ``generation_counts`` override can't mutate the
+    cached dict."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: dict[str, tuple[int, dict]] = {}
+        self.hits = 0
+        self.lookups = 0
+
+    def store(self, provider: str, version: int, stats: dict) -> None:
+        with self._lock:
+            self._entries[provider] = (version, dict(stats))
+
+    def get(self, provider: str, version: "int | None") -> "dict | None":
+        if version is None:
+            return None
+        with self._lock:
+            self.lookups += 1
+            entry = self._entries.get(provider)
+            if entry is None or entry[0] != version:
+                return None
+            self.hits += 1
+            return dict(entry[1])
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def counters(self) -> dict[str, int]:
+        return {"hits": self.hits, "lookups": self.lookups}
+
+
+#: Process-wide fused-rollup result cache (ADR-020).
+rollup_results = RollupResultCache()
+
+
+class WarmCarryCache:
+    """Process-scoped warm-start forecast carries (ADR-020), the third
+    cache tier after the fleet columns and the fused rollup results.
+    ADR-015's warm starts were app-scoped: a carry lived in one
+    ``DashboardApp``'s dict, so any host that rebuilds the app — a
+    fresh process serving its first request, the bench's fresh-app
+    discipline, a CLI one-shot — paid the full cold fit (~6x the warm
+    step budget of device compute) even though the process had already
+    learned perfectly good parameters for that exact chip set.
+
+    Unlike the other two tiers this one stages on HOST: ``store()``
+    copies every ``jax.Array`` leaf to numpy before keeping it. Two
+    reasons. First, lifetime: this cache lives at module scope, and a
+    module global releasing device buffers during interpreter teardown
+    races XLA's own static destructors — the exit segfaults after the
+    last test has already passed. Host arrays have no destructor
+    ordering against the backend. Second, donation: the warm fit
+    program donates its params/opt_state operands, so a device-resident
+    carry would be dead after one dispatch; a host carry mints fresh
+    device buffers at each ``device_put``, making the donated copy a
+    throwaway. The staging ``device_get`` also doubles as a completion
+    fence — a stored carry is never an in-flight computation. Cost:
+    one ~2 MB device→host copy per refit, off the request path.
+
+    ``take()`` still pops: a carry is refined in place by the fit that
+    consumes it, so leaving it visible would let a concurrent taker
+    race the same lineage and double-fit. The loser of the pop
+    cold-fits — correct, merely slower. The caller stores the NEW
+    carry when the fit returns.
+
+    Keys are whatever the caller derives from chip identity (the app's
+    ``_metrics_key``); entries evict LRU beyond ``max_keys`` — a carry
+    is ~2 MB of params + adam moments, and a dashboard serves a
+    handful of fleets, not hundreds. Quality is guarded downstream,
+    not here: the warm path's MSE demotion check (ADR-015) cold-refits
+    whenever a carried fit underperforms, so a stale carry can degrade
+    one fit's latency, never its served accuracy."""
+
+    def __init__(self, *, max_keys: int = 8) -> None:
+        self._lock = threading.Lock()
+        self._entries: dict[object, object] = {}
+        self.max_keys = max_keys
+        self.hits = 0
+        self.lookups = 0
+        self.evictions = 0
+
+    def take(self, key: object) -> object | None:
+        """Remove and return the carry for ``key`` (None on miss). Pop,
+        not peek: see class docstring — one fit per lineage at a time."""
+        with self._lock:
+            self.lookups += 1
+            state = self._entries.pop(key, None)
+            if state is not None:
+                self.hits += 1
+            return state
+
+    @staticmethod
+    def _host_staged(state: object) -> object:
+        """Copy every jax.Array leaf to numpy; non-array leaves (cfg,
+        host floats, generation counters) pass through as pytree
+        leaves untouched."""
+        import jax
+        import numpy as np
+
+        return jax.tree_util.tree_map(
+            lambda leaf: np.asarray(jax.device_get(leaf))
+            if isinstance(leaf, jax.Array)
+            else leaf,
+            state,
+        )
+
+    def store(self, key: object, state: object) -> None:
+        try:
+            state = self._host_staged(state)
+        except Exception:
+            # No jax / unmappable state: a device-resident carry still
+            # works, it just loses the teardown-safety guarantee.
+            pass
+        with self._lock:
+            # Re-insert at the end: dict order is the LRU eviction order.
+            self._entries.pop(key, None)
+            self._entries[key] = state
+            while len(self._entries) > self.max_keys:
+                oldest = next(iter(self._entries))
+                del self._entries[oldest]
+                self.evictions += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def counters(self) -> dict[str, int]:
+        return {
+            "hits": self.hits,
+            "lookups": self.lookups,
+            "evictions": self.evictions,
+        }
+
+
+#: Process-wide warm-carry store (ADR-020): fitted params + optimizer
+#: state survive app reconstruction, so only a chip-set never seen by
+#: THIS PROCESS pays a cold fit.
+warm_carries = WarmCarryCache()

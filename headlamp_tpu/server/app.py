@@ -129,13 +129,19 @@ def _runtime_health(
     MockTransport-backed demo/test apps report the other blocks
     unchanged."""
     try:
-        from ..runtime.device_cache import fleet_cache
+        from ..runtime.device_cache import fleet_cache, warm_carries
         from ..runtime.transfer import transfer_stats
         from ..transport.pool import pool_of
 
         out = {
             "transfer": transfer_stats.snapshot(),
             "fleet_cache": fleet_cache.snapshot(),
+            # Process-scoped warm-start carries (ADR-020): entries is
+            # how many chip sets this process has learned params for.
+            "warm_carries": {
+                **warm_carries.counters(),
+                "entries": len(warm_carries),
+            },
         }
         pool = pool_of(transport)
         if pool is not None:
@@ -158,6 +164,13 @@ def _runtime_health(
         # jitted program, plus counted host↔device bytes — the "is the
         # device path recompiling?" answer without opening a profile.
         out["jax"] = jax_ledger().snapshot()
+        # AOT registry (ADR-020): did startup absorb the compiles, and
+        # are requests hitting precompiled buckets? The phase split in
+        # the ledger block above plus this state answers "why did the
+        # first request spike" without a profile.
+        from ..models.aot import registry as _aot_registry
+
+        out["jax"]["aot"] = _aot_registry().snapshot()
         # Profiler vitals only (counters + overhead) — the call tree
         # itself lives at /debug/profilez, far too big for a probe.
         prof = profiler()
@@ -190,7 +203,7 @@ def _runtime_counters(
     ratios) that would turn the 'what this request moved' delta into
     noise."""
     try:
-        from ..runtime.device_cache import fleet_cache
+        from ..runtime.device_cache import fleet_cache, warm_carries
         from ..runtime.transfer import transfer_stats
         from ..transport.pool import pool_of
     except Exception:  # noqa: BLE001 — recording must never fail a request
@@ -199,6 +212,7 @@ def _runtime_counters(
     for prefix, counters in (
         ("transfer", transfer_stats.counters()),
         ("fleet_cache", fleet_cache.counters()),
+        ("warm_carries", warm_carries.counters()),
     ):
         for key, value in counters.items():
             out[f"{prefix}.{key}"] = value
@@ -219,6 +233,14 @@ def _runtime_counters(
     # bleed-between-neighbours caveat as every other counter here.
     for key, value in jax_ledger().counters().items():
         out[f"jax.{key}"] = value
+    # ADR-020: AOT registry bucket traffic and donation savings.
+    try:
+        from ..models.aot import registry as _aot_registry
+
+        for key, value in _aot_registry().counters().items():
+            out[f"jax.aot.{key}"] = value
+    except Exception:  # noqa: BLE001 — recording must never fail a request
+        pass
     for key, value in profiler().counters().items():
         out[f"profiler.{key}"] = value
     return out
@@ -323,10 +345,14 @@ class DashboardApp:
         self._metrics_refresher.on_store = self._capture_metrics_store
         #: Warm-start carries per forecast key (ADR-015): fitted params
         #: + optimizer state handed back to the next (re)fit for the
-        #: same fleet. Guarded by its own lock — entries are written
-        #: from refresher background workers.
-        self._warm_forecast_states: dict[Any, Any] = {}
-        self._warm_lock = threading.Lock()
+        #: same fleet. Process-scoped since ADR-020 (the
+        #: ``runtime.device_cache.warm_carries`` tier): carries survive
+        #: app reconstruction, so a rebuilt app — fresh serve, CLI
+        #: one-shot, the bench's fresh-app discipline — warm-starts
+        #: from what the process already learned for that chip set.
+        from ..runtime.device_cache import warm_carries
+
+        self._warm_forecast_states = warm_carries
         #: Bumped by /refresh. Cache entries record the epoch current
         #: when their fetch *started*; a mismatched epoch invalidates
         #: them. This lets refresh invalidate without touching the
@@ -506,6 +532,18 @@ class DashboardApp:
             from ..runtime.device_cache import fleet_cache
 
             fleet_cache.warm(state.view)
+            # ADR-020: whatever node/pod buckets this fleet actually
+            # encodes to get their rollup executable compiled in the
+            # background — observed shapes, not guesses, drive the
+            # backfill, and it rides the same off-request-path hook as
+            # the device upload.
+            from ..analytics.encode import _bucket
+            from ..models.aot import registry as _aot_registry
+
+            _aot_registry().ensure_rollup_shapes(
+                _bucket(max(len(state.view.nodes), 1)),
+                _bucket(max(len(state.view.pods), 1)),
+            )
         except Exception:  # noqa: BLE001 — warm is an optimization only
             pass
 
@@ -692,8 +730,9 @@ class DashboardApp:
             max_age_s=self.METRICS_PEEK_MAX_AGE_S,
         )
 
-    #: Warm-start carries kept per forecast key. Small on purpose: each
-    #: carry holds ~115k float32 params + adam moments (<2 MB); a
+    #: Warm-start carries kept per forecast key, LRU-capped inside the
+    #: process-wide ``warm_carries`` tier (ADR-020). Small on purpose:
+    #: each carry holds ~115k float32 params + adam moments (<2 MB); a
     #: dashboard serves a handful of fleets, not hundreds.
     WARM_STATE_MAX_KEYS = 8
 
@@ -750,8 +789,24 @@ class DashboardApp:
         except ImportError:
             return None
         key = self._metrics_key(metrics)
-        with self._warm_lock:
-            state = self._warm_forecast_states.get(key)
+        # take(), not get(): the warm program donates the carry's
+        # buffers, so the store must hand it to exactly one fit. The
+        # new carry is stored back below.
+        state = self._warm_forecast_states.take(key)
+        # ADR-020: hand the fused rollup+forecast path the current TPU
+        # fleet view — when the warm carry and a precompiled bucket line
+        # up, rollup + refinement run as ONE donated device program and
+        # the overview's next fleet_stats serves the parked rollup.
+        fleet_view = None
+        try:
+            snap = self._last_snapshot
+            provider_state = (
+                snap.providers.get("tpu") if snap is not None else None
+            )
+            if provider_state is not None:
+                fleet_view = provider_state.view
+        except Exception:  # noqa: BLE001 — fused path is an optimization
+            fleet_view = None
         view, new_state = compute_forecast_incremental(
             self._transport,
             metrics,
@@ -761,16 +816,10 @@ class DashboardApp:
             # window, fits train on real history (and say so in the
             # view's data_source) instead of the live range query.
             history_store=self.history,
+            fleet_view=fleet_view,
         )
-        with self._warm_lock:
-            if new_state is not None:
-                # Re-insert at the end: dict order is the LRU-ish
-                # eviction order below.
-                self._warm_forecast_states.pop(key, None)
-                self._warm_forecast_states[key] = new_state
-                while len(self._warm_forecast_states) > self.WARM_STATE_MAX_KEYS:
-                    oldest = next(iter(self._warm_forecast_states))
-                    del self._warm_forecast_states[oldest]
+        if new_state is not None:
+            self._warm_forecast_states.store(key, new_state)
         if view is not None and view.warm_demotion_reason is not None:
             self._forecast_refresher.note_demotion()
         return view
@@ -1282,6 +1331,19 @@ class DashboardApp:
         # constructing an app must never spawn threads (tests build
         # hundreds of apps); only a socket-serving host profiles itself.
         profiler().start()
+        # AOT startup compiles (ADR-020): a daemon thread lowers and
+        # compiles every hot program at its canonical buckets while the
+        # socket starts listening — requests that arrive before it
+        # finishes just miss (plain jit path, counted); once it is done
+        # the request path never pays a compile. Same never-in-__init__
+        # rule as the profiler, and guarded: a jax-less host parks the
+        # registry "unavailable" inside the thread, never breaks serve.
+        try:
+            from ..models.aot import registry as _aot_registry
+
+            _aot_registry().compile_startup()
+        except Exception:  # noqa: BLE001 — AOT is an optimization only
+            pass
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)

@@ -1,0 +1,475 @@
+"""AOT program registry + fused rollup→forecast path (ADR-020).
+
+The acceptance property this suite pins: after the startup pass, the
+request path never compiles — startup compiles are ledger-tracked under
+the EXACT (program, signature) keys the request sites use, so the
+first post-warmup request classifies as a warm dispatch and
+``request_compiles()`` stays zero. Around that core: the scripted-clock
+registry lifecycle, bucket padding numerics (the masked tail must never
+leak into results), buffer donation (donated carries really are
+consumed), miss-is-never-an-error fallbacks, and the background
+backfill path.
+
+Compile budget note: real ``lower().compile()`` calls cost ~0.5-1 s
+each on the CI host, so the suite compiles a handful of SMALL programs
+(bucket 8, short series) once per class where possible and otherwise
+uses stub builders through the injectable ``specs`` seam.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from headlamp_tpu.models import aot, forecast, service
+from headlamp_tpu.models.aot import AotProgramRegistry
+from headlamp_tpu.models.forecast import ForecastConfig, WARM_STEPS
+from headlamp_tpu.obs import jaxcost
+
+
+class _Perf:
+    """Scripted perf_counter: each read advances by ``step`` seconds,
+    so every compiled program 'lasts' exactly one step."""
+
+    def __init__(self, step: float = 1.0) -> None:
+        self.now = 0.0
+        self.step = step
+
+    def __call__(self) -> float:
+        value = self.now
+        self.now += self.step
+        return value
+
+
+def _series(n_chips: int, length: int = 61) -> np.ndarray:
+    return np.asarray(forecast.synthetic_telemetry(n_chips, length))
+
+
+@pytest.fixture()
+def swap_registry():
+    """Install a test registry as THE process registry, restoring the
+    previous one afterward (request sites read through aot.registry())."""
+    installed: list[AotProgramRegistry] = []
+
+    def install(reg: AotProgramRegistry) -> AotProgramRegistry:
+        installed.append(aot.set_registry(reg))
+        return reg
+
+    yield install
+    for prev in reversed(installed):
+        aot.set_registry(prev)
+
+
+# ---------------------------------------------------------------------------
+# Registry lifecycle (stub builders via the specs seam — no XLA cost)
+# ---------------------------------------------------------------------------
+
+
+class TestRegistryLifecycle:
+    def test_blocking_startup_compiles_every_spec_on_scripted_clock(self):
+        cfg = ForecastConfig()
+        perf = _Perf(step=0.5)
+        reg = AotProgramRegistry(
+            specs=[
+                ("forecast.aot_fit_forecast_state", (8, 61, cfg, 12, "xla", 0)),
+                ("analytics.fleet_rollup", ((8,), (8,))),
+            ],
+            perf=perf,
+        )
+        assert reg.state == "idle" and not reg.ready()
+        reg.compile_startup(block=True)
+        assert reg.ready() and reg.state == "ready"
+        assert reg.programs_compiled == 2
+        assert reg.compile_errors == 0 and reg.last_error is None
+        # Scripted clock: each compile reads perf twice -> 500 ms each.
+        assert reg.compile_ms_total == pytest.approx(1000.0)
+        assert reg.wait_ready(timeout=0.1)
+
+    def test_startup_is_idempotent(self):
+        reg = AotProgramRegistry(specs=[])
+        reg.compile_startup(block=True)
+        assert reg.ready()
+        reg.compile_startup(block=True)  # second call: no-op, no error
+        assert reg.ready() and reg.programs_compiled == 0
+
+    def test_background_startup_reaches_ready(self):
+        reg = AotProgramRegistry(
+            specs=[("analytics.fleet_rollup", ((8,), (8,)))]
+        )
+        reg.compile_startup()
+        assert reg.wait_ready(timeout=60.0)
+        assert reg.ready() and reg.programs_compiled == 1
+
+    def test_startup_compiles_are_ledger_tracked_as_startup_phase(self):
+        led = jaxcost.ledger()
+        before = led.counters()
+        reg = AotProgramRegistry(
+            specs=[("analytics.fleet_rollup", ((16,), (16,)))]
+        )
+        reg.compile_startup(block=True)
+        after = led.counters()
+        assert after["startup_compiles"] - before["startup_compiles"] == 1
+        # The startup pass never moves the request-compile count.
+        assert after["request_compiles"] == before["request_compiles"]
+
+    def test_broken_spec_is_recorded_not_raised(self):
+        reg = AotProgramRegistry(
+            specs=[
+                ("analytics.fleet_rollup", "not-a-shape-key"),
+                ("analytics.fleet_rollup", ((8,), (8,))),
+            ]
+        )
+        reg.compile_startup(block=True)
+        # The bad spec is a counted error; the good one still compiled
+        # and the registry still serves.
+        assert reg.ready()
+        assert reg.compile_errors == 1
+        assert "fleet_rollup" in (reg.last_error or "")
+        assert reg.programs_compiled == 1
+
+    def test_unknown_program_name_is_a_compile_error(self):
+        reg = AotProgramRegistry(specs=[("no.such.program", ())])
+        reg.compile_startup(block=True)
+        assert reg.ready() and reg.compile_errors == 1
+        assert "no builder" in (reg.last_error or "")
+
+    def test_executable_lookup_counts_hits_and_misses(self):
+        reg = AotProgramRegistry(
+            specs=[("analytics.fleet_rollup", ((8,), (8,)))]
+        )
+        reg.compile_startup(block=True)
+        assert reg.executable("analytics.fleet_rollup", ((8,), (8,))) is not None
+        assert reg.executable("analytics.fleet_rollup", ((32,), (32,))) is None
+        assert reg.bucket_hits == 1 and reg.bucket_misses == 1
+
+    def test_ensure_backfills_in_background(self):
+        reg = AotProgramRegistry(specs=[])
+        reg.compile_startup(block=True)
+        assert reg.ensure("analytics.fleet_rollup", ((8,), (8,))) is True
+        # Second request for the same pair while (or after) in flight
+        # never double-schedules once compiled.
+        deadline = 60.0
+        import time
+
+        t0 = time.monotonic()
+        while (
+            reg.executable("analytics.fleet_rollup", ((8,), (8,))) is None
+            and time.monotonic() - t0 < deadline
+        ):
+            time.sleep(0.05)
+        assert reg.executable("analytics.fleet_rollup", ((8,), (8,))) is not None
+        assert reg.ensure("analytics.fleet_rollup", ((8,), (8,))) is False
+
+    def test_ensure_noop_before_startup(self):
+        reg = AotProgramRegistry(specs=[])
+        assert reg.ensure("analytics.fleet_rollup", ((8,), (8,))) is False
+
+    def test_snapshot_and_counters_surfaces(self):
+        reg = AotProgramRegistry(
+            specs=[("analytics.fleet_rollup", ((8,), (8,)))]
+        )
+        reg.compile_startup(block=True)
+        reg.note_donation(4096)
+        snap = reg.snapshot()
+        assert snap["state"] == "ready"
+        assert snap["programs"] == ["analytics.fleet_rollup"]
+        assert snap["donation_saved_bytes"] == 4096
+        counters = reg.counters()
+        assert counters["programs_compiled"] == 1
+        assert counters["donation_saved_bytes"] == 4096
+        # Counters view is flat ints only (flight-recorder delta rule).
+        assert all(isinstance(v, int) for v in counters.values())
+
+
+# ---------------------------------------------------------------------------
+# Bucket padding numerics
+# ---------------------------------------------------------------------------
+
+
+class TestBucketPadding:
+    def test_chip_bucket_for(self):
+        assert aot.chip_bucket_for(1) == 8
+        assert aot.chip_bucket_for(8) == 8
+        assert aot.chip_bucket_for(9) == 64
+        assert aot.chip_bucket_for(256) == 256
+        assert aot.chip_bucket_for(257) is None
+
+    def test_pad_round_trips_exactly(self):
+        series = jnp.asarray(_series(5), jnp.float32)
+        padded, weights = forecast.pad_series_to_bucket(series, 8)
+        assert padded.shape == (8, series.shape[1])
+        np.testing.assert_array_equal(np.asarray(padded[:5]), np.asarray(series))
+        np.testing.assert_array_equal(np.asarray(padded[5:]), 0.0)
+        np.testing.assert_array_equal(
+            np.asarray(weights), [1, 1, 1, 1, 1, 0, 0, 0]
+        )
+
+    def test_masked_tail_never_leaks_into_fit_results(self):
+        """The padded program at bucket 8 must produce the SAME
+        predictions and the SAME training mse as the plain program on
+        the unpadded 5-chip series — if a padding row leaked into the
+        loss (or the stats), these would diverge."""
+        cfg = ForecastConfig()
+        series = jnp.asarray(_series(5), jnp.float32)
+        padded, weights = forecast.pad_series_to_bucket(series, 8)
+        key = jax.random.PRNGKey(0)
+        out_b, _p, _s, mse_b = forecast._bucketed_fit_forecast_state_program(
+            padded, weights, key, cfg, 12, "xla", 0
+        )
+        out_p, _p2, _s2, mse_p = forecast._fit_forecast_state_program(
+            series, key, cfg, 12, "xla", 0
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_b[:5]), np.asarray(out_p), rtol=1e-4, atol=1e-5
+        )
+        assert float(mse_b) == pytest.approx(float(mse_p), rel=1e-4)
+
+    def test_padding_rows_carry_zero_weight_in_loss(self):
+        """Direct loss-level check: corrupting the padded tail must not
+        move the masked loss at all (weight 0 ⇒ zero contribution)."""
+        cfg = ForecastConfig()
+        series = jnp.asarray(_series(5), jnp.float32)
+        padded, weights = forecast.pad_series_to_bucket(series, 8)
+        poisoned = padded.at[5:].set(1e6)
+        x, y = forecast.make_windows(padded, cfg.window, cfg.horizon)
+        xp, yp = forecast.make_windows(poisoned, cfg.window, cfg.horizon)
+        n_pos = x.shape[0] // 8
+        w = jnp.repeat(weights, n_pos)
+        params = forecast.init_params(jax.random.PRNGKey(1), cfg)
+        clean = float(forecast._masked_loss_fn(params, x, y, w))
+        dirty = float(forecast._masked_loss_fn(params, xp, yp, w))
+        assert clean == pytest.approx(dirty, rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Donation
+# ---------------------------------------------------------------------------
+
+
+class TestDonation:
+    def test_warm_program_consumes_the_donated_carry(self):
+        """``donate_argnums`` on the warm bucketed program must really
+        invalidate the donated params/opt_state buffers — reusing the
+        old carry after the call raises (the single-owner contract the
+        serving path relies on)."""
+        cfg = ForecastConfig()
+        series = jnp.asarray(_series(5), jnp.float32)
+        padded, weights = forecast.pad_series_to_bucket(series, 8)
+        key = jax.random.PRNGKey(0)
+        _out, params, opt_state, _mse = (
+            forecast._bucketed_fit_forecast_state_program(
+                padded, weights, key, cfg, 12, "xla", 0
+            )
+        )
+        donated_leaf = jax.tree_util.tree_leaves(params)[0]
+        _out2, new_params, _new_opt, _mse2 = (
+            forecast._bucketed_warm_fit_forecast_program(
+                padded, weights, params, opt_state, cfg, WARM_STEPS, "xla", 0
+            )
+        )
+        assert donated_leaf.is_deleted()
+        with pytest.raises(RuntimeError):
+            _ = donated_leaf + 1
+        # The replacement carry is live and usable.
+        assert not jax.tree_util.tree_leaves(new_params)[0].is_deleted()
+
+    def test_series_and_weights_survive_the_call(self):
+        """Only the carry is donated: the padded series has no
+        output to alias (donating it would be a no-op warning), so it
+        must remain readable after the call."""
+        cfg = ForecastConfig()
+        series = jnp.asarray(_series(5), jnp.float32)
+        padded, weights = forecast.pad_series_to_bucket(series, 8)
+        key = jax.random.PRNGKey(0)
+        _o, params, opt_state, _m = (
+            forecast._bucketed_fit_forecast_state_program(
+                padded, weights, key, cfg, 12, "xla", 0
+            )
+        )
+        forecast._bucketed_warm_fit_forecast_program(
+            padded, weights, params, opt_state, cfg, WARM_STEPS, "xla", 0
+        )
+        assert not padded.is_deleted() and not weights.is_deleted()
+        _ = float(jnp.sum(padded))  # still readable
+
+
+# ---------------------------------------------------------------------------
+# Zero request-path compiles after warmup + miss fallbacks
+# ---------------------------------------------------------------------------
+
+
+class TestRequestPath:
+    def test_first_post_warmup_request_records_zero_ledger_compiles(
+        self, swap_registry
+    ):
+        cfg = ForecastConfig()
+        reg = swap_registry(
+            AotProgramRegistry(
+                specs=[
+                    ("forecast.aot_fit_forecast_state",
+                     (8, 61, cfg, 60, "xla", 0)),
+                ]
+            )
+        )
+        reg.compile_startup(block=True)
+        led = jaxcost.ledger()
+        before = led.counters()
+        series = _series(5)
+        out, dispatch = forecast.fit_and_forecast_with_dispatch(
+            series, cfg, steps=60
+        )
+        after = led.counters()
+        # The startup thread tracked the IDENTICAL (name, key): this
+        # request classifies as a warm dispatch — zero request compiles.
+        assert after["request_compiles"] == before["request_compiles"]
+        assert reg.bucket_hits >= 1
+        assert np.asarray(out).shape == (5, cfg.horizon)
+        assert dispatch.path == "xla"
+
+    def test_bucket_miss_falls_back_to_plain_jit_counted_never_an_error(
+        self, swap_registry
+    ):
+        cfg = ForecastConfig()
+        reg = swap_registry(AotProgramRegistry(specs=[]))
+        reg.compile_startup(block=True)
+        before_misses = reg.bucket_misses
+        series = _series(5)
+        out, _dispatch = forecast.fit_and_forecast_with_dispatch(
+            series, cfg, steps=12
+        )
+        # The plain jitted path served a full-quality result; the miss
+        # was counted, no exec failure recorded.
+        assert np.asarray(out).shape == (5, cfg.horizon)
+        assert reg.bucket_misses > before_misses
+        assert reg.exec_failures == 0
+
+    def test_chip_count_above_top_bucket_is_a_counted_miss(
+        self, swap_registry
+    ):
+        cfg = ForecastConfig()
+        reg = swap_registry(AotProgramRegistry(specs=[]))
+        reg.compile_startup(block=True)
+        head = (jnp.asarray(_series(300), jnp.float32),
+                jax.random.PRNGKey(0), cfg, 12)
+        before = reg.bucket_misses
+        got = forecast._try_aot_forecast(
+            forecast._fit_forecast_state_program, head, "xla", 0
+        )
+        assert got is None
+        assert reg.bucket_misses == before + 1
+        assert reg.exec_failures == 0
+
+    def test_cold_registry_never_consulted(self, swap_registry):
+        reg = swap_registry(AotProgramRegistry(specs=[]))
+        # No compile_startup: state 'idle', ready() False — request
+        # sites skip the registry entirely (no counters move).
+        cfg = ForecastConfig()
+        series = _series(5)
+        out, _d = forecast.fit_and_forecast_with_dispatch(
+            series, cfg, steps=12
+        )
+        assert np.asarray(out).shape == (5, cfg.horizon)
+        assert reg.bucket_hits == 0 and reg.bucket_misses == 0
+
+
+# ---------------------------------------------------------------------------
+# Fused rollup+forecast service path
+# ---------------------------------------------------------------------------
+
+
+def _fused_fixture():
+    """(registry specs, fleet view, history, cold state) for the fused
+    path at the (256, 256) rollup bucket and the 64-chip live window."""
+    from headlamp_tpu.domain.accelerator import classify_fleet
+    from headlamp_tpu.fleet import fixtures as fx
+    from headlamp_tpu.metrics.client import UtilizationHistory
+
+    fleet = fx.fleet_large(256)
+    view = classify_fleet(fleet["nodes"], fleet["pods"])["tpu"]
+    view.version = 73
+    cfg = ForecastConfig()
+    series = _series(64)
+    hist = UtilizationHistory(
+        keys=[(f"n{i}", f"a{i}") for i in range(64)],
+        series=[list(row) for row in series],
+        step_s=60,
+        end=1000.0,
+        resolved_query="test",
+    )
+    return view, cfg, hist
+
+
+class TestFusedServicePath:
+    """One real fused compile (~2 s, class-scoped) covers the class."""
+
+    @pytest.fixture(scope="class")
+    def fused_env(self):
+        view, cfg, hist = _fused_fixture()
+        ledger_key = (
+            (256,), (256,), 64, 61, cfg, WARM_STEPS, "xla", 0
+        )
+        reg = AotProgramRegistry(
+            specs=[("fused.rollup_and_forecast", ledger_key)]
+        )
+        reg.compile_startup(block=True)
+        assert reg.ready() and reg.compile_errors == 0, reg.snapshot()
+        prev = aot.set_registry(reg)
+        yield reg, view, cfg, hist
+        aot.set_registry(prev)
+
+    def test_fused_serves_rollup_and_forecast_in_one_program(self, fused_env):
+        from headlamp_tpu.analytics.encode import encode_fleet
+        from headlamp_tpu.analytics.fleet_jax import rollup_to_dict
+        from headlamp_tpu.runtime.device_cache import rollup_results
+
+        reg, view, cfg, hist = fused_env
+        _v0, state0 = service.forecast_from_history_incremental(
+            hist, cfg, state=None, data_source="history"
+        )
+        assert state0 is not None
+        led = jaxcost.ledger()
+        before = led.counters()
+        result = service._fused_rollup_forecast(
+            hist, cfg, state0, view, "history"
+        )
+        after = led.counters()
+        assert result is not None, reg.snapshot()
+        fused_view, new_state = result
+        assert after["request_compiles"] == before["request_compiles"]
+        assert fused_view.inference_path == "xla-warm"
+        assert len(fused_view.chips) == 64
+        assert new_state is not None and new_state.generation == state0.generation
+        assert reg.donation_saved_bytes > 0
+        # The rollup half is parked and EXACT vs the standalone rollup.
+        parked = rollup_results.get(view.provider.name, view.version)
+        assert parked is not None
+        reference = rollup_to_dict(encode_fleet(view.nodes, view.pods))
+        for key in (
+            "capacity", "allocatable", "in_use", "free", "nodes_total",
+            "nodes_ready", "hot_nodes", "utilization_pct",
+        ):
+            assert parked[key] == reference[key], key
+
+    def test_fused_declines_unversioned_or_small_views(self, fused_env):
+        reg, view, cfg, hist = fused_env
+        _v0, state0 = service.forecast_from_history_incremental(
+            hist, cfg, state=None, data_source="history"
+        )
+        assert service._fused_rollup_forecast(
+            hist, cfg, state0, None, "history"
+        ) is None
+        unversioned = type(view).__new__(type(view))
+        unversioned.__dict__.update(view.__dict__)
+        unversioned.version = None
+        assert service._fused_rollup_forecast(
+            hist, cfg, state0, unversioned, "history"
+        ) is None
+
+    def test_fused_requires_a_warm_carry(self, fused_env):
+        reg, view, cfg, hist = fused_env
+        assert service._fused_rollup_forecast(
+            hist, cfg, None, view, "history"
+        ) is None

@@ -1,0 +1,121 @@
+"""Unregistered-jit gate (tools/no_unregistered_jit_check.py, ADR-020).
+
+Two halves, mirroring tests/test_no_inline_fit.py:
+  1. The gate itself: the live tree must be clean — no ``jax.jit`` /
+     ``jax.pmap`` entry points in ``headlamp_tpu/`` outside the three
+     kernel packages (models/, analytics/, parallel/), where the AOT
+     registry can see and startup-compile them.
+  2. Mutation coverage: sources that smuggle a jit program back into
+     serving code (decorator, partial, ``from jax import jit`` with or
+     without alias, bare-name use) must each produce a diagnostic —
+     and sanctioned look-alikes (plain ``import jax``, array math,
+     prose mentions, an unrelated ``jit`` kwarg) must not.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from no_unregistered_jit_check import _check_source, check_tree  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_repo_tree_is_clean():
+    diagnostics = check_tree(REPO)
+    assert diagnostics == [], "\n".join(str(d) for d in diagnostics)
+
+
+def test_kernel_packages_are_exempt():
+    paths = {d.path for d in check_tree(REPO)}
+    assert not any(
+        os.sep + "models" + os.sep in p
+        or os.sep + "analytics" + os.sep in p
+        or os.sep + "parallel" + os.sep in p
+        for p in paths
+    )
+
+
+class TestMutations:
+    def _diags(self, src):
+        return _check_source("mut.py", src)
+
+    def test_decorator_flagged(self):
+        diags = self._diags(
+            "import jax\n"
+            "@jax.jit\n"
+            "def hot(x):\n"
+            "    return x + 1\n"
+        )
+        assert len(diags) == 1 and diags[0].line == 2
+
+    def test_partial_jit_flagged(self):
+        diags = self._diags(
+            "import functools, jax\n"
+            "@functools.partial(jax.jit, static_argnames=('n',))\n"
+            "def hot(x, n):\n"
+            "    return x * n\n"
+        )
+        assert len(diags) == 1
+
+    def test_call_form_flagged(self):
+        diags = self._diags(
+            "import jax\n"
+            "program = jax.jit(lambda x: x + 1)\n"
+        )
+        assert len(diags) == 1 and diags[0].line == 2
+
+    def test_pmap_flagged(self):
+        diags = self._diags(
+            "import jax\n"
+            "program = jax.pmap(lambda x: x)\n"
+        )
+        assert len(diags) == 1
+
+    def test_from_import_and_use_both_flagged(self):
+        diags = self._diags(
+            "from jax import jit\n"
+            "hot = jit(lambda x: x)\n"
+        )
+        assert [d.line for d in diags] == [1, 2]
+
+    def test_aliased_import_reference_flagged(self):
+        # The alias hides `jit` from the bare-name scan; the import
+        # tracking must carry it.
+        diags = self._diags(
+            "from jax import jit as compile_me\n"
+            "hot = compile_me(lambda x: x)\n"
+        )
+        assert [d.line for d in diags] == [1, 2]
+
+    def test_plain_jax_usage_clean(self):
+        diags = self._diags(
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def pure(x):\n"
+            "    return jnp.sum(jax.nn.relu(x))\n"
+        )
+        assert diags == []
+
+    def test_unrelated_jit_names_clean(self):
+        # A local function named jit, or `jit=` keyword on a non-jax
+        # call, creates no XLA program.
+        diags = self._diags(
+            "def configure(jit=False):\n"
+            "    return {'jit': jit}\n"
+        )
+        assert diags == []
+
+    def test_prose_and_strings_clean(self):
+        diags = self._diags(
+            "# jax.jit is forbidden here\n"
+            "DOC = 'wrap with jax.jit inside models/ only'\n"
+        )
+        assert diags == []
+
+    def test_unparseable_reports_instead_of_crashing(self):
+        diags = self._diags("def broken(:\n")
+        assert len(diags) == 1 and "unparseable" in diags[0].message

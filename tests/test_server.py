@@ -426,9 +426,13 @@ class TestCaching:
         assert app._forecast_for(m) == "view2"  # the refit landed
 
     def test_background_refit_warm_starts_from_carried_state(self):
-        # App-level warm carry: the state the cold fit seeded must feed
-        # the background refit after the TTL lapse, and the refreshed
-        # view must SAY so (path "*-warm") — never a silent cold refit.
+        # Warm carry (process tier since ADR-020): the state the cold
+        # fit seeded must feed the background refit after the TTL
+        # lapse, and the refreshed view must SAY so (path "*-warm") —
+        # never a silent cold refit.
+        from headlamp_tpu.runtime.device_cache import warm_carries
+
+        warm_carries.invalidate()  # isolate from earlier tests' carries
         clock = [100.0]
         app = DashboardApp(
             make_demo_transport("v5e4"),
@@ -447,6 +451,31 @@ class TestCaching:
         )
         assert view is not None and view.inference_path.endswith("-warm")
         assert view.warm_demotion_reason is None
+
+    def test_fresh_app_warm_starts_from_process_tier(self):
+        # ADR-020: carries outlive the app. A REBUILT app serving the
+        # same chip set — fresh serve, CLI one-shot, the bench's
+        # fresh-app discipline — must warm-start from the process-wide
+        # warm_carries tier instead of paying the full cold fit.
+        from headlamp_tpu.runtime.device_cache import warm_carries
+
+        warm_carries.invalidate()
+        app1 = DashboardApp(make_demo_transport("v5e4"), min_sync_interval_s=0.0)
+        status, _, _ = app1.handle("/tpu/metrics")
+        assert status == 200 and len(warm_carries) == 1
+
+        app2 = DashboardApp(make_demo_transport("v5e4"), min_sync_interval_s=0.0)
+        status, _, _ = app2.handle("/tpu/metrics")
+        assert status == 200
+        m = app2._cached_metrics()
+        view = app2._forecast_refresher.peek(
+            app2._metrics_key(m), epoch=app2._cache_epoch
+        )
+        assert view is not None and view.inference_path.endswith("-warm")
+        # The donated carry was taken by app2's fit and its successor
+        # stored back — the tier never serves a dead carry twice.
+        assert len(warm_carries) == 1
+        assert warm_carries.counters()["hits"] >= 1
 
 
 class TestBackgroundSync:

@@ -1,0 +1,146 @@
+"""Static gate: no new ``jax.jit`` entry points outside the kernel
+layers.
+
+ADR-020 makes startup the only place XLA compiles: every hot jitted
+program lives in ``headlamp_tpu/models/`` / ``headlamp_tpu/analytics/``
+/ ``headlamp_tpu/parallel/`` and is AOT-compiled by the
+``models/aot.py`` registry at its canonical bucketed shapes, so the
+request path never pays a compile after warmup. A ``jax.jit`` call
+added anywhere ELSE in the serving tree creates a program the registry
+has never heard of — its first request at every novel shape recompiles
+inline, exactly the first-request latency cliff this design removed,
+and the zero-request-compiles acceptance gate would rot silently.
+
+This check makes the drift loud: ``jax.jit`` / ``jax.pmap`` references
+(call, decorator, ``functools.partial(jax.jit, ...)``, ``from jax
+import jit``) are forbidden in ``headlamp_tpu/`` outside the three
+kernel packages. A genuinely new jit entry point belongs in one of
+those packages WITH a builder registered in
+``models/aot.py``'s ``_BUILDERS`` table — that is the "unless
+AOT-registered" escape hatch, enforced by construction (code inside the
+sanctioned packages is where registration is possible and reviewed).
+
+Scope: ``headlamp_tpu/`` minus the three kernel packages. ``tests/``,
+``tools/``, and ``bench.py`` are exempt — they jit throwaway probe
+programs on purpose (cache-key experiments, compile-cost measurement).
+
+AST-based, not grep, mirroring ``no_raw_urlopen_check``: matches
+attribute access on any base (``jax.jit``, ``j.jit`` won't slip by an
+alias because the attribute name itself is matched), bare names bound
+by ``from jax import jit [as j]``, and flags the import itself —
+an unused jit import in serving code is already drift. Comments,
+docstrings, and prose never parse as references.
+
+Runs in the repo's static-check entry point
+(``tools/ts_static_check.py main()``) and in tier-1 via
+``tests/test_no_unregistered_jit.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from dataclasses import dataclass
+
+
+@dataclass
+class Diagnostic:
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.message}"
+
+
+#: Attribute/function names that create an XLA program entry point.
+_JIT_NAMES = {"jit", "pmap"}
+
+_MESSAGE = (
+    "jax.jit/pmap entry point outside models//analytics//parallel/ — "
+    "hot programs live in the kernel layers and are AOT-registered in "
+    "models/aot.py so the request path never compiles (ADR-020)"
+)
+
+
+def _check_source(path: str, src: str) -> list[Diagnostic]:
+    """Flag jit/pmap program-creation references in any form: attribute
+    access (``jax.jit(...)``, ``@jax.jit``, ``partial(jax.jit, ...)``),
+    ``from jax import jit [as alias]`` bindings, and bare-name loads of
+    those bindings. Plain ``import jax`` alone is fine — only reaching
+    for the compiler is flagged."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Diagnostic(path, e.lineno or 1, f"unparseable: {e.msg}")]
+
+    out: list[Diagnostic] = []
+    #: Local names bound to jax.jit/pmap via ``from jax import ...``.
+    aliases: set[str] = set()
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module != "jax" and not (
+                node.module or ""
+            ).startswith("jax."):
+                continue
+            for alias in node.names:
+                if alias.name in _JIT_NAMES:
+                    out.append(Diagnostic(path, node.lineno, _MESSAGE))
+                    aliases.add(alias.asname or alias.name)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in _JIT_NAMES:
+            # Only attribute reads rooted at a jax-ish base: ``jax.jit``
+            # or ``jax.numpy... .jit`` — an unrelated object's ``.jit``
+            # attribute (none exist today) would still be flagged, which
+            # is the safe direction for this gate.
+            out.append(Diagnostic(path, node.lineno, _MESSAGE))
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id in aliases:
+                out.append(Diagnostic(path, node.lineno, _MESSAGE))
+    return out
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check_tree(root: str | None = None) -> list[Diagnostic]:
+    """Scan ``headlamp_tpu/`` minus the kernel packages under ``root``
+    (repo root by default). Returns [] when clean."""
+    root = root or _repo_root()
+    base = os.path.join(root, "headlamp_tpu")
+    exempt_dirs = tuple(
+        os.path.abspath(os.path.join(base, d))
+        for d in ("models", "analytics", "parallel")
+    )
+    targets: list[str] = []
+    for dirpath, _dirnames, filenames in os.walk(base):
+        if any(
+            os.path.abspath(dirpath).startswith(d) for d in exempt_dirs
+        ):
+            continue
+        for filename in sorted(filenames):
+            if filename.endswith(".py"):
+                targets.append(os.path.join(dirpath, filename))
+
+    diagnostics: list[Diagnostic] = []
+    for path in targets:
+        with open(path, "r", encoding="utf-8") as f:
+            diagnostics.extend(_check_source(path, f.read()))
+    return diagnostics
+
+
+def main() -> int:
+    root = sys.argv[1] if len(sys.argv) > 1 else None
+    diagnostics = check_tree(root)
+    for diag in diagnostics:
+        print(diag)
+    print(f"{len(diagnostics)} unregistered-jit problem(s)")
+    return 1 if diagnostics else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

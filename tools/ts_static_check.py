@@ -1492,8 +1492,18 @@ def main() -> int:
     for diag in render_diags:
         print(diag)
     print(f"{len(render_diags)} direct-render problem(s)")
+    # AOT-registration gate (ADR-020): no jax.jit entry points outside
+    # the kernel layers — hot programs are startup-compiled, never
+    # request-compiled.
+    import no_unregistered_jit_check
+
+    jit_diags = no_unregistered_jit_check.check_tree()
+    for diag in jit_diags:
+        print(diag)
+    print(f"{len(jit_diags)} unregistered-jit problem(s)")
     return 1 if (
-        diagnostics or urlopen_diags or fit_diags or wall_diags or render_diags
+        diagnostics or urlopen_diags or fit_diags or wall_diags
+        or render_diags or jit_diags
     ) else 0
 
 
