@@ -43,6 +43,11 @@ from typing import Any, Callable, NamedTuple
 from urllib.parse import parse_qsl, urlparse
 
 from ..obs.metrics import registry as _metrics_registry
+from ..push.conditional import (
+    count_not_modified,
+    etag_for,
+    if_none_match_matches,
+)
 from .coalesce import RenderCoalescer
 from .pool import (
     PRIORITY_DEBUG,
@@ -65,7 +70,8 @@ RETRY_AFTER_S = 5
 _REQUESTS = _metrics_registry.counter(
     "headlamp_tpu_gateway_requests_total",
     "Requests through the render gateway, by priority class and outcome "
-    "(rendered/coalesced/shed/queue_full/expired/timeout/bypass/failed).",
+    "(rendered/coalesced/shed/queue_full/expired/timeout/bypass/failed/"
+    "not_modified).",
     labels=("priority", "outcome"),
 )
 _SHED = _metrics_registry.counter(
@@ -189,6 +195,11 @@ class RenderGateway:
         self.timeouts = 0
         self.degraded_renders = 0
         self.bypassed = 0
+        self.not_modified = 0
+        #: The push pipeline (ADR-021), attached by the app when one is
+        #: serving — gives /events its dedicated connection registry a
+        #: home in the gateway snapshot and the hub its shed probe.
+        self.push: Any = None
 
     # -- classification --------------------------------------------------
 
@@ -223,6 +234,23 @@ class RenderGateway:
 
     # -- responses -------------------------------------------------------
 
+    def _page_headers(
+        self, generation: int, degraded: bool
+    ) -> tuple[tuple[str, str], ...]:
+        """The ADR-021 page-response header set. ``X-Headlamp-Generation``
+        is the SSE resume anchor (a live-wall client records it from its
+        initial paint); ``X-Headlamp-Stale`` badges gateway-degraded
+        (stale-only) paints, previously indistinguishable from fresh
+        ones at the HTTP layer; ``Cache-Control: no-cache`` forces
+        intermediaries to revalidate through the ETag path instead of
+        serving stale paints around it."""
+        return (
+            ("ETag", etag_for(generation, self._epoch(), degraded)),
+            ("Cache-Control", "no-cache"),
+            ("X-Headlamp-Generation", str(int(generation))),
+            ("X-Headlamp-Stale", "1" if degraded else "0"),
+        )
+
     def _shed_response(
         self, route: str, reason: str, burn_state: dict[str, str]
     ) -> GatewayResponse:
@@ -251,7 +279,13 @@ class RenderGateway:
 
     # -- the request path ------------------------------------------------
 
-    def handle(self, path: str, *, accept: str | None = None) -> GatewayResponse:
+    def handle(
+        self,
+        path: str,
+        *,
+        accept: str | None = None,
+        if_none_match: str | None = None,
+    ) -> GatewayResponse:
         route = self._route_label(path)
         if route == "/healthz":
             # Liveness bypass: no queue, no shed, no coalesce. A wedged
@@ -267,6 +301,33 @@ class RenderGateway:
             self.shed_burn += 1
             _REQUESTS.inc(priority=pname, outcome="shed")
             return self._shed_response(route, "burn_rate", decision.burn_state)
+
+        if (
+            if_none_match
+            and priority == PRIORITY_INTERACTIVE
+            and route != "/refresh"
+        ):
+            # Conditional short-circuit (ADR-021): the ETag encodes the
+            # exact invariants the coalesce key uses — same generation +
+            # epoch + degraded flag means a render would reproduce the
+            # bytes the client already holds, so answer 304 BEFORE pool
+            # admission. SLO feed: requests_total once, NO duration
+            # histogram (the r10-review rule — a microsecond 304
+            # observed as a good render latency would dilute
+            # bad_fraction exactly when paints are slow).
+            generation = self._generation()
+            etag = etag_for(generation, self._epoch(), decision.degraded)
+            if if_none_match_matches(if_none_match, etag):
+                self.not_modified += 1
+                _REQUESTS.inc(priority=pname, outcome="not_modified")
+                self._req_total.inc(route=route, status="304")
+                count_not_modified(route)
+                return GatewayResponse(
+                    304,
+                    "text/html",
+                    "",
+                    self._page_headers(generation, decision.degraded),
+                )
 
         key = self._coalesce_key(path, route, decision.degraded)
         if key is not None:
@@ -371,7 +432,17 @@ class RenderGateway:
         if degraded:
             self.degraded_renders += 1
         _REQUESTS.inc(priority=pname, outcome="rendered")
-        return GatewayResponse(*job.result)
+        response = GatewayResponse(*job.result)
+        if priority == PRIORITY_INTERACTIVE and response.status == 200:
+            # Stamp BEFORE coalescer.finish publishes the response (the
+            # caller does that) so followers inherit the same headers —
+            # legitimate, because degraded is sealed into the coalesce
+            # key and the ETag ingredients are the key's own fields.
+            response = response._replace(
+                headers=response.headers
+                + self._page_headers(self._generation(), degraded)
+            )
+        return response
 
     # -- observability / lifecycle --------------------------------------
 
@@ -387,6 +458,7 @@ class RenderGateway:
             "timeouts": self.timeouts,
             "degraded_renders": self.degraded_renders,
             "bypassed": self.bypassed,
+            "not_modified": self.not_modified,
         }
         for key, value in self.pool.counters().items():
             out[f"pool_{key}"] = value
@@ -401,7 +473,20 @@ class RenderGateway:
         out["coalesce_inflight"] = self.coalescer.inflight()
         out["workers"] = self.pool.workers
         out["burn_state"] = self.shed_policy.states()
+        if self.push is not None:
+            # The dedicated SSE connection registry (ADR-021): streams
+            # live here, NOT in the render pool — this line is where an
+            # operator confirms that separation.
+            out["sse_connections"] = self.push.hub.connected()
         return out
+
+    def attach_push(self, pipeline: Any) -> None:
+        """Adopt the push pipeline (ADR-021): the gateway's snapshot
+        gains the SSE connection registry, and the hub's shed probe is
+        wired to this gateway's policy so DEBUG-class streams close
+        under the same paging burn that sheds /debug requests."""
+        self.push = pipeline
+        pipeline.hub.set_shed_check(self.shed_policy.paging)
 
     def close(self) -> None:
         self.pool.close()

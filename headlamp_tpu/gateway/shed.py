@@ -148,6 +148,14 @@ class ShedPolicy:
             return Decision(degraded=True, burn_state=states)
         return Decision(burn_state=states)
 
+    def paging(self) -> bool:
+        """Is ANY request-backed SLO currently paging? The broadcast
+        hub's shed probe (ADR-021): the same condition that sheds
+        /debug requests closes DEBUG-class SSE streams. Rides the
+        states() TTL cache, so long-lived streams can poll it freely."""
+        self.states()
+        return bool(self._paging_routes)
+
     def invalidate(self) -> None:
         """Drop the TTL cache (tests flip engine state mid-scenario)."""
         self._cached_at = None

@@ -1,0 +1,223 @@
+"""Push pipeline (ADR-021): generation-keyed snapshot deltas, an SSE
+broadcast hub, and conditional/compressed full paints.
+
+The three parts compose into "push, don't poll":
+
+1. **differ.py** — on each sync generation bump, reduce the snapshot
+   (+ non-blocking metrics/forecast peeks) to compact page models and
+   diff them against the previous generation's; changed pages become
+   JSON patch frames, unchanged pages nothing.
+2. **hub.py** — fan each generation's frames out to the connected
+   ``/events`` SSE subscribers: one fleet change → one render/diff → N
+   cheap frame writes, regardless of N.
+3. **conditional.py** — for clients still polling full paints: strong
+   ETags from (generation, epoch, degraded) answer ``If-None-Match``
+   with a 304 BEFORE render-pool admission, and bodies ship gzipped
+   when negotiated.
+
+This package must never import ``..gateway`` (the gateway imports
+``conditional`` for its pre-admission 304 check — the dependency runs
+one way) and must never spawn threads (it is constructed in
+``DashboardApp.__init__``; the socket server parks ITS handler threads
+in ``hub.next_event``).
+"""
+
+from __future__ import annotations
+
+import time
+import weakref
+from typing import Any, Callable
+
+from ..obs.flight import flight_recorder
+from ..obs.metrics import registry as _metrics_registry
+from .conditional import (
+    MIN_GZIP_SIZE,
+    count_not_modified,
+    encode_body,
+    etag_for,
+    gzip_accepted,
+    if_none_match_matches,
+)
+from .differ import PAGES, build_page_models, diff_models
+from .hub import (
+    BACKLOG_LIMIT,
+    HEARTBEAT_S,
+    OUTBOX_LIMIT,
+    BroadcastHub,
+    Subscription,
+    format_event,
+    parse_last_event_id,
+)
+
+_DIFF_SECONDS = _metrics_registry.histogram(
+    "headlamp_tpu_push_diff_seconds",
+    "Page-model build + diff time per sync generation bump (runs on "
+    "the sync thread, off the request path).",
+)
+
+#: The serving pipeline, for the connected-clients callback gauge —
+#: same weakref discipline as the gateway's queue gauges: tests build
+#: many pipelines per process and the gauge must follow the live one.
+_ACTIVE: weakref.ref | None = None
+
+
+def set_active_push(pipeline: "PushPipeline | None") -> None:
+    global _ACTIVE
+    _ACTIVE = weakref.ref(pipeline) if pipeline is not None else None
+
+
+def _clients_sample() -> float | None:
+    pipeline = _ACTIVE() if _ACTIVE is not None else None
+    return float(pipeline.hub.connected()) if pipeline is not None else None
+
+
+_metrics_registry.gauge_fn(
+    "headlamp_tpu_push_clients_count",
+    "SSE subscribers currently connected to /events.",
+    _clients_sample,
+)
+
+
+class PushPipeline:
+    """Differ + hub, hooked beside ``_record_sync``: every sync that
+    bumps the generation diffs the new snapshot's page models against
+    the previous generation's and broadcasts the patch frames. The
+    first-ever snapshot is the baseline — clients already hold current
+    state from their initial full paint, so it produces no frames."""
+
+    def __init__(
+        self,
+        *,
+        monotonic: Callable[[], float] | None = None,
+        heartbeat_s: float = HEARTBEAT_S,
+        outbox_limit: int = OUTBOX_LIMIT,
+        backlog_limit: int = BACKLOG_LIMIT,
+        shed_check: Callable[[], bool] | None = None,
+    ) -> None:
+        self._mono = monotonic or time.monotonic
+        self.hub = BroadcastHub(
+            monotonic=self._mono,
+            heartbeat_s=heartbeat_s,
+            outbox_limit=outbox_limit,
+            backlog_limit=backlog_limit,
+            shed_check=shed_check,
+        )
+        self._models: dict[str, dict[str, Any]] | None = None
+        self.generation = 0
+        # Monotone per-instance ints (healthz block + flight deltas).
+        self.diffs = 0
+        self.baselines = 0
+        self.frames_built = 0
+        self.skipped_stale = 0
+
+    def on_snapshot(
+        self,
+        snap: Any,
+        *,
+        generation: int,
+        metrics: Callable[[], Any] | None = None,
+        forecast: Callable[[], Any] | None = None,
+    ) -> int:
+        """Diff-and-broadcast hook, called from the sync path (both the
+        background loop and inline syncs). ``metrics``/``forecast`` are
+        zero-arg non-blocking peeks — evaluated here, once, so all four
+        page models see one consistent pair. Exception-absorbed end to
+        end: push is an optimization and must never break the sync
+        heartbeat rehearsing a renderer bug. Returns frames delivered."""
+        try:
+            if snap is None or generation <= self.generation:
+                self.skipped_stale += 1
+                return 0
+            t0 = self._mono()
+            metrics_value = metrics() if callable(metrics) else metrics
+            forecast_value = forecast() if callable(forecast) else forecast
+            models = build_page_models(
+                snap, metrics=metrics_value, forecast=forecast_value
+            )
+            frames = (
+                {} if self._models is None else diff_models(self._models, models)
+            )
+            baseline = self._models is None
+            self._models = models
+            self.generation = int(generation)
+            _DIFF_SECONDS.observe(max(self._mono() - t0, 0.0))
+            if baseline:
+                self.baselines += 1
+                return 0
+            self.diffs += 1
+            for frame in frames.values():
+                frame["generation"] = int(generation)
+            self.frames_built += len(frames)
+            delivered = self.hub.publish(int(generation), frames)
+            if frames:
+                # Broadcast wide event (ADR-016 discipline): one flat
+                # record per fan-out so /debug/flightz answers "what did
+                # that fleet change push, to how many clients" without a
+                # dedicated surface. Hand-built with the wide_event key
+                # shape (request/route/status/duration_ms/stages).
+                flight_recorder.record(
+                    {
+                        "request": f"PUSH g{int(generation)}",
+                        "route": "/events",
+                        "status": 200,
+                        "duration_ms": round((self._mono() - t0) * 1000, 3),
+                        "trace_id": None,
+                        "stages": {},
+                        "slo_violations": [],
+                        "counters": {
+                            "push.pages_changed": len(frames),
+                            "push.frames_delivered": delivered,
+                            "push.connected": self.hub.connected(),
+                        },
+                    }
+                )
+            return delivered
+        except Exception:  # noqa: BLE001 — push must never break the sync path
+            return 0
+
+    def counters(self) -> dict[str, int]:
+        out = {
+            "diffs": self.diffs,
+            "baselines": self.baselines,
+            "frames_built": self.frames_built,
+            "skipped_stale": self.skipped_stale,
+        }
+        out.update(self.hub.counters())
+        return out
+
+    def snapshot(self) -> dict[str, Any]:
+        """The /healthz ``runtime.push`` block."""
+        out: dict[str, Any] = {
+            "generation": self.generation,
+            "diffs": self.diffs,
+            "baselines": self.baselines,
+            "frames_built": self.frames_built,
+            "skipped_stale": self.skipped_stale,
+        }
+        out.update(self.hub.snapshot())
+        return out
+
+    def close(self) -> None:
+        self.hub.close()
+
+
+__all__ = [
+    "BACKLOG_LIMIT",
+    "HEARTBEAT_S",
+    "MIN_GZIP_SIZE",
+    "OUTBOX_LIMIT",
+    "PAGES",
+    "BroadcastHub",
+    "PushPipeline",
+    "Subscription",
+    "build_page_models",
+    "count_not_modified",
+    "diff_models",
+    "encode_body",
+    "etag_for",
+    "format_event",
+    "gzip_accepted",
+    "if_none_match_matches",
+    "parse_last_event_id",
+    "set_active_push",
+]

@@ -1,0 +1,136 @@
+"""Conditional + compressed full paints (ADR-021 part 3).
+
+Strong ETags derived from ``(generation, cache epoch, degraded)`` — the
+exact invariants the coalesce key already uses to decide two renders
+would be byte-identical. If those three match, the bytes the client
+holds are the bytes a render would produce, so ``If-None-Match`` can
+answer 304 BEFORE render-pool admission: a poll against an unchanged
+fleet costs a string compare, not a pool slot.
+
+Gzip is negotiated per request from ``Accept-Encoding`` and applied at
+the socket layer (the gateway trades in ``str`` bodies; encoding is a
+wire concern). ``mtime=0`` keeps the compressed bytes deterministic —
+two encodes of the same paint are byte-identical, which the bench's
+ratio math and any downstream cache both rely on.
+
+No request-side caching headers beyond ``Cache-Control: no-cache``:
+dynamic paints must revalidate through the ETag path, never be served
+stale AROUND it by an intermediary.
+"""
+
+from __future__ import annotations
+
+import gzip as _gzip
+
+from ..obs.metrics import registry as _metrics_registry
+
+#: Bodies below this size skip gzip: the ~20-byte header plus deflate
+#: bookkeeping can GROW tiny payloads, and a 304/frame already covers
+#: the small-response cases that matter.
+MIN_GZIP_SIZE = 512
+
+#: Compression level 6 (zlib default): the 1024-node paint compresses
+#: ~10x at level 1 already; 6 buys a few more percent for microseconds,
+#: 9 buys nothing measurable for milliseconds.
+GZIP_LEVEL = 6
+
+_GZIP_BYTES = _metrics_registry.counter(
+    "headlamp_tpu_push_gzip_bytes_total",
+    "Full-paint body bytes through the negotiated-gzip encoder, raw vs "
+    "compressed (the delta is wire bytes saved).",
+    labels=("kind",),
+)
+_NOT_MODIFIED = _metrics_registry.counter(
+    "headlamp_tpu_push_not_modified_total",
+    "Conditional requests answered 304 before render-pool admission, "
+    "by route template.",
+    labels=("route",),
+)
+
+
+def etag_for(generation: int, epoch: int, degraded: bool) -> str:
+    """Strong ETag (quoted, per RFC 7232) for the current paint
+    invariants. Opaque to clients; the fields are ordered for operator
+    eyeballs in curl output, not for parsing."""
+    return f'"g{int(generation)}-e{int(epoch)}-d{1 if degraded else 0}"'
+
+
+def if_none_match_matches(header: str | None, etag: str) -> bool:
+    """Does an ``If-None-Match`` header validate against ``etag``?
+
+    RFC 7232 §3.2: If-None-Match uses WEAK comparison — ``W/"x"``
+    matches ``"x"`` — and ``*`` matches any current representation.
+    The header is a comma-separated list; entity-tags never contain
+    commas, so a plain split is exact."""
+    if not header:
+        return False
+    header = header.strip()
+    if header == "*":
+        return True
+    for candidate in header.split(","):
+        candidate = candidate.strip()
+        if candidate.startswith("W/"):
+            candidate = candidate[2:]
+        if candidate == etag:
+            return True
+    return False
+
+
+def count_not_modified(route: str) -> None:
+    """Record one pre-admission 304 (called by the gateway alongside its
+    requests_total feed — the r10-review exactly-once rule lives THERE;
+    this family is the push pipeline's own ratio view)."""
+    _NOT_MODIFIED.inc(route=route)
+
+
+def gzip_accepted(accept_encoding: str | None) -> bool:
+    """Did the client offer gzip with a non-zero q? Parses the
+    ``Accept-Encoding`` list just enough to honour ``gzip;q=0`` (an
+    explicit refusal) and ``*`` (any coding acceptable)."""
+    if not accept_encoding:
+        return False
+    wildcard_q: float | None = None
+    for part in accept_encoding.split(","):
+        bits = part.strip().split(";")
+        coding = bits[0].strip().lower()
+        q = 1.0
+        for param in bits[1:]:
+            param = param.strip()
+            if param.startswith("q="):
+                try:
+                    q = float(param[2:])
+                except ValueError:
+                    q = 0.0
+        if coding == "gzip":
+            return q > 0.0
+        if coding == "*":
+            wildcard_q = q
+    return wildcard_q is not None and wildcard_q > 0.0
+
+
+def encode_body(data: bytes, accept_encoding: str | None) -> tuple[bytes, str | None]:
+    """(payload, content-encoding|None) for a full-paint body. Encodes
+    only when the client accepts gzip, the body clears MIN_GZIP_SIZE,
+    and compression actually shrank it (incompressible bodies ship
+    identity rather than paying the header tax). Byte counters record
+    every encoded paint so /metricsz shows the realized savings, not
+    the configured policy."""
+    if len(data) < MIN_GZIP_SIZE or not gzip_accepted(accept_encoding):
+        return data, None
+    compressed = _gzip.compress(data, GZIP_LEVEL, mtime=0)
+    if len(compressed) >= len(data):
+        return data, None
+    _GZIP_BYTES.inc(len(data), kind="raw")
+    _GZIP_BYTES.inc(len(compressed), kind="compressed")
+    return compressed, "gzip"
+
+
+__all__ = [
+    "GZIP_LEVEL",
+    "MIN_GZIP_SIZE",
+    "count_not_modified",
+    "encode_body",
+    "etag_for",
+    "gzip_accepted",
+    "if_none_match_matches",
+]

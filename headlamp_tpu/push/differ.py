@@ -1,0 +1,171 @@
+"""Generation-keyed snapshot differ (ADR-021 part 1).
+
+Pages re-render whole vdom trees (there is deliberately no vdom diff —
+the tree is rebuilt per request), so the differ works one level up: it
+reduces each diffable page to a compact PAGE MODEL — scalar cells plus
+keyed rows of scalars — and diffs models across sync generations.
+Changed cells/rows/removals become one JSON patch frame per page;
+unchanged pages produce no frame. A frame is what the page DISPLAYS,
+not how it is painted, so it survives renderer refactors.
+
+Models are pure functions of (snapshot, metrics-peek, forecast-peek):
+building one never fetches, never locks, never touches a device — it
+runs on the sync thread right after ``_record_sync``, and the sync
+heartbeat must not grow a Prometheus probe chain.
+
+Floats are rounded before comparison: a forecast refit that moves a
+prediction by 1e-9 is not a fleet change, and noise frames would turn
+the push pipeline back into polling with extra steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+#: The diffable page set: the live-wall surfaces whose content is a
+#: function of the snapshot generation (+ the metrics/forecast peeks).
+#: Debug/ops surfaces change per-request (live rings) and are excluded
+#: by design — a ring that describes traffic would broadcast forever.
+PAGES = ("/tpu", "/tpu/nodes", "/tpu/pods", "/tpu/metrics")
+
+
+def _node_ready(node: Mapping[str, Any]) -> bool:
+    for cond in ((node.get("status") or {}).get("conditions")) or []:
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False
+
+
+def _name(obj: Mapping[str, Any]) -> str:
+    return str(((obj.get("metadata") or {}).get("name")) or "")
+
+
+def _round(value: Any, digits: int = 4) -> Any:
+    if isinstance(value, float):
+        return round(value, digits)
+    return value
+
+
+def build_page_models(
+    snap: Any, *, metrics: Any = None, forecast: Any = None
+) -> dict[str, dict[str, Any]]:
+    """Page models for every diffable page. Each model is
+    ``{"cells": {name: scalar}, "rows": {key: [scalar, ...]}}`` —
+    JSON-able by construction (frames are ``json.dumps``ed verbatim)."""
+    overview_cells: dict[str, Any] = {
+        "errors": len(getattr(snap, "errors", []) or []),
+        "loading": bool(getattr(snap, "loading", False)),
+    }
+    node_rows: dict[str, list[Any]] = {}
+    pod_rows: dict[str, list[Any]] = {}
+    for pname, state in (getattr(snap, "providers", {}) or {}).items():
+        view = state.view
+        summary = view.allocation_summary()
+        for key, value in summary.items():
+            overview_cells[f"{pname}.{key}"] = value
+        overview_cells[f"{pname}.nodes"] = len(view.nodes)
+        overview_cells[f"{pname}.pods"] = len(view.pods)
+        overview_cells[f"{pname}.plugin_installed"] = bool(view.plugin_installed)
+        provider = view.provider
+        for node in view.nodes:
+            node_rows[_name(node)] = [
+                pname,
+                _node_ready(node),
+                int(provider.node_device_capacity(node)),
+                int(provider.node_device_allocatable(node)),
+            ]
+        for pod in view.pods:
+            meta = pod.get("metadata") or {}
+            key = f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+            pod_rows[key] = [
+                pname,
+                str(((pod.get("status") or {}).get("phase")) or ""),
+                str(((pod.get("spec") or {}).get("nodeName")) or ""),
+                int(provider.pod_device_request(pod)),
+            ]
+
+    metrics_cells: dict[str, Any] = {"available": metrics is not None}
+    metrics_rows: dict[str, list[Any]] = {}
+    if metrics is not None:
+        metrics_cells["chips"] = len(metrics.chips)
+        for chip in metrics.chips:
+            metrics_rows[f"{chip.node}/{chip.accelerator_id}"] = [
+                _round(chip.tensorcore_utilization),
+                _round(chip.duty_cycle),
+                _round(chip.hbm_bytes_used, 0),
+                _round(chip.hbm_bytes_total, 0),
+            ]
+    metrics_cells["forecast"] = forecast is not None
+    if forecast is not None:
+        metrics_cells["forecast_horizon_s"] = int(forecast.horizon_s)
+        metrics_cells["forecast_at_risk"] = sum(
+            1 for c in forecast.chips if c.saturation_risk
+        )
+        for chip in forecast.chips:
+            metrics_rows[f"forecast:{chip.node}/{chip.accelerator_id}"] = [
+                _round(chip.current),
+                _round(chip.predicted_peak),
+                _round(chip.predicted_mean),
+                bool(chip.saturation_risk),
+            ]
+
+    return {
+        "/tpu": {"cells": overview_cells, "rows": {}},
+        "/tpu/nodes": {"cells": {"total": len(node_rows)}, "rows": node_rows},
+        "/tpu/pods": {"cells": {"total": len(pod_rows)}, "rows": pod_rows},
+        "/tpu/metrics": {"cells": metrics_cells, "rows": metrics_rows},
+    }
+
+
+def diff_models(
+    prev: Mapping[str, Mapping[str, Any]],
+    new: Mapping[str, Mapping[str, Any]],
+) -> dict[str, dict[str, Any]]:
+    """Per-page patch frames: cells whose value changed, rows added or
+    changed (full replacement rows — a row is a handful of scalars, and
+    row-internal diffing would buy bytes at the cost of a stateful
+    client), and removed row keys. Pages with no change produce NO
+    entry — the no-frame-when-unchanged contract the bench pins."""
+    frames: dict[str, dict[str, Any]] = {}
+    for page, model in new.items():
+        before = prev.get(page) or {"cells": {}, "rows": {}}
+        prev_cells = before.get("cells", {})
+        prev_rows = before.get("rows", {})
+        cells = {
+            key: value
+            for key, value in model.get("cells", {}).items()
+            if prev_cells.get(key, _MISSING) != value
+        }
+        rows = {
+            key: value
+            for key, value in model.get("rows", {}).items()
+            if prev_rows.get(key, _MISSING) != value
+        }
+        removed = sorted(key for key in prev_rows if key not in model.get("rows", {}))
+        if cells or rows or removed:
+            frames[page] = {
+                "page": page,
+                "cells": cells,
+                "rows": rows,
+                "removed": removed,
+            }
+    return frames
+
+
+class _Missing:
+    """Sentinel distinct from every model value (None is a legitimate
+    cell value — an absent metric sample)."""
+
+    __slots__ = ()
+
+    def __eq__(self, other: object) -> bool:  # pragma: no cover - identity only
+        return other is self
+
+    def __ne__(self, other: object) -> bool:
+        return other is not self
+
+
+_MISSING = _Missing()
+
+
+__all__ = ["PAGES", "build_page_models", "diff_models"]

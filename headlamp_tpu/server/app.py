@@ -47,6 +47,8 @@ from ..obs.jaxcost import ledger as jax_ledger
 from ..obs.metrics import registry as metrics_registry
 from ..obs.profiler import attribution, profiler
 from ..obs.trace import annotate, span, trace_request, trace_ring
+from ..push import PAGES as PUSH_PAGES
+from ..push import PushPipeline, encode_body, format_event, set_active_push
 from ..runtime.refresh import Refresher
 from ..runtime.transfer import TransferBatch
 from ..pages.native import native_node_page, native_pod_page
@@ -118,6 +120,7 @@ def _runtime_health(
     refreshers: tuple[Refresher, ...] = (),
     gateway: Any = None,
     history: Any = None,
+    push: Any = None,
 ) -> dict[str, Any]:
     """Transfer-funnel, device-cache, transport-pool, and refresher
     counters for /healthz: how many blocking device_gets the process
@@ -157,6 +160,11 @@ def _runtime_health(
             # History-tier view (ADR-018): points/evictions/memory and
             # how far back /tpu/trends can currently answer.
             out["history"] = history.snapshot()
+        if push is not None:
+            # Push-pipeline view (ADR-021): connected SSE clients,
+            # frames sent, evictions, resume fallbacks — the live-wall
+            # triage block.
+            out["push"] = push.snapshot()
         # Burn-rate states per declared SLO (ADR-016): the one-line
         # answer a probe reader wants before opening /sloz.
         out["slo"] = slo_mod.engine().health_block()
@@ -194,6 +202,7 @@ def _runtime_counters(
     refreshers: tuple[Refresher, ...] = (),
     gateway: Any = None,
     history: Any = None,
+    push: Any = None,
 ) -> dict[str, float]:
     """Flat dotted monotone-counter snapshot for the flight recorder's
     before/after delta. Deliberately NOT _runtime_health: this runs
@@ -229,6 +238,9 @@ def _runtime_counters(
     if history is not None:
         for key, value in history.counters().items():
             out[f"history.{key}"] = value
+    if push is not None:
+        for key, value in push.counters().items():
+            out[f"push.{key}"] = value
     # ADR-019: process-wide singletons (ledger + profiler), same
     # bleed-between-neighbours caveat as every other counter here.
     for key, value in jax_ledger().counters().items():
@@ -413,6 +425,13 @@ class DashboardApp:
         #: injected by tests/bench). None for direct handle() callers —
         #: the CLI and unit tests measure the handler, not admission.
         self.gateway: Any = None
+        #: Push pipeline (ADR-021): snapshot differ + SSE broadcast
+        #: hub. Constructed eagerly — it spawns no threads (the /events
+        #: handler threads belong to the socket server, and the differ
+        #: runs on whichever thread syncs). The module-level weakref
+        #: only feeds the connected-clients gauge; latest app wins.
+        self.push = PushPipeline(monotonic=monotonic)
+        set_active_push(self.push)
 
     @property
     def registry(self) -> Registry:
@@ -577,6 +596,19 @@ class DashboardApp:
                 nodes=len(snap.all_nodes or []),
                 errors=len(snap.errors),
             )
+            # Differ hook (ADR-021): a generation bump diffs the new
+            # snapshot's page models against the previous generation's
+            # and broadcasts patch frames to the connected SSE clients.
+            # The metrics/forecast arguments are non-blocking PEEKS —
+            # the sync heartbeat must not grow a Prometheus probe chain
+            # or a jax fit. on_snapshot absorbs its own exceptions and
+            # no-ops on a clean tick (generation unchanged).
+            self.push.on_snapshot(
+                snap,
+                generation=generation,
+                metrics=self._peek_metrics,
+                forecast=self._peek_forecast,
+            )
         if snap is not None and not snap.errors:
             self._sync_failures = 0
         else:
@@ -730,6 +762,20 @@ class DashboardApp:
             max_age_s=self.METRICS_PEEK_MAX_AGE_S,
         )
 
+    def _peek_forecast(self) -> Any:
+        """Cached forecast for the metrics peek's fleet, or None —
+        never fetches, never fits (Refresher.peek only touches the
+        entry map). For the push differ: the /tpu/metrics page model
+        should diff whatever forecast a recent metrics view already
+        paid for, and a cold cache simply diffs the page without its
+        forecast rows."""
+        metrics = self._peek_metrics()
+        if metrics is None or not metrics.chips:
+            return None
+        return self._forecast_refresher.peek(
+            self._metrics_key(metrics), epoch=self._cache_epoch
+        )
+
     #: Warm-start carries kept per forecast key, LRU-capped inside the
     #: process-wide ``warm_carries`` tier (ADR-020). Small on purpose:
     #: each carry holds ~115k float32 params + adam moments (<2 MB); a
@@ -863,6 +909,7 @@ class DashboardApp:
             "/debug/flightz",
             "/debug/profilez",
             "/debug/profilez/folded",
+            "/events",
         ):
             return route_path
         if _NODE_DETAIL_RE.match(route_path):
@@ -921,6 +968,7 @@ class DashboardApp:
                 (self._metrics_refresher, self._forecast_refresher),
                 gateway=self.gateway,
                 history=self.history,
+                push=self.push,
             )
         # attribution() publishes this thread's route + trace id for the
         # sampling profiler (ADR-019). Entered AFTER trace_request so
@@ -979,6 +1027,7 @@ class DashboardApp:
                         (self._metrics_refresher, self._forecast_refresher),
                         gateway=self.gateway,
                         history=self.history,
+                        push=self.push,
                     )
                     violations = slo_mod.engine().violations(
                         route_label, duration_s, status
@@ -1031,6 +1080,7 @@ class DashboardApp:
                             (self._metrics_refresher, self._forecast_refresher),
                             gateway=self.gateway,
                             history=self.history,
+                            push=self.push,
                         ),
                     }
                 )
@@ -1068,6 +1118,7 @@ class DashboardApp:
                         (self._metrics_refresher, self._forecast_refresher),
                         gateway=self.gateway,
                         history=self.history,
+                        push=self.push,
                     ),
                 }
             )
@@ -1323,9 +1374,40 @@ class DashboardApp:
                 **overrides,
             )
             set_active(self.gateway)
+            # ADR-021: the gateway adopts the push pipeline — its
+            # snapshot gains the SSE connection registry, and the hub
+            # sheds DEBUG-class streams off the same paging policy.
+            self.gateway.attach_push(self.push)
         return self.gateway
 
+    def open_event_stream(self, path: str, *, last_event_id: str | None = None) -> Any:
+        """Admit one ``/events`` SSE subscription (ADR-021) — the
+        accounting half of the endpoint, separated from the socket loop
+        so tests drive the whole protocol without sockets. Parses
+        ``?pages=`` (comma-separated, unknown pages dropped, empty →
+        all diffable pages) and ``?class=debug`` (opts the stream into
+        the first-shed class — an always-on debug wall volunteers to be
+        the first capacity recovered under paging burn).
+
+        SLO feed, exactly once: the stream counts into requests_total
+        at admission (status 200) and NEVER into the render-latency
+        histogram — a connection's lifetime is not a paint latency, and
+        frames ride the broadcast path, not renders."""
+        query = parse_qs(urlparse(path).query)
+        requested = [
+            p for p in query.get("pages", [""])[0].split(",") if p
+        ]
+        pages = [p for p in requested if p in PUSH_PAGES] or list(PUSH_PAGES)
+        priority = (
+            "debug" if query.get("class", [""])[0] == "debug" else "interactive"
+        )
+        self._req_total.inc(route="/events", status="200")
+        return self.push.hub.subscribe(
+            pages, last_event_id=last_event_id, priority=priority
+        )
+
     def serve(self, host: str = "127.0.0.1", port: int = 8631) -> ThreadingHTTPServer:
+        app = self
         gateway = self.ensure_gateway()
         # Always-on low-rate sampler (ADR-019). Here, not in __init__:
         # constructing an app must never spawn threads (tests build
@@ -1347,8 +1429,17 @@ class DashboardApp:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                if urlparse(self.path).path.rstrip("/") == "/events":
+                    # SSE stream (ADR-021): parked on a plain handler
+                    # thread in the hub's condition wait — NEVER on a
+                    # render-pool worker; a wall of idle dashboards
+                    # must not occupy render capacity.
+                    self._serve_events()
+                    return
                 response = gateway.handle(
-                    self.path, accept=self.headers.get("Accept")
+                    self.path,
+                    accept=self.headers.get("Accept"),
+                    if_none_match=self.headers.get("If-None-Match"),
                 )
                 status, content_type, body = response[:3]
                 if status == 302:
@@ -1356,14 +1447,62 @@ class DashboardApp:
                     self.send_header("Location", content_type)
                     self.end_headers()
                     return
+                if status == 304:
+                    # RFC 7232: no body, no Content-Type — just the
+                    # validators/freshness headers the gateway stamped.
+                    self.send_response(304)
+                    for name, value in response.headers:
+                        self.send_header(name, value)
+                    self.end_headers()
+                    return
                 data = body.encode()
+                encoding = None
+                if status == 200:
+                    data, encoding = encode_body(
+                        data, self.headers.get("Accept-Encoding")
+                    )
                 self.send_response(status)
                 self.send_header("Content-Type", f"{content_type}; charset=utf-8")
+                if status == 200:
+                    # The representation varies by negotiation even
+                    # when this response shipped identity.
+                    self.send_header("Vary", "Accept-Encoding")
+                if encoding is not None:
+                    self.send_header("Content-Encoding", encoding)
                 self.send_header("Content-Length", str(len(data)))
                 for name, value in response.headers:
                     self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _serve_events(self) -> None:
+                sub = app.open_event_stream(
+                    self.path, last_event_id=self.headers.get("Last-Event-ID")
+                )
+                hub = app.push.hub
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header(
+                        "X-Headlamp-Generation", str(app.snapshot_generation())
+                    )
+                    self.end_headers()
+                    while True:
+                        event = hub.next_event(sub)
+                        if event is None:
+                            return
+                        self.wfile.write(format_event(event).encode())
+                        self.wfile.flush()
+                        if event.get("kind") == "bye":
+                            return
+                except OSError:
+                    # Client went away mid-stream — the normal way an
+                    # SSE connection ends; eviction accounting already
+                    # happened if the hub initiated it.
+                    pass
+                finally:
+                    hub.unsubscribe(sub)
 
             def log_message(self, *args: Any) -> None:
                 pass

@@ -201,6 +201,16 @@ class TestFormat:
             # sampler thread only starts with serve(), never handle()).
             "headlamp_tpu_jax_compile_seconds",
             "headlamp_tpu_profiler_overhead_seconds",
+            # ADR-021 push pipeline: labeled counters render no samples
+            # until a frame/eviction/304/gzip actually happens (the
+            # socketless fixture never connects an SSE client or sends
+            # If-None-Match), and the clients gauge goes quiet when the
+            # weakref'd active pipeline belongs to a dropped app.
+            "headlamp_tpu_push_frames_total",
+            "headlamp_tpu_push_evictions_total",
+            "headlamp_tpu_push_not_modified_total",
+            "headlamp_tpu_push_gzip_bytes_total",
+            "headlamp_tpu_push_clients_count",
         }, f"unexpected sample-free families: {sorted(quiet)}"
 
     def test_name_grammar_and_unit_suffixes(self, exposition):

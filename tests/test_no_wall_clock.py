@@ -125,15 +125,45 @@ class TestMutations:
         history = tmp_path / "headlamp_tpu" / "history"
         history.mkdir(parents=True)
         (history / "bad_store.py").write_text("import time\nnow = time.time()\n")
+        # ADR-021: the push pipeline's heartbeat/eviction timing too.
+        push = tmp_path / "headlamp_tpu" / "push"
+        push.mkdir(parents=True)
+        (push / "bad_hub.py").write_text("import time\nnow = time.time()\n")
         outside = tmp_path / "headlamp_tpu" / "server"
         outside.mkdir(parents=True)
         (outside / "app.py").write_text("import time\nnow = time.time()\n")
         diags = check_tree(str(tmp_path))
-        assert len(diags) == 2
+        assert len(diags) == 3
         assert {os.path.basename(d.path) for d in diags} == {
             "bad.py",
             "bad_store.py",
+            "bad_hub.py",
         }
+
+    def test_hub_heartbeat_on_wall_clock_flagged(self):
+        # The ADR-021 mistake the push scope guards in hub.py: deciding
+        # heartbeat cadence (or slow-consumer age) on the wall clock —
+        # the wire-format tests could never drive it without sleeping.
+        diags = self._diags(
+            "import time\n"
+            "def poll(self, sub):\n"
+            "    now = time.time()\n"
+            "    return now - sub.last_write >= self.heartbeat_s\n"
+        )
+        assert len(diags) == 1
+        assert diags[0].line == 3
+
+    def test_hub_sanctioned_forms_allowed(self):
+        # The real hub shape: injected-monotonic seam default, cadence
+        # math on self._mono() only.
+        diags = self._diags(
+            "import time\n"
+            "def __init__(self, *, monotonic=None):\n"
+            "    self._mono = monotonic or time.monotonic\n"
+            "def poll(self, sub):\n"
+            "    return self._mono() - sub.last_write_mono\n"
+        )
+        assert diags == []
 
     def test_profiler_scheduling_on_wall_clock_flagged(self):
         # The ADR-019 mistake the obs scope guards in profiler.py:

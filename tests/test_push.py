@@ -1,0 +1,663 @@
+"""Push-pipeline tests (ADR-021): the snapshot differ, the SSE
+broadcast hub's wire protocol, and conditional/compressed full paints.
+
+Clock discipline: every heartbeat/eviction/resume scenario runs on an
+injected monotonic (the same mutable FakeMono as the gateway suite) —
+zero real sleeps anywhere; real threads appear only where a socket
+handler would park (`next_event` with an already-queued frame).
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from headlamp_tpu.gateway import RenderGateway
+from headlamp_tpu.obs.metrics import registry as metrics_registry
+from headlamp_tpu.obs.slo import SLOEngine
+from headlamp_tpu.push import (
+    PAGES,
+    BroadcastHub,
+    PushPipeline,
+    build_page_models,
+    diff_models,
+    encode_body,
+    etag_for,
+    format_event,
+    gzip_accepted,
+    if_none_match_matches,
+    parse_last_event_id,
+)
+from headlamp_tpu.server import DashboardApp, make_demo_transport
+
+
+class FakeMono:
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def _snap(*, errors=(), loading=False, providers=None):
+    """Minimal snapshot stand-in: the differ reads only attributes."""
+    return SimpleNamespace(
+        errors=list(errors), loading=loading, providers=providers or {}
+    )
+
+
+def _metrics(chips):
+    return SimpleNamespace(chips=list(chips))
+
+
+def _chip(node="n0", acc="0", util=0.5, duty=0.4, used=1.0e9, total=2.0e9):
+    return SimpleNamespace(
+        node=node,
+        accelerator_id=acc,
+        tensorcore_utilization=util,
+        duty_cycle=duty,
+        hbm_bytes_used=used,
+        hbm_bytes_total=total,
+    )
+
+
+def _forecast(chips, horizon_s=300):
+    return SimpleNamespace(horizon_s=horizon_s, chips=list(chips))
+
+
+def _fchip(node="n0", acc="0", current=0.5, peak=0.9, mean=0.6, risk=False):
+    return SimpleNamespace(
+        node=node,
+        accelerator_id=acc,
+        current=current,
+        predicted_peak=peak,
+        predicted_mean=mean,
+        saturation_risk=risk,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Differ: page models and patch frames
+# ---------------------------------------------------------------------------
+
+
+class TestDiffer:
+    def test_models_cover_every_diffable_page(self):
+        models = build_page_models(_snap())
+        assert set(models) == set(PAGES)
+        for model in models.values():
+            assert set(model) == {"cells", "rows"}
+
+    def test_models_are_json_able(self):
+        models = build_page_models(
+            _snap(), metrics=_metrics([_chip()]), forecast=_forecast([_fchip()])
+        )
+        json.dumps(models)  # frames are dumps'ed verbatim
+
+    def test_identical_models_produce_no_frames(self):
+        a = build_page_models(_snap(), metrics=_metrics([_chip()]))
+        b = build_page_models(_snap(), metrics=_metrics([_chip()]))
+        assert diff_models(a, b) == {}
+
+    def test_changed_cell_produces_one_frame_for_that_page_only(self):
+        a = build_page_models(_snap())
+        b = build_page_models(_snap(loading=True))
+        frames = diff_models(a, b)
+        assert set(frames) == {"/tpu"}
+        frame = frames["/tpu"]
+        assert frame["cells"] == {"loading": True}
+        assert frame["rows"] == {}
+        assert frame["removed"] == []
+
+    def test_row_change_add_and_remove(self):
+        a = build_page_models(_snap(), metrics=_metrics([_chip("n0"), _chip("n1")]))
+        b = build_page_models(
+            _snap(), metrics=_metrics([_chip("n0", util=0.9), _chip("n2")])
+        )
+        frame = diff_models(a, b)["/tpu/metrics"]
+        assert set(frame["rows"]) == {"n0/0", "n2/0"}  # changed + added
+        assert frame["removed"] == ["n1/0"]
+
+    def test_float_noise_below_rounding_is_not_a_change(self):
+        a = build_page_models(_snap(), metrics=_metrics([_chip(util=0.5)]))
+        b = build_page_models(_snap(), metrics=_metrics([_chip(util=0.5 + 1e-9)]))
+        assert diff_models(a, b) == {}
+
+    def test_none_is_a_value_not_missing(self):
+        # The _MISSING sentinel: a cell that flips value→None must
+        # frame, and a cell that stays None must not.
+        a = {"/tpu": {"cells": {"x": 1, "y": None}, "rows": {}}}
+        b = {"/tpu": {"cells": {"x": None, "y": None}, "rows": {}}}
+        frames = diff_models(a, b)
+        assert frames["/tpu"]["cells"] == {"x": None}
+
+    def test_forecast_cells_and_rows(self):
+        models = build_page_models(
+            _snap(),
+            metrics=_metrics([_chip()]),
+            forecast=_forecast([_fchip(risk=True)], horizon_s=600),
+        )
+        cells = models["/tpu/metrics"]["cells"]
+        assert cells["forecast"] is True
+        assert cells["forecast_horizon_s"] == 600
+        assert cells["forecast_at_risk"] == 1
+        assert "forecast:n0/0" in models["/tpu/metrics"]["rows"]
+
+    def test_demo_transport_models_round_trip(self):
+        # Against the real snapshot shape: sync once, build, and diff
+        # self-vs-self (must be empty — model building is deterministic).
+        app = DashboardApp(make_demo_transport(), min_sync_interval_s=0.0)
+        app.handle("/tpu")
+        snap = app._last_snapshot
+        models = build_page_models(snap, metrics=app._peek_metrics())
+        assert models["/tpu/nodes"]["rows"], "demo fleet has nodes"
+        assert diff_models(models, models) == {}
+
+
+# ---------------------------------------------------------------------------
+# SSE wire format
+# ---------------------------------------------------------------------------
+
+
+class TestWireFormat:
+    def test_delta_frame_text(self):
+        text = format_event(
+            {"kind": "delta", "id": "g7", "data": {"page": "/tpu", "cells": {"a": 1}}}
+        )
+        assert text == (
+            "id: g7\n"
+            "event: delta\n"
+            'data: {"cells":{"a":1},"page":"/tpu"}\n'
+            "\n"
+        )
+
+    def test_data_is_single_line_compact_json(self):
+        text = format_event({"kind": "delta", "id": "g1", "data": {"rows": {"k": [1, 2]}}})
+        data_lines = [l for l in text.splitlines() if l.startswith("data:")]
+        assert len(data_lines) == 1
+        assert " " not in data_lines[0].split(" ", 1)[1]
+
+    def test_heartbeat_is_a_comment_frame(self):
+        assert format_event({"kind": "heartbeat", "id": None, "data": {}}) == ": hb\n\n"
+
+    def test_bye_frame_has_no_id(self):
+        text = format_event({"kind": "bye", "id": None, "data": {"reason": "shed"}})
+        assert text.startswith("event: bye\n")
+        assert "id:" not in text
+
+    def test_every_event_is_blank_line_terminated(self):
+        for event in (
+            {"kind": "heartbeat", "id": None, "data": {}},
+            {"kind": "delta", "id": "g1", "data": {}},
+            {"kind": "paint", "id": "g2", "data": {"reason": "resync"}},
+        ):
+            assert format_event(event).endswith("\n\n")
+
+    def test_parse_last_event_id(self):
+        assert parse_last_event_id("g12") == 12
+        assert parse_last_event_id(" g3 ") == 3
+        assert parse_last_event_id(None) is None
+        assert parse_last_event_id("") is None
+        assert parse_last_event_id("12") is None
+        assert parse_last_event_id("gx") is None
+
+
+# ---------------------------------------------------------------------------
+# Broadcast hub
+# ---------------------------------------------------------------------------
+
+
+def _frame(page, gen):
+    return {"page": page, "cells": {"g": gen}, "rows": {}, "removed": [], "generation": gen}
+
+
+class TestHub:
+    def test_publish_delivers_to_matching_pages_only(self):
+        hub = BroadcastHub(monotonic=FakeMono())
+        nodes = hub.subscribe(["/tpu/nodes"])
+        both = hub.subscribe(["/tpu/nodes", "/tpu/pods"])
+        delivered = hub.publish(
+            1, {"/tpu/nodes": _frame("/tpu/nodes", 1), "/tpu/pods": _frame("/tpu/pods", 1)}
+        )
+        assert delivered == 3  # nodes:1 + both:2
+        assert len(nodes.outbox) == 1
+        assert len(both.outbox) == 2
+        assert hub.counters()["frames_sent"] == 3
+        assert hub.counters()["broadcasts"] == 1
+
+    def test_empty_publish_is_not_a_broadcast(self):
+        hub = BroadcastHub(monotonic=FakeMono())
+        hub.subscribe(["/tpu"])
+        assert hub.publish(1, {}) == 0
+        assert hub.counters()["broadcasts"] == 0
+        assert hub.snapshot()["last_generation"] == 1  # generation still advances
+
+    def test_poll_drains_in_order_then_goes_quiet(self):
+        clock = FakeMono()
+        hub = BroadcastHub(monotonic=clock)
+        sub = hub.subscribe(["/tpu"])
+        hub.publish(1, {"/tpu": _frame("/tpu", 1)})
+        hub.publish(2, {"/tpu": _frame("/tpu", 2)})
+        assert hub.poll(sub)["id"] == "g1"
+        assert hub.poll(sub)["id"] == "g2"
+        assert hub.poll(sub) is None
+
+    def test_heartbeat_cadence_on_injected_clock(self):
+        clock = FakeMono()
+        hub = BroadcastHub(monotonic=clock, heartbeat_s=15.0)
+        sub = hub.subscribe(["/tpu"])
+        clock.advance(14.9)
+        assert hub.poll(sub) is None  # not due yet
+        clock.advance(0.2)
+        assert hub.poll(sub)["kind"] == "heartbeat"
+        assert hub.poll(sub) is None  # cadence resets on write
+        clock.advance(15.1)
+        assert hub.poll(sub)["kind"] == "heartbeat"
+        assert hub.counters()["heartbeats"] == 2
+
+    def test_frame_write_resets_heartbeat_timer(self):
+        clock = FakeMono()
+        hub = BroadcastHub(monotonic=clock, heartbeat_s=15.0)
+        sub = hub.subscribe(["/tpu"])
+        clock.advance(14.0)
+        hub.publish(1, {"/tpu": _frame("/tpu", 1)})
+        assert hub.poll(sub)["kind"] == "delta"
+        clock.advance(14.0)  # 28 s since subscribe, 14 since last write
+        assert hub.poll(sub) is None
+
+    def test_resume_replays_backlog_after_last_event_id(self):
+        hub = BroadcastHub(monotonic=FakeMono())
+        for gen in (1, 2, 3):
+            hub.publish(gen, {"/tpu/nodes": _frame("/tpu/nodes", gen)})
+        sub = hub.subscribe(["/tpu/nodes"], last_event_id="g1")
+        ids = [e["id"] for e in list(sub.outbox)]
+        assert ids == ["g2", "g3"]
+        assert all(e["kind"] == "delta" for e in sub.outbox)
+        assert hub.counters()["resume_fallbacks"] == 0
+
+    def test_resume_caught_up_replays_nothing(self):
+        hub = BroadcastHub(monotonic=FakeMono())
+        hub.publish(5, {"/tpu": _frame("/tpu", 5)})
+        sub = hub.subscribe(["/tpu"], last_event_id="g5")
+        assert list(sub.outbox) == []
+
+    def test_resume_too_far_behind_gets_paint_fallback(self):
+        hub = BroadcastHub(monotonic=FakeMono(), backlog_limit=2)
+        for gen in range(1, 7):  # backlog retains g5, g6 only
+            hub.publish(gen, {"/tpu/nodes": _frame("/tpu/nodes", gen)})
+        sub = hub.subscribe(["/tpu", "/tpu/nodes"], last_event_id="g1")
+        events = list(sub.outbox)
+        assert [e["kind"] for e in events] == ["paint", "paint"]
+        assert [e["data"]["page"] for e in events] == ["/tpu", "/tpu/nodes"]
+        assert all(e["data"]["reason"] == "resync" for e in events)
+        assert all(e["data"]["generation"] == 6 for e in events)
+        assert hub.counters()["resume_fallbacks"] == 1
+
+    def test_resume_into_fresh_process_gets_paint_fallback(self):
+        # Restart semantics: the new process retains no backlog, so ANY
+        # Last-Event-ID honestly answers "repaint", never fake deltas.
+        hub = BroadcastHub(monotonic=FakeMono())
+        sub = hub.subscribe(["/tpu"], last_event_id="g40")
+        assert [e["kind"] for e in sub.outbox] == ["paint"]
+        assert hub.counters()["resume_fallbacks"] == 1
+
+    def test_slow_consumer_evicted_with_bye(self):
+        hub = BroadcastHub(monotonic=FakeMono(), outbox_limit=3)
+        sub = hub.subscribe(["/tpu"])
+        reader = hub.subscribe(["/tpu"])
+        for gen in range(1, 5):  # 4th frame overflows sub's outbox
+            hub.publish(gen, {"/tpu": _frame("/tpu", gen)})
+            hub.poll(reader)
+        assert sub.evicted_reason == "slow_consumer"
+        events = list(sub.outbox)
+        assert len(events) == 1 and events[0]["kind"] == "bye"
+        assert events[0]["data"]["reason"] == "slow_consumer"
+        assert hub.counters()["evictions"] == 1
+        # The healthy reader rode through untouched.
+        assert reader.evicted_reason is None
+        # Further publishes skip the evicted subscription.
+        before = hub.counters()["frames_sent"]
+        hub.publish(9, {"/tpu": _frame("/tpu", 9)})
+        assert hub.poll(sub)["kind"] == "bye"
+        assert hub.poll(sub) is None
+        assert hub.counters()["frames_sent"] == before + 1  # reader only
+
+    def test_shed_closes_debug_streams_first(self):
+        paging = {"on": False}
+        hub = BroadcastHub(monotonic=FakeMono(), shed_check=lambda: paging["on"])
+        debug = hub.subscribe(["/tpu"], priority="debug")
+        interactive = hub.subscribe(["/tpu"])
+        assert hub.poll(debug) is None  # not paging: stream lives
+        paging["on"] = True
+        assert hub.poll(debug)["kind"] == "bye"
+        assert debug.evicted_reason == "shed"
+        assert interactive.evicted_reason is None  # interactive rides out the burn
+        assert hub.counters()["evictions"] == 1
+
+    def test_shed_check_errors_never_kill_streams(self):
+        def broken():
+            raise RuntimeError("engine exploded")
+
+        hub = BroadcastHub(monotonic=FakeMono(), shed_check=broken)
+        sub = hub.subscribe(["/tpu"], priority="debug")
+        assert hub.poll(sub) is None
+        assert sub.evicted_reason is None
+
+    def test_close_says_bye_to_everyone(self):
+        hub = BroadcastHub(monotonic=FakeMono())
+        subs = [hub.subscribe(["/tpu"]) for _ in range(3)]
+        hub.close()
+        for sub in subs:
+            assert hub.poll(sub)["kind"] == "bye"
+        assert hub.counters()["evictions"] == 3
+
+    def test_next_event_returns_queued_frame_immediately(self):
+        hub = BroadcastHub(monotonic=FakeMono())
+        sub = hub.subscribe(["/tpu"])
+        hub.publish(1, {"/tpu": _frame("/tpu", 1)})
+        assert hub.next_event(sub)["id"] == "g1"
+
+    def test_next_event_returns_none_after_unsubscribe(self):
+        hub = BroadcastHub(monotonic=FakeMono())
+        sub = hub.subscribe(["/tpu"])
+        hub.unsubscribe(sub)
+        assert hub.next_event(sub) is None
+        assert hub.connected() == 0
+
+
+# ---------------------------------------------------------------------------
+# Conditional + compressed paints
+# ---------------------------------------------------------------------------
+
+
+class TestConditional:
+    def test_etag_is_quoted_and_keyed_on_all_three_invariants(self):
+        assert etag_for(3, 2, False) == '"g3-e2-d0"'
+        assert etag_for(3, 2, True) == '"g3-e2-d1"'
+        assert len({etag_for(1, 0, False), etag_for(2, 0, False), etag_for(1, 1, False)}) == 3
+
+    def test_if_none_match_comparison(self):
+        etag = '"g1-e0-d0"'
+        assert if_none_match_matches(etag, etag)
+        assert if_none_match_matches(f"W/{etag}", etag)  # RFC 7232 weak compare
+        assert if_none_match_matches(f'"other", {etag}', etag)
+        assert if_none_match_matches("*", etag)
+        assert not if_none_match_matches('"g2-e0-d0"', etag)
+        assert not if_none_match_matches(None, etag)
+        assert not if_none_match_matches("", etag)
+
+    def test_gzip_negotiation(self):
+        assert gzip_accepted("gzip")
+        assert gzip_accepted("gzip, deflate, br")
+        assert gzip_accepted("gzip;q=0.5")
+        assert gzip_accepted("*")
+        assert gzip_accepted("br;q=1.0, *;q=0.1")
+        assert not gzip_accepted(None)
+        assert not gzip_accepted("")
+        assert not gzip_accepted("identity")
+        assert not gzip_accepted("gzip;q=0")  # explicit refusal
+        assert not gzip_accepted("br, *;q=0")
+
+    def test_encode_body_round_trips_and_is_deterministic(self):
+        body = (b"<tr><td>gke-tpu-node</td><td>4</td></tr>" * 100)
+        one, enc1 = encode_body(body, "gzip")
+        two, enc2 = encode_body(body, "gzip")
+        assert enc1 == enc2 == "gzip"
+        assert one == two  # mtime=0: byte-identical encodes
+        assert len(one) < len(body)
+        assert gzip.decompress(one) == body
+
+    def test_small_bodies_ship_identity(self):
+        payload, encoding = encode_body(b"tiny", "gzip")
+        assert (payload, encoding) == (b"tiny", None)
+
+    def test_no_gzip_without_negotiation(self):
+        body = b"x" * 4096
+        assert encode_body(body, None) == (body, None)
+        assert encode_body(body, "gzip;q=0") == (body, None)
+
+    def test_incompressible_bodies_ship_identity(self):
+        # Deterministic high-entropy bytes (a sha256 chain): gzip can
+        # only grow them, so identity must ship.
+        chunk = b"seed"
+        chunks = []
+        for _ in range(64):
+            chunk = hashlib.sha256(chunk).digest()
+            chunks.append(chunk)
+        noise = b"".join(chunks)
+        assert len(noise) >= 512  # clears MIN_GZIP_SIZE on its own
+        payload, encoding = encode_body(noise, "gzip")
+        assert encoding is None
+        assert payload == noise
+
+
+# ---------------------------------------------------------------------------
+# Gateway: pre-admission 304 and page-header stamping
+# ---------------------------------------------------------------------------
+
+
+def _route_label(path: str) -> str:
+    return path.split("?", 1)[0].rstrip("/") or "/tpu"
+
+
+def ok_handle(path, *, accept=None, gateway_info=None):
+    return 200, "text/html", f"page:{path}"
+
+
+class TestGatewayConditional:
+    def _gateway(self, gen):
+        return RenderGateway(
+            ok_handle,
+            route_label=_route_label,
+            workers=1,
+            request_timeout_s=10.0,
+            engine=lambda: SLOEngine(),
+            generation=lambda: gen["v"],
+            epoch=lambda: 0,
+        )
+
+    def test_pages_stamped_with_etag_generation_and_cache_control(self):
+        gen = {"v": 7}
+        gw = self._gateway(gen)
+        try:
+            response = gw.handle("/tpu/nodes")
+            headers = dict(response.headers)
+            assert headers["ETag"] == '"g7-e0-d0"'
+            assert headers["Cache-Control"] == "no-cache"
+            assert headers["X-Headlamp-Generation"] == "7"
+            assert headers["X-Headlamp-Stale"] == "0"
+        finally:
+            gw.close()
+
+    def test_if_none_match_answers_304_before_pool_admission(self):
+        gen = {"v": 1}
+        gw = self._gateway(gen)
+        req_total = metrics_registry.counter(
+            "headlamp_tpu_requests_total", "", labels=("route", "status")
+        )
+        req_hist = metrics_registry.histogram(
+            "headlamp_tpu_request_duration_seconds", "", labels=("route",)
+        )
+        try:
+            first = gw.handle("/tpu/nodes")
+            etag = dict(first.headers)["ETag"]
+            executed = gw.pool.counters()["executed"]
+            before_304 = req_total.value_for(route="/tpu/nodes", status="304")
+            before_hist = req_hist.count_for(route="/tpu/nodes")
+            response = gw.handle("/tpu/nodes", if_none_match=etag)
+            assert response.status == 304
+            assert response.body == ""
+            # Never entered the render pool: the whole point.
+            assert gw.pool.counters()["executed"] == executed
+            assert gw.counters()["not_modified"] == 1
+            # SLO feed exactly once (r10-review rule): requests_total
+            # moves, the render-latency histogram does not.
+            assert req_total.value_for(route="/tpu/nodes", status="304") == before_304 + 1
+            assert req_hist.count_for(route="/tpu/nodes") == before_hist
+            # The 304 re-stamps validators so the client can keep polling.
+            headers = dict(response.headers)
+            assert headers["ETag"] == etag
+            assert headers["X-Headlamp-Generation"] == "1"
+        finally:
+            gw.close()
+
+    def test_stale_etag_renders_fresh_page(self):
+        gen = {"v": 1}
+        gw = self._gateway(gen)
+        try:
+            etag = dict(gw.handle("/tpu").headers)["ETag"]
+            gen["v"] = 2  # a sync happened: the held bytes are stale
+            response = gw.handle("/tpu", if_none_match=etag)
+            assert response.status == 200
+            assert dict(response.headers)["ETag"] == '"g2-e0-d0"'
+        finally:
+            gw.close()
+
+    def test_refresh_and_debug_routes_never_shortcut_to_304(self):
+        gen = {"v": 1}
+        gw = self._gateway(gen)
+        try:
+            # /refresh EXISTS to force work; /debug/* headers carry no
+            # ETag (non-interactive) so a match would be meaningless.
+            assert gw.handle("/refresh", if_none_match="*").status == 200
+            assert gw.handle("/debug/traces", if_none_match="*").status == 200
+        finally:
+            gw.close()
+
+    def test_push_not_modified_family_counts_by_route(self):
+        gen = {"v": 1}
+        gw = self._gateway(gen)
+        family = metrics_registry.counter(
+            "headlamp_tpu_push_not_modified_total", "", labels=("route",)
+        )
+        try:
+            etag = dict(gw.handle("/tpu/pods").headers)["ETag"]
+            before = family.value_for(route="/tpu/pods")
+            assert gw.handle("/tpu/pods", if_none_match=etag).status == 304
+            assert family.value_for(route="/tpu/pods") == before + 1
+        finally:
+            gw.close()
+
+
+# ---------------------------------------------------------------------------
+# Pipeline + app wiring
+# ---------------------------------------------------------------------------
+
+
+class TestPushPipeline:
+    def test_first_snapshot_is_baseline_no_frames(self):
+        pipe = PushPipeline(monotonic=FakeMono())
+        sub = pipe.hub.subscribe(["/tpu"])
+        assert pipe.on_snapshot(_snap(), generation=1) == 0
+        assert pipe.baselines == 1
+        assert pipe.diffs == 0
+        assert list(sub.outbox) == []
+
+    def test_change_broadcasts_stamped_frames(self):
+        pipe = PushPipeline(monotonic=FakeMono())
+        sub = pipe.hub.subscribe(["/tpu"])
+        pipe.on_snapshot(_snap(), generation=1)
+        delivered = pipe.on_snapshot(_snap(loading=True), generation=2)
+        assert delivered == 1
+        event = pipe.hub.poll(sub)
+        assert event["kind"] == "delta"
+        assert event["data"]["generation"] == 2
+        assert event["data"]["page"] == "/tpu"
+        assert pipe.frames_built == 1
+
+    def test_unchanged_sync_produces_no_frames(self):
+        pipe = PushPipeline(monotonic=FakeMono())
+        pipe.on_snapshot(_snap(), generation=1)
+        assert pipe.on_snapshot(_snap(), generation=2) == 0
+        assert pipe.diffs == 1  # diffed, found nothing
+        assert pipe.frames_built == 0
+
+    def test_stale_and_missing_snapshots_are_skipped(self):
+        pipe = PushPipeline(monotonic=FakeMono())
+        pipe.on_snapshot(_snap(), generation=3)
+        assert pipe.on_snapshot(_snap(loading=True), generation=3) == 0
+        assert pipe.on_snapshot(None, generation=9) == 0
+        assert pipe.skipped_stale == 2
+        assert pipe.generation == 3
+
+    def test_broken_model_build_never_raises(self):
+        pipe = PushPipeline(monotonic=FakeMono())
+        # providers without .view: build_page_models will blow up —
+        # absorbed, because push must never break the sync path.
+        assert pipe.on_snapshot(SimpleNamespace(providers={"x": object()}), generation=1) == 0
+
+    def test_peeks_evaluated_once(self):
+        calls = {"n": 0}
+
+        def peek():
+            calls["n"] += 1
+            return None
+
+        pipe = PushPipeline(monotonic=FakeMono())
+        pipe.on_snapshot(_snap(), generation=1, metrics=peek, forecast=peek)
+        assert calls["n"] == 2  # once each, not once per page
+
+
+class TestAppWiring:
+    @pytest.fixture()
+    def app(self):
+        return DashboardApp(make_demo_transport(), min_sync_interval_s=0.0)
+
+    def test_sync_feeds_differ_and_healthz_reports_push_block(self, app):
+        app.handle("/tpu")  # inline sync → baseline
+        app.handle("/tpu")  # second sync → diff (no fleet change: no frames)
+        assert app.push.baselines == 1
+        assert app.push.diffs >= 1
+        assert app.push.frames_built == 0  # nothing changed
+        status, _, body = app.handle("/healthz")
+        block = json.loads(body)["runtime"]["push"]
+        assert status == 200
+        assert block["generation"] >= 2
+        assert block["connected"] == 0
+        assert "resume_complete_from" in block
+
+    def test_open_event_stream_parses_pages_and_class(self, app):
+        sub = app.open_event_stream("/events?pages=/tpu/nodes,/bogus")
+        assert sub.pages == frozenset({"/tpu/nodes"})
+        assert sub.priority == "interactive"
+        everything = app.open_event_stream("/events")
+        assert everything.pages == frozenset(PAGES)
+        debug = app.open_event_stream("/events?class=debug")
+        assert debug.priority == "debug"
+        assert app.push.hub.connected() == 3
+
+    def test_open_event_stream_feeds_slo_exactly_once(self, app):
+        req_total = metrics_registry.counter(
+            "headlamp_tpu_requests_total", "", labels=("route", "status")
+        )
+        req_hist = metrics_registry.histogram(
+            "headlamp_tpu_request_duration_seconds", "", labels=("route",)
+        )
+        before_total = req_total.value_for(route="/events", status="200")
+        before_hist = req_hist.count_for(route="/events")
+        app.open_event_stream("/events")
+        assert req_total.value_for(route="/events", status="200") == before_total + 1
+        assert req_hist.count_for(route="/events") == before_hist
+
+    def test_metricsz_exposes_push_families(self, app):
+        app.handle("/tpu")
+        _, _, body = app.handle("/metricsz")
+        assert "headlamp_tpu_push_diff_seconds" in body
+        assert "headlamp_tpu_push_clients_count" in body
+        assert "headlamp_tpu_push_broadcasts_total" in body
+
+    def test_gateway_adopts_pipeline_and_shed_probe(self, app):
+        app.ensure_gateway()
+        try:
+            assert app.gateway.push is app.push
+            assert app.push.hub._shed_check is not None
+            assert app.gateway.snapshot()["sse_connections"] == 0
+        finally:
+            app.gateway.close()

@@ -154,6 +154,7 @@ SCOPE = (
     os.path.join("headlamp_tpu", "gateway"),
     os.path.join("headlamp_tpu", "history"),
     os.path.join("headlamp_tpu", "obs"),
+    os.path.join("headlamp_tpu", "push"),
     os.path.join("headlamp_tpu", "runtime"),
     os.path.join("headlamp_tpu", "transport"),
 )
