@@ -1896,6 +1896,69 @@ def bench_profiler() -> dict:
     return out
 
 
+def bench_analysis() -> dict:
+    """ADR-022 static-analysis engine acceptance numbers: wall time of
+    ONE unified engine run over the full rule registry versus the five
+    separate tree walks the legacy gates used to chain in
+    ``ts_static_check.py`` main(), plus the single-pass proof
+    (``files_parsed_once`` — the engine's own parse counter says no
+    scoped file was parsed twice). The run must come back clean; a
+    dirty tree is a gate failure, not a perf number."""
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    from analysis.engine import Engine, default_baseline_path, load_baseline
+    from analysis.rules import all_rules
+
+    baseline = load_baseline(default_baseline_path())
+
+    def unified_once() -> tuple[float, object]:
+        t0 = time.perf_counter()
+        result = Engine(all_rules(), baseline=baseline).run()
+        return (time.perf_counter() - t0) * 1000.0, result
+
+    # Warm the OS file cache so both measurements compare parsing and
+    # rule work, not first-touch disk reads.
+    unified_once()
+    unified_samples = []
+    result = None
+    for _ in range(5):
+        ms, result = unified_once()
+        unified_samples.append(ms)
+
+    import no_direct_render_check
+    import no_inline_fit_check
+    import no_raw_urlopen_check
+    import no_unregistered_jit_check
+    import no_wall_clock_check
+
+    legacy_gates = (
+        no_raw_urlopen_check,
+        no_inline_fit_check,
+        no_wall_clock_check,
+        no_direct_render_check,
+        no_unregistered_jit_check,
+    )
+    legacy_samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for gate in legacy_gates:
+            gate.check_tree()
+        legacy_samples.append((time.perf_counter() - t0) * 1000.0)
+
+    assert result is not None and result.ok, "analysis run must be clean"
+    assert result.files_parsed_once, "single-pass contract broken"
+    return {
+        "analysis_wall_ms": round(statistics.median(unified_samples), 2),
+        "analysis_legacy_5walk_ms": round(statistics.median(legacy_samples), 2),
+        "analysis_files_scanned": len(result.parse_counts),
+        "analysis_rules": len(all_rules()),
+        "analysis_suppressed": len(result.suppressed),
+        "analysis_baselined": len(result.baselined),
+        "files_parsed_once": True,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Cross-round regression attribution (ADR-019)
 # ---------------------------------------------------------------------------
@@ -2139,6 +2202,7 @@ def main() -> None:
     push = bench_push(fleet)
     history = bench_history()
     profiler_numbers = bench_profiler()
+    analysis = bench_analysis()
     record = {
         "metric": (
             "metrics scrape→paint p50 (Prometheus fetch + forecast "
@@ -2185,6 +2249,7 @@ def main() -> None:
             **push,
             **history,
             **profiler_numbers,
+            **analysis,
         },
     }
     record["extra"]["prev_round_regressions"] = compare_prev_round(record)

@@ -300,7 +300,7 @@ class Refresher:
     ) -> None:
         try:
             value = self._run_compute(compute)
-        except BaseException:
+        except Exception:
             # Absorbed by design: the stale value keeps serving until
             # grace runs out — same degradation as the pre-refresher
             # cache, but counted instead of silent.
@@ -309,6 +309,14 @@ class Refresher:
                 self._flights.pop((key, epoch), None)
             flight.done.set()
             return
+        except BaseException:
+            # KeyboardInterrupt/SystemExit: unwind the flight so
+            # waiters don't hang, but never spend refit_errors on an
+            # interrupt — and let it take the worker down.
+            with self._lock:
+                self._flights.pop((key, epoch), None)
+            flight.done.set()
+            raise
         self._store(key, value, epoch)
         with self._lock:
             self._flights.pop((key, epoch), None)

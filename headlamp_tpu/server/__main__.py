@@ -62,8 +62,8 @@ def main(argv: list[str] | None = None) -> None:
     print(f"TPU dashboard on http://{args.host}:{args.port}/tpu ({mode})")
     try:
         server.serve_forever()
-    except KeyboardInterrupt:
-        server.shutdown()
+    except KeyboardInterrupt:  # analysis: disable=EXC001
+        server.shutdown()  # top-of-process Ctrl-C: clean stop IS the handling
 
 
 if __name__ == "__main__":

@@ -1,0 +1,412 @@
+"""Single-pass static-analysis engine (tools/analysis/ — ADR-022).
+
+What this file pins:
+
+  1. The live tree is CLEAN through the full rule registry — every
+     deliberate exception is visible (suppressed or baselined), never
+     silent.
+  2. The single-pass contract: one ``ast.parse`` per file per run even
+     though many rules scope the same trees.
+  3. Suppression pragmas and the baseline both COUNT findings rather
+     than hiding them, and a stale baseline entry fails the run.
+  4. Mutation pairs per new rule (HTL001 lock-discipline, EXC001
+     exception-breadth, THR001 thread-spawn, SYN001 metricsz-allowlist
+     sync), mirroring the test_no_wall_clock.py pattern: the flagged
+     form and its minimally-fixed twin.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from analysis.engine import (  # noqa: E402
+    Diagnostic,
+    Engine,
+    default_baseline_path,
+    load_baseline,
+)
+from analysis.rules import all_rules  # noqa: E402
+from analysis.rules.exception_breadth import ExceptionBreadthRule  # noqa: E402
+from analysis.rules.lock_blocking import LockBlockingRule  # noqa: E402
+from analysis.rules.metrics_allowlist import MetricsAllowlistRule  # noqa: E402
+from analysis.rules.thread_spawn import ThreadSpawnRule  # noqa: E402
+from analysis.rules.wall_clock import WallClockRule  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_live():
+    engine = Engine(
+        all_rules(), root=REPO, baseline=load_baseline(default_baseline_path())
+    )
+    return engine.run()
+
+
+class TestLiveTree:
+    def test_repo_is_clean_through_full_registry(self):
+        result = _run_live()
+        assert result.diagnostics == [], "\n".join(
+            str(d) for d in result.diagnostics
+        )
+        assert result.stale_baseline == [], result.stale_baseline
+        assert result.ok
+
+    def test_every_file_parsed_exactly_once(self):
+        # Many rules scope headlamp_tpu/ — the engine must still parse
+        # each file once, not once per interested rule.
+        result = _run_live()
+        assert result.parse_counts, "engine walked nothing"
+        over = {p: n for p, n in result.parse_counts.items() if n != 1}
+        assert not over, f"multi-parsed: {over}"
+        assert result.files_parsed_once
+
+    def test_deliberate_exceptions_are_counted_never_silent(self):
+        # The tree's known exceptions surface in the accounting: the
+        # __main__ Ctrl-C pragma and the baselined holds/spawns. Exact
+        # counts float with the code; non-zero and fully attributed
+        # (every baselined finding matches a reasoned entry) must not.
+        result = _run_live()
+        assert len(result.suppressed) >= 1
+        assert len(result.baselined) >= 1
+        entries = load_baseline(default_baseline_path())
+        keys = {(e["rule"], e["path"], e["context"]) for e in entries}
+        for diag in result.baselined:
+            assert (diag.rule, diag.path, diag.context) in keys
+
+
+class TestEngineMachinery:
+    def test_suppression_pragma_counts_finding(self, tmp_path):
+        scoped = tmp_path / "headlamp_tpu" / "gateway"
+        scoped.mkdir(parents=True)
+        (scoped / "x.py").write_text(
+            "import time\n"
+            "now = time.time()  # analysis: disable=WCK001\n"
+        )
+        result = Engine([WallClockRule()], root=str(tmp_path)).run()
+        assert result.diagnostics == []
+        assert len(result.suppressed) == 1
+        assert result.suppressed[0].rule == "WCK001"
+        assert result.ok
+
+    def test_pragma_for_other_rule_does_not_suppress(self, tmp_path):
+        scoped = tmp_path / "headlamp_tpu" / "gateway"
+        scoped.mkdir(parents=True)
+        (scoped / "x.py").write_text(
+            "import time\n"
+            "now = time.time()  # analysis: disable=THR001\n"
+        )
+        result = Engine([WallClockRule()], root=str(tmp_path)).run()
+        assert len(result.diagnostics) == 1
+
+    def test_baseline_match_and_stale_entry(self, tmp_path):
+        pkg = tmp_path / "headlamp_tpu"
+        pkg.mkdir(parents=True)
+        (pkg / "x.py").write_text(
+            "import threading\n"
+            "def boot():\n"
+            "    threading.Thread(target=print).start()\n"
+        )
+        entry = {
+            "rule": "THR001",
+            "path": "headlamp_tpu/x.py",
+            "context": "boot",
+            "reason": "test grandfather",
+        }
+        result = Engine(
+            [ThreadSpawnRule()], root=str(tmp_path), baseline=[entry]
+        ).run()
+        assert result.diagnostics == [] and len(result.baselined) == 1
+        assert result.ok
+
+        stale = dict(entry, context="gone_function")
+        result = Engine(
+            [ThreadSpawnRule()], root=str(tmp_path), baseline=[entry, stale]
+        ).run()
+        assert result.stale_baseline == [stale]
+        assert not result.ok, "stale baseline entries must fail the run"
+
+    def test_baseline_entries_require_reasons(self, tmp_path):
+        bad = tmp_path / "baseline.json"
+        bad.write_text(
+            '{"entries": [{"rule": "THR001", "path": "p", "context": "c"}]}'
+        )
+        try:
+            load_baseline(str(bad))
+        except ValueError as e:
+            assert "reason" in str(e)
+        else:
+            raise AssertionError("reasonless baseline entry must be rejected")
+
+    def test_unparseable_file_reported_not_crash(self, tmp_path):
+        pkg = tmp_path / "headlamp_tpu"
+        pkg.mkdir(parents=True)
+        (pkg / "x.py").write_text("def broken(:\n")
+        result = Engine([ThreadSpawnRule()], root=str(tmp_path)).run()
+        assert len(result.diagnostics) == 1
+        assert result.diagnostics[0].rule == "PAR000"
+
+    def test_diagnostic_formats(self):
+        d = Diagnostic("HTL001", "a/b.py", 7, "msg", context="C.f")
+        assert str(d) == "a/b.py:7: [HTL001] msg"
+        assert '"rule": "HTL001"' in d.to_json()
+
+
+def _check(rule, relpath, src):
+    engine = Engine([rule], root=REPO)
+    return engine.check_source(rule, relpath, src)
+
+
+class TestLockBlockingMutations:
+    """HTL001 — the r09 stall class, as flagged/fixed mutation pairs."""
+
+    def _diags(self, src, relpath="headlamp_tpu/server/mut.py"):
+        return _check(LockBlockingRule(), relpath, src)
+
+    def test_sleep_under_with_lock_flagged(self):
+        diags = self._diags(
+            "import time\n"
+            "class C:\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(1)\n"
+        )
+        assert len(diags) == 1
+        assert diags[0].line == 5 and "time.sleep" in diags[0].message
+        assert diags[0].context == "C.f"
+
+    def test_sleep_after_with_lock_clean(self):
+        diags = self._diags(
+            "import time\n"
+            "class C:\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            self.n += 1\n"
+            "        time.sleep(1)\n"
+        )
+        assert diags == []
+
+    def test_fit_call_under_lock_flagged(self):
+        diags = self._diags(
+            "class C:\n"
+            "    def f(self, d):\n"
+            "        with self._lock:\n"
+            "            return fit_and_forecast(d)\n"
+        )
+        assert len(diags) == 1 and "fit_and_forecast" in diags[0].message
+
+    def test_acquire_release_span_tracked(self):
+        flagged = self._diags(
+            "import time\n"
+            "def f(lock):\n"
+            "    lock.acquire()\n"
+            "    time.sleep(1)\n"
+            "    lock.release()\n"
+        )
+        assert len(flagged) == 1 and flagged[0].line == 4
+        clean = self._diags(
+            "import time\n"
+            "def f(lock):\n"
+            "    lock.acquire()\n"
+            "    lock.release()\n"
+            "    time.sleep(1)\n"
+        )
+        assert clean == []
+
+    def test_condition_wait_is_not_a_seam(self):
+        # Waiting under the condition's own lock is how conditions
+        # work — the r09 class is about COMPUTE under a lock.
+        diags = self._diags(
+            "class C:\n"
+            "    def f(self):\n"
+            "        with self._cond:\n"
+            "            self._cond.wait(1.0)\n"
+        )
+        assert diags == []
+
+    def test_nested_def_body_not_under_region(self):
+        diags = self._diags(
+            "import time\n"
+            "class C:\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            def later():\n"
+            "                time.sleep(1)\n"
+            "            self.cb = later\n"
+        )
+        assert diags == []
+
+    def test_aot_program_names_become_seams(self, tmp_path):
+        # The seam set extends with the ADR-020 registry's program
+        # names, read from models/aot.py in the SAME pass.
+        models = tmp_path / "headlamp_tpu" / "models"
+        models.mkdir(parents=True)
+        (models / "aot.py").write_text(
+            '_BUILDERS = {"analytics.fleet_rollup": None}\n'
+        )
+        srv = tmp_path / "headlamp_tpu" / "server"
+        srv.mkdir(parents=True)
+        (srv / "x.py").write_text(
+            "class C:\n"
+            "    def f(self, rows):\n"
+            "        with self._lock:\n"
+            "            return self.reg.fleet_rollup(rows)\n"
+        )
+        result = Engine([LockBlockingRule()], root=str(tmp_path)).run()
+        assert len(result.diagnostics) == 1
+        assert "fleet_rollup" in result.diagnostics[0].message
+
+
+class TestExceptionBreadthMutations:
+    """EXC001 — the r10-review swallow class."""
+
+    def _diags(self, src, relpath="headlamp_tpu/server/mut.py"):
+        return _check(ExceptionBreadthRule(), relpath, src)
+
+    def test_except_base_exception_flagged(self):
+        diags = self._diags(
+            "try:\n    work()\nexcept BaseException:\n    pass\n"
+        )
+        assert len(diags) == 1 and "BaseException" in diags[0].message
+
+    def test_bare_except_flagged(self):
+        diags = self._diags("try:\n    work()\nexcept:\n    pass\n")
+        assert len(diags) == 1 and "bare" in diags[0].message
+
+    def test_except_exception_clean(self):
+        assert (
+            self._diags("try:\n    work()\nexcept Exception:\n    pass\n")
+            == []
+        )
+
+    def test_reraise_makes_broad_handler_clean(self):
+        diags = self._diags(
+            "try:\n"
+            "    work()\n"
+            "except BaseException:\n"
+            "    cleanup()\n"
+            "    raise\n"
+        )
+        assert diags == []
+
+    def test_keyboard_interrupt_without_reraise_flagged(self):
+        diags = self._diags(
+            "try:\n    work()\nexcept KeyboardInterrupt:\n    stop()\n"
+        )
+        assert len(diags) == 1 and "KeyboardInterrupt" in diags[0].message
+
+    def test_narrow_tuple_clean_broad_tuple_flagged(self):
+        assert (
+            self._diags(
+                "try:\n    work()\nexcept (ValueError, KeyError):\n    pass\n"
+            )
+            == []
+        )
+        diags = self._diags(
+            "try:\n    work()\nexcept (ValueError, BaseException):\n    pass\n"
+        )
+        assert len(diags) == 1
+
+    def test_serve_loop_allowlist_is_path_and_qualname_scoped(self):
+        src = (
+            "class RenderPool:\n"
+            "    def _worker(self):\n"
+            "        try:\n"
+            "            job()\n"
+            "        except BaseException as exc:\n"
+            "            self.err = exc\n"
+        )
+        assert self._diags(src, "headlamp_tpu/gateway/pool.py") == []
+        # Same code anywhere else is a finding.
+        assert len(self._diags(src, "headlamp_tpu/server/mut.py")) == 1
+
+
+class TestThreadSpawnMutations:
+    """THR001 — ADR-021 spawn discipline."""
+
+    def _diags(self, src, relpath="headlamp_tpu/push/mut.py"):
+        return _check(ThreadSpawnRule(), relpath, src)
+
+    def test_thread_construction_flagged(self):
+        diags = self._diags(
+            "import threading\n"
+            "def kick():\n"
+            "    threading.Thread(target=print, daemon=True).start()\n"
+        )
+        assert len(diags) == 1 and diags[0].context == "kick"
+
+    def test_executor_construction_flagged(self):
+        diags = self._diags(
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "def fan(fns):\n"
+            "    with ThreadPoolExecutor(4) as ex:\n"
+            "        return [f.result() for f in map(ex.submit, fns)]\n"
+        )
+        assert len(diags) == 1
+
+    def test_plain_callables_clean(self):
+        assert (
+            self._diags(
+                "def kick(q):\n    q.put_nowait(1)\n    return sorted(q.items)\n"
+            )
+            == []
+        )
+
+    def test_sanctioned_seam_clean_same_code_elsewhere_flagged(self):
+        src = (
+            "import threading\n"
+            "class RenderPool:\n"
+            "    def __init__(self):\n"
+            "        self._t = threading.Thread(target=self._worker)\n"
+        )
+        assert self._diags(src, "headlamp_tpu/gateway/pool.py") == []
+        assert len(self._diags(src, "headlamp_tpu/push/mut.py")) == 1
+
+
+class TestMetricsAllowlistMutations:
+    """SYN001 — quiet-family allowlist ↔ registry-literal sync."""
+
+    def _tree(self, tmp_path, quiet, literals):
+        tests_dir = tmp_path / "tests"
+        tests_dir.mkdir()
+        names = ", ".join(repr(q) for q in quiet)
+        (tests_dir / "test_metricsz.py").write_text(
+            "def test_quiet():\n"
+            f"    assert quiet <= {{{names}}}\n"
+        )
+        pkg = tmp_path / "headlamp_tpu"
+        pkg.mkdir()
+        body = "\n".join(f"g = registry.gauge({lit!r})" for lit in literals)
+        (pkg / "metrics_wiring.py").write_text(body + "\n")
+        return Engine([MetricsAllowlistRule()], root=str(tmp_path)).run()
+
+    def test_live_entries_clean(self, tmp_path):
+        result = self._tree(
+            tmp_path,
+            quiet=["headlamp_tpu_alpha_total", "headlamp_tpu_beta_seconds"],
+            literals=["headlamp_tpu_alpha_total", "headlamp_tpu_beta_seconds"],
+        )
+        assert result.diagnostics == []
+
+    def test_dead_entry_flagged_by_name(self, tmp_path):
+        result = self._tree(
+            tmp_path,
+            quiet=["headlamp_tpu_alpha_total", "headlamp_tpu_gone_total"],
+            literals=["headlamp_tpu_alpha_total"],
+        )
+        assert len(result.diagnostics) == 1
+        assert "headlamp_tpu_gone_total" in result.diagnostics[0].message
+
+    def test_real_allowlist_is_matched(self):
+        # On the live tree the rule must find BOTH sides: a non-empty
+        # quiet set in tests/test_metricsz.py and the registry
+        # literals that satisfy every entry.
+        rule = MetricsAllowlistRule()
+        engine = Engine([rule], root=REPO)
+        result = engine.run()
+        assert result.diagnostics == [], "\n".join(
+            str(d) for d in result.diagnostics
+        )
+        assert rule.allowlisted_seen >= 5, "quiet allowlist not found"

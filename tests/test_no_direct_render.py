@@ -85,11 +85,12 @@ class TestMutations:
 
     def test_wired_into_static_check_entry_point(self):
         # The gate must ride tools/ts_static_check.py main() — a gate
-        # that exists but never runs protects nothing.
+        # that exists but never runs protects nothing. Since ADR-022 it
+        # rides as engine rule RND001 in the unified single-pass run.
         with open(os.path.join(_TOOLS, "ts_static_check.py"), encoding="utf-8") as f:
             src = f.read()
-        assert "no_direct_render_check" in src
-        assert "render_diags" in src
+        assert "RND001" in src
+        assert "direct-render" in src
 
 
 def test_checker_importable_as_script():
@@ -100,3 +101,21 @@ def test_checker_importable_as_script():
         assert checker.main() == 0
     finally:
         sys.argv = argv
+
+
+def test_engine_parity_on_dirty_tree(tmp_path):
+    # ADR-022 migration pin: the shim and the engine rule (RND001)
+    # emit identical findings over the same tree.
+    from analysis.engine import Engine
+    from analysis.rules.direct_render import DirectRenderRule
+
+    runtime = tmp_path / "headlamp_tpu" / "runtime"
+    runtime.mkdir(parents=True)
+    (runtime / "x.py").write_text("from headlamp_tpu.ui import render_html\n")
+    shim_view = {
+        (os.path.relpath(d.path, str(tmp_path)), d.line, d.message)
+        for d in checker.check_tree(str(tmp_path))
+    }
+    result = Engine([DirectRenderRule()], root=str(tmp_path)).run()
+    engine_view = {(d.path, d.line, d.message) for d in result.diagnostics}
+    assert shim_view and shim_view == engine_view

@@ -99,3 +99,24 @@ class TestMutations:
     def test_unparseable_reports_instead_of_crashing(self):
         diags = self._diags("def broken(:\n")
         assert len(diags) == 1 and "unparseable" in diags[0].message
+
+
+def test_engine_parity_on_dirty_tree(tmp_path):
+    # ADR-022 migration pin: the shim and the engine rule (FIT001)
+    # emit identical findings over the same tree.
+    from analysis.engine import Engine
+    from analysis.rules.inline_fit import InlineFitRule
+
+    server = tmp_path / "headlamp_tpu" / "server"
+    server.mkdir(parents=True)
+    (server / "x.py").write_text(
+        "from headlamp_tpu.models import fit_and_forecast\n"
+        "fit_and_forecast([1.0])\n"
+    )
+    shim_view = {
+        (os.path.relpath(d.path, str(tmp_path)), d.line, d.message)
+        for d in check_tree(str(tmp_path))
+    }
+    result = Engine([InlineFitRule()], root=str(tmp_path)).run()
+    engine_view = {(d.path, d.line, d.message) for d in result.diagnostics}
+    assert shim_view and shim_view == engine_view

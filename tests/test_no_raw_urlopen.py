@@ -101,3 +101,23 @@ class TestMutations:
         diags = check_tree(str(tmp_path))
         assert len(diags) == 1
         assert "other.py" in diags[0].path
+
+
+def test_engine_parity_on_dirty_tree(tmp_path):
+    # ADR-022 migration pin: the shim and the engine rule (URL001)
+    # emit identical findings over the same tree.
+    from analysis.engine import Engine
+    from analysis.rules.raw_urlopen import RawUrlopenRule
+
+    pkg = tmp_path / "headlamp_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "x.py").write_text(
+        "import urllib.request\nurllib.request.urlopen('http://x')\n"
+    )
+    shim_view = {
+        (os.path.relpath(d.path, str(tmp_path)), d.line, d.message)
+        for d in check_tree(str(tmp_path))
+    }
+    result = Engine([RawUrlopenRule()], root=str(tmp_path)).run()
+    engine_view = {(d.path, d.line, d.message) for d in result.diagnostics}
+    assert shim_view and shim_view == engine_view

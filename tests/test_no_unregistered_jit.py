@@ -119,3 +119,21 @@ class TestMutations:
     def test_unparseable_reports_instead_of_crashing(self):
         diags = self._diags("def broken(:\n")
         assert len(diags) == 1 and "unparseable" in diags[0].message
+
+
+def test_engine_parity_on_dirty_tree(tmp_path):
+    # ADR-022 migration pin: the shim and the engine rule (JIT001)
+    # emit identical findings over the same tree.
+    from analysis.engine import Engine
+    from analysis.rules.unregistered_jit import UnregisteredJitRule
+
+    pkg = tmp_path / "headlamp_tpu" / "server"
+    pkg.mkdir(parents=True)
+    (pkg / "x.py").write_text("import jax\nf = jax.jit(lambda x: x)\n")
+    shim_view = {
+        (os.path.relpath(d.path, str(tmp_path)), d.line, d.message)
+        for d in check_tree(str(tmp_path))
+    }
+    result = Engine([UnregisteredJitRule()], root=str(tmp_path)).run()
+    engine_view = {(d.path, d.line, d.message) for d in result.diagnostics}
+    assert shim_view and shim_view == engine_view

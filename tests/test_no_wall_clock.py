@@ -202,3 +202,21 @@ class TestMutations:
         )
         assert len(diags) == 1
         assert diags[0].line == 3
+
+
+def test_engine_parity_on_dirty_tree(tmp_path):
+    # ADR-022 migration pin: the shim and the engine rule (WCK001)
+    # emit identical findings over the same tree.
+    from analysis.engine import Engine
+    from analysis.rules.wall_clock import WallClockRule
+
+    bad = tmp_path / "headlamp_tpu" / "gateway"
+    bad.mkdir(parents=True)
+    (bad / "x.py").write_text("import time\nnow = time.time()\n")
+    shim_view = {
+        (os.path.relpath(d.path, str(tmp_path)), d.line, d.message)
+        for d in check_tree(str(tmp_path))
+    }
+    result = Engine([WallClockRule()], root=str(tmp_path)).run()
+    engine_view = {(d.path, d.line, d.message) for d in result.diagnostics}
+    assert shim_view and shim_view == engine_view

@@ -1,0 +1,23 @@
+"""Unified single-pass static-analysis engine (ADR-022).
+
+Public surface:
+
+- :class:`analysis.engine.Engine` — one walk, one parse per file,
+  pluggable rules, pragma suppressions, baseline, text/JSONL output.
+- :func:`analysis.rules.all_rules` — the full registry (the five ported
+  legacy gates plus HTL001/EXC001/THR001/SYN001).
+- The legacy gate modules (``tools/no_*_check.py``) remain as thin
+  shims over this package so their CLIs and test imports keep working.
+"""
+
+from .engine import (  # noqa: F401
+    Diagnostic,
+    Engine,
+    FileContext,
+    Rule,
+    RunResult,
+    default_baseline_path,
+    load_baseline,
+    repo_root,
+)
+from .rules import all_rules  # noqa: F401

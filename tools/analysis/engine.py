@@ -1,0 +1,414 @@
+"""Single-pass static-analysis engine (ADR-022).
+
+The repo grew five AST gates (wall-clock, raw-urlopen, inline-fit,
+direct-render, unregistered-jit) as disconnected scripts: each re-walked
+the tree, re-parsed every file it scoped, and invented its own
+reporting. This engine inverts that: ONE ``ast.parse`` per file feeds a
+registry of pluggable rules, each declaring its own path scope, with
+shared machinery the scripts never had —
+
+- **Suppression pragmas**: ``# analysis: disable=RULE1,RULE2`` on the
+  flagged line silences that rule there. Counted, never silent: the run
+  result carries every suppressed diagnostic and the CLI prints the
+  count.
+- **Baseline**: ``tools/analysis/baseline.json`` grandfathers
+  deliberate findings by ``(rule, path, context)`` with a mandatory
+  reason string. Baselined findings don't fail the run; a baseline
+  entry that matches nothing is STALE and fails the run (dead
+  suppressions rot into lies).
+- **Stable rule IDs** (``WCK001``, ``URL001``, … ``HTL001``) and text +
+  JSON-lines output.
+
+Parse discipline: ``RunResult.parse_counts`` records how many times
+each file was parsed; ``bench.py bench_analysis`` asserts the max is 1
+(``files_parsed_once``). Rules never call ``ast.parse`` themselves —
+they receive the shared tree through :class:`FileContext`.
+
+Scope roots are walked deterministically (sorted dirs and files) so two
+runs over the same tree emit diagnostics in the same order.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+#: Pragma grammar: ``# analysis: disable=HTL001`` or a comma list.
+_PRAGMA_RE = re.compile(r"#\s*analysis:\s*disable=([A-Za-z0-9_,\s]+)")
+
+#: Rule id for files the shared parser cannot read at all.
+PARSE_RULE_ID = "PAR000"
+
+
+@dataclass
+class Diagnostic:
+    """One finding. ``path`` is repo-relative (the engine's canonical
+    form); shims join it back onto their root for the legacy gates'
+    absolute-path contract. ``context`` is the enclosing qualname for
+    rules that compute one — the baseline's line-number-proof key."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    context: str = ""
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "rule": self.rule,
+                "path": self.path,
+                "line": self.line,
+                "message": self.message,
+                "context": self.context,
+            },
+            sort_keys=True,
+        )
+
+
+class FileContext:
+    """Everything a rule may read about one file: source, the SHARED
+    parse tree, and a lazily built function table. Rules must not
+    re-parse — that is the single-pass contract."""
+
+    def __init__(self, root: str, relpath: str, source: str, tree: ast.Module) -> None:
+        self.root = root
+        self.relpath = relpath
+        self.source = source
+        self.tree = tree
+        self._functions: list[tuple[str, ast.AST]] | None = None
+
+    def functions(self) -> list[tuple[str, ast.AST]]:
+        """All function defs as ``(qualname, node)``, CPython-style
+        qualnames (``Class.method``, ``outer.<locals>.inner``)."""
+        if self._functions is None:
+            out: list[tuple[str, ast.AST]] = []
+
+            def walk(node: ast.AST, prefix: str) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        qual = prefix + child.name
+                        out.append((qual, child))
+                        walk(child, qual + ".<locals>.")
+                    elif isinstance(child, ast.ClassDef):
+                        walk(child, prefix + child.name + ".")
+                    else:
+                        walk(child, prefix)
+
+            walk(self.tree, "")
+            self._functions = out
+        return self._functions
+
+    def enclosing_qualname(self, line: int) -> str:
+        """Qualname of the innermost function containing ``line`` —
+        diagnostics anchor to functions, baselines match on them."""
+        best = ""
+        best_span = None
+        for qual, node in self.functions():
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end:
+                span = end - node.lineno
+                if best_span is None or span <= best_span:
+                    best, best_span = qual, span
+        return best
+
+
+class Rule:
+    """One pluggable check. Subclasses set the class attributes and
+    implement :meth:`check_file`; tree-level rules may also implement
+    :meth:`finalize` (called once after every scoped file was checked).
+    """
+
+    rule_id: str = "XXX000"
+    name: str = "unnamed"
+    description: str = ""
+    #: Top-level entries (dirs or files, repo-relative) this rule needs
+    #: walked. The engine unions these across rules into one walk.
+    top_dirs: tuple[str, ...] = ("headlamp_tpu",)
+    #: Repo-relative dir prefixes the rule scopes to (None = all of
+    #: top_dirs), minus exemptions.
+    scope_dirs: tuple[str, ...] | None = None
+    exempt_dirs: tuple[str, ...] = ()
+    exempt_files: tuple[str, ...] = ()
+
+    def wants(self, relpath: str) -> bool:
+        if not relpath.endswith(".py"):
+            return False
+        norm = relpath.replace(os.sep, "/")
+        if norm in set(self.exempt_files):
+            return False
+        if any(norm.startswith(d.rstrip("/") + "/") for d in self.exempt_dirs):
+            return False
+        tops = {t.rstrip("/") for t in self.top_dirs}
+        in_top = norm in tops or any(norm.startswith(t + "/") for t in tops)
+        if not in_top:
+            return False
+        if self.scope_dirs is None:
+            return True
+        return any(norm.startswith(d.rstrip("/") + "/") for d in self.scope_dirs)
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        raise NotImplementedError
+
+    def finalize(self, run: "Engine") -> list[Diagnostic]:
+        return []
+
+
+@dataclass
+class RunResult:
+    diagnostics: list[Diagnostic] = field(default_factory=list)
+    suppressed: list[Diagnostic] = field(default_factory=list)
+    baselined: list[Diagnostic] = field(default_factory=list)
+    stale_baseline: list[dict] = field(default_factory=list)
+    parse_counts: dict[str, int] = field(default_factory=dict)
+
+    @property
+    def files_parsed_once(self) -> bool:
+        return all(count == 1 for count in self.parse_counts.values())
+
+    @property
+    def ok(self) -> bool:
+        return not self.diagnostics and not self.stale_baseline
+
+    def for_rule(self, rule_id: str) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.rule == rule_id]
+
+    def to_jsonl(self) -> str:
+        lines = [d.to_json() for d in self.diagnostics]
+        for d in self.suppressed:
+            lines.append(json.dumps({"suppressed": json.loads(d.to_json())}))
+        for d in self.baselined:
+            lines.append(json.dumps({"baselined": json.loads(d.to_json())}))
+        for entry in self.stale_baseline:
+            lines.append(json.dumps({"stale_baseline": entry}, sort_keys=True))
+        return "\n".join(lines)
+
+
+def repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def load_baseline(path: str) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data.get("entries", [])
+    for entry in entries:
+        for key in ("rule", "path", "context", "reason"):
+            if not entry.get(key):
+                raise ValueError(
+                    f"baseline entry missing required '{key}': {entry!r} — "
+                    "grandfathered findings carry a reason, always"
+                )
+    return entries
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+
+class Engine:
+    """One run = one walk, one parse per file, every rule fed from the
+    shared trees. Construct with the rule instances to run (default:
+    the full registry) and call :meth:`run`."""
+
+    def __init__(
+        self,
+        rules: Iterable[Rule] | None = None,
+        *,
+        root: str | None = None,
+        baseline: list[dict] | None = None,
+    ) -> None:
+        if rules is None:
+            from .rules import all_rules
+
+            rules = all_rules()
+        self.rules = list(rules)
+        self.root = root or repo_root()
+        self.baseline = list(baseline or [])
+        #: Per-file contexts by relpath — rules' finalize() may consult
+        #: trees already parsed this pass (e.g. HTL001 reads the AOT
+        #: builder table from models/aot.py without re-parsing it).
+        self.contexts: dict[str, FileContext] = {}
+
+    # -- target discovery ------------------------------------------------
+
+    def _targets(self) -> list[str]:
+        tops: set[str] = set()
+        for rule in self.rules:
+            tops.update(rule.top_dirs)
+        out: list[str] = []
+        for top in sorted(tops):
+            base = os.path.join(self.root, top)
+            if os.path.isfile(base):
+                out.append(top.replace(os.sep, "/"))
+                continue
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames.sort()
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for filename in sorted(filenames):
+                    if filename.endswith(".py"):
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, filename), self.root
+                        )
+                        out.append(rel.replace(os.sep, "/"))
+        return out
+
+    # -- the pass --------------------------------------------------------
+
+    def run(self) -> RunResult:
+        result = RunResult()
+        raw: list[Diagnostic] = []
+        suppress_map: dict[str, dict[int, set[str]]] = {}
+        for relpath in self._targets():
+            interested = [r for r in self.rules if r.wants(relpath)]
+            if not interested:
+                continue
+            abspath = os.path.join(self.root, relpath)
+            with open(abspath, "r", encoding="utf-8") as f:
+                source = f.read()
+            result.parse_counts[relpath] = result.parse_counts.get(relpath, 0) + 1
+            try:
+                tree = ast.parse(source, filename=relpath)
+            except SyntaxError as e:
+                raw.append(
+                    Diagnostic(
+                        PARSE_RULE_ID, relpath, e.lineno or 1, f"unparseable: {e.msg}"
+                    )
+                )
+                continue
+            ctx = FileContext(self.root, relpath, source, tree)
+            self.contexts[relpath] = ctx
+            suppress_map[relpath] = _suppressions(source)
+            for rule in interested:
+                raw.extend(rule.check_file(ctx))
+        for rule in self.rules:
+            raw.extend(rule.finalize(self))
+
+        # Suppressions first (pragma wins over baseline: the pragma is
+        # in the code, reviewed where the finding lives).
+        unsuppressed: list[Diagnostic] = []
+        for diag in raw:
+            rules_off = suppress_map.get(diag.path, {}).get(diag.line, set())
+            if diag.rule in rules_off:
+                result.suppressed.append(diag)
+            else:
+                unsuppressed.append(diag)
+
+        # Baseline: (rule, path, context) exact match. Every entry must
+        # match at least one finding or it is stale — and stale entries
+        # FAIL the run, so dead grandfathers cannot linger.
+        matched: set[int] = set()
+        for diag in unsuppressed:
+            hit = False
+            for i, entry in enumerate(self.baseline):
+                if (
+                    entry["rule"] == diag.rule
+                    and entry["path"] == diag.path
+                    and entry["context"] == diag.context
+                ):
+                    matched.add(i)
+                    hit = True
+                    break
+            if hit:
+                result.baselined.append(diag)
+            else:
+                result.diagnostics.append(diag)
+        result.stale_baseline = [
+            entry for i, entry in enumerate(self.baseline) if i not in matched
+        ]
+        return result
+
+    # -- single-source seam (shims, mutation tests) ---------------------
+
+    def check_source(self, rule: Rule, relpath: str, source: str) -> list[Diagnostic]:
+        """Run ONE rule over in-memory source — the legacy gates'
+        ``_check_source`` contract and the mutation tests' seam. No
+        suppression/baseline processing: the caller sees raw findings."""
+        try:
+            tree = ast.parse(source, filename=relpath)
+        except SyntaxError as e:
+            return [
+                Diagnostic(
+                    PARSE_RULE_ID, relpath, e.lineno or 1, f"unparseable: {e.msg}"
+                )
+            ]
+        ctx = FileContext(self.root, relpath, source, tree)
+        self.contexts[relpath] = ctx
+        return rule.check_file(ctx) + rule.finalize(self)
+
+
+def _suppressions(source: str) -> dict[int, set[str]]:
+    out: dict[int, set[str]] = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _PRAGMA_RE.search(line)
+        if m:
+            out[lineno] = {
+                token.strip() for token in m.group(1).split(",") if token.strip()
+            }
+    return out
+
+
+def dotted_name(expr: ast.AST) -> str | None:
+    """``a.b.c`` for Attribute/Name chains, None for anything else —
+    the shared helper every ported gate used to re-implement."""
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    jsonl = "--jsonl" in argv
+    argv = [a for a in argv if a != "--jsonl"]
+    root = argv[0] if argv else None
+    engine = Engine(root=root, baseline=load_baseline(default_baseline_path()))
+    result = engine.run()
+    if jsonl:
+        out = result.to_jsonl()
+        if out:
+            print(out)
+    else:
+        for diag in result.diagnostics:
+            print(diag)
+        for entry in result.stale_baseline:
+            print(
+                f"{entry['path']}: STALE baseline entry for {entry['rule']} "
+                f"({entry['context']}) matches nothing — remove it"
+            )
+    print(
+        f"{len(result.diagnostics)} problem(s), "
+        f"{len(result.suppressed)} suppressed, "
+        f"{len(result.baselined)} baselined, "
+        f"{len(result.stale_baseline)} stale baseline entr(y/ies)"
+    )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    if __package__ in (None, ""):
+        # Invoked as ``python tools/analysis/engine.py`` — re-enter
+        # through the package so the relative rule imports resolve.
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        from analysis.engine import main as _pkg_main
+
+        raise SystemExit(_pkg_main())
+    raise SystemExit(main())

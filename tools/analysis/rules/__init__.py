@@ -1,0 +1,46 @@
+"""Rule registry (ADR-022). Order is presentation order: the five
+ported legacy gates first (their IDs are aliases for the historical
+gate names), then the concurrency/exception rules grounded in the r09
+and r10-review incidents, then the self-consistency checks."""
+
+from __future__ import annotations
+
+from ..engine import Rule
+from .direct_render import DirectRenderRule
+from .exception_breadth import ExceptionBreadthRule
+from .inline_fit import InlineFitRule
+from .lock_blocking import LockBlockingRule
+from .metrics_allowlist import MetricsAllowlistRule
+from .raw_urlopen import RawUrlopenRule
+from .thread_spawn import ThreadSpawnRule
+from .unregistered_jit import UnregisteredJitRule
+from .wall_clock import WallClockRule
+
+
+def all_rules() -> list[Rule]:
+    """Fresh instances — rules may carry per-run state between
+    ``check_file`` and ``finalize``, so one registry serves one run."""
+    return [
+        RawUrlopenRule(),
+        InlineFitRule(),
+        WallClockRule(),
+        DirectRenderRule(),
+        UnregisteredJitRule(),
+        LockBlockingRule(),
+        ExceptionBreadthRule(),
+        ThreadSpawnRule(),
+        MetricsAllowlistRule(),
+    ]
+
+
+RULE_IDS = {
+    "URL001": RawUrlopenRule,
+    "FIT001": InlineFitRule,
+    "WCK001": WallClockRule,
+    "RND001": DirectRenderRule,
+    "JIT001": UnregisteredJitRule,
+    "HTL001": LockBlockingRule,
+    "EXC001": ExceptionBreadthRule,
+    "THR001": ThreadSpawnRule,
+    "SYN001": MetricsAllowlistRule,
+}

@@ -1,0 +1,70 @@
+"""RND001 — no direct render-path calls outside the gateway.
+
+Port of ``tools/no_direct_render_check.py`` (ADR-017): the bounded
+render pool, burn-rate shedding, and whole-page coalescing only hold if
+there is exactly ONE door into the render path. Identical semantics to
+the legacy gate, pinned by ``tests/test_no_direct_render.py`` through
+the shim.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Diagnostic, FileContext, Rule
+
+#: Page-render entry points whose references are gated.
+RENDER_NAMES = ("render_html", "native_node_page", "native_pod_page")
+
+HANDLE_MESSAGE = (
+    "direct .handle() call outside gateway/ — serving code must route "
+    "through RenderGateway.handle (admission, shed, coalesce; ADR-017)"
+)
+RENDER_MESSAGE = (
+    "direct page-render reference outside ui//pages//server — rendering "
+    "belongs behind the gateway's admission layer (ADR-017)"
+)
+
+
+class DirectRenderRule(Rule):
+    rule_id = "RND001"
+    name = "no-direct-render"
+    description = "Rendering happens only behind the gateway's admission layer"
+    top_dirs = ("headlamp_tpu", "tools")
+    exempt_dirs = (
+        "headlamp_tpu/gateway",
+        "headlamp_tpu/ui",
+        "headlamp_tpu/pages",
+    )
+    exempt_files = (
+        "headlamp_tpu/server/app.py",
+        "tools/make_screenshots.py",
+    )
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        tree, path = ctx.tree, ctx.relpath
+        out: list[Diagnostic] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Attribute) and func.attr == "handle":
+                    out.append(
+                        Diagnostic(self.rule_id, path, node.lineno, HANDLE_MESSAGE)
+                    )
+            if isinstance(node, ast.Attribute) and node.attr in RENDER_NAMES:
+                out.append(
+                    Diagnostic(self.rule_id, path, node.lineno, RENDER_MESSAGE)
+                )
+            elif isinstance(node, ast.Name) and node.id in RENDER_NAMES:
+                out.append(
+                    Diagnostic(self.rule_id, path, node.lineno, RENDER_MESSAGE)
+                )
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name in RENDER_NAMES:
+                        out.append(
+                            Diagnostic(
+                                self.rule_id, path, node.lineno, RENDER_MESSAGE
+                            )
+                        )
+        return out

@@ -1,0 +1,139 @@
+"""EXC001 — exception breadth: never swallow KeyboardInterrupt/
+SystemExit.
+
+The r10-review incident class: the transport pool's connect-failure
+counter caught ``BaseException``, so a KeyboardInterrupt landing
+mid-connect spent the transport_connect SLO's 0.1% error budget
+(CHANGES.md r10-review). This rule enforces the narrowed discipline
+everywhere in ``headlamp_tpu/``:
+
+- Bare ``except:`` and ``except BaseException`` are findings UNLESS the
+  handler re-raises (a bare ``raise`` anywhere in the handler body —
+  cleanup-and-propagate is the sanctioned idiom, e.g. the transport
+  pool's slot-accounting unwind).
+- ``except KeyboardInterrupt`` / ``except SystemExit`` that do not
+  re-raise are findings too — catching the interrupt by name and
+  dropping it is the same swallow, spelled out.
+- Top-level serve loops that must survive anything and TRANSPORT the
+  exception to a waiter (the render-pool worker: ``job.error = exc``,
+  re-raised by the gateway) are allowlisted by ``(path, qualname)``
+  below. Anything else deliberate goes in the baseline with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Diagnostic, FileContext, Rule
+
+#: (relpath, qualname) pairs allowed to catch BaseException without
+#: re-raising: top-level serve loops whose jobs carry the exception to
+#: the real waiter. Keep this list SHORT — every entry is a place a
+#: Ctrl-C can vanish into a job object instead of stopping the process.
+SERVE_LOOP_ALLOWLIST = {
+    # ADR-017 render worker: job.error transports to the admitted
+    # request's thread, which re-raises; the worker must outlive it.
+    ("headlamp_tpu/gateway/pool.py", "RenderPool._worker"),
+}
+
+BROAD_MESSAGE = (
+    "{what} swallows KeyboardInterrupt/SystemExit — narrow to "
+    "`except Exception`, re-raise, or (for a serve loop that transports "
+    "the error to its waiter) allowlist it (r10-review class; ADR-022)"
+)
+INTERRUPT_MESSAGE = (
+    "except {name} without re-raise — interrupts must propagate, never "
+    "be absorbed into counters or logs (r10-review class; ADR-022)"
+)
+
+_INTERRUPT_NAMES = {"KeyboardInterrupt", "SystemExit", "GeneratorExit"}
+
+
+def _names_in_type(type_node: ast.expr | None) -> list[str]:
+    """Exception class names an ``except`` clause matches, by terminal
+    name (handles ``builtins.BaseException`` spellings)."""
+    if type_node is None:
+        return ["<bare>"]
+    nodes = (
+        list(type_node.elts) if isinstance(type_node, ast.Tuple) else [type_node]
+    )
+    out: list[str] = []
+    for node in nodes:
+        if isinstance(node, ast.Name):
+            out.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.append(node.attr)
+    return out
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body contains a bare ``raise`` (or
+    ``raise <bound name>``) at any depth outside nested defs — the
+    cleanup-and-propagate idiom."""
+    bound = handler.name
+    stack: list[ast.AST] = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Raise):
+            if node.exc is None:
+                return True
+            if (
+                bound
+                and isinstance(node.exc, ast.Name)
+                and node.exc.id == bound
+            ):
+                return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+class ExceptionBreadthRule(Rule):
+    rule_id = "EXC001"
+    name = "exception-breadth"
+    description = "No handler absorbs BaseException/KeyboardInterrupt/SystemExit"
+    top_dirs = ("headlamp_tpu",)
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        out: list[Diagnostic] = []
+        norm = ctx.relpath.replace("\\", "/")
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            names = _names_in_type(node.type)
+            broad = "<bare>" in names or "BaseException" in names
+            interrupts = [n for n in names if n in _INTERRUPT_NAMES]
+            if not broad and not interrupts:
+                continue
+            if _reraises(node):
+                continue
+            qual = ctx.enclosing_qualname(node.lineno)
+            if broad and (norm, qual) in SERVE_LOOP_ALLOWLIST:
+                continue
+            if broad:
+                what = (
+                    "bare `except:`"
+                    if "<bare>" in names
+                    else "`except BaseException`"
+                )
+                out.append(
+                    Diagnostic(
+                        self.rule_id,
+                        ctx.relpath,
+                        node.lineno,
+                        BROAD_MESSAGE.format(what=what),
+                        context=qual,
+                    )
+                )
+            else:
+                out.append(
+                    Diagnostic(
+                        self.rule_id,
+                        ctx.relpath,
+                        node.lineno,
+                        INTERRUPT_MESSAGE.format(name="/".join(interrupts)),
+                        context=qual,
+                    )
+                )
+        return out

@@ -1,0 +1,62 @@
+"""FIT001 — no direct ``fit_and_forecast*`` calls outside the model
+layer.
+
+Port of ``tools/no_inline_fit_check.py`` (ADR-015): request handlers
+read through the stale-while-revalidate refresher; a direct fit call in
+the serving tree re-introduces the multi-second request-path cold fit.
+Identical semantics to the legacy gate, pinned by
+``tests/test_no_inline_fit.py`` through the shim.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Diagnostic, FileContext, Rule
+
+_PREFIX = "fit_and_forecast"
+
+MESSAGE = (
+    "direct fit_and_forecast* reference outside models/ — request-path "
+    "code must go through the stale-while-revalidate refresher "
+    "(runtime/refresh.py, ADR-015)"
+)
+
+
+class InlineFitRule(Rule):
+    rule_id = "FIT001"
+    name = "no-inline-fit"
+    description = "Serving code never calls the forecast fit entries directly"
+    top_dirs = ("headlamp_tpu", "tools")
+    exempt_dirs = ("headlamp_tpu/models",)
+    exempt_files = ("headlamp_tpu/runtime/refresh.py",)
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        """Flag ``fit_and_forecast*`` references in any form: attribute
+        access on any base, bare-name loads, and the ``from m import
+        fit_and_forecast_x [as y]`` imports that bind them locally. The
+        import itself is flagged — an unused import of a fit entry in
+        serving code is already drift."""
+        tree, path = ctx.tree, ctx.relpath
+        out: list[Diagnostic] = []
+        #: Local names bound to a fit entry via ``from ... import``
+        #: aliases (``from ..models import fit_and_forecast as f``).
+        func_aliases: set[str] = set()
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name.startswith(_PREFIX):
+                        out.append(
+                            Diagnostic(self.rule_id, path, node.lineno, MESSAGE)
+                        )
+                        if alias.asname:
+                            func_aliases.add(alias.asname)
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and node.attr.startswith(_PREFIX):
+                out.append(Diagnostic(self.rule_id, path, node.lineno, MESSAGE))
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id.startswith(_PREFIX) or node.id in func_aliases:
+                    out.append(Diagnostic(self.rule_id, path, node.lineno, MESSAGE))
+        return out

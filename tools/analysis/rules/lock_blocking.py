@@ -1,0 +1,233 @@
+"""HTL001 — no blocking call while holding a lock.
+
+The r09 incident class: ``DashboardApp`` once ran a multi-second JAX
+forecast fit while holding the metrics-cache lock, so every concurrent
+metrics view stalled behind one cold fit (fixed by the ADR-015
+refresher; CHANGES.md r09). This rule machine-enforces the invariant
+everywhere: a call into a known-blocking seam inside a held lock
+region is a finding.
+
+Lock regions, per function:
+
+- ``with self._lock:`` / ``with slot.lock:`` / ``with self._cond:`` —
+  any ``with`` whose context expression's terminal name is lock-ish
+  (``lock``/``mutex``/``cond``/``cv``, optionally underscore-prefixed).
+- ``X.acquire()`` … ``X.release()`` spans tracked linearly through a
+  statement list (the try/finally idiom works because ``release`` is
+  not a seam).
+
+Nested ``def``/``class`` bodies are excluded — they run later, not
+under the region.
+
+Blocking seams (the r09 post-mortem list, ADR-022):
+
+- jitted program entries — names derived from the ADR-020 registry's
+  ``_BUILDERS`` table in ``models/aot.py`` (read from the SAME parse
+  pass, never re-parsed) plus the ``fit_and_forecast*`` /
+  ``fit_forecast*`` / ``compute_forecast*`` / ``forecast_slo_burn``
+  fit-entry prefixes;
+- transport/socket seams: ``request``, ``getresponse``, ``urlopen``,
+  ``sync``, ``refresh`` (the cluster-context network entries);
+- render/serve seams: ``handle``, ``render``, ``render_html``,
+  ``native_node_page``, ``native_pod_page``;
+- ``sleep``.
+
+``Condition.wait`` is deliberately NOT a seam — waiting under the
+condition's own lock is how conditions work.
+
+Deliberate holds (the background sync loop holds the sync lock across
+a tick BY DESIGN — page views read the published snapshot without the
+lock) live in ``tools/analysis/baseline.json`` with a reason string.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+
+from ..engine import Diagnostic, FileContext, Rule, dotted_name
+
+#: Terminal attribute/variable names that denote a mutex-like object.
+_LOCKISH_RE = re.compile(r"^_{0,2}(bg_)?(lock|mutex|cond|cv)$")
+
+#: Call names that block by nature (see module docstring).
+STATIC_SEAMS = {
+    "sleep",
+    "handle",
+    "render",
+    "render_html",
+    "native_node_page",
+    "native_pod_page",
+    "urlopen",
+    "getresponse",
+    "request",
+    "sync",
+    "refresh",
+}
+
+#: Fit-entry prefixes — the jitted programs the r09 stall ran inline.
+FIT_PREFIXES = ("fit_and_forecast", "fit_forecast", "compute_forecast")
+
+MESSAGE = (
+    "blocking call `{call}` while holding `{lock}` — run the blocking "
+    "work outside the lock region (r09 Refresher stall class; ADR-022)"
+)
+
+
+@dataclass
+class _Candidate:
+    path: str
+    line: int
+    context: str
+    call: str  # full dotted call name
+    terminal: str  # last path component (matched against seams)
+    lock: str  # dotted name of the innermost held lock
+
+
+def _lockish(expr: ast.AST) -> str | None:
+    """Dotted name of ``expr`` when its terminal name is lock-like."""
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    terminal = name.rsplit(".", 1)[-1]
+    return name if _LOCKISH_RE.match(terminal) else None
+
+
+def _lock_method_target(stmt: ast.stmt, method: str) -> str | None:
+    """``X.acquire()`` / ``X.release()`` expression-statement on a
+    lock-ish ``X`` → dotted name of X."""
+    if not isinstance(stmt, ast.Expr) or not isinstance(stmt.value, ast.Call):
+        return None
+    func = stmt.value.func
+    if not isinstance(func, ast.Attribute) or func.attr != method:
+        return None
+    return _lockish(func.value)
+
+
+class LockBlockingRule(Rule):
+    rule_id = "HTL001"
+    name = "no-lock-held-blocking-call"
+    description = "Blocking seams are never called while a lock is held"
+    top_dirs = ("headlamp_tpu",)
+
+    def __init__(self) -> None:
+        self._candidates: list[_Candidate] = []
+        self._aot_programs: set[str] = set()
+
+    # -- per-file pass ---------------------------------------------------
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        if ctx.relpath.replace("\\", "/").endswith("models/aot.py"):
+            self._aot_programs |= _builder_entry_names(ctx.tree)
+        for qual, fn in ctx.functions():
+            self._scan_block(ctx, qual, fn.body, [])
+        return []  # emitted in finalize, once the seam set is complete
+
+    def _scan_block(
+        self,
+        ctx: FileContext,
+        qual: str,
+        stmts: list[ast.stmt],
+        held: list[str],
+    ) -> None:
+        held = list(held)
+        for stmt in stmts:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue  # runs later, not under this region
+            acquired = _lock_method_target(stmt, "acquire")
+            if acquired is not None:
+                held.append(acquired)
+                continue
+            released = _lock_method_target(stmt, "release")
+            if released is not None and released in held:
+                held.remove(released)
+                continue
+            if isinstance(stmt, ast.With):
+                locks = [
+                    lock
+                    for lock in (_lockish(i.context_expr) for i in stmt.items)
+                    if lock
+                ]
+                if locks:
+                    self._scan_block(ctx, qual, stmt.body, held + locks)
+                    continue
+            if held:
+                self._collect_calls(ctx, qual, stmt, held[-1])
+            else:
+                for attr in ("body", "orelse", "finalbody"):
+                    inner = getattr(stmt, attr, None)
+                    if inner:
+                        self._scan_block(ctx, qual, inner, held)
+                for handler in getattr(stmt, "handlers", None) or []:
+                    self._scan_block(ctx, qual, handler.body, held)
+
+    def _collect_calls(
+        self, ctx: FileContext, qual: str, stmt: ast.stmt, lock: str
+    ) -> None:
+        """Every call under ``stmt`` (nested defs excluded) is a
+        candidate; seam matching happens in finalize when the AOT-
+        derived names are known."""
+        stack: list[ast.AST] = [stmt]
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+            ) and node is not stmt:
+                continue
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name is not None:
+                    self._candidates.append(
+                        _Candidate(
+                            ctx.relpath,
+                            node.lineno,
+                            qual,
+                            name,
+                            name.rsplit(".", 1)[-1],
+                            lock,
+                        )
+                    )
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- seam matching ---------------------------------------------------
+
+    def finalize(self, run) -> list[Diagnostic]:
+        seams = STATIC_SEAMS | self._aot_programs | {"forecast_slo_burn"}
+        out: list[Diagnostic] = []
+        for cand in self._candidates:
+            if cand.terminal in seams or cand.terminal.startswith(FIT_PREFIXES):
+                out.append(
+                    Diagnostic(
+                        self.rule_id,
+                        cand.path,
+                        cand.line,
+                        MESSAGE.format(call=cand.call, lock=cand.lock),
+                        context=cand.context,
+                    )
+                )
+        self._candidates = []
+        return sorted(out, key=lambda d: (d.path, d.line))
+
+
+def _builder_entry_names(tree: ast.Module) -> set[str]:
+    """Last components of the ``_BUILDERS`` table's program keys —
+    'analytics.fleet_rollup' registers the callable ``fleet_rollup``,
+    and calling it while holding a lock is the r09 class."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "_BUILDERS"
+                for t in node.targets
+            )
+            and isinstance(node.value, ast.Dict)
+        ):
+            return {
+                key.value.rsplit(".", 1)[-1]
+                for key in node.value.keys
+                if isinstance(key, ast.Constant) and isinstance(key.value, str)
+            }
+    return set()

@@ -1,0 +1,98 @@
+"""SYN001 — the metricsz quiet-family allowlist names only real
+families.
+
+``tests/test_metricsz.py`` allows a known set of metric families to
+render HELP/TYPE with zero samples ("quiet"). When an instrument is
+renamed or removed, its allowlist entry becomes dead — the test keeps
+passing, and the allowlist silently stops describing reality. This
+rule cross-checks every ``headlamp_tpu_*`` name in the quiet set
+against the metric-family string literals actually present in
+``headlamp_tpu/`` (registration uses literal names by convention —
+enforced by the registry's name validation), so a dead entry fails
+fast.
+
+Both sides come from the SAME single parse pass: the quiet set from
+the test file's set literals, the registered names from every string
+constant in the package tree.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Diagnostic, FileContext, Rule
+
+_TEST_FILE = "tests/test_metricsz.py"
+_PREFIX = "headlamp_tpu_"
+
+MESSAGE = (
+    "quiet-family allowlist entry `{name}` names no metric family "
+    "literal in headlamp_tpu/ — the instrument was renamed or removed; "
+    "delete the dead entry (ADR-022)"
+)
+
+
+class MetricsAllowlistRule(Rule):
+    rule_id = "SYN001"
+    name = "metricsz-allowlist-sync"
+    description = "test_metricsz quiet-family allowlist entries must exist"
+    top_dirs = ("headlamp_tpu", _TEST_FILE)
+
+    def __init__(self) -> None:
+        self._registered: set[str] = set()
+        self._allowlisted: list[tuple[str, int]] = []  # (name, line)
+        #: Entries the last finalize saw — lets tests assert the rule
+        #: actually FOUND the allowlist (an empty sweep proves nothing).
+        self.allowlisted_seen = 0
+
+    def wants(self, relpath: str) -> bool:
+        norm = relpath.replace("\\", "/")
+        if norm == _TEST_FILE:
+            return True
+        return super().wants(relpath)
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        norm = ctx.relpath.replace("\\", "/")
+        if norm == _TEST_FILE:
+            # Quiet set = every set literal whose elements are all
+            # headlamp_tpu_* string constants (the allowlist is the
+            # only such set in the file; anchoring on shape, not on the
+            # assert's exact spelling, survives test refactors).
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Set) and node.elts:
+                    names = [
+                        e.value
+                        for e in node.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                        and e.value.startswith(_PREFIX)
+                    ]
+                    if len(names) == len(node.elts):
+                        for elt in node.elts:
+                            assert isinstance(elt, ast.Constant)
+                            self._allowlisted.append((elt.value, elt.lineno))
+        else:
+            for node in ast.walk(ctx.tree):
+                if (
+                    isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and node.value.startswith(_PREFIX)
+                ):
+                    self._registered.add(node.value)
+        return []
+
+    def finalize(self, run) -> list[Diagnostic]:
+        out = [
+            Diagnostic(
+                self.rule_id,
+                _TEST_FILE,
+                line,
+                MESSAGE.format(name=name),
+                context="quiet-family-allowlist",
+            )
+            for name, line in self._allowlisted
+            if name not in self._registered
+        ]
+        self.allowlisted_seen = len(self._allowlisted)
+        self._allowlisted = []
+        return out

@@ -1,0 +1,64 @@
+"""URL001 — no raw ``urllib.request.urlopen`` outside ``transport/``.
+
+Port of ``tools/no_raw_urlopen_check.py`` (ADR-014): every HTTP call
+routes through the keep-alive connection pool. Identical semantics to
+the legacy gate, pinned by ``tests/test_no_raw_urlopen.py`` through the
+shim.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Diagnostic, FileContext, Rule, dotted_name
+
+MESSAGE = (
+    "raw urllib.request.urlopen outside transport/ — route this call "
+    "through the keep-alive ConnectionPool (ADR-014)"
+)
+
+
+class RawUrlopenRule(Rule):
+    rule_id = "URL001"
+    name = "no-raw-urlopen"
+    description = "HTTP calls go through the pooled transport, never raw urlopen"
+    top_dirs = ("headlamp_tpu", "tools", "bench.py")
+    exempt_dirs = ("headlamp_tpu/transport",)
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        """Flag urlopen references reachable from ``urllib.request``:
+        direct attribute calls, module aliases (``import urllib.request
+        as r``), and name imports (``from urllib.request import urlopen
+        [as x]``). References count, not just calls — passing
+        ``urlopen`` as a callback bypasses the pool identically."""
+        tree, path = ctx.tree, ctx.relpath
+        out: list[Diagnostic] = []
+        #: Local names bound to the urllib.request module object.
+        module_aliases = {"urllib.request"}
+        #: Local names bound to the urlopen function itself.
+        func_aliases: set[str] = set()
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "urllib.request" and alias.asname:
+                        module_aliases.add(alias.asname)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "urllib.request":
+                    for alias in node.names:
+                        if alias.name == "urlopen":
+                            func_aliases.add(alias.asname or alias.name)
+                elif node.module == "urllib":
+                    for alias in node.names:
+                        if alias.name == "request":
+                            module_aliases.add(alias.asname or alias.name)
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and node.attr == "urlopen":
+                base = dotted_name(node.value)
+                if base in module_aliases:
+                    out.append(Diagnostic(self.rule_id, path, node.lineno, MESSAGE))
+            elif isinstance(node, ast.Name) and node.id in func_aliases:
+                if isinstance(node.ctx, ast.Load):
+                    out.append(Diagnostic(self.rule_id, path, node.lineno, MESSAGE))
+        return out

@@ -1,0 +1,82 @@
+"""THR001 — thread-spawn discipline.
+
+ADR-021 states the invariant for the push pipeline ("PushPipeline
+never spawns threads — SSE handler threads are the server's, the
+differ runs on the sync loop"); the ROADMAP's read-tier and federation
+items will multiply background workers, so the discipline is enforced
+everywhere: ``threading.Thread(...)`` construction and executor
+construction (``ThreadPoolExecutor`` / ``ProcessPoolExecutor`` /
+``threading.Timer``) are allowed only at the sanctioned spawn seams —
+
+- the serve-side sync heartbeat (``DashboardApp.serve`` /
+  ``start_background_sync``),
+- ``RenderPool`` (ADR-017's bounded worker pool),
+- ``FanoutScheduler`` (ADR-014's persistent fan-out executor),
+- the profiler seam (``SamplingProfiler`` — its daemon sampler is
+  started by serve()).
+
+Every other spawn is a finding. Deliberate ones (the ADR-015 refresher
+refit worker, the ADR-020 startup compile thread, the thread-per-call
+timeout shim, the reactive-track worker) are grandfathered in
+``tools/analysis/baseline.json`` with reasons — new code does NOT get
+to add spawn sites silently.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Diagnostic, FileContext, Rule
+
+#: Constructor terminal names that create a thread of execution.
+_SPAWN_NAMES = {"Thread", "Timer", "ThreadPoolExecutor", "ProcessPoolExecutor"}
+
+#: (relpath, qualname prefix) pairs sanctioned to spawn.
+SPAWN_ALLOWLIST = (
+    ("headlamp_tpu/server/app.py", "DashboardApp.serve"),
+    ("headlamp_tpu/server/app.py", "DashboardApp.start_background_sync"),
+    ("headlamp_tpu/gateway/pool.py", "RenderPool."),
+    ("headlamp_tpu/transport/pool.py", "FanoutScheduler."),
+    ("headlamp_tpu/obs/profiler.py", "SamplingProfiler."),
+)
+
+MESSAGE = (
+    "thread/executor spawned outside the sanctioned seams (serve sync "
+    "heartbeat, RenderPool, FanoutScheduler, profiler) — background "
+    "work rides an existing worker or earns a baseline entry with a "
+    "reason (ADR-021 discipline; ADR-022)"
+)
+
+
+class ThreadSpawnRule(Rule):
+    rule_id = "THR001"
+    name = "thread-spawn-discipline"
+    description = "Threads and executors are constructed only at sanctioned seams"
+    top_dirs = ("headlamp_tpu",)
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        out: list[Diagnostic] = []
+        norm = ctx.relpath.replace("\\", "/")
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (
+                func.attr
+                if isinstance(func, ast.Attribute)
+                else func.id
+                if isinstance(func, ast.Name)
+                else None
+            )
+            if name not in _SPAWN_NAMES:
+                continue
+            qual = ctx.enclosing_qualname(node.lineno)
+            if any(
+                norm == path and qual.startswith(prefix)
+                for path, prefix in SPAWN_ALLOWLIST
+            ):
+                continue
+            out.append(
+                Diagnostic(self.rule_id, ctx.relpath, node.lineno, MESSAGE, context=qual)
+            )
+        return out

@@ -1,0 +1,72 @@
+"""JIT001 — no new ``jax.jit`` entry points outside the kernel layers.
+
+Port of ``tools/no_unregistered_jit_check.py`` (ADR-020): startup is
+the only place XLA compiles; hot programs live in models//analytics//
+parallel/ where the AOT registry can see them. Identical semantics to
+the legacy gate, pinned by ``tests/test_no_unregistered_jit.py``
+through the shim.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Diagnostic, FileContext, Rule
+
+#: Attribute/function names that create an XLA program entry point.
+_JIT_NAMES = {"jit", "pmap"}
+
+MESSAGE = (
+    "jax.jit/pmap entry point outside models//analytics//parallel/ — "
+    "hot programs live in the kernel layers and are AOT-registered in "
+    "models/aot.py so the request path never compiles (ADR-020)"
+)
+
+
+class UnregisteredJitRule(Rule):
+    rule_id = "JIT001"
+    name = "no-unregistered-jit"
+    description = "jit/pmap entry points exist only in the AOT-registered kernel layers"
+    top_dirs = ("headlamp_tpu",)
+    exempt_dirs = (
+        "headlamp_tpu/models",
+        "headlamp_tpu/analytics",
+        "headlamp_tpu/parallel",
+    )
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        """Flag jit/pmap program-creation references in any form:
+        attribute access (``jax.jit(...)``, ``@jax.jit``,
+        ``partial(jax.jit, ...)``), ``from jax import jit [as alias]``
+        bindings, and bare-name loads of those bindings. Plain ``import
+        jax`` alone is fine — only reaching for the compiler is
+        flagged."""
+        tree, path = ctx.tree, ctx.relpath
+        out: list[Diagnostic] = []
+        #: Local names bound to jax.jit/pmap via ``from jax import``.
+        aliases: set[str] = set()
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module != "jax" and not (node.module or "").startswith(
+                    "jax."
+                ):
+                    continue
+                for alias in node.names:
+                    if alias.name in _JIT_NAMES:
+                        out.append(
+                            Diagnostic(self.rule_id, path, node.lineno, MESSAGE)
+                        )
+                        aliases.add(alias.asname or alias.name)
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and node.attr in _JIT_NAMES:
+                # Only attribute reads rooted at a jax-ish base stay
+                # realistic today; an unrelated object's ``.jit``
+                # attribute would still be flagged, which is the safe
+                # direction for this gate.
+                out.append(Diagnostic(self.rule_id, path, node.lineno, MESSAGE))
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in aliases:
+                    out.append(Diagnostic(self.rule_id, path, node.lineno, MESSAGE))
+        return out

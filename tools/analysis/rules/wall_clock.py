@@ -1,0 +1,114 @@
+"""WCK001 — no wall-clock reads in the injected-clock subsystems.
+
+Port of ``tools/no_wall_clock_check.py`` (ADR-013 clock discipline, the
+r07 clock-skew fix): every TTL/age/burn computation in the scoped trees
+runs on an INJECTED monotonic clock. Semantics are identical to the
+legacy gate — same violations, same sanctioned forms, same messages —
+pinned by ``tests/test_no_wall_clock.py`` running through the shim.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Diagnostic, FileContext, Rule, dotted_name
+
+CALL_MESSAGE = (
+    "wall-clock read in an injected-clock subsystem — accept a clock "
+    "seam (monotonic=..., wall=...) instead (ADR-013)"
+)
+IMPORT_MESSAGE = (
+    "`from time import time` hides wall-clock calls from review — "
+    "import the module and use an injected seam (ADR-013)"
+)
+
+#: datetime-object constructors that read the wall clock when called.
+_DATETIME_CALLS = {"now", "utcnow", "today", "fromtimestamp"}
+_WALL_FREE_DATETIME = {"fromtimestamp"}  # reads no clock: converts an arg
+
+#: time-module attributes that read the wall clock when called with no
+#: positional argument (with an argument they convert, not read).
+_ARGLESS_WALL = {"localtime", "gmtime", "ctime"}
+
+
+class WallClockRule(Rule):
+    rule_id = "WCK001"
+    name = "no-wall-clock"
+    description = (
+        "Injected-clock subsystems must not read the wall clock inline"
+    )
+    top_dirs = ("headlamp_tpu",)
+    scope_dirs = (
+        "headlamp_tpu/gateway",
+        "headlamp_tpu/history",
+        "headlamp_tpu/obs",
+        "headlamp_tpu/push",
+        "headlamp_tpu/runtime",
+        "headlamp_tpu/transport",
+    )
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        tree, path = ctx.tree, ctx.relpath
+        out: list[Diagnostic] = []
+        #: Local names bound to the time module object.
+        time_aliases = {"time"}
+        #: Local names bound to the datetime/date CLASSES.
+        datetime_aliases: set[str] = set()
+        #: Local names bound to the datetime MODULE.
+        datetime_module_aliases: set[str] = set()
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "time":
+                        time_aliases.add(alias.asname or alias.name)
+                    elif alias.name == "datetime":
+                        datetime_module_aliases.add(alias.asname or alias.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "time":
+                    for alias in node.names:
+                        if alias.name == "time":
+                            out.append(
+                                Diagnostic(
+                                    self.rule_id, path, node.lineno, IMPORT_MESSAGE
+                                )
+                            )
+                elif node.module == "datetime":
+                    for alias in node.names:
+                        if alias.name in ("datetime", "date"):
+                            datetime_aliases.add(alias.asname or alias.name)
+
+        for node in ast.walk(tree):
+            # Only CALLS are hazards; a bare time.time reference is the
+            # injectable-seam default and stays legal.
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            base = dotted_name(func.value)
+            if base in time_aliases:
+                if func.attr == "time":
+                    out.append(
+                        Diagnostic(self.rule_id, path, node.lineno, CALL_MESSAGE)
+                    )
+                elif func.attr in _ARGLESS_WALL and not node.args:
+                    out.append(
+                        Diagnostic(self.rule_id, path, node.lineno, CALL_MESSAGE)
+                    )
+            elif func.attr in _DATETIME_CALLS - _WALL_FREE_DATETIME:
+                # datetime.now(...) via the class alias or the module
+                # path (datetime.datetime.now). A tz argument does not
+                # help — the instant still comes from the wall clock.
+                if base in datetime_aliases:
+                    out.append(
+                        Diagnostic(self.rule_id, path, node.lineno, CALL_MESSAGE)
+                    )
+                elif base is not None and any(
+                    base == f"{mod}.datetime" or base == f"{mod}.date"
+                    for mod in datetime_module_aliases
+                ):
+                    out.append(
+                        Diagnostic(self.rule_id, path, node.lineno, CALL_MESSAGE)
+                    )
+        return out

@@ -1,48 +1,26 @@
 """Static gate: no direct render-path calls outside the gateway.
 
-ADR-017 puts every served request behind ``headlamp_tpu/gateway/``:
-the bounded render pool (backpressure), burn-rate load shedding, and
-whole-page coalescing only hold if there is exactly ONE door into the
-render path. A stray ``app.handle(...)`` call — or a page rendered by
-calling ``render_html``/``native_node_page``/``native_pod_page``
-directly from serving code — bypasses admission entirely: no queue
-depth cap, no shed, no coalesce key, and the "100 identical requests
-cost one render" property silently stops being true. Code cannot
-drift back: this check runs in the repo's static-check entry point
-(``tools/ts_static_check.py main()``) and in tier-1 via
-``tests/test_no_direct_render.py``.
-
-What counts as a violation:
-
-- Any attribute CALL named ``.handle(...)`` — the app's render entry.
-  The name is matched structurally (any receiver): the binding of
-  ``DashboardApp`` instances to local names is not resolvable
-  statically, and no other ``handle`` attribute exists in scope. A
-  future false positive is a rename away (or an allowlist entry with a
-  reason), which is the right friction for a load-bearing boundary.
-- Any REFERENCE (attribute access, bare name, or ``from ... import``)
-  to the page-render entry points ``render_html`` /
-  ``native_node_page`` / ``native_pod_page``. References, not just
-  calls — passing the renderer as a callback bypasses the gateway
-  identically (same rule as the no-inline-fit gate).
-
-Scope: ``headlamp_tpu/`` plus ``tools/``, minus the defining and
-sanctioned layers — ``headlamp_tpu/gateway/`` (the admission layer
-itself), ``headlamp_tpu/server/app.py`` (defines ``handle``, hosts the
-page dispatch, and wires the gateway), ``headlamp_tpu/ui/`` (defines
-``render_html``), ``headlamp_tpu/pages/`` (defines the native pages),
-and ``tools/make_screenshots.py`` (offline artifact generator — no
-traffic to admit). ``tests/`` and ``bench.py`` are exempt — they call
-``handle`` directly ON PURPOSE, to measure the handler with and
-without admission.
+Compatibility shim (ADR-022). The check lives in
+``tools/analysis/rules/direct_render.py`` (rule ``RND001``) and runs
+in the single-pass engine; this module keeps the legacy CLI and the
+``_check_source``/``check_tree`` API that
+``tests/test_no_direct_render.py`` pins — legacy diagnostic format
+(``path:line: message``), absolute paths from ``check_tree``. ADR-017
+rationale and the exact flagged forms are documented on the rule.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from dataclasses import dataclass
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from analysis.engine import Engine  # noqa: E402
+from analysis.rules.direct_render import DirectRenderRule  # noqa: E402
 
 
 @dataclass
@@ -55,79 +33,29 @@ class Diagnostic:
         return f"{self.path}:{self.line}: {self.message}"
 
 
-#: Page-render entry points whose references are gated.
-RENDER_NAMES = ("render_html", "native_node_page", "native_pod_page")
-
-_HANDLE_MESSAGE = (
-    "direct .handle() call outside gateway/ — serving code must route "
-    "through RenderGateway.handle (admission, shed, coalesce; ADR-017)"
-)
-_RENDER_MESSAGE = (
-    "direct page-render reference outside ui//pages//server — rendering "
-    "belongs behind the gateway's admission layer (ADR-017)"
-)
+def _repo_root() -> str:
+    return os.path.dirname(_TOOLS_DIR)
 
 
 def _check_source(path: str, src: str) -> list[Diagnostic]:
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [Diagnostic(path, e.lineno or 1, f"unparseable: {e.msg}")]
-
-    out: list[Diagnostic] = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            func = node.func
-            if isinstance(func, ast.Attribute) and func.attr == "handle":
-                out.append(Diagnostic(path, node.lineno, _HANDLE_MESSAGE))
-        if isinstance(node, ast.Attribute) and node.attr in RENDER_NAMES:
-            out.append(Diagnostic(path, node.lineno, _RENDER_MESSAGE))
-        elif isinstance(node, ast.Name) and node.id in RENDER_NAMES:
-            out.append(Diagnostic(path, node.lineno, _RENDER_MESSAGE))
-        elif isinstance(node, ast.ImportFrom):
-            for alias in node.names:
-                if alias.name in RENDER_NAMES:
-                    out.append(Diagnostic(path, node.lineno, _RENDER_MESSAGE))
-    return out
-
-
-def _repo_root() -> str:
-    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rule = DirectRenderRule()
+    engine = Engine([rule], root=_repo_root())
+    return [
+        Diagnostic(d.path, d.line, d.message)
+        for d in engine.check_source(rule, path, src)
+    ]
 
 
 def check_tree(root: str | None = None) -> list[Diagnostic]:
     """Scan the gateway-funnel scope under ``root`` (repo root by
     default). Returns [] when clean."""
     root = root or _repo_root()
-    exempt_dirs = (
-        os.path.join(root, "headlamp_tpu", "gateway"),
-        os.path.join(root, "headlamp_tpu", "ui"),
-        os.path.join(root, "headlamp_tpu", "pages"),
-    )
-    exempt_files = {
-        os.path.abspath(os.path.join(root, "headlamp_tpu", "server", "app.py")),
-        os.path.abspath(os.path.join(root, "tools", "make_screenshots.py")),
-    }
-    targets: list[str] = []
-    for top in ("headlamp_tpu", "tools"):
-        base = os.path.join(root, top)
-        for dirpath, _dirnames, filenames in os.walk(base):
-            if any(
-                os.path.abspath(dirpath).startswith(os.path.abspath(d))
-                for d in exempt_dirs
-            ):
-                continue
-            for filename in sorted(filenames):
-                if filename.endswith(".py"):
-                    path = os.path.join(dirpath, filename)
-                    if os.path.abspath(path) not in exempt_files:
-                        targets.append(path)
-
-    diagnostics: list[Diagnostic] = []
-    for path in targets:
-        with open(path, "r", encoding="utf-8") as f:
-            diagnostics.extend(_check_source(path, f.read()))
-    return diagnostics
+    engine = Engine([DirectRenderRule()], root=root)
+    result = engine.run()
+    return [
+        Diagnostic(os.path.join(root, *d.path.split("/")), d.line, d.message)
+        for d in result.diagnostics + result.suppressed
+    ]
 
 
 def main() -> int:

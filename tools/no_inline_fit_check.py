@@ -1,37 +1,27 @@
 """Static gate: no direct ``fit_and_forecast*`` calls outside the
 model layer.
 
-ADR-015 moves the forecast fit off the request path: request handlers
-read through the stale-while-revalidate refresher
-(`headlamp_tpu/runtime/refresh.py`), which serves a cached view and
-refits on a background worker. A direct ``fit_and_forecast`` /
-``fit_and_forecast_with_dispatch`` / ``fit_and_forecast_incremental``
-call anywhere in the serving tree silently re-introduces the
-multi-second request-path cold fit (BENCH_r06's 2451 ms cliff) that
-this design removed. Code cannot drift back: this check runs in the
-repo's static-check entry point (``tools/ts_static_check.py main()``)
-and in tier-1 via ``tests/test_no_inline_fit.py``.
-
-Scope: ``headlamp_tpu/`` minus ``headlamp_tpu/models/`` (the defining
-layer — its service glue is the one sanctioned call site) and
-``headlamp_tpu/runtime/refresh.py``, plus ``tools/``. ``tests/`` and
-``bench.py`` are exempt — both call the fit entries directly ON
-PURPOSE, to measure and to pin warm/cold parity.
-
-AST-based, not grep: matches ``fit_and_forecast*`` attribute access,
-bare-name references, and ``from ... import fit_and_forecast[_...]``
-forms without false-positives on comments, docstrings, or this file's
-own prose. References count, not just calls — passing the function as
-a compute callback from a request handler bypasses the refresher's
-scheduling identically.
+Compatibility shim (ADR-022). The check lives in
+``tools/analysis/rules/inline_fit.py`` (rule ``FIT001``) and runs in
+the single-pass engine; this module keeps the legacy CLI and the
+``_check_source``/``check_tree`` API that ``tests/test_no_inline_fit.py``
+pins — legacy diagnostic format (``path:line: message``), absolute
+paths from ``check_tree``. ADR-015 rationale and the exact flagged
+forms are documented on the rule.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from dataclasses import dataclass
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from analysis.engine import Engine  # noqa: E402
+from analysis.rules.inline_fit import InlineFitRule  # noqa: E402
 
 
 @dataclass
@@ -44,80 +34,29 @@ class Diagnostic:
         return f"{self.path}:{self.line}: {self.message}"
 
 
-_PREFIX = "fit_and_forecast"
-
-_MESSAGE = (
-    "direct fit_and_forecast* reference outside models/ — request-path "
-    "code must go through the stale-while-revalidate refresher "
-    "(runtime/refresh.py, ADR-015)"
-)
+def _repo_root() -> str:
+    return os.path.dirname(_TOOLS_DIR)
 
 
 def _check_source(path: str, src: str) -> list[Diagnostic]:
-    """Flag ``fit_and_forecast*`` references in any form: attribute
-    access on any base (``forecast.fit_and_forecast(...)``), bare-name
-    loads, and the ``from m import fit_and_forecast_x [as y]`` imports
-    that bind them locally. The import itself is flagged — an unused
-    import of a fit entry in serving code is already drift."""
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [Diagnostic(path, e.lineno or 1, f"unparseable: {e.msg}")]
-
-    out: list[Diagnostic] = []
-    #: Local names bound to a fit entry via ``from ... import`` aliases
-    #: (``from ..models import fit_and_forecast as f``).
-    func_aliases: set[str] = set()
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom):
-            for alias in node.names:
-                if alias.name.startswith(_PREFIX):
-                    out.append(Diagnostic(path, node.lineno, _MESSAGE))
-                    if alias.asname:
-                        func_aliases.add(alias.asname)
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Attribute) and node.attr.startswith(_PREFIX):
-            out.append(Diagnostic(path, node.lineno, _MESSAGE))
-        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
-            if node.id.startswith(_PREFIX) or node.id in func_aliases:
-                out.append(Diagnostic(path, node.lineno, _MESSAGE))
-    return out
-
-
-def _repo_root() -> str:
-    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rule = InlineFitRule()
+    engine = Engine([rule], root=_repo_root())
+    return [
+        Diagnostic(d.path, d.line, d.message)
+        for d in engine.check_source(rule, path, src)
+    ]
 
 
 def check_tree(root: str | None = None) -> list[Diagnostic]:
     """Scan the refresher-funnel scope under ``root`` (repo root by
     default). Returns [] when clean."""
     root = root or _repo_root()
-    exempt_dirs = (os.path.join(root, "headlamp_tpu", "models"),)
-    exempt_files = {
-        os.path.abspath(os.path.join(root, "headlamp_tpu", "runtime", "refresh.py")),
-    }
-    targets: list[str] = []
-    for top in ("headlamp_tpu", "tools"):
-        base = os.path.join(root, top)
-        for dirpath, _dirnames, filenames in os.walk(base):
-            if any(
-                os.path.abspath(dirpath).startswith(os.path.abspath(d))
-                for d in exempt_dirs
-            ):
-                continue
-            for filename in sorted(filenames):
-                if filename.endswith(".py"):
-                    path = os.path.join(dirpath, filename)
-                    if os.path.abspath(path) not in exempt_files:
-                        targets.append(path)
-
-    diagnostics: list[Diagnostic] = []
-    for path in targets:
-        with open(path, "r", encoding="utf-8") as f:
-            diagnostics.extend(_check_source(path, f.read()))
-    return diagnostics
+    engine = Engine([InlineFitRule()], root=root)
+    result = engine.run()
+    return [
+        Diagnostic(os.path.join(root, *d.path.split("/")), d.line, d.message)
+        for d in result.diagnostics + result.suppressed
+    ]
 
 
 def main() -> int:

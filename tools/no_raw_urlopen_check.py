@@ -1,31 +1,26 @@
 """Static gate: no raw ``urllib.request.urlopen`` outside ``transport/``.
 
-ADR-014 funnels every HTTP call through the keep-alive connection pool
-(`headlamp_tpu/transport/pool.py`). A raw ``urlopen`` anywhere else
-silently re-introduces a fresh TCP(+TLS) handshake per call — exactly
-the per-round-trip tax the pool exists to amortize — and it leaks the
-``HTTPError`` response object on non-2xx raise paths (the bug this
-PR's transport rewrite removed). Code cannot drift back: this check
-runs in the repo's static-check entry point (``tools/ts_static_check.py
-main()``) and in tier-1 via ``tests/test_no_raw_urlopen.py``.
-
-Scope: ``headlamp_tpu/`` (minus ``headlamp_tpu/transport/``, which is
-the one sanctioned implementation site), ``bench.py``, and ``tools/``.
-``tests/`` is exempt — tests use ``urlopen`` as a plain HTTP *client*
-against the server under test, where pooling semantics would get in
-the way of connection-lifecycle assertions.
-
-AST-based, not grep: matches ``urllib.request.urlopen(...)`` and the
-``from urllib.request import urlopen`` / aliased-module forms without
-false-positives on comments, docstrings, or this file's own prose.
+Compatibility shim (ADR-022). The check lives in
+``tools/analysis/rules/raw_urlopen.py`` (rule ``URL001``) and runs in
+the single-pass engine; this module keeps the legacy CLI and the
+``_check_source``/``check_tree`` API that ``tests/test_no_raw_urlopen.py``
+pins — legacy diagnostic format (``path:line: message``), absolute
+paths from ``check_tree``. ADR-014 rationale and the exact flagged
+forms are documented on the rule.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from dataclasses import dataclass
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from analysis.engine import Engine  # noqa: E402
+from analysis.rules.raw_urlopen import RawUrlopenRule  # noqa: E402
 
 
 @dataclass
@@ -38,92 +33,29 @@ class Diagnostic:
         return f"{self.path}:{self.line}: {self.message}"
 
 
-_MESSAGE = (
-    "raw urllib.request.urlopen outside transport/ — route this call "
-    "through the keep-alive ConnectionPool (ADR-014)"
-)
+def _repo_root() -> str:
+    return os.path.dirname(_TOOLS_DIR)
 
 
 def _check_source(path: str, src: str) -> list[Diagnostic]:
-    """Flag urlopen references reachable from ``urllib.request``:
-    direct attribute calls, module aliases (``import urllib.request as
-    r``), and name imports (``from urllib.request import urlopen [as
-    x]``). References count, not just calls — passing ``urlopen`` as a
-    callback bypasses the pool identically."""
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [Diagnostic(path, e.lineno or 1, f"unparseable: {e.msg}")]
-
-    out: list[Diagnostic] = []
-    #: Local names bound to the urllib.request module object.
-    module_aliases = {"urllib.request"}
-    #: Local names bound to the urlopen function itself.
-    func_aliases: set[str] = set()
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                if alias.name == "urllib.request" and alias.asname:
-                    module_aliases.add(alias.asname)
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "urllib.request":
-                for alias in node.names:
-                    if alias.name == "urlopen":
-                        func_aliases.add(alias.asname or alias.name)
-            elif node.module == "urllib":
-                for alias in node.names:
-                    if alias.name == "request":
-                        module_aliases.add(alias.asname or alias.name)
-
-    def dotted(expr: ast.AST) -> str | None:
-        parts: list[str] = []
-        while isinstance(expr, ast.Attribute):
-            parts.append(expr.attr)
-            expr = expr.value
-        if isinstance(expr, ast.Name):
-            parts.append(expr.id)
-            return ".".join(reversed(parts))
-        return None
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Attribute) and node.attr == "urlopen":
-            base = dotted(node.value)
-            if base in module_aliases:
-                out.append(Diagnostic(path, node.lineno, _MESSAGE))
-        elif isinstance(node, ast.Name) and node.id in func_aliases:
-            if isinstance(node.ctx, ast.Load):
-                out.append(Diagnostic(path, node.lineno, _MESSAGE))
-    return out
-
-
-def _repo_root() -> str:
-    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rule = RawUrlopenRule()
+    engine = Engine([rule], root=_repo_root())
+    return [
+        Diagnostic(d.path, d.line, d.message)
+        for d in engine.check_source(rule, path, src)
+    ]
 
 
 def check_tree(root: str | None = None) -> list[Diagnostic]:
     """Scan the pooled-HTTP scope under ``root`` (repo root by
     default). Returns [] when clean."""
     root = root or _repo_root()
-    exempt_dir = os.path.join(root, "headlamp_tpu", "transport")
-    targets: list[str] = []
-    for top in ("headlamp_tpu", "tools"):
-        base = os.path.join(root, top)
-        for dirpath, _dirnames, filenames in os.walk(base):
-            if os.path.abspath(dirpath).startswith(os.path.abspath(exempt_dir)):
-                continue
-            for filename in sorted(filenames):
-                if filename.endswith(".py"):
-                    targets.append(os.path.join(dirpath, filename))
-    bench = os.path.join(root, "bench.py")
-    if os.path.exists(bench):
-        targets.append(bench)
-
-    diagnostics: list[Diagnostic] = []
-    for path in targets:
-        with open(path, "r", encoding="utf-8") as f:
-            diagnostics.extend(_check_source(path, f.read()))
-    return diagnostics
+    engine = Engine([RawUrlopenRule()], root=root)
+    result = engine.run()
+    return [
+        Diagnostic(os.path.join(root, *d.path.split("/")), d.line, d.message)
+        for d in result.diagnostics + result.suppressed
+    ]
 
 
 def main() -> int:

@@ -1,47 +1,27 @@
 """Static gate: no new ``jax.jit`` entry points outside the kernel
 layers.
 
-ADR-020 makes startup the only place XLA compiles: every hot jitted
-program lives in ``headlamp_tpu/models/`` / ``headlamp_tpu/analytics/``
-/ ``headlamp_tpu/parallel/`` and is AOT-compiled by the
-``models/aot.py`` registry at its canonical bucketed shapes, so the
-request path never pays a compile after warmup. A ``jax.jit`` call
-added anywhere ELSE in the serving tree creates a program the registry
-has never heard of — its first request at every novel shape recompiles
-inline, exactly the first-request latency cliff this design removed,
-and the zero-request-compiles acceptance gate would rot silently.
-
-This check makes the drift loud: ``jax.jit`` / ``jax.pmap`` references
-(call, decorator, ``functools.partial(jax.jit, ...)``, ``from jax
-import jit``) are forbidden in ``headlamp_tpu/`` outside the three
-kernel packages. A genuinely new jit entry point belongs in one of
-those packages WITH a builder registered in
-``models/aot.py``'s ``_BUILDERS`` table — that is the "unless
-AOT-registered" escape hatch, enforced by construction (code inside the
-sanctioned packages is where registration is possible and reviewed).
-
-Scope: ``headlamp_tpu/`` minus the three kernel packages. ``tests/``,
-``tools/``, and ``bench.py`` are exempt — they jit throwaway probe
-programs on purpose (cache-key experiments, compile-cost measurement).
-
-AST-based, not grep, mirroring ``no_raw_urlopen_check``: matches
-attribute access on any base (``jax.jit``, ``j.jit`` won't slip by an
-alias because the attribute name itself is matched), bare names bound
-by ``from jax import jit [as j]``, and flags the import itself —
-an unused jit import in serving code is already drift. Comments,
-docstrings, and prose never parse as references.
-
-Runs in the repo's static-check entry point
-(``tools/ts_static_check.py main()``) and in tier-1 via
-``tests/test_no_unregistered_jit.py``.
+Compatibility shim (ADR-022). The check lives in
+``tools/analysis/rules/unregistered_jit.py`` (rule ``JIT001``) and
+runs in the single-pass engine; this module keeps the legacy CLI and
+the ``_check_source``/``check_tree`` API that
+``tests/test_no_unregistered_jit.py`` pins — legacy diagnostic format
+(``path:line: message``), absolute paths from ``check_tree``. ADR-020
+rationale and the exact flagged forms are documented on the rule.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from dataclasses import dataclass
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from analysis.engine import Engine  # noqa: E402
+from analysis.rules.unregistered_jit import UnregisteredJitRule  # noqa: E402
 
 
 @dataclass
@@ -54,83 +34,29 @@ class Diagnostic:
         return f"{self.path}:{self.line}: {self.message}"
 
 
-#: Attribute/function names that create an XLA program entry point.
-_JIT_NAMES = {"jit", "pmap"}
-
-_MESSAGE = (
-    "jax.jit/pmap entry point outside models//analytics//parallel/ — "
-    "hot programs live in the kernel layers and are AOT-registered in "
-    "models/aot.py so the request path never compiles (ADR-020)"
-)
+def _repo_root() -> str:
+    return os.path.dirname(_TOOLS_DIR)
 
 
 def _check_source(path: str, src: str) -> list[Diagnostic]:
-    """Flag jit/pmap program-creation references in any form: attribute
-    access (``jax.jit(...)``, ``@jax.jit``, ``partial(jax.jit, ...)``),
-    ``from jax import jit [as alias]`` bindings, and bare-name loads of
-    those bindings. Plain ``import jax`` alone is fine — only reaching
-    for the compiler is flagged."""
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [Diagnostic(path, e.lineno or 1, f"unparseable: {e.msg}")]
-
-    out: list[Diagnostic] = []
-    #: Local names bound to jax.jit/pmap via ``from jax import ...``.
-    aliases: set[str] = set()
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom):
-            if node.module != "jax" and not (
-                node.module or ""
-            ).startswith("jax."):
-                continue
-            for alias in node.names:
-                if alias.name in _JIT_NAMES:
-                    out.append(Diagnostic(path, node.lineno, _MESSAGE))
-                    aliases.add(alias.asname or alias.name)
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Attribute) and node.attr in _JIT_NAMES:
-            # Only attribute reads rooted at a jax-ish base: ``jax.jit``
-            # or ``jax.numpy... .jit`` — an unrelated object's ``.jit``
-            # attribute (none exist today) would still be flagged, which
-            # is the safe direction for this gate.
-            out.append(Diagnostic(path, node.lineno, _MESSAGE))
-        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
-            if node.id in aliases:
-                out.append(Diagnostic(path, node.lineno, _MESSAGE))
-    return out
-
-
-def _repo_root() -> str:
-    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rule = UnregisteredJitRule()
+    engine = Engine([rule], root=_repo_root())
+    return [
+        Diagnostic(d.path, d.line, d.message)
+        for d in engine.check_source(rule, path, src)
+    ]
 
 
 def check_tree(root: str | None = None) -> list[Diagnostic]:
-    """Scan ``headlamp_tpu/`` minus the kernel packages under ``root``
-    (repo root by default). Returns [] when clean."""
+    """Scan the AOT-registration scope under ``root`` (repo root by
+    default). Returns [] when clean."""
     root = root or _repo_root()
-    base = os.path.join(root, "headlamp_tpu")
-    exempt_dirs = tuple(
-        os.path.abspath(os.path.join(base, d))
-        for d in ("models", "analytics", "parallel")
-    )
-    targets: list[str] = []
-    for dirpath, _dirnames, filenames in os.walk(base):
-        if any(
-            os.path.abspath(dirpath).startswith(d) for d in exempt_dirs
-        ):
-            continue
-        for filename in sorted(filenames):
-            if filename.endswith(".py"):
-                targets.append(os.path.join(dirpath, filename))
-
-    diagnostics: list[Diagnostic] = []
-    for path in targets:
-        with open(path, "r", encoding="utf-8") as f:
-            diagnostics.extend(_check_source(path, f.read()))
-    return diagnostics
+    engine = Engine([UnregisteredJitRule()], root=root)
+    result = engine.run()
+    return [
+        Diagnostic(os.path.join(root, *d.path.split("/")), d.line, d.message)
+        for d in result.diagnostics + result.suppressed
+    ]
 
 
 def main() -> int:

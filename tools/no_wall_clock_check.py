@@ -1,51 +1,27 @@
 """Static gate: no wall-clock reads in the injected-clock subsystems.
 
-ADR-013's clock discipline (and the r07 clock-skew fix) made every
-TTL/age/burn computation in ``obs/``, ``runtime/``, and ``transport/``
-run on an INJECTED monotonic clock: an NTP step must never fake cache
-freshness, wedge a health probe, or flip an SLO burn state, and tests
-must drive time with a list cell instead of sleeping. A stray
-``time.time()`` (or argless ``datetime.now()``) in those trees silently
-re-couples the logic to the host's wall clock. Code cannot drift back:
-this check runs in the repo's static-check entry point
-(``tools/ts_static_check.py main()``) and in tier-1 via
-``tests/test_no_wall_clock.py``.
-
-What counts as a violation — CALLS that read the wall clock:
-
-- ``time.time()`` (any alias of the ``time`` module)
-- ``datetime.now()`` / ``datetime.utcnow()`` / ``datetime.today()`` /
-  ``date.today()`` via the class or module path, in ANY call form — a
-  tz argument changes the representation, not the wall-clock read
-- ``from time import time`` (the import itself — any later bare
-  ``time()`` call would be invisible to a reference scan)
-
-What is deliberately ALLOWED:
-
-- Bare references like ``wall: Any = time.time`` — the injectable-seam
-  DEFAULT. The seam pattern is the sanctioned idiom: the reference is
-  stored and called by the app layer (outside this scope) or under an
-  injected override in tests.
-- ``time.monotonic`` / ``time.perf_counter`` in any form — monotonic
-  sources are the contract, not the hazard.
-- ``time.strftime`` / ``time.localtime`` — formatting an ALREADY
-  CAPTURED wall stamp for display (the waterfall page) reads no clock
-  when given an argument; argless ``time.localtime()`` does, and is
-  flagged.
-
-Scope: ``headlamp_tpu/gateway/``, ``headlamp_tpu/obs/``,
-``headlamp_tpu/runtime/``, ``headlamp_tpu/transport/``. The
-app/server layer is exempt — it is
-where wall clocks legitimately enter (as injected defaults), and
-``tests/`` drives both kinds of clock explicitly.
+Compatibility shim (ADR-022). The check itself lives in
+``tools/analysis/rules/wall_clock.py`` (rule ``WCK001``) and runs in
+the single-pass engine; this module keeps the legacy CLI and the
+``_check_source``/``check_tree`` API that ``tests/test_no_wall_clock.py``
+and downstream tooling pin, including the legacy diagnostic format
+(``path:line: message`` — no rule tag) and absolute paths from
+``check_tree``. Semantics — what is flagged, what is deliberately
+allowed, and the ADR-013 rationale — are documented on the rule.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from dataclasses import dataclass
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+from analysis.engine import Engine  # noqa: E402
+from analysis.rules.wall_clock import WallClockRule  # noqa: E402
 
 
 @dataclass
@@ -58,125 +34,36 @@ class Diagnostic:
         return f"{self.path}:{self.line}: {self.message}"
 
 
-_CALL_MESSAGE = (
-    "wall-clock read in an injected-clock subsystem — accept a clock "
-    "seam (monotonic=..., wall=...) instead (ADR-013)"
-)
-_IMPORT_MESSAGE = (
-    "`from time import time` hides wall-clock calls from review — "
-    "import the module and use an injected seam (ADR-013)"
-)
+def _repo_root() -> str:
+    return os.path.dirname(_TOOLS_DIR)
 
-#: datetime-object constructors that read the wall clock when called.
-_DATETIME_CALLS = {"now", "utcnow", "today", "fromtimestamp"}
-_WALL_FREE_DATETIME = {"fromtimestamp"}  # reads no clock: converts an arg
 
-#: time-module attributes that read the wall clock when called with no
-#: positional argument (with an argument they convert, not read).
-_ARGLESS_WALL = {"localtime", "gmtime", "ctime"}
+#: The injected-clock subtrees (relative to the repo root) — mirrors
+#: the rule's scope_dirs; kept for callers that introspect the gate.
+SCOPE = tuple(
+    os.path.join(*d.split("/")) for d in WallClockRule.scope_dirs
+)
 
 
 def _check_source(path: str, src: str) -> list[Diagnostic]:
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [Diagnostic(path, e.lineno or 1, f"unparseable: {e.msg}")]
-
-    out: list[Diagnostic] = []
-    #: Local names bound to the time module object.
-    time_aliases = {"time"}
-    #: Local names bound to the datetime/date CLASSES.
-    datetime_aliases: set[str] = set()
-    #: Local names bound to the datetime MODULE.
-    datetime_module_aliases: set[str] = set()
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                if alias.name == "time":
-                    time_aliases.add(alias.asname or alias.name)
-                elif alias.name == "datetime":
-                    datetime_module_aliases.add(alias.asname or alias.name)
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "time":
-                for alias in node.names:
-                    if alias.name == "time":
-                        out.append(Diagnostic(path, node.lineno, _IMPORT_MESSAGE))
-            elif node.module == "datetime":
-                for alias in node.names:
-                    if alias.name in ("datetime", "date"):
-                        datetime_aliases.add(alias.asname or alias.name)
-
-    def dotted(expr: ast.AST) -> str | None:
-        parts: list[str] = []
-        while isinstance(expr, ast.Attribute):
-            parts.append(expr.attr)
-            expr = expr.value
-        if isinstance(expr, ast.Name):
-            parts.append(expr.id)
-            return ".".join(reversed(parts))
-        return None
-
-    for node in ast.walk(tree):
-        # Only CALLS are hazards; a bare time.time reference is the
-        # injectable-seam default and stays legal.
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        if not isinstance(func, ast.Attribute):
-            continue
-        base = dotted(func.value)
-        if base in time_aliases:
-            if func.attr == "time":
-                out.append(Diagnostic(path, node.lineno, _CALL_MESSAGE))
-            elif func.attr in _ARGLESS_WALL and not node.args:
-                out.append(Diagnostic(path, node.lineno, _CALL_MESSAGE))
-        elif func.attr in _DATETIME_CALLS - _WALL_FREE_DATETIME:
-            # datetime.now(...) via the class alias or the module path
-            # (datetime.datetime.now). A tz argument does not help — the
-            # instant still comes from the wall clock.
-            if base in datetime_aliases:
-                out.append(Diagnostic(path, node.lineno, _CALL_MESSAGE))
-            elif base is not None and any(
-                base == f"{mod}.datetime" or base == f"{mod}.date"
-                for mod in datetime_module_aliases
-            ):
-                out.append(Diagnostic(path, node.lineno, _CALL_MESSAGE))
-    return out
-
-
-def _repo_root() -> str:
-    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-#: The injected-clock subtrees (relative to the repo root).
-SCOPE = (
-    os.path.join("headlamp_tpu", "gateway"),
-    os.path.join("headlamp_tpu", "history"),
-    os.path.join("headlamp_tpu", "obs"),
-    os.path.join("headlamp_tpu", "push"),
-    os.path.join("headlamp_tpu", "runtime"),
-    os.path.join("headlamp_tpu", "transport"),
-)
+    rule = WallClockRule()
+    engine = Engine([rule], root=_repo_root())
+    return [
+        Diagnostic(d.path, d.line, d.message)
+        for d in engine.check_source(rule, path, src)
+    ]
 
 
 def check_tree(root: str | None = None) -> list[Diagnostic]:
     """Scan the injected-clock scope under ``root`` (repo root by
     default). Returns [] when clean."""
     root = root or _repo_root()
-    targets: list[str] = []
-    for rel in SCOPE:
-        base = os.path.join(root, rel)
-        for dirpath, _dirnames, filenames in os.walk(base):
-            for filename in sorted(filenames):
-                if filename.endswith(".py"):
-                    targets.append(os.path.join(dirpath, filename))
-
-    diagnostics: list[Diagnostic] = []
-    for path in targets:
-        with open(path, "r", encoding="utf-8") as f:
-            diagnostics.extend(_check_source(path, f.read()))
-    return diagnostics
+    engine = Engine([WallClockRule()], root=root)
+    result = engine.run()
+    return [
+        Diagnostic(os.path.join(root, *d.path.split("/")), d.line, d.message)
+        for d in result.diagnostics + result.suppressed
+    ]
 
 
 def main() -> int:

@@ -1451,6 +1451,17 @@ def check_tree(root: str) -> list[Diagnostic]:
     return diagnostics
 
 
+#: (rule id, legacy summary noun) in the order the gates historically
+#: printed — output format is pinned by the gate tests and CI greps.
+_PY_GATES = (
+    ("URL001", "raw-urlopen"),      # ADR-014 transport funnel
+    ("FIT001", "inline-fit"),       # ADR-015 refresher funnel
+    ("WCK001", "wall-clock"),       # ADR-013/016 clock discipline
+    ("RND001", "direct-render"),    # ADR-017 gateway funnel
+    ("JIT001", "unregistered-jit"), # ADR-020 AOT registration
+)
+
+
 def main() -> int:
     root = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "plugin", "src"
@@ -1459,52 +1470,53 @@ def main() -> int:
     for diag in diagnostics:
         print(diag)
     print(f"{len(diagnostics)} problem(s) in {root}")
-    # Python-side transport gate rides the same entry point (ADR-014):
-    # no raw urllib.request.urlopen outside headlamp_tpu/transport/.
-    import no_raw_urlopen_check
 
-    urlopen_diags = no_raw_urlopen_check.check_tree()
-    for diag in urlopen_diags:
-        print(diag)
-    print(f"{len(urlopen_diags)} raw-urlopen problem(s)")
-    # Forecast-fit gate rides along too (ADR-015): request handlers go
-    # through the refresher, never call fit_and_forecast* inline.
-    import no_inline_fit_check
+    # Python-side gates ride the same entry point, all off ONE
+    # single-pass engine run (ADR-022): one ast.parse per file feeds
+    # every rule, replacing the five separate tree walks this main()
+    # used to chain. Per-gate sections keep the legacy format.
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    from analysis.engine import (
+        Engine,
+        default_baseline_path,
+        load_baseline,
+        repo_root,
+    )
 
-    fit_diags = no_inline_fit_check.check_tree()
-    for diag in fit_diags:
+    engine = Engine(baseline=load_baseline(default_baseline_path()))
+    result = engine.run()
+    assert result.files_parsed_once, "single-pass contract broken"
+    legacy_ids = {rule_id for rule_id, _ in _PY_GATES}
+    for rule_id, noun in _PY_GATES:
+        gate_diags = result.for_rule(rule_id)
+        for diag in gate_diags:
+            # Legacy gate format: absolute path, no rule tag.
+            print(
+                f"{os.path.join(repo_root(), *diag.path.split('/'))}:"
+                f"{diag.line}: {diag.message}"
+            )
+        print(f"{len(gate_diags)} {noun} problem(s)")
+    # Engine-native rules (HTL001 lock discipline, EXC001 exception
+    # breadth, THR001 thread spawns, SYN001 metricsz allowlist sync,
+    # PAR000 parse failures) report in engine format, with the
+    # suppression/baseline accounting the legacy gates never had.
+    analysis_diags = [d for d in result.diagnostics if d.rule not in legacy_ids]
+    for diag in analysis_diags:
         print(diag)
-    print(f"{len(fit_diags)} inline-fit problem(s)")
-    # Clock-discipline gate (ADR-013/ADR-016): no wall-clock reads in
-    # obs/, runtime/, transport/ — injected monotonic is the contract.
-    import no_wall_clock_check
-
-    wall_diags = no_wall_clock_check.check_tree()
-    for diag in wall_diags:
-        print(diag)
-    print(f"{len(wall_diags)} wall-clock problem(s)")
-    # Gateway-funnel gate (ADR-017): serving code reaches the render
-    # path only through RenderGateway — no direct .handle()/render
-    # calls outside gateway/ and the sanctioned wiring.
-    import no_direct_render_check
-
-    render_diags = no_direct_render_check.check_tree()
-    for diag in render_diags:
-        print(diag)
-    print(f"{len(render_diags)} direct-render problem(s)")
-    # AOT-registration gate (ADR-020): no jax.jit entry points outside
-    # the kernel layers — hot programs are startup-compiled, never
-    # request-compiled.
-    import no_unregistered_jit_check
-
-    jit_diags = no_unregistered_jit_check.check_tree()
-    for diag in jit_diags:
-        print(diag)
-    print(f"{len(jit_diags)} unregistered-jit problem(s)")
-    return 1 if (
-        diagnostics or urlopen_diags or fit_diags or wall_diags
-        or render_diags or jit_diags
-    ) else 0
+    for entry in result.stale_baseline:
+        print(
+            f"{entry['path']}: STALE baseline entry for {entry['rule']} "
+            f"({entry['context']}) matches nothing — remove it"
+        )
+    print(
+        f"{len(analysis_diags)} analysis problem(s), "
+        f"{len(result.suppressed)} suppressed, "
+        f"{len(result.baselined)} baselined, "
+        f"{len(result.stale_baseline)} stale baseline entr(y/ies)"
+    )
+    return 1 if (diagnostics or not result.ok) else 0
 
 
 if __name__ == "__main__":
