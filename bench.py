@@ -1946,15 +1946,61 @@ def bench_analysis() -> dict:
             gate.check_tree()
         legacy_samples.append((time.perf_counter() - t0) * 1000.0)
 
+    # The flow layer (ADR-023: call graph + CFGs) rides the same run,
+    # so files_parsed_once above IS the proof it never re-parses.
     assert result is not None and result.ok, "analysis run must be clean"
     assert result.files_parsed_once, "single-pass contract broken"
+    wall_ms = round(statistics.median(unified_samples), 2)
+
+    # Fail-soft regression gate on the engine itself: compare against
+    # the latest committed round and FLAG >25% growth (the flow layer
+    # must not quietly double the gate's cost). Reporting only — the
+    # bench never fails because history is absent or malformed.
+    prev_wall_ms = None
+    regressed = False
+    try:
+        import glob as _glob
+        import re as _re
+
+        newest = None
+        here = os.path.dirname(os.path.abspath(__file__))
+        for path in _glob.glob(os.path.join(here, "BENCH_r*.json")):
+            m = _re.search(r"BENCH_r(\d+)\.json$", path)
+            if m and (newest is None or int(m.group(1)) > newest[0]):
+                newest = (int(m.group(1)), path)
+        if newest is not None:
+            with open(newest[1], "r", encoding="utf-8") as f:
+                prev = json.load(f)
+            prev_extra = prev.get("parsed", prev).get("extra") or {}
+            pv = prev_extra.get("analysis_wall_ms")
+            if isinstance(pv, (int, float)) and pv > 0:
+                prev_wall_ms = pv
+                regressed = wall_ms / pv > 1.25
+                if regressed:
+                    print(
+                        f"[bench] analysis_wall_ms regressed >25% vs "
+                        f"{os.path.basename(newest[1])}: {pv} -> {wall_ms}",
+                        file=sys.stderr,
+                    )
+    except Exception as exc:
+        print(f"[bench] analysis wall comparison skipped: {exc!r}", file=sys.stderr)
+
+    flow_rules = sum(
+        1 for r in all_rules() if r.rule_id in ("HTL002", "LCK002", "REL001", "OBS001")
+    )
     return {
-        "analysis_wall_ms": round(statistics.median(unified_samples), 2),
+        "analysis_wall_ms": wall_ms,
         "analysis_legacy_5walk_ms": round(statistics.median(legacy_samples), 2),
         "analysis_files_scanned": len(result.parse_counts),
         "analysis_rules": len(all_rules()),
+        "analysis_flow_rules": flow_rules,
         "analysis_suppressed": len(result.suppressed),
         "analysis_baselined": len(result.baselined),
+        # prev_round prefix => skipped by compare_prev_round (it would
+        # compare prev against prev-prev); the explicit flag above is
+        # the comparator for this key.
+        "prev_round_analysis_wall_ms": prev_wall_ms,
+        "analysis_wall_regressed": regressed,
         "files_parsed_once": True,
     }
 

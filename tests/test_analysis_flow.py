@@ -1,0 +1,762 @@
+"""ADR-023 flow layer (tools/analysis/flow/ + the four flow rules).
+
+What this file pins:
+
+  1. Call-graph resolution: ``self.`` methods, module-level defs,
+     ``from``-imports across files — and that UNRESOLVED targets are
+     recorded on the graph, never silently dropped.
+  2. CFG shape essentials the rules rely on: branch order on ``If``,
+     exception edges only inside ``try`` bodies, ``finally``
+     duplication covering the raise path.
+  3. The ``enclosing_qualname`` interval index returns exactly what
+     the old linear scan returned, for every line of a nested file.
+  4. A mutation pair per flow rule (HTL002 transitive blocking, LCK002
+     reversed lock pair, REL001 leaked checkout on an exception path,
+     OBS001 double observe): the seeded bug fires, the minimal fix is
+     clean. The live tree staying clean is test_analysis.py's job.
+  5. Engine CLI exit codes: 0 clean, 1 findings, 2 stale baseline,
+     3 parse/internal error.
+  6. ``update_baseline`` (the ``ts_static_check --update-baseline``
+     core): adds under the mandatory reason, keeps original reasons,
+     prunes stale entries.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from analysis.engine import (  # noqa: E402
+    EXIT_FINDINGS,
+    EXIT_INTERNAL,
+    EXIT_OK,
+    EXIT_STALE_BASELINE,
+    Engine,
+    FileContext,
+    main as engine_main,
+    update_baseline,
+)
+from analysis.flow.callgraph import build_call_graph  # noqa: E402
+from analysis.flow.cfg import build_cfg  # noqa: E402
+from analysis.rules.lock_order import LockOrderRule  # noqa: E402
+from analysis.rules.release_paths import ReleaseOnAllPathsRule  # noqa: E402
+from analysis.rules.slo_observation import SloObservationRule  # noqa: E402
+from analysis.rules.thread_spawn import ThreadSpawnRule  # noqa: E402
+from analysis.rules.transitive_blocking import (  # noqa: E402
+    TransitiveLockBlockingRule,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tree(tmp_path, files):
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    return str(tmp_path)
+
+
+def _graph_for(tmp_path, files):
+    engine = Engine([TransitiveLockBlockingRule()], root=_tree(tmp_path, files))
+    engine.run()
+    return build_call_graph(engine.contexts)
+
+
+def _check(rule, relpath, src):
+    engine = Engine([rule], root=REPO)
+    return engine.check_source(rule, relpath, src)
+
+
+# ---------------------------------------------------------------------------
+# Call-graph resolution
+# ---------------------------------------------------------------------------
+
+
+class TestCallGraphResolution:
+    def test_self_method_resolves_to_own_class(self, tmp_path):
+        g = _graph_for(
+            tmp_path,
+            {
+                "headlamp_tpu/x.py": (
+                    "class C:\n"
+                    "    def f(self):\n"
+                    "        self.g()\n"
+                    "    def g(self):\n"
+                    "        pass\n"
+                )
+            },
+        )
+        assert ("headlamp_tpu/x.py", "C.g") in g.callees(
+            ("headlamp_tpu/x.py", "C.f")
+        )
+
+    def test_module_level_def_resolves_same_file(self, tmp_path):
+        g = _graph_for(
+            tmp_path,
+            {"headlamp_tpu/x.py": "def a():\n    b()\ndef b():\n    pass\n"},
+        )
+        assert g.callees(("headlamp_tpu/x.py", "a")) == [("headlamp_tpu/x.py", "b")]
+
+    def test_from_import_resolves_across_files(self, tmp_path):
+        g = _graph_for(
+            tmp_path,
+            {
+                "headlamp_tpu/m1.py": "def helper():\n    pass\n",
+                "headlamp_tpu/m2.py": (
+                    "from headlamp_tpu.m1 import helper\n"
+                    "def go():\n"
+                    "    helper()\n"
+                ),
+            },
+        )
+        assert g.callees(("headlamp_tpu/m2.py", "go")) == [
+            ("headlamp_tpu/m1.py", "helper")
+        ]
+
+    def test_relative_import_resolves(self, tmp_path):
+        g = _graph_for(
+            tmp_path,
+            {
+                "headlamp_tpu/__init__.py": "",
+                "headlamp_tpu/m1.py": "def helper():\n    pass\n",
+                "headlamp_tpu/m2.py": (
+                    "from .m1 import helper\ndef go():\n    helper()\n"
+                ),
+            },
+        )
+        assert g.callees(("headlamp_tpu/m2.py", "go")) == [
+            ("headlamp_tpu/m1.py", "helper")
+        ]
+
+    def test_unresolved_targets_recorded_never_dropped(self, tmp_path):
+        g = _graph_for(
+            tmp_path,
+            {
+                "headlamp_tpu/x.py": (
+                    "def f(obj):\n"
+                    "    obj.method()\n"
+                    "    unknown_name()\n"
+                    "    getattr(obj, 'm')()\n"
+                )
+            },
+        )
+        key = ("headlamp_tpu/x.py", "f")
+        dotted = sorted(s.dotted for s in g.unresolved(key))
+        # obj.method + unknown_name + getattr + the <dynamic> outer call
+        assert "obj.method" in dotted and "unknown_name" in dotted
+        assert "<dynamic>" in dotted
+        assert g.callees(key) == []
+        assert g.unresolved_total() >= 4
+
+
+# ---------------------------------------------------------------------------
+# CFG shape
+# ---------------------------------------------------------------------------
+
+
+def _cfg_of(src):
+    fn = ast.parse(src).body[0]
+    return build_cfg(fn)
+
+
+class TestCfgShape:
+    def test_if_branch_order_true_then_false(self):
+        cfg = _cfg_of("def f(x):\n    if x:\n        a()\n    else:\n        b()\n")
+        if_block = next(
+            b for b in cfg.stmt_blocks() if isinstance(b.stmt, ast.If)
+        )
+        true_block = cfg.blocks[if_block.succs[0]]
+        false_block = cfg.blocks[if_block.succs[1]]
+        assert ast.unparse(true_block.stmt).startswith("a(")
+        assert ast.unparse(false_block.stmt).startswith("b(")
+
+    def test_exception_edges_only_inside_try(self):
+        cfg = _cfg_of(
+            "def f():\n"
+            "    before()\n"
+            "    try:\n"
+            "        inside()\n"
+            "    except ValueError:\n"
+            "        handle()\n"
+            "    after()\n"
+        )
+        by_src = {
+            ast.unparse(b.stmt): b
+            for b in cfg.stmt_blocks()
+            if not isinstance(b.stmt, ast.Try)
+        }
+        assert by_src["before()"].exc_succs == []
+        assert by_src["after()"].exc_succs == []
+        assert by_src["inside()"].exc_succs != []
+
+    def test_raise_goes_to_raise_exit(self):
+        cfg = _cfg_of("def f():\n    raise ValueError()\n")
+        raise_block = next(
+            b for b in cfg.stmt_blocks() if isinstance(b.stmt, ast.Raise)
+        )
+        assert raise_block.exc_succs == [cfg.RAISE]
+        assert raise_block.succs == []
+
+    def test_finally_duplicated_on_raise_path(self):
+        # `finally` must run on the exception escape too: the raise
+        # path reaches the finally copy whose successor is RAISE.
+        cfg = _cfg_of(
+            "def f():\n"
+            "    try:\n"
+            "        work()\n"
+            "    finally:\n"
+            "        cleanup()\n"
+        )
+        cleanups = [
+            b
+            for b in cfg.stmt_blocks()
+            if b.stmt is not None and ast.unparse(b.stmt) == "cleanup()"
+        ]
+        assert len(cleanups) >= 2  # normal + exception copies at least
+        assert any(cfg.RAISE in b.succs for b in cleanups)
+        assert any(cfg.EXIT in b.succs for b in cleanups)
+
+
+# ---------------------------------------------------------------------------
+# enclosing_qualname interval index
+# ---------------------------------------------------------------------------
+
+
+class TestEnclosingQualnameIndex:
+    SRC = (
+        "import os\n"
+        "class Outer:\n"
+        "    def method(self):\n"
+        "        x = 1\n"
+        "        def inner():\n"
+        "            return x\n"
+        "        return inner\n"
+        "    class Inner:\n"
+        "        def deep(self):\n"
+        "            pass\n"
+        "def top():\n"
+        "    pass\n"
+        "VALUE = 1\n"
+    )
+
+    def test_index_matches_linear_reference(self):
+        tree = ast.parse(self.SRC)
+        ctx = FileContext(REPO, "x.py", self.SRC, tree)
+
+        def reference(line):
+            best, best_span = "", None
+            for qual, node in ctx.functions():
+                end = getattr(node, "end_lineno", node.lineno)
+                if node.lineno <= line <= end:
+                    span = end - node.lineno
+                    if best_span is None or span <= best_span:
+                        best, best_span = qual, span
+            return best
+
+        for line in range(1, len(self.SRC.splitlines()) + 2):
+            assert ctx.enclosing_qualname(line) == reference(line), line
+
+    def test_innermost_wins(self):
+        ctx = FileContext(REPO, "x.py", self.SRC, ast.parse(self.SRC))
+        assert ctx.enclosing_qualname(6) == "Outer.method.<locals>.inner"
+        assert ctx.enclosing_qualname(4) == "Outer.method"
+        assert ctx.enclosing_qualname(10) == "Outer.Inner.deep"
+        assert ctx.enclosing_qualname(13) == ""
+
+
+# ---------------------------------------------------------------------------
+# HTL002 — transitive lock-held blocking
+# ---------------------------------------------------------------------------
+
+
+class TestTransitiveBlockingMutations:
+    def test_transitive_sleep_under_lock_flagged(self, tmp_path):
+        root = _tree(
+            tmp_path,
+            {
+                "headlamp_tpu/server/x.py": (
+                    "import time\n"
+                    "def helper():\n"
+                    "    time.sleep(0.1)\n"
+                    "class C:\n"
+                    "    def f(self):\n"
+                    "        with self._lock:\n"
+                    "            helper()\n"
+                )
+            },
+        )
+        result = Engine([TransitiveLockBlockingRule()], root=root).run()
+        assert len(result.diagnostics) == 1
+        d = result.diagnostics[0]
+        assert d.rule == "HTL002" and d.context == "C.f"
+        assert "helper" in d.message and "time.sleep" in d.message
+
+    def test_cross_file_chain_flagged_with_chain_in_message(self, tmp_path):
+        root = _tree(
+            tmp_path,
+            {
+                "headlamp_tpu/util.py": (
+                    "import time\n"
+                    "def slow():\n"
+                    "    time.sleep(1)\n"
+                ),
+                "headlamp_tpu/server/x.py": (
+                    "from headlamp_tpu.util import slow\n"
+                    "def mid():\n"
+                    "    slow()\n"
+                    "class C:\n"
+                    "    def f(self):\n"
+                    "        with self._lock:\n"
+                    "            mid()\n"
+                ),
+            },
+        )
+        result = Engine([TransitiveLockBlockingRule()], root=root).run()
+        assert len(result.diagnostics) == 1
+        assert "mid -> slow -> time.sleep" in result.diagnostics[0].message
+
+    def test_non_blocking_helper_clean(self, tmp_path):
+        root = _tree(
+            tmp_path,
+            {
+                "headlamp_tpu/server/x.py": (
+                    "def helper():\n"
+                    "    return 1\n"
+                    "class C:\n"
+                    "    def f(self):\n"
+                    "        with self._lock:\n"
+                    "            helper()\n"
+                )
+            },
+        )
+        result = Engine([TransitiveLockBlockingRule()], root=root).run()
+        assert result.diagnostics == []
+
+    def test_direct_seam_left_to_htl001(self, tmp_path):
+        # A direct `time.sleep` under the lock is HTL001's finding;
+        # HTL002 must not double-report it.
+        root = _tree(
+            tmp_path,
+            {
+                "headlamp_tpu/server/x.py": (
+                    "import time\n"
+                    "class C:\n"
+                    "    def f(self):\n"
+                    "        with self._lock:\n"
+                    "            time.sleep(1)\n"
+                )
+            },
+        )
+        result = Engine([TransitiveLockBlockingRule()], root=root).run()
+        assert result.diagnostics == []
+
+
+# ---------------------------------------------------------------------------
+# LCK002 — lock-order cycles
+# ---------------------------------------------------------------------------
+
+
+class TestLockOrderMutations:
+    def test_reversed_lock_pair_flagged(self, tmp_path):
+        root = _tree(
+            tmp_path,
+            {
+                "headlamp_tpu/push/x.py": (
+                    "class A:\n"
+                    "    def m1(self):\n"
+                    "        with self._lock:\n"
+                    "            with self._bg_lock:\n"
+                    "                pass\n"
+                    "    def m2(self):\n"
+                    "        with self._bg_lock:\n"
+                    "            with self._lock:\n"
+                    "                pass\n"
+                )
+            },
+        )
+        result = Engine([LockOrderRule()], root=root).run()
+        assert len(result.diagnostics) == 1
+        d = result.diagnostics[0]
+        assert d.rule == "LCK002"
+        assert "A._lock" in d.message and "A._bg_lock" in d.message
+
+    def test_consistent_order_clean(self, tmp_path):
+        root = _tree(
+            tmp_path,
+            {
+                "headlamp_tpu/push/x.py": (
+                    "class A:\n"
+                    "    def m1(self):\n"
+                    "        with self._lock:\n"
+                    "            with self._bg_lock:\n"
+                    "                pass\n"
+                    "    def m2(self):\n"
+                    "        with self._lock:\n"
+                    "            with self._bg_lock:\n"
+                    "                pass\n"
+                )
+            },
+        )
+        result = Engine([LockOrderRule()], root=root).run()
+        assert result.diagnostics == []
+
+    def test_transitive_acquisition_closes_cycle(self, tmp_path):
+        # m2 holds _bg_lock and CALLS a helper that takes _lock — the
+        # interprocedural edge must close the cycle.
+        root = _tree(
+            tmp_path,
+            {
+                "headlamp_tpu/push/x.py": (
+                    "class A:\n"
+                    "    def m1(self):\n"
+                    "        with self._lock:\n"
+                    "            with self._bg_lock:\n"
+                    "                pass\n"
+                    "    def m2(self):\n"
+                    "        with self._bg_lock:\n"
+                    "            self._take()\n"
+                    "    def _take(self):\n"
+                    "        with self._lock:\n"
+                    "            pass\n"
+                )
+            },
+        )
+        result = Engine([LockOrderRule()], root=root).run()
+        assert len(result.diagnostics) == 1
+        assert "A._bg_lock" in result.diagnostics[0].message
+
+
+# ---------------------------------------------------------------------------
+# REL001 — release on all paths
+# ---------------------------------------------------------------------------
+
+
+class TestReleasePathsMutations:
+    def test_acquire_leaked_on_handler_return_flagged(self):
+        diags = _check(
+            ReleaseOnAllPathsRule(),
+            "headlamp_tpu/push/hub.py",
+            "class P:\n"
+            "    def f(self):\n"
+            "        self._sem.acquire()\n"
+            "        try:\n"
+            "            work()\n"
+            "        except Exception:\n"
+            "            return None\n"
+            "        self._sem.release()\n",
+        )
+        assert len(diags) == 1
+        assert diags[0].rule == "REL001" and "self._sem" in diags[0].message
+
+    def test_try_finally_release_clean(self):
+        diags = _check(
+            ReleaseOnAllPathsRule(),
+            "headlamp_tpu/push/hub.py",
+            "class P:\n"
+            "    def f(self):\n"
+            "        self._sem.acquire()\n"
+            "        try:\n"
+            "            work()\n"
+            "        finally:\n"
+            "            self._sem.release()\n",
+        )
+        assert diags == []
+
+    def test_guard_idiom_bailout_is_not_a_leak(self):
+        # `if not X.acquire(...):` — held only on the fall-through.
+        diags = _check(
+            ReleaseOnAllPathsRule(),
+            "headlamp_tpu/transport/pool.py",
+            "class P:\n"
+            "    def f(self):\n"
+            "        if not self._sem.acquire(timeout=1):\n"
+            "            return None\n"
+            "        work()\n"
+            "        self._sem.release()\n",
+        )
+        assert diags == []
+
+    def test_checkout_leaked_on_exception_path_flagged(self):
+        diags = _check(
+            ReleaseOnAllPathsRule(),
+            "headlamp_tpu/transport/pool.py",
+            "class P:\n"
+            "    def g(self, key):\n"
+            "        conn, reused = self._checkout(key)\n"
+            "        try:\n"
+            "            self._send_preamble()\n"
+            "        except Exception:\n"
+            "            return None\n"
+            "        return self._wrap(conn)\n",
+        )
+        assert len(diags) == 1
+        assert "conn" in diags[0].message and diags[0].context == "P.g"
+
+    def test_checkout_discarded_on_exception_path_clean(self):
+        diags = _check(
+            ReleaseOnAllPathsRule(),
+            "headlamp_tpu/transport/pool.py",
+            "class P:\n"
+            "    def g(self, key):\n"
+            "        conn, reused = self._checkout(key)\n"
+            "        try:\n"
+            "            self._send_preamble()\n"
+            "        except Exception:\n"
+            "            self._discard(conn)\n"
+            "            return None\n"
+            "        return self._wrap(conn)\n",
+        )
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# OBS001 — exactly-once SLO observation
+# ---------------------------------------------------------------------------
+
+
+class TestSloObservationMutations:
+    def test_double_observe_flagged(self):
+        diags = _check(
+            SloObservationRule(),
+            "headlamp_tpu/gateway/x.py",
+            "class G:\n"
+            "    def handle(self, route):\n"
+            "        self._req_hist.observe(1.0, route=route)\n"
+            "        self._req_hist.observe(2.0, route=route)\n"
+            "        return make()\n",
+        )
+        assert len(diags) == 1
+        assert "more than once" in diags[0].message
+
+    def test_observe_before_5xx_return_flagged(self):
+        diags = _check(
+            SloObservationRule(),
+            "headlamp_tpu/gateway/x.py",
+            "class G:\n"
+            "    def handle(self, route):\n"
+            "        self._req_hist.observe(0.1, route=route)\n"
+            "        return GatewayResponse(503, 'text/plain', 'shed')\n",
+        )
+        assert len(diags) == 1
+        assert "5xx/304/shed" in diags[0].message
+
+    def test_transitive_observe_through_helper_flagged(self):
+        diags = _check(
+            SloObservationRule(),
+            "headlamp_tpu/gateway/x.py",
+            "class G:\n"
+            "    def _obs(self, t):\n"
+            "        self._req_hist.observe(t)\n"
+            "    def handle(self):\n"
+            "        self._obs(0.1)\n"
+            "        return GatewayResponse(304, 'text/html', '')\n",
+        )
+        assert len(diags) == 1
+
+    def test_single_guarded_observe_clean(self):
+        diags = _check(
+            SloObservationRule(),
+            "headlamp_tpu/gateway/x.py",
+            "class G:\n"
+            "    def handle(self, status, route, t0):\n"
+            "        if status < 500:\n"
+            "            self._req_hist.observe(t0, route=route)\n"
+            "        return make()\n",
+        )
+        assert diags == []
+
+    def test_other_histograms_are_not_the_slo_histogram(self):
+        # _QUEUE_WAIT.observe is a different histogram — receiver-matched.
+        diags = _check(
+            SloObservationRule(),
+            "headlamp_tpu/gateway/x.py",
+            "class G:\n"
+            "    def handle(self, waited):\n"
+            "        _QUEUE_WAIT.observe(waited)\n"
+            "        return GatewayResponse(503, 'text/plain', 'shed')\n",
+        )
+        assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# Engine CLI exit codes
+# ---------------------------------------------------------------------------
+
+
+class TestExitCodes:
+    def _baseline(self, tmp_path, entries):
+        path = tmp_path / "bl.json"
+        path.write_text(json.dumps({"entries": entries}))
+        return str(path)
+
+    def test_clean_tree_exits_0(self, tmp_path):
+        root = _tree(tmp_path, {"headlamp_tpu/x.py": "def ok():\n    pass\n"})
+        bl = self._baseline(tmp_path, [])
+        assert engine_main([root, "--baseline", bl]) == EXIT_OK
+
+    def test_findings_exit_1(self, tmp_path):
+        root = _tree(
+            tmp_path,
+            {
+                "headlamp_tpu/x.py": (
+                    "import threading\n"
+                    "def boot():\n"
+                    "    threading.Thread(target=print).start()\n"
+                )
+            },
+        )
+        bl = self._baseline(tmp_path, [])
+        assert engine_main([root, "--baseline", bl]) == EXIT_FINDINGS
+
+    def test_stale_baseline_exits_2(self, tmp_path):
+        root = _tree(tmp_path, {"headlamp_tpu/x.py": "def ok():\n    pass\n"})
+        bl = self._baseline(
+            tmp_path,
+            [
+                {
+                    "rule": "THR001",
+                    "path": "headlamp_tpu/x.py",
+                    "context": "gone",
+                    "reason": "stale on purpose",
+                }
+            ],
+        )
+        assert engine_main([root, "--baseline", bl]) == EXIT_STALE_BASELINE
+
+    def test_parse_error_exits_3(self, tmp_path):
+        root = _tree(tmp_path, {"headlamp_tpu/x.py": "def broken(:\n"})
+        bl = self._baseline(tmp_path, [])
+        assert engine_main([root, "--baseline", bl]) == EXIT_INTERNAL
+
+    def test_unreadable_baseline_exits_3(self, tmp_path):
+        root = _tree(tmp_path, {"headlamp_tpu/x.py": "def ok():\n    pass\n"})
+        bad = tmp_path / "bl.json"
+        bad.write_text("{not json")
+        assert engine_main([root, "--baseline", str(bad)]) == EXIT_INTERNAL
+
+
+# ---------------------------------------------------------------------------
+# update_baseline (ts_static_check --update-baseline core)
+# ---------------------------------------------------------------------------
+
+
+class TestUpdateBaseline:
+    FINDING_SRC = (
+        "import threading\n"
+        "def boot():\n"
+        "    threading.Thread(target=print).start()\n"
+    )
+
+    def test_adds_prunes_and_keeps_with_reasons(self, tmp_path):
+        root = _tree(tmp_path, {"headlamp_tpu/x.py": self.FINDING_SRC})
+        bl = tmp_path / "bl.json"
+        bl.write_text(
+            json.dumps(
+                {
+                    "entries": [
+                        {
+                            "rule": "THR001",
+                            "path": "headlamp_tpu/x.py",
+                            "context": "long_gone",
+                            "reason": "now stale",
+                        }
+                    ]
+                }
+            )
+        )
+        stats = update_baseline(
+            root,
+            str(bl),
+            reason="r16 sweep",
+            rules=[ThreadSpawnRule()],
+        )
+        assert stats["added"] == 1 and stats["pruned"] == 1 and stats["kept"] == 0
+        entries = json.loads(bl.read_text())["entries"]
+        assert entries == [
+            {
+                "rule": "THR001",
+                "path": "headlamp_tpu/x.py",
+                "context": "boot",
+                "reason": "r16 sweep",
+            }
+        ]
+        # the regenerated baseline makes the run clean
+        result = Engine(
+            [ThreadSpawnRule()], root=root, baseline=entries
+        ).run()
+        assert result.ok
+
+    def test_matching_entries_keep_original_reason(self, tmp_path):
+        root = _tree(tmp_path, {"headlamp_tpu/x.py": self.FINDING_SRC})
+        bl = tmp_path / "bl.json"
+        bl.write_text(
+            json.dumps(
+                {
+                    "entries": [
+                        {
+                            "rule": "THR001",
+                            "path": "headlamp_tpu/x.py",
+                            "context": "boot",
+                            "reason": "the ORIGINAL reviewed reason",
+                        }
+                    ]
+                }
+            )
+        )
+        stats = update_baseline(
+            root, str(bl), reason="new sweep", rules=[ThreadSpawnRule()]
+        )
+        assert stats["kept"] == 1 and stats["added"] == 0
+        entries = json.loads(bl.read_text())["entries"]
+        assert entries[0]["reason"] == "the ORIGINAL reviewed reason"
+
+    def test_reason_is_mandatory(self, tmp_path):
+        root = _tree(tmp_path, {"headlamp_tpu/x.py": "def ok():\n    pass\n"})
+        bl = tmp_path / "bl.json"
+        bl.write_text('{"entries": []}')
+        try:
+            update_baseline(root, str(bl), reason="  ", rules=[ThreadSpawnRule()])
+        except ValueError as e:
+            assert "reason" in str(e)
+        else:
+            raise AssertionError("empty reason must be rejected")
+
+    def test_cli_requires_reason(self):
+        import ts_static_check
+
+        assert ts_static_check.main(["--update-baseline"]) == EXIT_INTERNAL
+
+
+# ---------------------------------------------------------------------------
+# Live tree through the flow rules alone
+# ---------------------------------------------------------------------------
+
+
+class TestLiveTreeFlowRules:
+    def test_flow_rules_report_only_baselined_findings(self):
+        from analysis.engine import default_baseline_path, load_baseline
+
+        engine = Engine(
+            [
+                TransitiveLockBlockingRule(),
+                LockOrderRule(),
+                ReleaseOnAllPathsRule(),
+                SloObservationRule(),
+            ],
+            root=REPO,
+            baseline=load_baseline(default_baseline_path()),
+        )
+        result = engine.run()
+        assert result.diagnostics == [], "\n".join(
+            str(d) for d in result.diagnostics
+        )
+        # the one designed exception: _checkout's ownership transfer
+        assert any(
+            d.rule == "REL001" and d.context == "ConnectionPool._checkout"
+            for d in result.baselined
+        )

@@ -85,6 +85,8 @@ class FileContext:
         self.source = source
         self.tree = tree
         self._functions: list[tuple[str, ast.AST]] | None = None
+        self._line_index: tuple[list[int], list[tuple[int, int, str]]] | None = None
+        self._cfgs: dict[int, Any] = {}
 
     def functions(self) -> list[tuple[str, ast.AST]]:
         """All function defs as ``(qualname, node)``, CPython-style
@@ -109,16 +111,53 @@ class FileContext:
 
     def enclosing_qualname(self, line: int) -> str:
         """Qualname of the innermost function containing ``line`` —
-        diagnostics anchor to functions, baselines match on them."""
-        best = ""
-        best_span = None
-        for qual, node in self.functions():
-            end = getattr(node, "end_lineno", node.lineno)
-            if node.lineno <= line <= end:
-                span = end - node.lineno
-                if best_span is None or span <= best_span:
-                    best, best_span = qual, span
-        return best
+        diagnostics anchor to functions, baselines match on them.
+        Backed by a sorted, non-overlapping line-interval index built
+        once per file: flow rules hammer this lookup, and the old
+        linear scan over every function was O(functions) per call."""
+        import bisect
+
+        starts, segments = self._interval_index()
+        i = bisect.bisect_right(starts, line) - 1
+        if i >= 0:
+            start, end, qual = segments[i]
+            if start <= line <= end:
+                return qual
+        return ""
+
+    def _interval_index(self) -> tuple[list[int], list[tuple[int, int, str]]]:
+        """Flatten the (nested) function spans into disjoint segments,
+        innermost qualname winning, so lookup is one bisect."""
+        if self._line_index is None:
+            spans = [
+                (node.lineno, getattr(node, "end_lineno", node.lineno), qual)
+                for qual, node in self.functions()
+            ]
+            bounds = sorted({s for s, _, _ in spans} | {e + 1 for _, e, _ in spans})
+            segments: list[tuple[int, int, str]] = []
+            for j, start in enumerate(bounds):
+                end = (bounds[j + 1] - 1) if j + 1 < len(bounds) else start
+                best, best_span = "", None
+                for s, e, qual in spans:
+                    if s <= start and end <= e:
+                        span = e - s
+                        if best_span is None or span <= best_span:
+                            best, best_span = qual, span
+                if best:
+                    segments.append((start, end, best))
+            self._line_index = ([s for s, _, _ in segments], segments)
+        return self._line_index
+
+    def cfg(self, node: ast.AST) -> "Any":
+        """Memoized per-function control-flow graph (ADR-023). Built
+        lazily — only rules that ask pay for it — from the SHARED tree,
+        so the single-parse contract holds with the flow layer on."""
+        key = id(node)
+        if key not in self._cfgs:
+            from .flow.cfg import build_cfg
+
+            self._cfgs[key] = build_cfg(node)
+        return self._cfgs[key]
 
 
 class Rule:
@@ -160,6 +199,25 @@ class Rule:
 
     def finalize(self, run: "Engine") -> list[Diagnostic]:
         return []
+
+
+class ProjectContext:
+    """Cross-file view for flow rules (ADR-023): the per-file contexts
+    already parsed this pass plus a memoized project call graph. Built
+    lazily in the finalize phase — intraprocedural rules never pay for
+    it — and always from :attr:`Engine.contexts`, never a re-parse."""
+
+    def __init__(self, engine: "Engine") -> None:
+        self.engine = engine
+        self.contexts = engine.contexts
+        self._callgraph: Any = None
+
+    def callgraph(self) -> "Any":
+        if self._callgraph is None:
+            from .flow.callgraph import build_call_graph
+
+            self._callgraph = build_call_graph(self.contexts)
+        return self._callgraph
 
 
 @dataclass
@@ -241,6 +299,14 @@ class Engine:
         #: trees already parsed this pass (e.g. HTL001 reads the AOT
         #: builder table from models/aot.py without re-parsing it).
         self.contexts: dict[str, FileContext] = {}
+        self._project: ProjectContext | None = None
+
+    def project(self) -> ProjectContext:
+        """The cross-file finalize-phase view (call graph et al.),
+        memoized per pass and invalidated whenever contexts change."""
+        if self._project is None:
+            self._project = ProjectContext(self)
+        return self._project
 
     # -- target discovery ------------------------------------------------
 
@@ -269,6 +335,7 @@ class Engine:
 
     def run(self) -> RunResult:
         result = RunResult()
+        self._project = None
         raw: list[Diagnostic] = []
         suppress_map: dict[str, dict[int, set[str]]] = {}
         for relpath in self._targets():
@@ -346,6 +413,7 @@ class Engine:
             ]
         ctx = FileContext(self.root, relpath, source, tree)
         self.contexts[relpath] = ctx
+        self._project = None  # the new context must be visible to flow rules
         return rule.check_file(ctx) + rule.finalize(self)
 
 
@@ -373,13 +441,113 @@ def dotted_name(expr: ast.AST) -> str | None:
     return None
 
 
+#: Engine CLI exit codes — distinct so CI can tell "you added a
+#: finding" from "a grandfather went stale" from "the tree does not
+#: even parse" without scraping stdout.
+EXIT_OK = 0
+EXIT_FINDINGS = 1
+EXIT_STALE_BASELINE = 2
+EXIT_INTERNAL = 3
+
+
+def exit_code(result: RunResult) -> int:
+    """Map a run result to the CLI contract: 3 = parse/internal error
+    (PAR000 present), 1 = real findings, 2 = stale-baseline-only."""
+    if any(d.rule == PARSE_RULE_ID for d in result.diagnostics):
+        return EXIT_INTERNAL
+    if result.diagnostics:
+        return EXIT_FINDINGS
+    if result.stale_baseline:
+        return EXIT_STALE_BASELINE
+    return EXIT_OK
+
+
+def update_baseline(
+    root: str | None = None,
+    baseline_path: str | None = None,
+    *,
+    reason: str,
+    rules: Iterable[Rule] | None = None,
+) -> dict:
+    """Regenerate ``baseline.json`` from the current tree: entries that
+    still match keep their ORIGINAL reason, current unbaselined findings
+    are added under the caller's (mandatory) reason, and stale entries
+    are pruned. Parse failures (PAR000) are never grandfathered — an
+    unparseable file must be fixed, not baselined."""
+    if not reason or not reason.strip():
+        raise ValueError("--update-baseline requires a non-empty --reason")
+    baseline_path = baseline_path or default_baseline_path()
+    existing = load_baseline(baseline_path)
+    engine = Engine(rules, root=root, baseline=existing)
+    result = engine.run()
+    if any(d.rule == PARSE_RULE_ID for d in result.diagnostics):
+        bad = [d for d in result.diagnostics if d.rule == PARSE_RULE_ID]
+        raise RuntimeError(
+            "cannot regenerate baseline over an unparseable tree: "
+            + "; ".join(str(d) for d in bad)
+        )
+    kept_keys = {(e["rule"], e["path"], e["context"]) for e in existing} - {
+        (e["rule"], e["path"], e["context"]) for e in result.stale_baseline
+    }
+    kept = [e for e in existing if (e["rule"], e["path"], e["context"]) in kept_keys]
+    added: list[dict] = []
+    seen = set(kept_keys)
+    for diag in result.diagnostics:
+        key = (diag.rule, diag.path, diag.context)
+        if key in seen:
+            continue
+        seen.add(key)
+        added.append(
+            {
+                "rule": diag.rule,
+                "path": diag.path,
+                "context": diag.context,
+                "reason": reason.strip(),
+            }
+        )
+    entries = sorted(
+        kept + added, key=lambda e: (e["rule"], e["path"], e["context"])
+    )
+    payload = {
+        "_comment": (
+            "Grandfathered findings (ADR-022). Keyed (rule, path, context) "
+            "so line drift cannot orphan an entry; every entry carries a "
+            "reason. Stale entries FAIL the run. Regenerate with "
+            "`python tools/ts_static_check.py --update-baseline --reason ...`."
+        ),
+        "entries": entries,
+    }
+    with open(baseline_path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return {
+        "kept": len(kept),
+        "added": len(added),
+        "pruned": len(result.stale_baseline),
+        "path": baseline_path,
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     jsonl = "--jsonl" in argv
     argv = [a for a in argv if a != "--jsonl"]
+    baseline_path = default_baseline_path()
+    if "--baseline" in argv:
+        i = argv.index("--baseline")
+        try:
+            baseline_path = argv[i + 1]
+        except IndexError:
+            print("--baseline requires a path", file=sys.stderr)
+            return EXIT_INTERNAL
+        del argv[i : i + 2]
     root = argv[0] if argv else None
-    engine = Engine(root=root, baseline=load_baseline(default_baseline_path()))
-    result = engine.run()
+    try:
+        engine = Engine(root=root, baseline=load_baseline(baseline_path))
+        result = engine.run()
+    except Exception as exc:  # unreadable baseline, bad root, rule crash
+        print(f"internal error: {exc}", file=sys.stderr)
+        return EXIT_INTERNAL
     if jsonl:
         out = result.to_jsonl()
         if out:
@@ -398,7 +566,7 @@ def main(argv: list[str] | None = None) -> int:
         f"{len(result.baselined)} baselined, "
         f"{len(result.stale_baseline)} stale baseline entr(y/ies)"
     )
-    return 0 if result.ok else 1
+    return exit_code(result)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,16 @@
+"""Interprocedural flow layer (ADR-023).
+
+Everything here is built FROM the engine's shared parse trees — no
+module in this package may call ``ast.parse``; that is the single-pass
+contract the bench asserts (``files_parsed_once``).
+
+- :mod:`cfg` — per-function statement-level control-flow graphs with
+  explicit normal/raise exits and exception edges.
+- :mod:`callgraph` — project-wide call graph over module-level defs,
+  ``self.``/class methods, and ``from``-imports; unresolved targets
+  recorded, never silently dropped.
+- :mod:`locks` — shared lock-region scanner for the HTL002/LCK002
+  rules (held-lock call sites and nested acquisitions).
+"""
+
+from __future__ import annotations

@@ -1,0 +1,225 @@
+"""Project-wide call graph (ADR-023).
+
+Built from the engine's already-parsed :class:`FileContext` table —
+never a re-parse. Nodes are ``(relpath, qualname)`` pairs over the
+same CPython-style qualnames ``FileContext.functions()`` produces.
+
+Resolution strategy (the ADR-023 limits, in order):
+
+1. ``name(...)`` — a module-level ``def`` in the same file; else a
+   ``from mod import name`` whose ``mod`` resolves to a project file
+   with a top-level ``def name``. A bare class name resolves to its
+   ``__init__`` when one is defined.
+2. ``self.name(...)`` / ``cls.name(...)`` — a method ``name`` on the
+   caller's own (lexically enclosing) class, same file. Inheritance is
+   NOT modelled.
+3. ``mod.name(...)`` / ``pkg.mod.name(...)`` — the longest dotted
+   prefix that names an imported project module, then a top-level
+   ``def name`` in it.
+
+Everything else — attribute chains through objects, callables stored
+in variables, ``getattr`` — is UNRESOLVED and recorded as such on the
+call site (``target is None``). Unresolved is a first-class answer:
+rules and tests can count them; they are never silently dropped.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from ..engine import FileContext, dotted_name
+
+NodeKey = tuple[str, str]  # (relpath, qualname)
+
+
+@dataclass
+class CallSite:
+    line: int
+    dotted: str  # the dotted call name as written ("self._evict", …)
+    target: NodeKey | None  # resolved callee, or None = unresolved
+
+
+class CallGraph:
+    def __init__(self) -> None:
+        #: Every known function: (relpath, qual) -> ast def node.
+        self.defs: dict[NodeKey, ast.AST] = {}
+        #: Call sites per caller, resolved or not.
+        self.calls: dict[NodeKey, list[CallSite]] = {}
+
+    def callees(self, key: NodeKey) -> list[NodeKey]:
+        return [s.target for s in self.calls.get(key, []) if s.target is not None]
+
+    def unresolved(self, key: NodeKey) -> list[CallSite]:
+        return [s for s in self.calls.get(key, []) if s.target is None]
+
+    def unresolved_total(self) -> int:
+        return sum(len(self.unresolved(k)) for k in self.calls)
+
+
+# -- per-file symbol tables ---------------------------------------------------
+
+
+def _module_name(relpath: str) -> str:
+    name = relpath[:-3] if relpath.endswith(".py") else relpath
+    name = name.replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+@dataclass
+class _FileIndex:
+    relpath: str
+    toplevel: dict[str, str]  # name -> qualname of module-level def
+    classes: dict[str, set[str]]  # class qual -> method names
+    owner_class: dict[str, str]  # function qual -> enclosing class qual ("" = none)
+    imported_modules: dict[str, str]  # local name -> module name
+    imported_names: dict[str, tuple[str, str]]  # local name -> (module, attr)
+    defs: dict[str, ast.AST]  # function qual -> def node
+    calls: dict[str, list[ast.Call]]  # function qual -> call nodes, AST order
+
+
+def _index_file(ctx: FileContext, modules: dict[str, str]) -> _FileIndex:
+    """ONE iterative traversal per file collecting defs, class/method
+    tables, owner classes, imports AND per-function call nodes — the
+    call-graph build is on the engine's hot path, so no second walk."""
+    toplevel: dict[str, str] = {}
+    classes: dict[str, set[str]] = {}
+    owner: dict[str, str] = {}
+    imp_mod: dict[str, str] = {}
+    imp_name: dict[str, tuple[str, str]] = {}
+    defs: dict[str, ast.AST] = {}
+    calls: dict[str, list[ast.Call]] = {}
+    mod_name = _module_name(ctx.relpath)
+    package = mod_name if ctx.relpath.endswith("__init__.py") else mod_name.rsplit(".", 1)[0]
+
+    # (node, qual-prefix, enclosing class qual, enclosing function qual)
+    stack: list[tuple[ast.AST, str, str, str | None]] = [(ctx.tree, "", "", None)]
+    while stack:
+        node, prefix, cls, fn_qual = stack.pop()
+        for child in reversed(list(ast.iter_child_nodes(node))):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = prefix + child.name
+                owner[qual] = cls
+                defs[qual] = child
+                calls[qual] = []
+                if prefix == "":
+                    toplevel[child.name] = qual
+                if cls and prefix == cls + ".":
+                    classes.setdefault(cls, set()).add(child.name)
+                stack.append((child, qual + ".<locals>.", cls, qual))
+            elif isinstance(child, ast.ClassDef):
+                cqual = prefix + child.name
+                classes.setdefault(cqual, set())
+                stack.append((child, cqual + ".", cqual, fn_qual))
+            elif isinstance(child, ast.Lambda):
+                continue  # runs later; its calls belong to no def node
+            elif isinstance(child, ast.Import):
+                for alias in child.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    # `import a.b` binds `a`; `import a.b as m` binds m->a.b
+                    imp_mod[local] = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+            elif isinstance(child, ast.ImportFrom):
+                if child.level:
+                    base_parts = package.split(".")
+                    drop = child.level - 1
+                    base = (
+                        ".".join(base_parts[: len(base_parts) - drop])
+                        if drop
+                        else package
+                    )
+                    src = f"{base}.{child.module}" if child.module else base
+                else:
+                    src = child.module or ""
+                for alias in child.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    if f"{src}.{alias.name}" in modules:
+                        imp_mod[local] = f"{src}.{alias.name}"
+                    else:
+                        imp_name[local] = (src, alias.name)
+            else:
+                if isinstance(child, ast.Call) and fn_qual is not None:
+                    calls[fn_qual].append(child)
+                stack.append((child, prefix, cls, fn_qual))
+    return _FileIndex(
+        ctx.relpath, toplevel, classes, owner, imp_mod, imp_name, defs, calls
+    )
+
+
+# -- graph construction -------------------------------------------------------
+
+
+def _resolve(
+    dotted: str,
+    caller_qual: str,
+    idx: _FileIndex,
+    indexes: dict[str, _FileIndex],
+    modules: dict[str, str],
+) -> NodeKey | None:
+    parts = dotted.split(".")
+    # 1. bare name
+    if len(parts) == 1:
+        name = parts[0]
+        if name in idx.toplevel:
+            return (idx.relpath, idx.toplevel[name])
+        if name in idx.classes and "__init__" in idx.classes[name]:
+            return (idx.relpath, f"{name}.__init__")
+        if name in idx.imported_names:
+            src_mod, attr = idx.imported_names[name]
+            src_rel = modules.get(src_mod)
+            if src_rel is not None:
+                src_idx = indexes[src_rel]
+                if attr in src_idx.toplevel:
+                    return (src_rel, src_idx.toplevel[attr])
+                if attr in src_idx.classes and "__init__" in src_idx.classes[attr]:
+                    return (src_rel, f"{attr}.__init__")
+        return None
+    # 2. self.method / cls.method on the caller's own class
+    if len(parts) == 2 and parts[0] in ("self", "cls"):
+        cls = idx.owner_class.get(caller_qual, "")
+        if cls and parts[1] in idx.classes.get(cls, set()):
+            return (idx.relpath, f"{cls}.{parts[1]}")
+        return None
+    # 3. imported-module attribute: longest prefix naming a module
+    for cut in range(len(parts) - 1, 0, -1):
+        head, attr_parts = parts[:cut], parts[cut:]
+        if len(attr_parts) != 1:
+            continue
+        local = head[0]
+        if len(head) == 1 and local in idx.imported_modules:
+            mod = idx.imported_modules[local]
+        else:
+            mod = ".".join(head)
+        src_rel = modules.get(mod)
+        if src_rel is not None:
+            src_idx = indexes[src_rel]
+            name = attr_parts[0]
+            if name in src_idx.toplevel:
+                return (src_rel, src_idx.toplevel[name])
+    return None
+
+
+def build_call_graph(contexts: dict[str, FileContext]) -> CallGraph:
+    modules = {_module_name(rel): rel for rel in contexts}
+    indexes = {rel: _index_file(ctx, modules) for rel, ctx in contexts.items()}
+    graph = CallGraph()
+    for rel in sorted(contexts):
+        idx = indexes[rel]
+        for qual, fn in idx.defs.items():
+            key = (rel, qual)
+            graph.defs[key] = fn
+            sites: list[CallSite] = []
+            for call in idx.calls[qual]:
+                dotted = dotted_name(call.func)
+                if dotted is None:
+                    sites.append(CallSite(call.lineno, "<dynamic>", None))
+                    continue
+                target = _resolve(dotted, qual, idx, indexes, modules)
+                sites.append(CallSite(call.lineno, dotted, target))
+            graph.calls[key] = sites
+    return graph

@@ -1,0 +1,242 @@
+"""Per-function control-flow graphs (ADR-023).
+
+Shape: one :class:`Block` per STATEMENT (not basic blocks — the rules
+here reason about individual acquire/release/observe statements, and a
+repo of this size does not need basic-block compression), plus three
+virtual blocks: ``ENTRY``, ``EXIT`` (normal return / fall-off-end) and
+``RAISE`` (uncaught exception leaves the function).
+
+Edges:
+
+- ``succs`` — normal control flow. Convention for ``If``/``While``/
+  ``For``: ``succs[0]`` is the true/iterate branch, ``succs[1]`` the
+  false/exhausted branch (rules that need branch-sensitive events —
+  REL001's ``if not X.acquire(...)`` guard — rely on this order).
+- ``exc_succs`` — where control goes if the statement raises. Only
+  statements INSIDE a ``try`` body get implicit exception edges (to the
+  handler dispatch / ``finally``); an explicit ``raise`` always has
+  one. Code outside any ``try`` is assumed non-raising — the classic
+  precision/soundness trade (documented in ADR-023): modelling "any
+  statement may raise" would drown REL001 in findings for every
+  helper call after a checkout.
+
+``finally`` bodies are duplicated per escape kind (normal / exception /
+return / break / continue) — the same inlining CPython's compiler does —
+so a release inside ``finally`` covers every path without special
+casing in the dataflow. Duplicated blocks share the same underlying
+``ast.stmt`` objects, so event extraction sees identical statements.
+
+``with`` is transparent: the header is one block (its context
+expressions are evaluated there), the body flows through. Exception
+suppression by ``__exit__`` is not modelled.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Block:
+    id: int
+    kind: str  # "entry" | "exit" | "raise" | "stmt" | "join"
+    stmt: ast.stmt | None = None
+    succs: list[int] = field(default_factory=list)
+    exc_succs: list[int] = field(default_factory=list)
+
+
+@dataclass
+class _Ctx:
+    """Where non-local control transfers go from the current position."""
+
+    exc: int | None  # implicit exception target (None = not in a try)
+    ret: int  # where `return` goes (EXIT, or a finally copy)
+    brk: int | None = None
+    cont: int | None = None
+
+
+class FunctionCFG:
+    ENTRY = 0
+    EXIT = 1
+    RAISE = 2
+
+    def __init__(self, fn: ast.AST) -> None:
+        self.fn = fn
+        self.blocks: list[Block] = []
+        self._new("entry")
+        self._new("exit")
+        self._new("raise")
+        ctx = _Ctx(exc=None, ret=self.EXIT)
+        entry_id = self._build_stmts(list(getattr(fn, "body", [])), self.EXIT, ctx)
+        self.blocks[self.ENTRY].succs = [entry_id]
+
+    # -- construction ----------------------------------------------------
+
+    def _new(self, kind: str, stmt: ast.stmt | None = None) -> int:
+        block = Block(len(self.blocks), kind, stmt)
+        self.blocks.append(block)
+        return block.id
+
+    def _build_stmts(self, stmts: list[ast.stmt], succ: int, ctx: _Ctx) -> int:
+        """Build blocks for a statement list ending at ``succ``; return
+        the entry block id for the list (``succ`` itself if empty)."""
+        entry = succ
+        for stmt in reversed(stmts):
+            entry = self._build_stmt(stmt, entry, ctx)
+        return entry
+
+    def _build_stmt(self, stmt: ast.stmt, succ: int, ctx: _Ctx) -> int:
+        if isinstance(stmt, ast.Return):
+            b = self._new("stmt", stmt)
+            self.blocks[b].succs = [ctx.ret]
+            self._maybe_exc(b, ctx)
+            return b
+        if isinstance(stmt, ast.Raise):
+            b = self._new("stmt", stmt)
+            self.blocks[b].succs = []
+            self.blocks[b].exc_succs = [ctx.exc if ctx.exc is not None else self.RAISE]
+            return b
+        if isinstance(stmt, ast.Break):
+            b = self._new("stmt", stmt)
+            self.blocks[b].succs = [ctx.brk if ctx.brk is not None else succ]
+            return b
+        if isinstance(stmt, ast.Continue):
+            b = self._new("stmt", stmt)
+            self.blocks[b].succs = [ctx.cont if ctx.cont is not None else succ]
+            return b
+        if isinstance(stmt, ast.If):
+            b = self._new("stmt", stmt)
+            true_entry = self._build_stmts(stmt.body, succ, ctx)
+            false_entry = self._build_stmts(stmt.orelse, succ, ctx) if stmt.orelse else succ
+            self.blocks[b].succs = [true_entry, false_entry]
+            self._maybe_exc(b, ctx)
+            return b
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            b = self._new("stmt", stmt)  # test / iterable evaluation
+            after = (
+                self._build_stmts(stmt.orelse, succ, ctx) if stmt.orelse else succ
+            )
+            loop_ctx = _Ctx(exc=ctx.exc, ret=ctx.ret, brk=succ, cont=b)
+            body_entry = self._build_stmts(stmt.body, b, loop_ctx)
+            self.blocks[b].succs = [body_entry, after]
+            self._maybe_exc(b, ctx)
+            return b
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            b = self._new("stmt", stmt)  # context expressions evaluate here
+            body_entry = self._build_stmts(stmt.body, succ, ctx)
+            self.blocks[b].succs = [body_entry]
+            self._maybe_exc(b, ctx)
+            return b
+        if isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            return self._build_try(stmt, succ, ctx)
+        if isinstance(stmt, ast.Match):
+            b = self._new("stmt", stmt)
+            entries = [self._build_stmts(c.body, succ, ctx) for c in stmt.cases]
+            self.blocks[b].succs = entries + [succ]  # + fall-through (no match)
+            self._maybe_exc(b, ctx)
+            return b
+        # Simple statement (incl. nested def/class — they define a name
+        # here and run later; the CFG does not descend into them).
+        b = self._new("stmt", stmt)
+        self.blocks[b].succs = [succ]
+        self._maybe_exc(b, ctx)
+        return b
+
+    def _build_try(self, stmt: ast.Try, succ: int, ctx: _Ctx) -> int:
+        # finally copies, one per escape kind that can cross it.
+        if stmt.finalbody:
+            f_norm = self._build_stmts(stmt.finalbody, succ, ctx)
+            f_ret = self._build_stmts(stmt.finalbody, ctx.ret, ctx)
+            f_exc = self._build_stmts(
+                stmt.finalbody, ctx.exc if ctx.exc is not None else self.RAISE, ctx
+            )
+            f_brk = (
+                self._build_stmts(stmt.finalbody, ctx.brk, ctx)
+                if ctx.brk is not None
+                else None
+            )
+            f_cont = (
+                self._build_stmts(stmt.finalbody, ctx.cont, ctx)
+                if ctx.cont is not None
+                else None
+            )
+        else:
+            f_norm = succ
+            f_ret = ctx.ret
+            f_exc = ctx.exc if ctx.exc is not None else self.RAISE
+            f_brk, f_cont = ctx.brk, ctx.cont
+
+        handler_ctx = _Ctx(
+            exc=f_exc if stmt.finalbody else ctx.exc,
+            ret=f_ret,
+            brk=f_brk,
+            cont=f_cont,
+        )
+        handler_entries = [
+            self._build_stmts(h.body, f_norm, handler_ctx) for h in stmt.handlers
+        ]
+        # Handler dispatch: any handler may match, or none does and the
+        # exception escapes (through finally when present). A catch-all
+        # handler (bare `except`, `except Exception`/`BaseException`)
+        # removes the escape edge — nothing gets past it.
+        dispatch = self._new("join")
+        catch_all = any(_is_catch_all(h) for h in stmt.handlers)
+        self.blocks[dispatch].succs = handler_entries + (
+            [] if catch_all else [f_exc]
+        )
+        body_ctx = _Ctx(exc=dispatch, ret=f_ret, brk=f_brk, cont=f_cont)
+        after_body = (
+            self._build_stmts(stmt.orelse, f_norm, handler_ctx)
+            if stmt.orelse
+            else f_norm
+        )
+        return self._build_stmts(stmt.body, after_body, body_ctx)
+
+    def _maybe_exc(self, block_id: int, ctx: _Ctx) -> None:
+        if ctx.exc is not None:
+            self.blocks[block_id].exc_succs = [ctx.exc]
+
+    # -- queries ---------------------------------------------------------
+
+    def stmt_blocks(self) -> list[Block]:
+        return [b for b in self.blocks if b.kind == "stmt"]
+
+
+def _is_catch_all(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    return isinstance(handler.type, ast.Name) and handler.type.id in (
+        "Exception",
+        "BaseException",
+    )
+
+
+def build_cfg(fn: ast.AST) -> FunctionCFG:
+    return FunctionCFG(fn)
+
+
+def own_nodes(stmt: ast.stmt) -> list[ast.AST]:
+    """The nodes executed BY this block itself: the statement's own
+    expressions, with nested statements pruned (a compound statement's
+    body/orelse/handlers are separate blocks — counting their calls on
+    the header double-counts every event) and nested def/lambda bodies
+    pruned (they run later). Dataflow rules must extract events from
+    this, never from ``ast.walk(stmt)``."""
+    out: list[ast.AST] = []
+    stack: list[ast.AST] = [
+        child for child in ast.iter_child_nodes(stmt) if not isinstance(child, ast.stmt)
+    ]
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        out.append(node)
+        stack.extend(
+            child
+            for child in ast.iter_child_nodes(node)
+            if not isinstance(child, ast.stmt)
+        )
+    return out

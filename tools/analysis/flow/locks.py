@@ -1,0 +1,206 @@
+"""Shared lock-region scanner for the flow rules (ADR-023).
+
+HTL001 keeps its own (pinned) intraprocedural scan; HTL002 and LCK002
+both need the same two facts per function and get them from here:
+
+- every CALL made while a lock is held (with the innermost held lock),
+- every lock ACQUISITION made while another lock is held (the edges of
+  the lock-order graph).
+
+Region grammar matches HTL001: ``with X:`` where X's terminal name is
+lock-ish, plus linear ``X.acquire()`` … ``X.release()`` spans. Nested
+``def``/``class`` bodies are excluded (they run later). Unlike HTL001's
+collector, compound statements (``if``/``try``/…) are recursed
+structurally so a ``with lock:`` nested inside an ``if`` under a held
+lock still records an ordering edge.
+
+Lock identity: ``self.X`` normalises to ``Class.X`` (so two classes'
+``_lock`` attributes stay distinct); anything else keeps its dotted
+name as written (``slot.lock``). That naming is per-spelling, not
+per-object — the ADR-023 soundness caveat.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from ..engine import FileContext, dotted_name
+from ..rules.lock_blocking import _lock_method_target, _lockish
+
+_COMPOUND_BODIES = ("body", "orelse", "finalbody")
+
+
+@dataclass
+class HeldCall:
+    qual: str  # enclosing function qualname
+    line: int
+    call: str  # dotted call name as written
+    lock: str  # normalised innermost held lock
+
+
+@dataclass
+class LockEdge:
+    qual: str
+    line: int
+    held: str  # normalised lock already held
+    acquired: str  # normalised lock taken while `held` is held
+
+
+@dataclass
+class FunctionLocks:
+    qual: str
+    acquired: set[str] = field(default_factory=set)  # all locks this fn takes
+    held_calls: list[HeldCall] = field(default_factory=list)
+    edges: list[LockEdge] = field(default_factory=list)
+
+
+def normalize_lock(name: str, owner_class: str) -> str:
+    """``self._lock`` inside class C -> ``C._lock``; else verbatim."""
+    parts = name.split(".")
+    if parts[0] in ("self", "cls") and owner_class:
+        return ".".join([owner_class] + parts[1:])
+    return name
+
+
+def scan_function(
+    ctx: FileContext, qual: str, fn: ast.AST, owner_class: str
+) -> FunctionLocks:
+    out = FunctionLocks(qual)
+
+    def norm(name: str) -> str:
+        return normalize_lock(name, owner_class)
+
+    def record_calls(node: ast.AST, lock: str, *, prune_bodies: bool) -> None:
+        """Calls under ``node`` attributed to ``lock``; when
+        ``prune_bodies`` the compound sub-blocks are skipped (they are
+        scanned separately with their own held state)."""
+        stack: list[ast.AST] = []
+        if prune_bodies:
+            for fname, value in ast.iter_fields(node):
+                if fname in _COMPOUND_BODIES or fname == "handlers":
+                    continue
+                if isinstance(value, list):
+                    stack.extend(v for v in value if isinstance(v, ast.AST))
+                elif isinstance(value, ast.AST):
+                    stack.append(value)
+        else:
+            stack.append(node)
+        while stack:
+            n = stack.pop()
+            if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(n, ast.Call):
+                name = dotted_name(n.func)
+                if name is not None:
+                    out.held_calls.append(HeldCall(qual, n.lineno, name, lock))
+            stack.extend(ast.iter_child_nodes(n))
+
+    def scan(stmts: list[ast.stmt], held: list[str]) -> None:
+        held = list(held)
+        for stmt in stmts:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            acquired = _lock_method_target(stmt, "acquire")
+            if acquired is not None:
+                lock = norm(acquired)
+                out.acquired.add(lock)
+                if held:
+                    out.edges.append(LockEdge(qual, stmt.lineno, held[-1], lock))
+                held.append(lock)
+                continue
+            released = _lock_method_target(stmt, "release")
+            if released is not None and norm(released) in held:
+                held.remove(norm(released))
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                locks = [
+                    norm(lock)
+                    for lock in (_lockish(i.context_expr) for i in stmt.items)
+                    if lock
+                ]
+                if locks:
+                    for lock in locks:
+                        out.acquired.add(lock)
+                        if held:
+                            out.edges.append(
+                                LockEdge(qual, stmt.lineno, held[-1], lock)
+                            )
+                    scan(stmt.body, held + locks)
+                    continue
+            is_compound = isinstance(
+                stmt,
+                (
+                    ast.If,
+                    ast.While,
+                    ast.For,
+                    ast.AsyncFor,
+                    ast.With,
+                    ast.AsyncWith,
+                    ast.Try,
+                ),
+            )
+            if held and not is_compound:
+                record_calls(stmt, held[-1], prune_bodies=False)
+                continue
+            if held and is_compound:
+                # header expressions (test/iter/context items) run here
+                record_calls(stmt, held[-1], prune_bodies=True)
+            for attr in _COMPOUND_BODIES:
+                inner = getattr(stmt, attr, None)
+                if inner:
+                    scan(inner, held)
+            for handler in getattr(stmt, "handlers", None) or []:
+                scan(handler.body, held)
+
+    scan(list(getattr(fn, "body", [])), [])
+    return out
+
+
+def function_locks(
+    ctx: FileContext, qual: str, fn: ast.AST, owner_class: str
+) -> FunctionLocks:
+    """Memoized :func:`scan_function` — HTL002 and LCK002 both need the
+    same scan for overlapping scopes; cache it on the per-run context."""
+    cache = getattr(ctx, "_function_locks", None)
+    if cache is None:
+        cache = {}
+        setattr(ctx, "_function_locks", cache)
+    if qual not in cache:
+        cache[qual] = scan_function(ctx, qual, fn, owner_class)
+    return cache[qual]
+
+
+def class_quals(ctx: FileContext) -> set[str]:
+    """All class qualnames in the file (``Outer.Inner`` style)."""
+    out: set[str] = set()
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                out.add(prefix + child.name)
+                walk(child, prefix + child.name + ".")
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(child, prefix + child.name + ".<locals>.")
+            else:
+                walk(child, prefix)
+
+    walk(ctx.tree, "")
+    return out
+
+
+def owner_class_of(qual: str, class_quals: set[str]) -> str:
+    """Innermost class qualname prefix of a function qualname —
+    ``C.f`` -> ``C``, ``Outer.Inner.f.<locals>.g`` -> ``Outer.Inner``,
+    module-level ``f`` -> ''."""
+    parts = qual.split(".")
+    best = ""
+    for cut in range(len(parts) - 1, 0, -1):
+        cand = ".".join(parts[:cut])
+        if cand in class_quals:
+            return cand
+    return best

@@ -10,9 +10,13 @@ from .direct_render import DirectRenderRule
 from .exception_breadth import ExceptionBreadthRule
 from .inline_fit import InlineFitRule
 from .lock_blocking import LockBlockingRule
+from .lock_order import LockOrderRule
 from .metrics_allowlist import MetricsAllowlistRule
 from .raw_urlopen import RawUrlopenRule
+from .release_paths import ReleaseOnAllPathsRule
+from .slo_observation import SloObservationRule
 from .thread_spawn import ThreadSpawnRule
+from .transitive_blocking import TransitiveLockBlockingRule
 from .unregistered_jit import UnregisteredJitRule
 from .wall_clock import WallClockRule
 
@@ -30,6 +34,11 @@ def all_rules() -> list[Rule]:
         ExceptionBreadthRule(),
         ThreadSpawnRule(),
         MetricsAllowlistRule(),
+        # ADR-023 flow rules — call-graph/CFG backed, finalize-phase.
+        TransitiveLockBlockingRule(),
+        LockOrderRule(),
+        ReleaseOnAllPathsRule(),
+        SloObservationRule(),
     ]
 
 
@@ -43,4 +52,8 @@ RULE_IDS = {
     "EXC001": ExceptionBreadthRule,
     "THR001": ThreadSpawnRule,
     "SYN001": MetricsAllowlistRule,
+    "HTL002": TransitiveLockBlockingRule,
+    "LCK002": LockOrderRule,
+    "REL001": ReleaseOnAllPathsRule,
+    "OBS001": SloObservationRule,
 }

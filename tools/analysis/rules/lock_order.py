@@ -1,0 +1,191 @@
+"""LCK002 — lock-order-cycle detection (ADR-023).
+
+Builds the lock acquisition-order graph across ``runtime/``,
+``gateway/``, ``push/``, ``transport/`` and ``obs/``: an edge A -> B
+means some code path acquires B while holding A — either a nested
+``with``/``acquire()`` in the same function, or (interprocedurally) a
+call made under A to a function that transitively acquires B through
+resolved call-graph edges. Any cycle in that graph is a potential
+deadlock: two threads entering the cycle from different points can
+each hold what the other wants.
+
+Lock identity is the ADR-023 per-spelling normalisation
+(``Class.attr`` for ``self.X``, dotted name as written otherwise) —
+two *instances* behind one spelling collapse to one node, and a
+re-entrant RLock self-edge is reported like any other cycle; both
+caveats are grandfather material, not reasons to mute the rule.
+"""
+
+from __future__ import annotations
+
+from ..engine import Diagnostic, FileContext, Rule
+
+MESSAGE = (
+    "lock-order cycle {cycle} — threads acquiring these locks in "
+    "different orders can deadlock; pick one global order (ADR-023). "
+    "Sites: {sites}"
+)
+
+_SCOPES = (
+    "headlamp_tpu/runtime/",
+    "headlamp_tpu/gateway/",
+    "headlamp_tpu/push/",
+    "headlamp_tpu/transport/",
+    "headlamp_tpu/obs/",
+)
+
+
+class LockOrderRule(Rule):
+    rule_id = "LCK002"
+    name = "no-lock-order-cycles"
+    description = "The cross-subsystem lock acquisition graph stays acyclic"
+    top_dirs = ("headlamp_tpu",)
+    scope_dirs = _SCOPES
+
+    def __init__(self) -> None:
+        #: (relpath, FunctionLocks) for every scoped function.
+        self._scanned: list[tuple[str, object]] = []
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        from ..flow.locks import class_quals, function_locks, owner_class_of
+
+        classes = class_quals(ctx)
+        for qual, fn in ctx.functions():
+            owner = owner_class_of(qual, classes)
+            self._scanned.append((ctx.relpath, function_locks(ctx, qual, fn, owner)))
+        return []
+
+    def finalize(self, run) -> list[Diagnostic]:
+        from ..flow.locks import class_quals, function_locks, owner_class_of
+
+        scanned, self._scanned = self._scanned, []
+        if not scanned:
+            return []
+        graph = run.project().callgraph()
+        contexts = run.project().contexts
+        class_cache: dict[str, set[str]] = {}
+
+        #: Lazily scanned FunctionLocks for ANY project function the
+        #: closure walks into (callees may live outside the scope dirs).
+        locks_cache: dict[tuple[str, str], object] = {
+            (rel, fl.qual): fl for rel, fl in scanned
+        }
+
+        def locks_of(key: tuple[str, str]):
+            if key not in locks_cache:
+                rel, qual = key
+                ctx = contexts.get(rel)
+                fn = graph.defs.get(key)
+                if ctx is None or fn is None:
+                    return None
+                if rel not in class_cache:
+                    class_cache[rel] = class_quals(ctx)
+                owner = owner_class_of(qual, class_cache[rel])
+                locks_cache[key] = function_locks(ctx, qual, fn, owner)
+            return locks_cache[key]
+
+        #: Transitively acquired lock set per function (memoized DFS,
+        #: cycle-guarded: a recursion cycle contributes what it has).
+        closure_memo: dict[tuple[str, str], set[str]] = {}
+
+        def closure(key: tuple[str, str], visiting: set) -> set[str]:
+            if key in closure_memo:
+                return closure_memo[key]
+            if key in visiting:
+                return set()
+            visiting.add(key)
+            fl = locks_of(key)
+            acc: set[str] = set(fl.acquired) if fl is not None else set()
+            for callee in graph.callees(key):
+                acc |= closure(callee, visiting)
+            visiting.discard(key)
+            closure_memo[key] = acc
+            return acc
+
+        # Build the lock-order graph: direct nested edges + edges into
+        # everything a function called under the lock transitively takes.
+        adj: dict[str, set[str]] = {}
+        sites: dict[tuple[str, str], tuple[str, int, str]] = {}
+
+        def add_edge(a: str, b: str, path: str, line: int, qual: str) -> None:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+            sites.setdefault((a, b), (path, line, qual))
+
+        for rel, fl in scanned:
+            for edge in fl.edges:
+                add_edge(edge.held, edge.acquired, rel, edge.line, edge.qual)
+            for hc in fl.held_calls:
+                caller = (rel, hc.qual)
+                target = None
+                for site in graph.calls.get(caller, []):
+                    if site.line == hc.line and site.dotted == hc.call:
+                        target = site.target
+                        break
+                if target is None:
+                    continue
+                for lock in sorted(closure(target, set())):
+                    add_edge(hc.lock, lock, rel, hc.line, hc.qual)
+
+        # Tarjan SCC over the lock graph; any SCC of size >1 (or a
+        # self-edge) is a cycle.
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on_stack: set[str] = set()
+        stack: list[str] = []
+        sccs: list[list[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            for w in sorted(adj.get(v, ())):
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                sccs.append(comp)
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+
+        out: list[Diagnostic] = []
+        for comp in sccs:
+            comp_set = set(comp)
+            cyclic = len(comp) > 1 or (comp[0] in adj.get(comp[0], set()))
+            if not cyclic:
+                continue
+            members = sorted(comp_set)
+            cycle_edges = sorted(
+                (a, b) for (a, b) in sites if a in comp_set and b in comp_set
+            )
+            site_bits = [
+                f"{a}->{b} at {sites[(a, b)][0]}:{sites[(a, b)][1]}"
+                for a, b in cycle_edges
+            ]
+            anchor = min(sites[e] for e in cycle_edges)
+            out.append(
+                Diagnostic(
+                    self.rule_id,
+                    anchor[0],
+                    anchor[1],
+                    MESSAGE.format(
+                        cycle=" -> ".join(members + [members[0]]),
+                        sites="; ".join(site_bits),
+                    ),
+                    context=anchor[2],
+                )
+            )
+        return sorted(out, key=lambda d: (d.path, d.line))

@@ -1,0 +1,205 @@
+"""REL001 — release-on-all-paths (ADR-023).
+
+The pool files hand-manage non-``with`` resources: semaphore slots
+(``slot.sem.acquire`` in ``transport/pool.py``), raw ``acquire()``
+spans, and checkout bindings (``conn, reused = self._checkout(...)``).
+This rule walks each function's CFG and fires when a path from an
+acquisition reaches the normal OR raise exit without disposing of the
+resource.
+
+Acquisition forms:
+
+- ``X.acquire(...)`` expression statement, ``X`` lock-ish or
+  semaphore-ish — held on every successor.
+- ``if not X.acquire(...):`` guard — held only on the FALSE branch
+  (the CFG's branch-order convention), so the guard's bail-out path is
+  not a false positive.
+- ``name = <...>._checkout(...)`` / ``name, flag = ...`` — the bound
+  name is a checked-out resource.
+
+Dispositions (deliberately loose — zero false positives beats perfect
+leak proofs; the ADR-023 caveat):
+
+- for ``X.acquire`` resources: a statement calling ``X.release()``;
+- for checkout bindings: ANY statement that mentions the bound name —
+  returning it, passing it to ``_discard``/``_release``/a response
+  wrapper all transfer ownership somewhere that is responsible for it.
+
+Exception edges exist only inside ``try`` bodies (plus explicit
+``raise``) — see ``flow/cfg.py``; a helper call outside any ``try``
+is assumed non-raising.
+
+Ownership transfers that are correct BY CONTRACT (``_checkout``
+returns holding the slot semaphore; ``PooledResponse.close`` releases
+it later) are grandfathered in ``baseline.json`` with that reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+
+from ..engine import Diagnostic, FileContext, Rule, dotted_name
+
+_FILES = (
+    "headlamp_tpu/transport/pool.py",
+    "headlamp_tpu/gateway/pool.py",
+    "headlamp_tpu/push/hub.py",
+)
+
+#: Terminal names that denote a hand-released resource object.
+_RESOURCE_RE = re.compile(r"^_{0,2}(bg_)?(lock|mutex|cond|cv|sem|semaphore)$")
+
+#: Call terminals that bind a checked-out resource to a name.
+_CHECKOUT_TERMINALS = {"_checkout", "checkout"}
+
+MESSAGE = (
+    "`{res}` acquired here can reach the {exit} exit without a "
+    "release/hand-off on some path — every CFG path (including "
+    "exception edges) must dispose of it (REL001, ADR-023)"
+)
+
+
+def _resourceish(expr: ast.AST) -> str | None:
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    return name if _RESOURCE_RE.match(name.rsplit(".", 1)[-1]) else None
+
+
+def _acquire_call(node: ast.AST) -> str | None:
+    """``X.acquire(...)`` with resource-ish X -> dotted X."""
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    if not isinstance(func, ast.Attribute) or func.attr != "acquire":
+        return None
+    return _resourceish(func.value)
+
+
+@dataclass
+class _Resource:
+    kind: str  # "acquire" | "guard" | "checkout"
+    name: str  # dotted lock/sem name, or bound variable name
+    line: int
+
+
+class ReleaseOnAllPathsRule(Rule):
+    rule_id = "REL001"
+    name = "release-on-all-paths"
+    description = (
+        "Pool checkouts and raw acquire()s are disposed of on every "
+        "CFG path, exception edges included"
+    )
+    top_dirs = ("headlamp_tpu",)
+
+    def wants(self, relpath: str) -> bool:
+        return relpath.replace("\\", "/") in _FILES
+
+    # -- acquisition / disposition classification ------------------------
+
+    def _classify(self, stmt: ast.stmt) -> _Resource | None:
+        if isinstance(stmt, ast.Expr):
+            name = _acquire_call(stmt.value)
+            if name is not None:
+                return _Resource("acquire", name, stmt.lineno)
+        if (
+            isinstance(stmt, ast.If)
+            and isinstance(stmt.test, ast.UnaryOp)
+            and isinstance(stmt.test.op, ast.Not)
+        ):
+            name = _acquire_call(stmt.test.operand)
+            if name is not None:
+                return _Resource("guard", name, stmt.lineno)
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            func_name = dotted_name(stmt.value.func)
+            if (
+                func_name is not None
+                and func_name.rsplit(".", 1)[-1] in _CHECKOUT_TERMINALS
+            ):
+                target = stmt.targets[0]
+                if isinstance(target, ast.Tuple) and target.elts:
+                    target = target.elts[0]
+                if isinstance(target, ast.Name):
+                    return _Resource("checkout", target.id, stmt.lineno)
+        return None
+
+    def _disposes(self, stmt: ast.stmt, res: _Resource) -> bool:
+        # own_nodes: a release nested in a compound statement's BODY is
+        # that body block's disposition, not the header's — attributing
+        # it here would mark the skip branch disposed too.
+        from ..flow.cfg import own_nodes
+
+        if res.kind == "checkout":
+            return any(
+                isinstance(node, ast.Name) and node.id == res.name
+                for node in own_nodes(stmt)
+            )
+        return any(
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "release"
+            and dotted_name(node.func.value) == res.name
+            for node in own_nodes(stmt)
+        )
+
+    # -- per-function CFG walk -------------------------------------------
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        out: list[Diagnostic] = []
+        for qual, fn in ctx.functions():
+            cfg = ctx.cfg(fn)
+            resources: list[tuple[_Resource, list[int]]] = []
+            for block in cfg.stmt_blocks():
+                res = self._classify(block.stmt)
+                if res is None:
+                    continue
+                if res.kind == "guard":
+                    # held only where the guard test is FALSE
+                    starts = [block.succs[1]] if len(block.succs) > 1 else []
+                else:
+                    starts = list(block.succs)
+                resources.append((res, starts))
+            seen: set[tuple[str, int, str]] = set()
+            for res, starts in resources:
+                leak = self._leaks(cfg, res, starts)
+                if leak is None:
+                    continue
+                key = (res.name, res.line, leak)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(
+                    Diagnostic(
+                        self.rule_id,
+                        ctx.relpath,
+                        res.line,
+                        MESSAGE.format(res=res.name, exit=leak),
+                        context=qual,
+                    )
+                )
+        return sorted(out, key=lambda d: (d.line, d.message))
+
+    def _leaks(self, cfg, res: _Resource, starts: list[int]) -> str | None:
+        """BFS from the acquisition's successors; disposal blocks stop
+        the walk. Returns which exit a still-held path reaches."""
+        queue = list(starts)
+        visited: set[int] = set()
+        hit: str | None = None
+        while queue:
+            bid = queue.pop(0)
+            if bid in visited:
+                continue
+            visited.add(bid)
+            if bid == cfg.EXIT:
+                return "normal"  # worst case first: report deterministically
+            if bid == cfg.RAISE:
+                hit = "raise"
+                continue
+            block = cfg.blocks[bid]
+            if block.stmt is not None and self._disposes(block.stmt, res):
+                continue
+            queue.extend(block.succs)
+            queue.extend(block.exc_succs)
+        return hit

@@ -1,0 +1,193 @@
+"""OBS001 — exactly-once SLO observation in the gateway (ADR-023).
+
+The r10-review invariant, statically enforced: every gateway outcome
+path observes the request-duration histogram (``*._req_hist.observe``)
+AT MOST once, and the paths that must stay out of the latency SLO —
+shed/queue-full/timeout 5xx responses and 304 revalidations — never
+observe it at all (they still count in ``requests_total``; that is a
+counter, not this histogram).
+
+Mechanics: forward dataflow over the ADR-023 CFG tracking the set of
+possible observation counts {0, 1, 2+} reaching each block. An
+"observation event" is a direct ``…._req_hist.observe(...)`` call
+(receiver-matched, so ``_QUEUE_WAIT.observe`` in the same file is NOT
+an event) or a resolved call-graph edge into a function that may
+observe transitively. At each ``return``:
+
+- possible count ≥ 2  → "may observe more than once";
+- a no-observe return (``return self._shed_response(...)`` or
+  ``return GatewayResponse(<const ≥500 or 304>, ...)``) with possible
+  count ≥ 1 → "5xx/304/shed path observes the SLO histogram".
+
+Raise exits are not checked — an exception that escapes the gateway is
+the socket layer's problem, not an SLO outcome path.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Diagnostic, FileContext, Rule, dotted_name
+
+MESSAGE_TWICE = (
+    "a path reaching this return may observe the request-duration "
+    "histogram more than once — the SLO denominator must count each "
+    "request exactly once (r10-review invariant; ADR-023)"
+)
+MESSAGE_ERROR_PATH = (
+    "5xx/304/shed return, but a path reaching it observes the "
+    "request-duration histogram — error and revalidation outcomes must "
+    "stay out of the latency SLO (r10-review invariant; ADR-023)"
+)
+
+#: Histogram receiver suffix that makes a call an observation event.
+_OBSERVE_SUFFIX = ("_req_hist", "observe")
+
+
+def _is_observe(dotted: str) -> bool:
+    parts = dotted.split(".")
+    return len(parts) >= 2 and tuple(parts[-2:]) == _OBSERVE_SUFFIX
+
+
+def _calls_in_stmt(stmt: ast.stmt) -> list[tuple[int, str]]:
+    """(line, dotted) for every call executed BY this block — the
+    statement's own expressions only. Nested statement bodies are their
+    own CFG blocks (counting them here double-counts every event) and
+    nested def/lambda bodies run later; ``own_nodes`` prunes both."""
+    from ..flow.cfg import own_nodes
+
+    out: list[tuple[int, str]] = []
+    for node in own_nodes(stmt):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is not None:
+                out.append((node.lineno, name))
+    return out
+
+
+def _no_observe_return(stmt: ast.Return) -> bool:
+    value = stmt.value
+    if not isinstance(value, ast.Call):
+        return False
+    name = dotted_name(value.func)
+    if name is None:
+        return False
+    terminal = name.rsplit(".", 1)[-1]
+    if terminal == "_shed_response":
+        return True
+    if terminal == "GatewayResponse" and value.args:
+        status = value.args[0]
+        if isinstance(status, ast.Constant) and isinstance(status.value, int):
+            return status.value >= 500 or status.value == 304
+    return False
+
+
+class SloObservationRule(Rule):
+    rule_id = "OBS001"
+    name = "exactly-once-slo-observation"
+    description = (
+        "Every gateway outcome path observes the request-duration "
+        "histogram at most once; 5xx/304/shed paths never do"
+    )
+    top_dirs = ("headlamp_tpu",)
+    scope_dirs = ("headlamp_tpu/gateway/",)
+
+    def __init__(self) -> None:
+        self._functions: list[tuple[FileContext, str, ast.AST]] = []
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        self._functions.extend((ctx, qual, fn) for qual, fn in ctx.functions())
+        return []
+
+    def finalize(self, run) -> list[Diagnostic]:
+        functions, self._functions = self._functions, []
+        if not functions:
+            return []
+        graph = run.project().callgraph()
+
+        # Transitive may-observe closure over resolved call edges.
+        may_observe: dict[tuple[str, str], bool] = {}
+
+        def observes(key: tuple[str, str], visiting: set) -> bool:
+            if key in may_observe:
+                return may_observe[key]
+            if key in visiting:
+                return False
+            visiting.add(key)
+            hit = False
+            for site in graph.calls.get(key, []):
+                if _is_observe(site.dotted):
+                    hit = True
+                    break
+                if site.target is not None and observes(site.target, visiting):
+                    hit = True
+                    break
+            visiting.discard(key)
+            may_observe[key] = hit
+            return hit
+
+        out: list[Diagnostic] = []
+        for ctx, qual, fn in functions:
+            key = (ctx.relpath, qual)
+            site_targets = {
+                (s.line, s.dotted): s.target for s in graph.calls.get(key, [])
+            }
+
+            def events(stmt: ast.stmt) -> int:
+                n = 0
+                for line, dotted in _calls_in_stmt(stmt):
+                    if _is_observe(dotted):
+                        n += 1
+                        continue
+                    target = site_targets.get((line, dotted))
+                    if target is not None and observes(target, set()):
+                        n += 1
+                return n
+
+            cfg = ctx.cfg(fn)
+            # Forward worklist: possible observe-counts INTO each block.
+            in_counts: dict[int, set[int]] = {cfg.ENTRY: {0}}
+            work = [cfg.ENTRY]
+            while work:
+                bid = work.pop()
+                block = cfg.blocks[bid]
+                state = in_counts.get(bid, set())
+                ev = events(block.stmt) if block.stmt is not None else 0
+                out_state = {min(c + ev, 2) for c in state}
+                for nxt in list(block.succs) + list(block.exc_succs):
+                    known = in_counts.setdefault(nxt, set())
+                    if not out_state <= known:
+                        known |= out_state
+                        work.append(nxt)
+
+            for block in cfg.stmt_blocks():
+                stmt = block.stmt
+                if not isinstance(stmt, ast.Return):
+                    continue
+                state = in_counts.get(block.id)
+                if not state:
+                    continue  # unreachable
+                after = {min(c + events(stmt), 2) for c in state}
+                if 2 in after:
+                    out.append(
+                        Diagnostic(
+                            self.rule_id,
+                            ctx.relpath,
+                            stmt.lineno,
+                            MESSAGE_TWICE,
+                            context=qual,
+                        )
+                    )
+                elif _no_observe_return(stmt) and max(after) >= 1:
+                    out.append(
+                        Diagnostic(
+                            self.rule_id,
+                            ctx.relpath,
+                            stmt.lineno,
+                            MESSAGE_ERROR_PATH,
+                            context=qual,
+                        )
+                    )
+        # A return duplicated into several finally copies reports once.
+        unique = {(d.path, d.line, d.message): d for d in out}
+        return sorted(unique.values(), key=lambda d: (d.path, d.line))

@@ -1,0 +1,146 @@
+"""HTL002 — no TRANSITIVELY blocking call while holding a lock.
+
+HTL001 catches ``time.sleep`` textually inside a lock region. The r09
+stall was never that obvious: the sync loop held the metrics-cache
+lock and called a helper that called the fit entry. This rule walks
+the ADR-023 call graph from every call made under a held lock and
+fires when the callee TRANSITIVELY reaches a blocking seam (the same
+seam set HTL001 matches: AOT program entries from ``models/aot.py``'s
+``_BUILDERS`` table, fit prefixes, transport/render/sleep names).
+
+Division of labour: a call whose own terminal name IS a seam is
+HTL001's finding and is skipped here — HTL002 only reports chains of
+length ≥ 2, so the pair never double-reports one site.
+
+Unresolved call targets (attribute chains through objects, callables
+in variables) are not followed — the ADR-023 resolution limits; the
+call graph records them, and `tools/analysis/flow/callgraph.py` keeps
+the count inspectable.
+"""
+
+from __future__ import annotations
+
+from ..engine import Diagnostic, FileContext, Rule
+from .lock_blocking import (
+    FIT_PREFIXES,
+    STATIC_SEAMS,
+    _builder_entry_names,
+)
+
+MESSAGE = (
+    "call `{call}` while holding `{lock}` transitively reaches blocking "
+    "seam `{seam}` (chain: {chain}) — hoist the blocking work out of the "
+    "lock region (r09 class, interprocedural; ADR-023)"
+)
+
+
+class TransitiveLockBlockingRule(Rule):
+    rule_id = "HTL002"
+    name = "no-lock-held-transitive-blocking-call"
+    description = (
+        "Functions called while a lock is held must not transitively "
+        "reach a blocking seam"
+    )
+    top_dirs = ("headlamp_tpu",)
+
+    def __init__(self) -> None:
+        self._held_calls: list[tuple[str, object]] = []  # (relpath, HeldCall)
+        self._aot_programs: set[str] = set()
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        from ..flow.locks import class_quals, function_locks, owner_class_of
+
+        if ctx.relpath.replace("\\", "/").endswith("models/aot.py"):
+            self._aot_programs |= _builder_entry_names(ctx.tree)
+        classes = class_quals(ctx)
+        for qual, fn in ctx.functions():
+            owner = owner_class_of(qual, classes)
+            locks = function_locks(ctx, qual, fn, owner)
+            for hc in locks.held_calls:
+                self._held_calls.append((ctx.relpath, hc))
+        return []
+
+    def finalize(self, run) -> list[Diagnostic]:
+        held_calls, self._held_calls = self._held_calls, []
+        aot, self._aot_programs = self._aot_programs, set()
+        if not held_calls:
+            return []
+        seams = STATIC_SEAMS | aot | {"forecast_slo_burn"}
+
+        def is_seam(dotted: str) -> bool:
+            terminal = dotted.rsplit(".", 1)[-1]
+            return terminal in seams or terminal.startswith(FIT_PREFIXES)
+
+        graph = run.project().callgraph()
+
+        #: node -> first direct seam call's dotted name, if any.
+        direct: dict[tuple[str, str], str] = {}
+        for key, sites in graph.calls.items():
+            for site in sites:
+                if is_seam(site.dotted):
+                    direct[key] = site.dotted
+                    break
+
+        #: memo: node -> (seam dotted, chain of node quals) or None
+        memo: dict[tuple[str, str], tuple[str, list[str]] | None] = {}
+
+        def reaches_seam(start: tuple[str, str]) -> tuple[str, list[str]] | None:
+            if start in memo:
+                return memo[start]
+            # BFS with parent pointers — shortest chain for the message.
+            parents: dict[tuple[str, str], tuple[str, str] | None] = {start: None}
+            queue = [start]
+            while queue:
+                node = queue.pop(0)
+                if node in direct:
+                    chain = []
+                    cur: tuple[str, str] | None = node
+                    while cur is not None:
+                        chain.append(cur[1])
+                        cur = parents[cur]
+                    hit = (direct[node], list(reversed(chain)))
+                    memo[start] = hit
+                    return hit
+                for callee in graph.callees(node):
+                    if callee not in parents:
+                        parents[callee] = node
+                        queue.append(callee)
+            memo[start] = None
+            return None
+
+        out: list[Diagnostic] = []
+        seen: set[tuple[str, int, str, str]] = set()
+        for relpath, hc in held_calls:
+            if is_seam(hc.call):
+                continue  # direct seam = HTL001's finding, not ours
+            caller = (relpath, hc.qual)
+            target = None
+            for site in graph.calls.get(caller, []):
+                if site.line == hc.line and site.dotted == hc.call:
+                    target = site.target
+                    break
+            if target is None:
+                continue  # unresolved — recorded on the graph, not followed
+            hit = reaches_seam(target)
+            if hit is None:
+                continue
+            seam, chain = hit
+            key = (relpath, hc.line, hc.call, hc.lock)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(
+                Diagnostic(
+                    self.rule_id,
+                    relpath,
+                    hc.line,
+                    MESSAGE.format(
+                        call=hc.call,
+                        lock=hc.lock,
+                        seam=seam,
+                        chain=" -> ".join(chain + [seam]),
+                    ),
+                    context=hc.qual,
+                )
+            )
+        return sorted(out, key=lambda d: (d.path, d.line))

@@ -1462,8 +1462,44 @@ _PY_GATES = (
 )
 
 
-def main() -> int:
-    root = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    if "--update-baseline" in argv:
+        # Maintenance subcommand: regenerate tools/analysis/baseline.json
+        # from the CURRENT findings — still-matching entries keep their
+        # original reason, new findings are added under the mandatory
+        # --reason, stale entries are pruned. Replaces hand-editing.
+        tools_dir = os.path.dirname(os.path.abspath(__file__))
+        if tools_dir not in sys.path:
+            sys.path.insert(0, tools_dir)
+        from analysis.engine import EXIT_INTERNAL, update_baseline
+
+        reason = ""
+        if "--reason" in argv:
+            i = argv.index("--reason")
+            if i + 1 < len(argv):
+                reason = argv[i + 1]
+        if not reason.strip():
+            print(
+                "--update-baseline requires --reason \"...\" — grandfathered "
+                "findings carry a reason, always",
+                file=sys.stderr,
+            )
+            return EXIT_INTERNAL
+        try:
+            stats = update_baseline(reason=reason)
+        except Exception as exc:
+            print(f"baseline regeneration failed: {exc}", file=sys.stderr)
+            return EXIT_INTERNAL
+        print(
+            f"baseline regenerated: {stats['added']} added, "
+            f"{stats['kept']} kept, {stats['pruned']} pruned "
+            f"-> {stats['path']}"
+        )
+        return 0
+
+    root = argv[0] if argv else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "plugin", "src"
     )
     diagnostics = check_tree(root)
