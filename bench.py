@@ -1986,14 +1986,26 @@ def bench_analysis() -> dict:
         print(f"[bench] analysis wall comparison skipped: {exc!r}", file=sys.stderr)
 
     flow_rules = sum(
-        1 for r in all_rules() if r.rule_id in ("HTL002", "LCK002", "REL001", "OBS001")
+        1
+        for r in all_rules()
+        if r.rule_id
+        in ("HTL002", "LCK002", "REL001", "OBS001", "GRD001", "GRD002", "PUB001")
     )
+    # Per-rule wall from the engine's own accounting (ADR-024): lazy
+    # project artifacts (call graph, thread roles, field index) are
+    # billed to the FIRST finalize that asks for them, so the shape of
+    # this dict shifts with registry order — read it as "where did the
+    # run's time go", not as each rule's intrinsic cost.
+    rule_ms = {
+        rule_id: round(ms, 2) for rule_id, ms in sorted(result.rule_ms.items())
+    }
     return {
         "analysis_wall_ms": wall_ms,
         "analysis_legacy_5walk_ms": round(statistics.median(legacy_samples), 2),
         "analysis_files_scanned": len(result.parse_counts),
         "analysis_rules": len(all_rules()),
         "analysis_flow_rules": flow_rules,
+        "analysis_rule_ms": rule_ms,
         "analysis_suppressed": len(result.suppressed),
         "analysis_baselined": len(result.baselined),
         # prev_round prefix => skipped by compare_prev_round (it would

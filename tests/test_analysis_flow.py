@@ -2,9 +2,11 @@
 
 What this file pins:
 
-  1. Call-graph resolution: ``self.`` methods, module-level defs,
-     ``from``-imports across files — and that UNRESOLVED targets are
-     recorded on the graph, never silently dropped.
+  1. Call-graph resolution: ``self.`` methods (own class, then one
+     single-level base — same-file or ``from``-imported; grandparents
+     stay unresolved), module-level defs, ``from``-imports across
+     files — and that UNRESOLVED targets are recorded on the graph,
+     never silently dropped.
   2. CFG shape essentials the rules rely on: branch order on ``If``,
      exception edges only inside ``try`` bodies, ``finally``
      duplication covering the raise path.
@@ -15,7 +17,9 @@ What this file pins:
      OBS001 double observe): the seeded bug fires, the minimal fix is
      clean. The live tree staying clean is test_analysis.py's job.
   5. Engine CLI exit codes: 0 clean, 1 findings, 2 stale baseline,
-     3 parse/internal error.
+     3 parse/internal error — and that ``--only RULE_ID[,…]`` keeps
+     those semantics (unselected rules' baseline entries are filtered,
+     not reported stale; unknown ids are a usage error).
   6. ``update_baseline`` (the ``ts_static_check --update-baseline``
      core): adds under the mandatory reason, keeps original reasons,
      prunes stale entries.
@@ -132,6 +136,88 @@ class TestCallGraphResolution:
         assert g.callees(("headlamp_tpu/m2.py", "go")) == [
             ("headlamp_tpu/m1.py", "helper")
         ]
+
+    def test_self_method_resolves_through_same_file_base(self, tmp_path):
+        g = _graph_for(
+            tmp_path,
+            {
+                "headlamp_tpu/x.py": (
+                    "class Base:\n"
+                    "    def helper(self):\n"
+                    "        pass\n"
+                    "class Child(Base):\n"
+                    "    def go(self):\n"
+                    "        self.helper()\n"
+                )
+            },
+        )
+        assert g.callees(("headlamp_tpu/x.py", "Child.go")) == [
+            ("headlamp_tpu/x.py", "Base.helper")
+        ]
+
+    def test_self_method_resolves_through_imported_base(self, tmp_path):
+        g = _graph_for(
+            tmp_path,
+            {
+                "headlamp_tpu/base.py": (
+                    "class Base:\n"
+                    "    def helper(self):\n"
+                    "        pass\n"
+                ),
+                "headlamp_tpu/child.py": (
+                    "from headlamp_tpu.base import Base\n"
+                    "class Child(Base):\n"
+                    "    def go(self):\n"
+                    "        self.helper()\n"
+                ),
+            },
+        )
+        assert g.callees(("headlamp_tpu/child.py", "Child.go")) == [
+            ("headlamp_tpu/base.py", "Base.helper")
+        ]
+
+    def test_own_method_shadows_base_method(self, tmp_path):
+        g = _graph_for(
+            tmp_path,
+            {
+                "headlamp_tpu/x.py": (
+                    "class Base:\n"
+                    "    def helper(self):\n"
+                    "        pass\n"
+                    "class Child(Base):\n"
+                    "    def helper(self):\n"
+                    "        pass\n"
+                    "    def go(self):\n"
+                    "        self.helper()\n"
+                )
+            },
+        )
+        assert g.callees(("headlamp_tpu/x.py", "Child.go")) == [
+            ("headlamp_tpu/x.py", "Child.helper")
+        ]
+
+    def test_grandparent_base_not_followed(self, tmp_path):
+        # Single-level on purpose (ADR-023): a method defined two hops
+        # up stays UNRESOLVED — recorded, not misattributed.
+        g = _graph_for(
+            tmp_path,
+            {
+                "headlamp_tpu/x.py": (
+                    "class A:\n"
+                    "    def helper(self):\n"
+                    "        pass\n"
+                    "class B(A):\n"
+                    "    pass\n"
+                    "class C(B):\n"
+                    "    def go(self):\n"
+                    "        self.helper()\n"
+                )
+            },
+        )
+        key = ("headlamp_tpu/x.py", "C.go")
+        assert g.callees(key) == []
+        assert [s.dotted for s in g.unresolved(key)] == ["self.helper"]
+        assert g.unresolved_total() == 1
 
     def test_unresolved_targets_recorded_never_dropped(self, tmp_path):
         g = _graph_for(
@@ -638,6 +724,48 @@ class TestExitCodes:
         bad = tmp_path / "bl.json"
         bad.write_text("{not json")
         assert engine_main([root, "--baseline", str(bad)]) == EXIT_INTERNAL
+
+    FINDING_SRC = (
+        "import threading\n"
+        "def boot():\n"
+        "    threading.Thread(target=print).start()\n"
+    )
+
+    def test_only_runs_selected_rules_with_same_exit_codes(self, tmp_path):
+        root = _tree(tmp_path, {"headlamp_tpu/x.py": self.FINDING_SRC})
+        bl = self._baseline(tmp_path, [])
+        # The THR001 finding fires when selected, disappears when not.
+        args = [root, "--baseline", bl]
+        assert engine_main(args + ["--only", "THR001"]) == EXIT_FINDINGS
+        assert engine_main(args + ["--only", "EXC001,REL001"]) == EXIT_OK
+
+    def test_only_filters_unselected_baseline_entries(self, tmp_path):
+        # A grandfathered finding of a rule you did NOT run must not
+        # read as stale: --only filters the baseline too, so exit
+        # semantics are unchanged (0, not 2).
+        root = _tree(tmp_path, {"headlamp_tpu/x.py": self.FINDING_SRC})
+        bl = self._baseline(
+            tmp_path,
+            [
+                {
+                    "rule": "THR001",
+                    "path": "headlamp_tpu/x.py",
+                    "context": "boot",
+                    "reason": "synthetic grandfather",
+                }
+            ],
+        )
+        args = [root, "--baseline", bl]
+        assert engine_main(args + ["--only", "EXC001"]) == EXIT_OK
+        # ... and the entry still matches when its rule IS selected.
+        assert engine_main(args + ["--only", "THR001"]) == EXIT_OK
+
+    def test_only_unknown_rule_id_exits_3(self, tmp_path):
+        root = _tree(tmp_path, {"headlamp_tpu/x.py": "def ok():\n    pass\n"})
+        bl = self._baseline(tmp_path, [])
+        args = [root, "--baseline", bl]
+        assert engine_main(args + ["--only", "NOPE001"]) == EXIT_INTERNAL
+        assert engine_main(args + ["--only", ""]) == EXIT_INTERNAL
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,433 @@
+"""ADR-024 thread-role race layer (flow/threads.py, flow/fields.py +
+the GRD001/GRD002/PUB001 rules).
+
+What this file pins:
+
+  1. Thread-role inference over a synthetic two-seam module: each
+     resolved ``threading.Thread`` spawn is its own role named after
+     the TARGET, reachability follows the ADR-023 call graph, a
+     function two roles reach is shared, the ADR-015 trampoline shape
+     (``target=ctx.run, args=(self._refit, …)``) resolves through
+     ``args[0]``, and a spawn already covered by a static role row
+     does NOT double-count as a second role.
+  2. A mutation pair per race rule: GRD001 (an unguarded minority
+     access of a two-role field fires; the fully-guarded twin is
+     clean), GRD002 (check and act under two separate acquisitions of
+     the same lock fires; the single-region twin is clean), PUB001
+     (mutating a published object fires; the deep-copy twin and the
+     rebind-kill twin are clean).
+  3. The CI guard: the full 16-rule engine over the LIVE tree exits
+     0 against the committed baseline, and per-rule wall accounting
+     (``rule_ms``) covers every registered rule.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from analysis.engine import (  # noqa: E402
+    EXIT_OK,
+    Engine,
+    main as engine_main,
+)
+from analysis.flow.callgraph import build_call_graph  # noqa: E402
+from analysis.flow.threads import build_thread_roles  # noqa: E402
+from analysis.rules import RULE_IDS, all_rules  # noqa: E402
+from analysis.rules.atomicity import CheckThenActRule  # noqa: E402
+from analysis.rules.guarded_by import GuardedByRule  # noqa: E402
+from analysis.rules.publish_mutate import PublishThenMutateRule  # noqa: E402
+from analysis.rules.transitive_blocking import (  # noqa: E402
+    TransitiveLockBlockingRule,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tree(tmp_path, files):
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    return str(tmp_path)
+
+
+def _roles_for(tmp_path, files):
+    engine = Engine([TransitiveLockBlockingRule()], root=_tree(tmp_path, files))
+    engine.run()
+    return build_thread_roles(engine.contexts, build_call_graph(engine.contexts))
+
+
+def _check(rule, relpath, src):
+    engine = Engine([rule], root=REPO)
+    return engine.check_source(rule, relpath, src)
+
+
+# ---------------------------------------------------------------------------
+# Thread-role inference
+# ---------------------------------------------------------------------------
+
+
+TWO_SEAM_SRC = (
+    "import threading\n"
+    "class Svc:\n"
+    "    def start(self):\n"
+    "        threading.Thread(target=self._loop_a, daemon=True).start()\n"
+    "        threading.Thread(target=self._loop_b, daemon=True).start()\n"
+    "    def _loop_a(self):\n"
+    "        self._shared_step()\n"
+    "    def _loop_b(self):\n"
+    "        self._shared_step()\n"
+    "        self._b_only()\n"
+    "    def _shared_step(self):\n"
+    "        pass\n"
+    "    def _b_only(self):\n"
+    "        pass\n"
+)
+
+
+class TestThreadRoleInference:
+    def test_each_spawn_target_is_its_own_role(self, tmp_path):
+        tr = _roles_for(tmp_path, {"headlamp_tpu/svc.py": TWO_SEAM_SRC})
+        assert set(tr.entries) == {"spawn:Svc._loop_a", "spawn:Svc._loop_b"}
+        assert tr.entries["spawn:Svc._loop_a"] == (
+            ("headlamp_tpu/svc.py", "Svc._loop_a"),
+        )
+
+    def test_reachability_follows_call_graph(self, tmp_path):
+        tr = _roles_for(tmp_path, {"headlamp_tpu/svc.py": TWO_SEAM_SRC})
+        rel = "headlamp_tpu/svc.py"
+        assert tr.roles_of((rel, "Svc._loop_a")) == {"spawn:Svc._loop_a"}
+        assert tr.roles_of((rel, "Svc._b_only")) == {"spawn:Svc._loop_b"}
+        assert tr.roles_of((rel, "Svc._shared_step")) == {
+            "spawn:Svc._loop_a",
+            "spawn:Svc._loop_b",
+        }
+        # the spawner itself is reached by no role — nothing BFSes to it
+        assert tr.roles_of((rel, "Svc.start")) == frozenset()
+
+    def test_shared_means_two_or_more_roles(self, tmp_path):
+        tr = _roles_for(tmp_path, {"headlamp_tpu/svc.py": TWO_SEAM_SRC})
+        rel = "headlamp_tpu/svc.py"
+        assert tr.shared_functions() == {(rel, "Svc._shared_step")}
+        assert tr.is_shared((rel, "Svc._shared_step"))
+        assert not tr.is_shared((rel, "Svc._loop_a"))
+
+    def test_trampoline_spawn_resolves_through_first_arg(self, tmp_path):
+        # The ADR-015 refresher shape: target is an unresolvable value,
+        # the real entry rides args[0].
+        tr = _roles_for(
+            tmp_path,
+            {
+                "headlamp_tpu/r.py": (
+                    "import contextvars\n"
+                    "import threading\n"
+                    "class R:\n"
+                    "    def kick(self):\n"
+                    "        ctx = contextvars.copy_context()\n"
+                    "        threading.Thread(\n"
+                    "            target=ctx.run, args=(self._refit, 1)\n"
+                    "        ).start()\n"
+                    "    def _refit(self, n):\n"
+                    "        pass\n"
+                )
+            },
+        )
+        assert set(tr.entries) == {"spawn:R._refit"}
+        assert tr.roles_of(("headlamp_tpu/r.py", "R._refit")) == {"spawn:R._refit"}
+
+    def test_nested_def_target_resolves(self, tmp_path):
+        tr = _roles_for(
+            tmp_path,
+            {
+                "headlamp_tpu/n.py": (
+                    "import threading\n"
+                    "def boot():\n"
+                    "    def loop():\n"
+                    "        pass\n"
+                    "    threading.Thread(target=loop).start()\n"
+                )
+            },
+        )
+        assert set(tr.entries) == {"spawn:boot.<locals>.loop"}
+
+    def test_spawn_covered_by_static_row_does_not_double_count(self, tmp_path):
+        # Same (relpath, qualname) as the ADR-019 profiler static row:
+        # the static role name wins, no second spawn:* role appears.
+        tr = _roles_for(
+            tmp_path,
+            {
+                "headlamp_tpu/obs/profiler.py": (
+                    "import threading\n"
+                    "class SamplingProfiler:\n"
+                    "    def start(self):\n"
+                    "        threading.Thread(target=self._run).start()\n"
+                    "    def _run(self):\n"
+                    "        pass\n"
+                )
+            },
+        )
+        assert set(tr.entries) == {"profiler"}
+        assert tr.roles_of(
+            ("headlamp_tpu/obs/profiler.py", "SamplingProfiler._run")
+        ) == {"profiler"}
+
+
+# ---------------------------------------------------------------------------
+# GRD001 — guarded-by inference
+# ---------------------------------------------------------------------------
+
+
+class TestGuardedByMutations:
+    FIRES = (
+        "import threading\n"
+        "class Svc:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.items = []\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._producer).start()\n"
+        "        threading.Thread(target=self._consumer).start()\n"
+        "    def _producer(self):\n"
+        "        with self._lock:\n"
+        "            self.items.append(1)\n"
+        "        with self._lock:\n"
+        "            self.items.append(2)\n"
+        "    def _consumer(self):\n"
+        "        with self._lock:\n"
+        "            self.items.pop()\n"
+        "        with self._lock:\n"
+        "            self.items.clear()\n"
+        "        n = self.items\n"  # the unguarded minority (4/5 = 0.8)
+        "        return n\n"
+    )
+
+    def test_unguarded_minority_access_flagged(self):
+        diags = _check(GuardedByRule(), "headlamp_tpu/svc.py", self.FIRES)
+        assert len(diags) == 1
+        d = diags[0]
+        assert d.rule == "GRD001"
+        assert d.context == "Svc._consumer"
+        assert "Svc.items" in d.message
+        assert "Svc._lock" in d.message
+        assert "4/5" in d.message
+
+    def test_fully_guarded_twin_clean(self):
+        fixed = self.FIRES.replace(
+            "        n = self.items\n",
+            "        with self._lock:\n            n = self.items\n",
+        )
+        assert _check(GuardedByRule(), "headlamp_tpu/svc.py", fixed) == []
+
+    def test_consistently_unguarded_field_is_quiet(self):
+        # Eraser flags these; our threshold requires an inferable
+        # majority guard — a field guarded NOWHERE is a design choice
+        # (ADR-013 published reference), not an inconsistency.
+        src = self.FIRES.replace("with self._lock:\n            ", "")
+        assert _check(GuardedByRule(), "headlamp_tpu/svc.py", src) == []
+
+    def test_locked_suffix_helper_counts_as_guarded(self):
+        # Caller-holds-lock convention: the unguarded access lives in a
+        # *_locked helper, so it is NOT a minority — quiet.
+        src = self.FIRES.replace(
+            "        n = self.items\n        return n\n",
+            "        return self._peek_locked()\n"
+            "    def _peek_locked(self):\n"
+            "        n = self.items\n"
+            "        return n\n",
+        )
+        assert _check(GuardedByRule(), "headlamp_tpu/svc.py", src) == []
+
+    def test_single_role_field_is_quiet(self):
+        # Only one spawn -> one role -> not shared, however unguarded.
+        src = self.FIRES.replace(
+            "        threading.Thread(target=self._consumer).start()\n", ""
+        ).replace(
+            "    def _consumer(self):\n",
+            "    def _consumer_unspawned(self):\n",
+        )
+        assert _check(GuardedByRule(), "headlamp_tpu/svc.py", src) == []
+
+
+# ---------------------------------------------------------------------------
+# GRD002 — check-then-act atomicity
+# ---------------------------------------------------------------------------
+
+
+class TestCheckThenActMutations:
+    FIRES = (
+        "class Svc:\n"
+        "    def ensure(self):\n"
+        "        with self._lock:\n"
+        "            missing = self._val is None\n"
+        "        if missing:\n"
+        "            with self._lock:\n"
+        "                self._val = self._build()\n"
+    )
+
+    def test_released_between_check_and_act_flagged(self):
+        diags = _check(CheckThenActRule(), "headlamp_tpu/svc.py", self.FIRES)
+        assert len(diags) == 1
+        d = diags[0]
+        assert d.rule == "GRD002"
+        assert d.context == "Svc.ensure"
+        assert "Svc._val" in d.message and "TOCTOU" in d.message
+
+    def test_single_region_twin_clean(self):
+        fixed = (
+            "class Svc:\n"
+            "    def ensure(self):\n"
+            "        with self._lock:\n"
+            "            missing = self._val is None\n"
+            "            if missing:\n"
+            "                self._val = self._build()\n"
+        )
+        assert _check(CheckThenActRule(), "headlamp_tpu/svc.py", fixed) == []
+
+    def test_acquire_release_span_grammar_fires_too(self):
+        src = (
+            "class Svc:\n"
+            "    def ensure(self):\n"
+            "        self._lock.acquire()\n"
+            "        missing = self._val is None\n"
+            "        self._lock.release()\n"
+            "        if missing:\n"
+            "            self._lock.acquire()\n"
+            "            self._val = self._build()\n"
+            "            self._lock.release()\n"
+        )
+        diags = _check(CheckThenActRule(), "headlamp_tpu/svc.py", src)
+        assert [d.rule for d in diags] == ["GRD002"]
+
+    def test_rebound_from_unguarded_value_clears_taint(self):
+        src = self.FIRES.replace(
+            "        if missing:\n",
+            "        missing = self._probe()\n        if missing:\n",
+        )
+        assert _check(CheckThenActRule(), "headlamp_tpu/svc.py", src) == []
+
+    def test_unguarded_check_is_not_a_taint(self):
+        # The check never held the lock — that is GRD001's unguarded-
+        # access territory, not a TOCTOU between two regions.
+        src = (
+            "class Svc:\n"
+            "    def ensure(self):\n"
+            "        missing = self._val is None\n"
+            "        if missing:\n"
+            "            with self._lock:\n"
+            "                self._val = self._build()\n"
+        )
+        assert _check(CheckThenActRule(), "headlamp_tpu/svc.py", src) == []
+
+
+# ---------------------------------------------------------------------------
+# PUB001 — publish-then-mutate
+# ---------------------------------------------------------------------------
+
+
+class TestPublishThenMutateMutations:
+    FIRES = (
+        "class Push:\n"
+        "    def tick(self, frames):\n"
+        "        self.hub.publish(1, frames)\n"
+        "        frames['generation'] = 2\n"
+    )
+
+    def test_mutation_after_publish_flagged(self):
+        diags = _check(PublishThenMutateRule(), "headlamp_tpu/p.py", self.FIRES)
+        assert len(diags) == 1
+        d = diags[0]
+        assert d.rule == "PUB001"
+        assert d.context == "Push.tick"
+        assert "`frames`" in d.message and "self.hub.publish" in d.message
+
+    def test_deep_copy_twin_clean(self):
+        fixed = (
+            "import copy\n"
+            "class Push:\n"
+            "    def tick(self, frames):\n"
+            "        self.hub.publish(1, copy.deepcopy(frames))\n"
+            "        frames['generation'] = 2\n"
+        )
+        assert _check(PublishThenMutateRule(), "headlamp_tpu/p.py", fixed) == []
+
+    def test_rebinding_kills_the_published_lifetime(self):
+        src = (
+            "class Push:\n"
+            "    def tick(self, frames):\n"
+            "        self.hub.publish(1, frames)\n"
+            "        frames = {}\n"
+            "        frames['generation'] = 2\n"
+        )
+        assert _check(PublishThenMutateRule(), "headlamp_tpu/p.py", src) == []
+
+    def test_mutation_on_exception_path_flagged(self):
+        src = (
+            "class Push:\n"
+            "    def tick(self, frames):\n"
+            "        try:\n"
+            "            self.hub.publish(1, frames)\n"
+            "            self.audit(frames)\n"
+            "        except Exception:\n"
+            "            frames.clear()\n"
+        )
+        diags = _check(PublishThenMutateRule(), "headlamp_tpu/p.py", src)
+        assert [d.rule for d in diags] == ["PUB001"]
+
+    def test_mutation_before_publish_clean(self):
+        src = (
+            "class Push:\n"
+            "    def tick(self, frames):\n"
+            "        frames['generation'] = 2\n"
+            "        self.hub.publish(1, frames)\n"
+        )
+        assert _check(PublishThenMutateRule(), "headlamp_tpu/p.py", src) == []
+
+    def test_unpinned_record_is_not_a_seam(self):
+        src = (
+            "class Push:\n"
+            "    def tick(self, rec):\n"
+            "        self.recorder.record(rec)\n"
+            "        rec['late'] = 1\n"
+        )
+        assert _check(PublishThenMutateRule(), "headlamp_tpu/p.py", src) == []
+        pinned = src.replace("record(rec)", "record(rec, pinned=True)")
+        diags = _check(PublishThenMutateRule(), "headlamp_tpu/p.py", pinned)
+        assert [d.rule for d in diags] == ["PUB001"]
+
+
+# ---------------------------------------------------------------------------
+# Live tree: the CI guard
+# ---------------------------------------------------------------------------
+
+
+class TestLiveTreeRaceRules:
+    def test_full_engine_exits_0_on_live_tree(self):
+        # Satellite CI guard: the complete 16-rule registry against the
+        # committed baseline must come back clean — any new GRD/PUB
+        # finding needs a fix or a reasoned baseline entry, in the same
+        # change that introduced it.
+        assert engine_main([REPO]) == EXIT_OK
+
+    def test_rule_ms_covers_every_registered_rule(self):
+        engine = Engine(all_rules(), root=REPO)
+        result = engine.run()
+        assert set(result.rule_ms) == set(RULE_IDS)
+        assert all(ms >= 0.0 for ms in result.rule_ms.values())
+
+    def test_live_tree_roles_cross_known_seams(self):
+        # The SSE handler is reached from both the plain request
+        # threads (admission) and the stream loop — the canonical
+        # shared function the role map must keep seeing.
+        engine = Engine(all_rules(), root=REPO)
+        engine.run()
+        tr = engine.project().threads()
+        roles = tr.roles_of(
+            (
+                "headlamp_tpu/server/app.py",
+                "DashboardApp.serve.<locals>.Handler._serve_events",
+            )
+        )
+        assert {"request-handler", "sse-handler"} <= roles

@@ -35,6 +35,7 @@ import json
 import os
 import re
 import sys
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable
 
@@ -211,6 +212,8 @@ class ProjectContext:
         self.engine = engine
         self.contexts = engine.contexts
         self._callgraph: Any = None
+        self._threads: Any = None
+        self._fields: Any = None
 
     def callgraph(self) -> "Any":
         if self._callgraph is None:
@@ -218,6 +221,24 @@ class ProjectContext:
 
             self._callgraph = build_call_graph(self.contexts)
         return self._callgraph
+
+    def threads(self) -> "Any":
+        """Thread-role reachability (ADR-024): every function labelled
+        with the roles that can reach it over the call graph."""
+        if self._threads is None:
+            from .flow.threads import build_thread_roles
+
+            self._threads = build_thread_roles(self.contexts, self.callgraph())
+        return self._threads
+
+    def fields(self) -> "Any":
+        """Field-access index (ADR-024): every ``self.X`` read/write
+        with the locks held at the access, from the same parse pass."""
+        if self._fields is None:
+            from .flow.fields import build_field_index
+
+            self._fields = build_field_index(self.contexts)
+        return self._fields
 
 
 @dataclass
@@ -227,6 +248,11 @@ class RunResult:
     baselined: list[Diagnostic] = field(default_factory=list)
     stale_baseline: list[dict] = field(default_factory=list)
     parse_counts: dict[str, int] = field(default_factory=dict)
+    #: Wall ms spent per rule (check_file + finalize). Shared project
+    #: artifacts (call graph, thread roles, field index) are billed to
+    #: the first rule whose finalize asks for them — the bench's
+    #: per-rule attribution contract (lazy build, first payer).
+    rule_ms: dict[str, float] = field(default_factory=dict)
 
     @property
     def files_parsed_once(self) -> bool:
@@ -359,9 +385,17 @@ class Engine:
             self.contexts[relpath] = ctx
             suppress_map[relpath] = _suppressions(source)
             for rule in interested:
+                t0 = time.perf_counter()
                 raw.extend(rule.check_file(ctx))
+                result.rule_ms[rule.rule_id] = result.rule_ms.get(
+                    rule.rule_id, 0.0
+                ) + (time.perf_counter() - t0) * 1000.0
         for rule in self.rules:
+            t0 = time.perf_counter()
             raw.extend(rule.finalize(self))
+            result.rule_ms[rule.rule_id] = result.rule_ms.get(
+                rule.rule_id, 0.0
+            ) + (time.perf_counter() - t0) * 1000.0
 
         # Suppressions first (pragma wins over baseline: the pragma is
         # in the code, reviewed where the finding lives).
@@ -541,9 +575,41 @@ def main(argv: list[str] | None = None) -> int:
             print("--baseline requires a path", file=sys.stderr)
             return EXIT_INTERNAL
         del argv[i : i + 2]
+    only_ids: list[str] | None = None
+    if "--only" in argv:
+        # Fast local iteration on one rule: run a comma list of rule
+        # ids with exit-code semantics unchanged. Baseline entries for
+        # UNSELECTED rules are filtered out too — otherwise every
+        # grandfathered finding of a rule you did not run would read as
+        # stale and turn exit 0 into exit 2.
+        i = argv.index("--only")
+        try:
+            spec = argv[i + 1]
+        except IndexError:
+            print("--only requires RULE_ID[,RULE_ID...]", file=sys.stderr)
+            return EXIT_INTERNAL
+        del argv[i : i + 2]
+        from .rules import RULE_IDS
+
+        only_ids = [token.strip() for token in spec.split(",") if token.strip()]
+        unknown = [rule_id for rule_id in only_ids if rule_id not in RULE_IDS]
+        if unknown or not only_ids:
+            print(
+                f"--only: unknown rule id(s) {unknown or ['<empty>']} — "
+                f"known: {', '.join(sorted(RULE_IDS))}",
+                file=sys.stderr,
+            )
+            return EXIT_INTERNAL
     root = argv[0] if argv else None
     try:
-        engine = Engine(root=root, baseline=load_baseline(baseline_path))
+        baseline = load_baseline(baseline_path)
+        rules = None
+        if only_ids is not None:
+            from .rules import RULE_IDS
+
+            rules = [RULE_IDS[rule_id]() for rule_id in only_ids]
+            baseline = [e for e in baseline if e["rule"] in set(only_ids)]
+        engine = Engine(rules, root=root, baseline=baseline)
         result = engine.run()
     except Exception as exc:  # unreadable baseline, bad root, rule crash
         print(f"internal error: {exc}", file=sys.stderr)

@@ -11,6 +11,12 @@ contract the bench asserts (``files_parsed_once``).
   recorded, never silently dropped.
 - :mod:`locks` — shared lock-region scanner for the HTL002/LCK002
   rules (held-lock call sites and nested acquisitions).
+- :mod:`threads` — thread-role inference (ADR-024): BFS from the
+  sanctioned spawn seams labels every function with the roles that can
+  reach it; ≥2 roles = shared.
+- :mod:`fields` — field-access index (ADR-024): every ``self.X``
+  read/write with the locks held at the access, feeding the GRD/PUB
+  race rules.
 """
 
 from __future__ import annotations

@@ -11,8 +11,11 @@ Resolution strategy (the ADR-023 limits, in order):
    with a top-level ``def name``. A bare class name resolves to its
    ``__init__`` when one is defined.
 2. ``self.name(...)`` / ``cls.name(...)`` — a method ``name`` on the
-   caller's own (lexically enclosing) class, same file. Inheritance is
-   NOT modelled.
+   caller's own (lexically enclosing) class, same file; else on a
+   SINGLE-LEVEL base class (a base named in the ``class`` header that
+   resolves to a project class, same file or ``from``-imported).
+   Grandparent bases are NOT followed — one level covers the repo's
+   actual hierarchies without opening the full-MRO can of worms.
 3. ``mod.name(...)`` / ``pkg.mod.name(...)`` — the longest dotted
    prefix that names an imported project module, then a top-level
    ``def name`` in it.
@@ -73,6 +76,7 @@ class _FileIndex:
     relpath: str
     toplevel: dict[str, str]  # name -> qualname of module-level def
     classes: dict[str, set[str]]  # class qual -> method names
+    bases: dict[str, list[str]]  # class qual -> base names as written
     owner_class: dict[str, str]  # function qual -> enclosing class qual ("" = none)
     imported_modules: dict[str, str]  # local name -> module name
     imported_names: dict[str, tuple[str, str]]  # local name -> (module, attr)
@@ -86,6 +90,7 @@ def _index_file(ctx: FileContext, modules: dict[str, str]) -> _FileIndex:
     call-graph build is on the engine's hot path, so no second walk."""
     toplevel: dict[str, str] = {}
     classes: dict[str, set[str]] = {}
+    bases: dict[str, list[str]] = {}
     owner: dict[str, str] = {}
     imp_mod: dict[str, str] = {}
     imp_name: dict[str, tuple[str, str]] = {}
@@ -112,6 +117,9 @@ def _index_file(ctx: FileContext, modules: dict[str, str]) -> _FileIndex:
             elif isinstance(child, ast.ClassDef):
                 cqual = prefix + child.name
                 classes.setdefault(cqual, set())
+                bases[cqual] = [
+                    b for b in (dotted_name(base) for base in child.bases) if b
+                ]
                 stack.append((child, cqual + ".", cqual, fn_qual))
             elif isinstance(child, ast.Lambda):
                 continue  # runs later; its calls belong to no def node
@@ -147,11 +155,41 @@ def _index_file(ctx: FileContext, modules: dict[str, str]) -> _FileIndex:
                     calls[fn_qual].append(child)
                 stack.append((child, prefix, cls, fn_qual))
     return _FileIndex(
-        ctx.relpath, toplevel, classes, owner, imp_mod, imp_name, defs, calls
+        ctx.relpath, toplevel, classes, bases, owner, imp_mod, imp_name, defs, calls
     )
 
 
 # -- graph construction -------------------------------------------------------
+
+
+def _resolve_class(
+    name: str,
+    idx: _FileIndex,
+    indexes: dict[str, _FileIndex],
+    modules: dict[str, str],
+) -> tuple[str, str] | None:
+    """Resolve a base name as written in a ``class`` header to a
+    project class: (relpath, class qual). Same-file classes win; then
+    ``from``-imported names; then ``mod.Class`` through an imported
+    module. Anything else (stdlib bases, attribute chains) is None."""
+    parts = name.split(".")
+    if len(parts) == 1:
+        if name in idx.classes:
+            return (idx.relpath, name)
+        if name in idx.imported_names:
+            src_mod, attr = idx.imported_names[name]
+            src_rel = modules.get(src_mod)
+            if src_rel is not None and attr in indexes[src_rel].classes:
+                return (src_rel, attr)
+        return None
+    if len(parts) == 2:
+        local, attr = parts
+        mod = idx.imported_modules.get(local)
+        if mod is not None:
+            src_rel = modules.get(mod)
+            if src_rel is not None and attr in indexes[src_rel].classes:
+                return (src_rel, attr)
+    return None
 
 
 def _resolve(
@@ -179,11 +217,22 @@ def _resolve(
                 if attr in src_idx.classes and "__init__" in src_idx.classes[attr]:
                     return (src_rel, f"{attr}.__init__")
         return None
-    # 2. self.method / cls.method on the caller's own class
+    # 2. self.method / cls.method on the caller's own class, else on a
+    #    single-level base (grandparents NOT followed).
     if len(parts) == 2 and parts[0] in ("self", "cls"):
         cls = idx.owner_class.get(caller_qual, "")
-        if cls and parts[1] in idx.classes.get(cls, set()):
-            return (idx.relpath, f"{cls}.{parts[1]}")
+        if not cls:
+            return None
+        method = parts[1]
+        if method in idx.classes.get(cls, set()):
+            return (idx.relpath, f"{cls}.{method}")
+        for base_name in idx.bases.get(cls, ()):
+            base = _resolve_class(base_name, idx, indexes, modules)
+            if base is None:
+                continue
+            base_rel, base_cls = base
+            if method in indexes[base_rel].classes.get(base_cls, set()):
+                return (base_rel, f"{base_cls}.{method}")
         return None
     # 3. imported-module attribute: longest prefix naming a module
     for cut in range(len(parts) - 1, 0, -1):

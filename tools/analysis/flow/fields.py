@@ -1,0 +1,250 @@
+"""Field-access index (ADR-024).
+
+From the engine's single parse, every ``self.X`` / ``cls.X`` access in
+every function is recorded as a :class:`FieldAccess` — (class, field),
+enclosing function, read-or-write, and the FULL set of locks held at
+the access statement. The lock-region grammar is `flow/locks.py`'s
+(``with <lockish>:`` blocks plus linear ``acquire()``/``release()``
+spans; ``self.X`` identity normalised to ``Class.X``), extended with a
+per-function REGION id so GRD002 can tell "same ``with`` block" from
+"the same lock re-acquired later".
+
+Classification:
+
+- attribute store / ``del`` / AugAssign target        -> write
+- store through the field (``self.d[k] = v``, ``self.a.b = v``)
+  and calls to known container mutators
+  (``self.rows.append(...)``)                         -> write
+- any other Load                                      -> read
+- ``self.method(...)`` call positions are NOT field accesses, and
+  lock-ish fields (``_lock``, ``_cond``, …) are excluded — a lock is
+  accessed unguarded by definition.
+- ``__init__`` accesses are recorded with ``in_init=True`` so GRD001
+  can exclude thread-confined construction.
+
+Built from shared trees only (``ProjectContext.fields()``); never
+calls ``ast.parse``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from ..engine import FileContext, dotted_name
+from ..rules.lock_blocking import _lock_method_target, _lockish
+from .locks import class_quals, normalize_lock, owner_class_of
+
+_COMPOUND_BODIES = ("body", "orelse", "finalbody")
+
+#: Method terminal names that mutate their receiver in place.
+MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
+    "clear", "update", "add", "discard", "setdefault", "sort", "reverse",
+    "put", "put_nowait", "set",
+}
+
+
+@dataclass(frozen=True)
+class FieldAccess:
+    relpath: str
+    class_qual: str  # owning class ("" for self-less functions — skipped)
+    field: str
+    qual: str  # enclosing function qualname
+    line: int
+    kind: str  # "read" | "write"
+    locks: frozenset[str]  # normalised locks held at the statement
+    #: (lock, region-id) pairs — region ids are unique per syntactic
+    #: acquire within one function, so GRD002 can detect re-acquisition.
+    regions: frozenset[tuple[str, int]]
+    in_init: bool
+
+
+class FieldIndex:
+    def __init__(self) -> None:
+        #: (relpath, class_qual, field) -> accesses, AST order per file.
+        self.by_field: dict[tuple[str, str, str], list[FieldAccess]] = {}
+
+    def add(self, access: FieldAccess) -> None:
+        key = (access.relpath, access.class_qual, access.field)
+        self.by_field.setdefault(key, []).append(access)
+
+
+def _classify(attr: ast.Attribute, parents: dict[int, ast.AST]) -> str | None:
+    """read / write / None (= not a data access: a method-call func)."""
+    parent = parents.get(id(attr))
+    if isinstance(parent, ast.Call) and parent.func is attr:
+        return None  # self.method(...) — a call, not a field access
+    if isinstance(attr.ctx, (ast.Store, ast.Del)):
+        return "write"
+    # Load — look one level up for a store/mutation THROUGH the field.
+    if isinstance(parent, (ast.Subscript, ast.Attribute)) and isinstance(
+        parent.ctx, (ast.Store, ast.Del)
+    ):
+        return "write"
+    if isinstance(parent, ast.Attribute):
+        grand = parents.get(id(parent))
+        if (
+            isinstance(grand, ast.Call)
+            and grand.func is parent
+            and parent.attr in MUTATORS
+        ):
+            return "write"
+    return "read"
+
+
+def _field_nodes(
+    node: ast.AST, *, prune_bodies: bool
+) -> list[tuple[ast.Attribute, dict[int, ast.AST]]]:
+    """``self.X``/``cls.X`` Attribute nodes executed BY this statement
+    itself (compound sub-blocks and nested def/lambda bodies pruned),
+    each with a parent map for classification."""
+    parents: dict[int, ast.AST] = {}
+    roots: list[ast.AST] = []
+    if prune_bodies:
+        for fname, value in ast.iter_fields(node):
+            if fname in _COMPOUND_BODIES or fname == "handlers":
+                continue
+            if isinstance(value, list):
+                roots.extend(v for v in value if isinstance(v, ast.AST))
+            elif isinstance(value, ast.AST):
+                roots.append(value)
+    else:
+        roots.append(node)
+    out: list[tuple[ast.Attribute, dict[int, ast.AST]]] = []
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        if (
+            isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Name)
+            and n.value.id in ("self", "cls")
+            and _lockish(n) is None
+        ):
+            out.append((n, parents))
+        for child in ast.iter_child_nodes(n):
+            parents[id(child)] = n
+            stack.append(child)
+    return out
+
+
+def scan_function_fields(
+    ctx: FileContext, qual: str, fn: ast.AST, owner_class: str
+) -> list[FieldAccess]:
+    """All field accesses in one function, with held-lock sets and
+    region ids. Mirrors `flow/locks.py`'s region grammar."""
+    if not owner_class:
+        return []  # no self/cls to attribute fields to
+    out: list[FieldAccess] = []
+    in_init = qual.split(".")[-1] == "__init__"
+    region_counter = [0]
+
+    def norm(name: str) -> str:
+        return normalize_lock(name, owner_class)
+
+    def record(stmt: ast.stmt, held: list[tuple[str, int]], *, prune: bool) -> None:
+        locks = frozenset(lock for lock, _ in held)
+        regions = frozenset(held)
+        for attr, parents in _field_nodes(stmt, prune_bodies=prune):
+            kind = _classify(attr, parents)
+            if kind is None:
+                continue
+            out.append(
+                FieldAccess(
+                    ctx.relpath,
+                    owner_class,
+                    attr.attr,
+                    qual,
+                    attr.lineno,
+                    kind,
+                    locks,
+                    regions,
+                    in_init,
+                )
+            )
+
+    def scan(stmts: list[ast.stmt], held: list[tuple[str, int]]) -> None:
+        held = list(held)
+        for stmt in stmts:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            acquired = _lock_method_target(stmt, "acquire")
+            if acquired is not None:
+                region_counter[0] += 1
+                held.append((norm(acquired), region_counter[0]))
+                continue
+            released = _lock_method_target(stmt, "release")
+            if released is not None:
+                name = norm(released)
+                for i in range(len(held) - 1, -1, -1):
+                    if held[i][0] == name:
+                        del held[i]
+                        break
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                locks = [
+                    norm(lock)
+                    for lock in (_lockish(i.context_expr) for i in stmt.items)
+                    if lock
+                ]
+                if locks:
+                    record(stmt, held, prune=True)
+                    inner = list(held)
+                    for lock in locks:
+                        region_counter[0] += 1
+                        inner.append((lock, region_counter[0]))
+                    scan(stmt.body, inner)
+                    continue
+            is_compound = isinstance(
+                stmt,
+                (
+                    ast.If,
+                    ast.While,
+                    ast.For,
+                    ast.AsyncFor,
+                    ast.With,
+                    ast.AsyncWith,
+                    ast.Try,
+                ),
+            )
+            if not is_compound:
+                record(stmt, held, prune=False)
+                continue
+            record(stmt, held, prune=True)  # header expressions run here
+            for attr in _COMPOUND_BODIES:
+                inner_stmts = getattr(stmt, attr, None)
+                if inner_stmts:
+                    scan(inner_stmts, held)
+            for handler in getattr(stmt, "handlers", None) or []:
+                scan(handler.body, held)
+
+    scan(list(getattr(fn, "body", [])), [])
+    return out
+
+
+def file_field_accesses(ctx: FileContext) -> list[FieldAccess]:
+    """Every field access in the file — memoized per engine pass."""
+    cached = getattr(ctx, "_field_accesses", None)
+    if cached is not None:
+        return cached
+    classes = class_quals(ctx)
+    out: list[FieldAccess] = []
+    for qual, fn in ctx.functions():
+        owner = owner_class_of(qual, classes)
+        out.extend(scan_function_fields(ctx, qual, fn, owner))
+    setattr(ctx, "_field_accesses", out)
+    return out
+
+
+def build_field_index(contexts: dict[str, FileContext]) -> FieldIndex:
+    index = FieldIndex()
+    for rel in sorted(contexts):
+        for access in file_field_accesses(contexts[rel]):
+            index.add(access)
+    return index

@@ -1,0 +1,248 @@
+"""Thread-role inference (ADR-024).
+
+Every function in the tree is classified with the set of THREAD ROLES
+that can reach it, by BFS over the ADR-023 call graph from two kinds
+of role entry points:
+
+1. **The static role table** (:data:`STATIC_ROLE_ENTRIES`) — the
+   THR001 seam set, written down as (role, relpath, qualname) rows.
+   Some rows are *bridges*: the serve loop hands ``app._handle`` to the
+   gateway as a value and the gateway's worker calls it through a
+   closure, which ADR-023 resolution cannot follow — the bridge rows
+   re-attach those known dynamic dispatches so a whole subsystem does
+   not silently fall out of the role map.
+2. **Spawn-derived roles** — every ``threading.Thread(target=...)`` /
+   ``threading.Timer(interval, fn)`` construction whose target resolves
+   to a project function becomes its own role named after the target
+   (``spawn:C._loop``). The ADR-015 refresher shape — an unresolvable
+   ``target=ctx.run`` whose real entry rides ``args=(self._refit, …)``
+   — resolves through ``args[0]``. A spawn whose target is already
+   covered by a static row is NOT a second role (the static name wins;
+   otherwise every sanctioned seam would double-count itself).
+
+A function reachable from **two or more** roles is *shared*: two
+different kinds of thread can be inside it, so the state it touches
+needs a guard (GRD001) or a publication discipline (PUB001). One role
+running on N threads (render workers racing each other) is NOT marked
+shared by this definition — that is a documented ADR-024 limitation,
+kept because per-role reachability is what the call graph can actually
+prove.
+
+Built from the engine's single pass (``ProjectContext.threads()``);
+never calls ``ast.parse``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from ..engine import FileContext, dotted_name
+from .callgraph import CallGraph
+from .locks import class_quals, owner_class_of
+
+NodeKey = tuple[str, str]  # (relpath, qualname)
+
+#: Thread-constructor terminal names whose TARGET names an entry
+#: function. Executors are excluded on purpose: the construction names
+#: no entry (submit targets are values), so executor-backed roles are
+#: static rows below.
+_THREAD_CTORS = {"Thread", "Timer"}
+
+#: (role, relpath, qualname-or-prefix) rows. A trailing ``.`` is a
+#: prefix match — the same convention as THR001's SPAWN_ALLOWLIST.
+#: Rows past the first per role are bridges across dynamic dispatch
+#: the ADR-023 resolver records as unresolved (closures handed to the
+#: pool, ``self.push.hub`` attribute chains).
+STATIC_ROLE_ENTRIES: tuple[tuple[str, str, str], ...] = (
+    # The serve-side background sync heartbeat (ADR-013/021): the tick
+    # closure family, plus the push differ it drives through
+    # ``self.push.on_snapshot`` and ``self.hub.publish``.
+    ("sync-loop", "headlamp_tpu/server/app.py",
+     "DashboardApp.start_background_sync.<locals>."),
+    ("sync-loop", "headlamp_tpu/push/__init__.py", "PushPipeline.on_snapshot"),
+    ("sync-loop", "headlamp_tpu/push/hub.py", "BroadcastHub.publish"),
+    # ADR-017 render pool workers; bridges: the coalesced-render
+    # closure the gateway submits, and the app handler it invokes.
+    ("render-worker", "headlamp_tpu/gateway/pool.py", "RenderPool._worker"),
+    ("render-worker", "headlamp_tpu/gateway/gateway.py",
+     "RenderGateway._render.<locals>.run"),
+    ("render-worker", "headlamp_tpu/server/app.py", "DashboardApp._handle"),
+    # Plain HTTP handler threads (ThreadingHTTPServer): admission,
+    # ETag/304 and shedding run here BEFORE the pool (ADR-021).
+    ("request-handler", "headlamp_tpu/server/app.py",
+     "DashboardApp.serve.<locals>.Handler.do_GET"),
+    ("request-handler", "headlamp_tpu/gateway/gateway.py",
+     "RenderGateway.handle"),
+    # ADR-014 fan-out executor chunks.
+    ("fanout-worker", "headlamp_tpu/transport/pool.py",
+     "FanoutScheduler.map.<locals>.run_chunk"),
+    # ADR-019 sampling profiler tick thread.
+    ("profiler", "headlamp_tpu/obs/profiler.py", "SamplingProfiler._run"),
+    # ADR-015 background refit worker, plus the foreground fill path
+    # serving threads take through ``Refresher.get`` (bridged: callers
+    # reach it through an attribute the resolver cannot follow).
+    ("refresher", "headlamp_tpu/runtime/refresh.py",
+     "Refresher._background_refit"),
+    ("render-worker", "headlamp_tpu/runtime/refresh.py", "Refresher.get"),
+    # ADR-021 SSE handler threads and the hub delivery methods they
+    # park in (reached via ``app.push.hub`` — bridged).
+    ("sse-handler", "headlamp_tpu/server/app.py",
+     "DashboardApp.serve.<locals>.Handler._serve_events"),
+    ("sse-handler", "headlamp_tpu/server/app.py",
+     "DashboardApp.open_event_stream"),
+    ("push-delivery", "headlamp_tpu/push/hub.py", "BroadcastHub.next_event"),
+    ("push-delivery", "headlamp_tpu/push/hub.py", "BroadcastHub.poll"),
+    ("push-delivery", "headlamp_tpu/push/hub.py", "BroadcastHub.subscribe"),
+    ("push-delivery", "headlamp_tpu/push/hub.py", "BroadcastHub.unsubscribe"),
+)
+
+
+@dataclass
+class ThreadRoles:
+    """Role-reachability answer set for one engine pass."""
+
+    #: function -> roles that can reach it (absent = no role reaches).
+    roles: dict[NodeKey, frozenset[str]] = field(default_factory=dict)
+    #: role -> its entry functions, for messages and tests.
+    entries: dict[str, tuple[NodeKey, ...]] = field(default_factory=dict)
+
+    def roles_of(self, key: NodeKey) -> frozenset[str]:
+        return self.roles.get(key, frozenset())
+
+    def is_shared(self, key: NodeKey) -> bool:
+        return len(self.roles_of(key)) >= 2
+
+    def shared_functions(self) -> set[NodeKey]:
+        return {k for k, r in self.roles.items() if len(r) >= 2}
+
+
+def _static_entry_keys(
+    role_rows: tuple[tuple[str, str, str], ...], graph: CallGraph
+) -> dict[str, list[NodeKey]]:
+    out: dict[str, list[NodeKey]] = {}
+    for role, relpath, pattern in role_rows:
+        for rel, qual in graph.defs:
+            if rel != relpath:
+                continue
+            if pattern.endswith("."):
+                if not qual.startswith(pattern):
+                    continue
+            elif qual != pattern:
+                continue
+            out.setdefault(role, []).append((rel, qual))
+    return out
+
+
+def _covered_by_static(key: NodeKey) -> bool:
+    rel, qual = key
+    for _, relpath, pattern in STATIC_ROLE_ENTRIES:
+        if rel != relpath:
+            continue
+        if pattern.endswith("."):
+            if qual.startswith(pattern):
+                return True
+        elif qual == pattern:
+            return True
+    return False
+
+
+def _resolve_spawn_target(
+    expr: ast.AST, ctx: FileContext, line: int, classes: set[str]
+) -> str | None:
+    """Resolve a ``target=`` expression to a qualname in the same file:
+    ``self.X``/``cls.X`` -> a method on the spawning function's own
+    class; a bare name -> a nested def in the spawning function, else a
+    module-level def. Anything else is unresolvable (None)."""
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    parts = name.split(".")
+    spawner = ctx.enclosing_qualname(line)
+    defined = {qual for qual, _ in ctx.functions()}
+    if len(parts) == 2 and parts[0] in ("self", "cls"):
+        owner = owner_class_of(spawner, classes)
+        if owner and f"{owner}.{parts[1]}" in defined:
+            return f"{owner}.{parts[1]}"
+        return None
+    if len(parts) == 1:
+        if spawner and f"{spawner}.<locals>.{parts[0]}" in defined:
+            return f"{spawner}.<locals>.{parts[0]}"
+        if parts[0] in defined:
+            return parts[0]
+    return None
+
+
+def _spawn_roles(contexts: dict[str, FileContext]) -> dict[str, list[NodeKey]]:
+    """One role per resolved spawn TARGET (``spawn:<qual>``), skipping
+    targets a static row already covers."""
+    out: dict[str, list[NodeKey]] = {}
+    for rel in sorted(contexts):
+        ctx = contexts[rel]
+        classes = class_quals(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            ctor = (
+                func.attr
+                if isinstance(func, ast.Attribute)
+                else func.id
+                if isinstance(func, ast.Name)
+                else None
+            )
+            if ctor not in _THREAD_CTORS:
+                continue
+            target_expr: ast.AST | None = None
+            args_expr: ast.AST | None = None
+            for kw in node.keywords:
+                if kw.arg == "target" or (ctor == "Timer" and kw.arg == "function"):
+                    target_expr = kw.value
+                elif kw.arg == "args":
+                    args_expr = kw.value
+            if target_expr is None and ctor == "Timer" and len(node.args) >= 2:
+                target_expr = node.args[1]
+            if target_expr is None:
+                continue
+            qual = _resolve_spawn_target(target_expr, ctx, node.lineno, classes)
+            if qual is None and isinstance(args_expr, (ast.Tuple, ast.List)):
+                # target is a trampoline value (``target=ctx.run``);
+                # the real entry rides the first positional arg — the
+                # ADR-015 refresher spawn shape.
+                if args_expr.elts:
+                    qual = _resolve_spawn_target(
+                        args_expr.elts[0], ctx, node.lineno, classes
+                    )
+            if qual is None:
+                continue
+            key = (rel, qual)
+            if _covered_by_static(key):
+                continue
+            out.setdefault(f"spawn:{qual}", []).append(key)
+    return out
+
+
+def build_thread_roles(
+    contexts: dict[str, FileContext], graph: CallGraph
+) -> ThreadRoles:
+    entries = _static_entry_keys(STATIC_ROLE_ENTRIES, graph)
+    for role, keys in _spawn_roles(contexts).items():
+        entries.setdefault(role, []).extend(
+            k for k in keys if k in graph.defs
+        )
+    result = ThreadRoles(
+        entries={role: tuple(sorted(keys)) for role, keys in entries.items() if keys}
+    )
+    roles: dict[NodeKey, set[str]] = {}
+    for role in sorted(result.entries):
+        seen: set[NodeKey] = set()
+        queue = list(result.entries[role])
+        while queue:
+            node = queue.pop()
+            if node in seen:
+                continue
+            seen.add(node)
+            roles.setdefault(node, set()).add(role)
+            queue.extend(graph.callees(node))
+    result.roles = {k: frozenset(v) for k, v in roles.items()}
+    return result

@@ -6,12 +6,15 @@ and r10-review incidents, then the self-consistency checks."""
 from __future__ import annotations
 
 from ..engine import Rule
+from .atomicity import CheckThenActRule
 from .direct_render import DirectRenderRule
 from .exception_breadth import ExceptionBreadthRule
+from .guarded_by import GuardedByRule
 from .inline_fit import InlineFitRule
 from .lock_blocking import LockBlockingRule
 from .lock_order import LockOrderRule
 from .metrics_allowlist import MetricsAllowlistRule
+from .publish_mutate import PublishThenMutateRule
 from .raw_urlopen import RawUrlopenRule
 from .release_paths import ReleaseOnAllPathsRule
 from .slo_observation import SloObservationRule
@@ -39,6 +42,11 @@ def all_rules() -> list[Rule]:
         LockOrderRule(),
         ReleaseOnAllPathsRule(),
         SloObservationRule(),
+        # ADR-024 thread-role race rules — lockset inference, TOCTOU,
+        # publish-then-mutate over the role/field layers.
+        GuardedByRule(),
+        CheckThenActRule(),
+        PublishThenMutateRule(),
     ]
 
 
@@ -56,4 +64,7 @@ RULE_IDS = {
     "LCK002": LockOrderRule,
     "REL001": ReleaseOnAllPathsRule,
     "OBS001": SloObservationRule,
+    "GRD001": GuardedByRule,
+    "GRD002": CheckThenActRule,
+    "PUB001": PublishThenMutateRule,
 }

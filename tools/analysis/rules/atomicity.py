@@ -1,0 +1,209 @@
+"""GRD002 — check-then-act atomicity (TOCTOU under a re-acquired lock).
+
+The shape this catches:
+
+    with self._lock:
+        missing = self._val is None   # CHECK — guarded
+    if missing:
+        with self._lock:
+            self._val = build()       # ACT — guarded, but the lock was
+                                      # RELEASED between check and act
+
+Both accesses hold the lock, so GRD001's lockset is satisfied — yet
+another thread can win the window between the two regions and the act
+runs on a stale decision. Detection is intraprocedural and rides the
+ADR-024 field machinery: the lock-region scan assigns every syntactic
+acquire a REGION id; a guarded read of ``self.F`` whose value lands in
+a local name TAINTS that name with (field, lock, region); when a
+branch tests a tainted name, a guarded write of the same field under
+the same lock but a DIFFERENT region inside the branch is the finding.
+Rebinding the name from an unguarded expression clears the taint, and
+check+act inside one region (the single-region twin) never fires —
+region ids are equal.
+
+The fix is almost always widening: move the act into the check's
+region, or re-validate the condition after re-acquiring.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Diagnostic, FileContext, Rule
+from ..flow.fields import _field_nodes
+from ..flow.locks import class_quals, normalize_lock, owner_class_of
+from .lock_blocking import _lock_method_target, _lockish
+
+_COMPOUND_BODIES = ("body", "orelse", "finalbody")
+
+MESSAGE = (
+    "write of `{cls}.{field}` under re-acquired `{lock}` acts on a check "
+    "made at line {check_line} under a PREVIOUS `{lock}` region — the lock "
+    "was released between check and act (TOCTOU); widen the region or "
+    "re-validate after re-acquiring (ADR-024)"
+)
+
+#: (field, lock, region-id, check line) — one taint fact.
+_Taint = tuple[str, str, int, int]
+
+
+class CheckThenActRule(Rule):
+    rule_id = "GRD002"
+    name = "check-then-act-atomicity"
+    description = (
+        "A guarded check that feeds a branch must share its lock region "
+        "with the guarded act inside that branch"
+    )
+    top_dirs = ("headlamp_tpu",)
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        out: list[Diagnostic] = []
+        classes = class_quals(ctx)
+        for qual, fn in ctx.functions():
+            owner = owner_class_of(qual, classes)
+            if not owner:
+                continue
+            out.extend(self._scan_function(ctx, qual, fn, owner))
+        return sorted(out, key=lambda d: (d.path, d.line))
+
+    def _scan_function(
+        self, ctx: FileContext, qual: str, fn: ast.AST, owner: str
+    ) -> list[Diagnostic]:
+        out: list[Diagnostic] = []
+        region_counter = [0]
+        tainted: dict[str, set[_Taint]] = {}
+
+        def norm(name: str) -> str:
+            return normalize_lock(name, owner)
+
+        def reads_writes(stmt: ast.stmt, *, prune: bool):
+            reads: list[tuple[str, int]] = []
+            writes: list[tuple[str, int]] = []
+            from ..flow.fields import _classify
+
+            for attr, parents in _field_nodes(stmt, prune_bodies=prune):
+                kind = _classify(attr, parents)
+                if kind == "read":
+                    reads.append((attr.attr, attr.lineno))
+                elif kind == "write":
+                    writes.append((attr.attr, attr.lineno))
+            return reads, writes
+
+        def check_writes(
+            stmt: ast.stmt,
+            held: list[tuple[str, int]],
+            guards: list[_Taint],
+            *,
+            prune: bool,
+        ) -> None:
+            if not guards or not held:
+                return
+            _, writes = reads_writes(stmt, prune=prune)
+            for fname, line in writes:
+                for g_field, g_lock, g_region, g_line in guards:
+                    if g_field != fname:
+                        continue
+                    for lock, region in held:
+                        if lock == g_lock and region != g_region:
+                            out.append(
+                                Diagnostic(
+                                    self.rule_id,
+                                    ctx.relpath,
+                                    line,
+                                    MESSAGE.format(
+                                        cls=owner,
+                                        field=fname,
+                                        lock=lock,
+                                        check_line=g_line,
+                                    ),
+                                    context=qual,
+                                )
+                            )
+
+        def taint_from_assign(
+            stmt: ast.Assign, held: list[tuple[str, int]]
+        ) -> None:
+            if len(stmt.targets) != 1 or not isinstance(stmt.targets[0], ast.Name):
+                return
+            name = stmt.targets[0].id
+            reads, _ = reads_writes(stmt, prune=False)
+            if held and reads:
+                facts = {
+                    (fname, lock, region, line)
+                    for fname, line in reads
+                    for lock, region in held
+                }
+                tainted.setdefault(name, set()).update(facts)
+            else:
+                tainted.pop(name, None)  # rebound from an unguarded value
+
+        def tested_taints(test: ast.expr) -> list[_Taint]:
+            facts: list[_Taint] = []
+            for node in ast.walk(test):
+                if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                    facts.extend(tainted.get(node.id, ()))
+            return facts
+
+        def scan(
+            stmts: list[ast.stmt],
+            held: list[tuple[str, int]],
+            guards: list[_Taint],
+        ) -> None:
+            held = list(held)
+            for stmt in stmts:
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    continue
+                acquired = _lock_method_target(stmt, "acquire")
+                if acquired is not None:
+                    region_counter[0] += 1
+                    held.append((norm(acquired), region_counter[0]))
+                    continue
+                released = _lock_method_target(stmt, "release")
+                if released is not None:
+                    name = norm(released)
+                    for i in range(len(held) - 1, -1, -1):
+                        if held[i][0] == name:
+                            del held[i]
+                            break
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    locks = [
+                        norm(lock)
+                        for lock in (_lockish(i.context_expr) for i in stmt.items)
+                        if lock
+                    ]
+                    if locks:
+                        inner = list(held)
+                        for lock in locks:
+                            region_counter[0] += 1
+                            inner.append((lock, region_counter[0]))
+                        scan(stmt.body, inner, guards)
+                        continue
+                if isinstance(stmt, (ast.If, ast.While)):
+                    check_writes(stmt, held, guards, prune=True)
+                    branch_guards = guards + tested_taints(stmt.test)
+                    scan(stmt.body, held, branch_guards)
+                    if stmt.orelse:
+                        scan(stmt.orelse, held, branch_guards)
+                    continue
+                if isinstance(stmt, ast.Assign):
+                    check_writes(stmt, held, guards, prune=False)
+                    taint_from_assign(stmt, held)
+                    continue
+                is_compound = isinstance(
+                    stmt, (ast.For, ast.AsyncFor, ast.With, ast.AsyncWith, ast.Try)
+                )
+                check_writes(stmt, held, guards, prune=is_compound)
+                if not is_compound:
+                    continue
+                for attr in _COMPOUND_BODIES:
+                    inner_stmts = getattr(stmt, attr, None)
+                    if inner_stmts:
+                        scan(inner_stmts, held, guards)
+                for handler in getattr(stmt, "handlers", None) or []:
+                    scan(handler.body, held, guards)
+
+        scan(list(getattr(fn, "body", [])), [], [])
+        return out

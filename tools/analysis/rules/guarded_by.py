@@ -1,0 +1,118 @@
+"""GRD001 — guarded-by inference (Eraser lockset refinement).
+
+The HTL/LCK/REL rules prove locks are *held correctly*; none of them
+ask whether a field is *accessed without its lock at all*. This rule
+does, with the classic lockset refinement (Eraser, Savage et al. 1997)
+made static: for every ``(class, field)`` whose accesses span two or
+more THREAD ROLES (ADR-024 role inference over the ADR-023 call
+graph), infer the guard as the lock held at ≥80% of the role-reachable
+access sites — and flag the unguarded minority. A field guarded
+nowhere, or everywhere, is quiet; the signal is the INCONSISTENCY.
+
+False-positive discipline:
+
+- ``__init__`` accesses are excluded (thread-confined construction —
+  the RacerD ownership argument).
+- read-only fields are excluded: no write anywhere → no race.
+- accesses in functions no role reaches are excluded (main-thread
+  setup, test-only paths) — they cannot race a worker.
+- accesses inside ``*_locked`` helpers count as guarded by whichever
+  lock is being scored: the suffix is this repo's caller-holds-lock
+  convention (``_evict_locked``, ``_spawn_refit_locked``, …), and the
+  intraprocedural lockset cannot see the caller's ``with``.
+- the ≥80% threshold means a minority can only exist once a field has
+  ≥5 role-reachable accesses, so tiny fields never trip it.
+
+Deliberate unguarded publication (the ADR-013 atomically-published
+snapshot reference) is exactly what the reasoned baseline is for.
+"""
+
+from __future__ import annotations
+
+from ..engine import Diagnostic, FileContext, Rule
+
+#: Minimum fraction of role-reachable accesses that must hold the same
+#: lock before it is inferred as the field's guard.
+GUARD_THRESHOLD = 0.8
+
+def _holds(access, lock: str) -> bool:
+    """Guarded: the lock is in the static lockset, or the access sits
+    in a ``*_locked`` helper (caller holds the lock by convention)."""
+    return lock in access.locks or access.qual.rsplit(".", 1)[-1].endswith("_locked")
+
+
+MESSAGE = (
+    "field `{cls}.{field}` is guarded by `{lock}` at {guarded}/{total} "
+    "role-reachable access sites (roles: {roles}) but {kind} here without "
+    "it — take `{lock}` or baseline with a reason (Eraser lockset; ADR-024)"
+)
+
+
+class GuardedByRule(Rule):
+    rule_id = "GRD001"
+    name = "guarded-by-inference"
+    description = (
+        "Fields accessed from two or more thread roles hold their "
+        "inferred guard at every access site"
+    )
+    top_dirs = ("headlamp_tpu",)
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        return []  # cross-file: everything happens in finalize
+
+    def finalize(self, run) -> list[Diagnostic]:
+        project = run.project()
+        threads = project.threads()
+        index = project.fields()
+        out: list[Diagnostic] = []
+        for (rel, cls, fname) in sorted(index.by_field):
+            if not self.wants(rel):
+                continue
+            accesses = index.by_field[(rel, cls, fname)]
+            considered = []
+            role_union: set[str] = set()
+            for access in accesses:
+                if access.in_init:
+                    continue
+                roles = threads.roles_of((rel, access.qual))
+                if not roles:
+                    continue
+                role_union |= roles
+                considered.append(access)
+            if len(role_union) < 2:
+                continue  # thread-confined or single-role — not shared
+            if not any(a.kind == "write" for a in considered):
+                continue  # read-only shared data cannot race
+            total = len(considered)
+            candidates = sorted({lock for a in considered for lock in a.locks})
+            best: tuple[str, int] | None = None
+            for lock in candidates:
+                guarded = sum(1 for a in considered if _holds(a, lock))
+                if best is None or guarded > best[1]:
+                    best = (lock, guarded)
+            if best is None:
+                continue  # never guarded anywhere — no inferable guard
+            lock, guarded = best
+            if guarded == total or guarded / total < GUARD_THRESHOLD:
+                continue
+            for access in considered:
+                if _holds(access, lock):
+                    continue
+                out.append(
+                    Diagnostic(
+                        self.rule_id,
+                        rel,
+                        access.line,
+                        MESSAGE.format(
+                            cls=cls,
+                            field=fname,
+                            lock=lock,
+                            guarded=guarded,
+                            total=total,
+                            roles=", ".join(sorted(role_union)),
+                            kind="written" if access.kind == "write" else "read",
+                        ),
+                        context=access.qual,
+                    )
+                )
+        return sorted(out, key=lambda d: (d.path, d.line))

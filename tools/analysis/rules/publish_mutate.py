@@ -1,0 +1,168 @@
+"""PUB001 — no mutation after cross-thread publication (ADR-021
+deep-copy discipline, machine-enforced).
+
+ADR-021 settled the ownership rule for objects that cross a thread
+boundary: the moment a value is handed to a publication seam —
+``hub.publish`` (SSE fan-out), the refresher's ``_store`` (swapped
+under the entry lock, read by serving threads), the history tier's
+``append_many``, a pinned flight-recorder ``record`` — the publisher
+no longer owns it. Mutating it afterwards races every consumer that
+already holds the reference.
+
+This rule walks the publisher's CFG (ADR-023) forward from each
+publication statement: along ANY path, a mutation rooted at a
+published name — attribute/subscript store, ``del``, an in-place
+mutator call (``append``/``update``/…) — is a finding. A plain
+rebinding of the name (``frames = …``, a ``for`` target, ``with …
+as``) KILLS the tracking on that path: the name no longer refers to
+the published object. Exception edges count — a handler that
+"cleans up" a published dict is exactly the bug.
+
+Seam identity is by terminal call name (the ADR-023 per-spelling
+caveat); ``record`` only counts when called with a ``pinned=``
+keyword, because unpinned ring records are copied at the seam.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Diagnostic, FileContext, Rule, dotted_name
+from ..flow.cfg import own_nodes
+from ..flow.fields import MUTATORS
+
+#: Terminal call names that publish their bare-name arguments.
+PUBLISH_SEAMS = {"publish", "append_many", "_store"}
+
+MESSAGE = (
+    "`{name}` was published via `{seam}` (line {publish_line}) and is "
+    "mutated here afterwards — a consumer thread may already hold the "
+    "reference; publish a copy or hand off ownership (ADR-021; ADR-024)"
+)
+
+
+def _root_name(expr: ast.AST) -> str | None:
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _publications(nodes: list[ast.AST]) -> list[tuple[str, str, int]]:
+    """(published name, seam as written, line) for every seam call."""
+    out: list[tuple[str, str, int]] = []
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = dotted_name(node.func)
+        if dotted is None:
+            continue
+        terminal = dotted.rsplit(".", 1)[-1]
+        if terminal == "record":
+            if not any(kw.arg == "pinned" for kw in node.keywords):
+                continue
+        elif terminal not in PUBLISH_SEAMS:
+            continue
+        published = [a for a in node.args if isinstance(a, ast.Name)]
+        published += [
+            kw.value
+            for kw in node.keywords
+            if kw.arg != "pinned" and isinstance(kw.value, ast.Name)
+        ]
+        for arg in published:
+            out.append((arg.id, dotted, node.lineno))
+    return out
+
+
+def _mutation_of(nodes: list[ast.AST], name: str) -> int | None:
+    """Line of the first mutation rooted at ``name``, else None."""
+    for node in nodes:
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            if isinstance(node.ctx, (ast.Store, ast.Del)) and _root_name(node) == name:
+                return node.lineno
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in MUTATORS
+                and _root_name(func.value) == name
+            ):
+                return node.lineno
+    return None
+
+
+def _kills(nodes: list[ast.AST], name: str) -> bool:
+    """A plain rebinding of ``name`` (assign / for target / with-as /
+    walrus / AugAssign on the bare name) ends the published lifetime on
+    this path."""
+    return any(
+        isinstance(node, ast.Name)
+        and node.id == name
+        and isinstance(node.ctx, ast.Store)
+        for node in nodes
+    )
+
+
+class PublishThenMutateRule(Rule):
+    rule_id = "PUB001"
+    name = "no-mutation-after-publish"
+    description = (
+        "Objects handed to cross-thread publication seams are not "
+        "mutated by the publisher afterwards"
+    )
+    top_dirs = ("headlamp_tpu",)
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        out: list[Diagnostic] = []
+        for qual, fn in ctx.functions():
+            out.extend(self._check_function(ctx, qual, fn))
+        return sorted(out, key=lambda d: (d.path, d.line))
+
+    def _check_function(
+        self, ctx: FileContext, qual: str, fn: ast.AST
+    ) -> list[Diagnostic]:
+        cfg = ctx.cfg(fn)
+        out: list[Diagnostic] = []
+        seen: set[tuple[str, int, int]] = set()
+        for block in cfg.stmt_blocks():
+            pubs = _publications(own_nodes(block.stmt))
+            for name, seam, publish_line in pubs:
+                # Forward BFS from the publish statement's successors;
+                # exception successors of LATER statements count (the
+                # publish itself failing means nothing was handed off).
+                queue = list(block.succs)
+                visited: set[int] = set()
+                while queue:
+                    bid = queue.pop()
+                    if bid in visited:
+                        continue
+                    visited.add(bid)
+                    b = cfg.blocks[bid]
+                    if b.kind != "stmt":
+                        queue.extend(b.succs)
+                        queue.extend(b.exc_succs)
+                        continue
+                    nodes = own_nodes(b.stmt)
+                    line = _mutation_of(nodes, name)
+                    if line is not None:
+                        key = (name, publish_line, line)
+                        if key not in seen:
+                            seen.add(key)
+                            out.append(
+                                Diagnostic(
+                                    self.rule_id,
+                                    ctx.relpath,
+                                    line,
+                                    MESSAGE.format(
+                                        name=name,
+                                        seam=seam,
+                                        publish_line=publish_line,
+                                    ),
+                                    context=qual,
+                                )
+                            )
+                        continue  # report once per path direction
+                    if _kills(nodes, name):
+                        continue  # rebound — published object released
+                    queue.extend(b.succs)
+                    queue.extend(b.exc_succs)
+        return out

@@ -1499,6 +1499,16 @@ def main(argv: list[str] | None = None) -> int:
         )
         return 0
 
+    if "--only" in argv:
+        # Rule-filtered iteration is the engine CLI's job — delegate the
+        # whole invocation (TS gates don't have rule ids to filter by).
+        tools_dir = os.path.dirname(os.path.abspath(__file__))
+        if tools_dir not in sys.path:
+            sys.path.insert(0, tools_dir)
+        from analysis.engine import main as engine_main
+
+        return engine_main(argv)
+
     root = argv[0] if argv else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "plugin", "src"
     )
@@ -1536,8 +1546,10 @@ def main(argv: list[str] | None = None) -> int:
         print(f"{len(gate_diags)} {noun} problem(s)")
     # Engine-native rules (HTL001 lock discipline, EXC001 exception
     # breadth, THR001 thread spawns, SYN001 metricsz allowlist sync,
-    # PAR000 parse failures) report in engine format, with the
-    # suppression/baseline accounting the legacy gates never had.
+    # the ADR-023 flow rules HTL002/LCK002/REL001/OBS001, the ADR-024
+    # race rules GRD001/GRD002/PUB001, PAR000 parse failures) report in
+    # engine format, with the suppression/baseline accounting the
+    # legacy gates never had.
     analysis_diags = [d for d in result.diagnostics if d.rule not in legacy_ids]
     for diag in analysis_diags:
         print(diag)
