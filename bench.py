@@ -299,7 +299,7 @@ def load_prev_round_p50() -> dict:
 
 
 #: Keys where MORE is better; everything else numeric is latency-like.
-_HIGHER_IS_BETTER_MARKERS = ("rate", "reuse", "vs_baseline", "hit")
+_HIGHER_IS_BETTER_MARKERS = ("rate", "reuse", "vs_baseline", "hit", "rps", "per_sec")
 #: Informational / environment keys a regression flag would mislabel:
 #: tunnel noise, sample counts, prior-round echoes, static budgets.
 _COMPARE_SKIP_PREFIXES = (
@@ -1189,6 +1189,83 @@ def bench_transport_pool(fleet) -> dict:
     }
 
 
+def _bench_get(port: int, path: str, conn=None, timeout: float = 30.0):
+    """One timed GET against a local bench server; with ``conn`` the
+    request rides that keep-alive connection (the browser steady
+    state), else a throwaway connection. Returns (status, body, ms)."""
+    import http.client
+
+    own = conn is None
+    if own:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        t0 = time.perf_counter()
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        elapsed_ms = (time.perf_counter() - t0) * 1000
+        return resp.status, body, elapsed_ms
+    finally:
+        if own:
+            conn.close()
+
+
+def _saturation_curve(
+    ports: list,
+    prefix: str,
+    concurrencies: tuple = (1, 4, 16, 32),
+    requests: int = 8,
+) -> dict:
+    """The real-socket concurrent-client driver shared by
+    ``bench_gateway`` (one port) and ``bench_replication`` (replica
+    ports, round-robin across workers): c keep-alive clients released
+    by a barrier, unique query strings so coalescing never hides pool
+    queueing. Reports ``{prefix}_p50_ms_c{c}`` / ``{prefix}_p99_ms_c{c}``
+    and the aggregate ``{prefix}_agg_rps_c{c}`` (completed requests per
+    wall second across all clients — the number replica scaling is
+    judged on)."""
+    import http.client
+    import threading
+
+    out: dict = {}
+    for c in concurrencies:
+        lat: list[float] = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(c)
+
+        def client(worker: int, c: int = c) -> None:
+            port = ports[worker % len(ports)]
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            barrier.wait()
+            mine = []
+            for i in range(requests):
+                status, _, ms = _bench_get(
+                    port, f"/tpu?c={c}&w={worker}&i={i}", conn
+                )
+                assert status in (200, 503)
+                mine.append(ms)
+            conn.close()
+            with lock:
+                lat.extend(mine)
+
+        threads = [
+            threading.Thread(target=client, args=(w,)) for w in range(c)
+        ]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall_s = max(time.perf_counter() - t0, 1e-9)
+        lat.sort()
+        out[f"{prefix}_p50_ms_c{c}"] = round(statistics.median(lat), 2)
+        out[f"{prefix}_p99_ms_c{c}"] = round(
+            lat[max(0, int(len(lat) * 0.99) - 1)], 2
+        )
+        out[f"{prefix}_agg_rps_c{c}"] = round(len(lat) / wall_s, 1)
+    return out
+
+
 def bench_gateway(fleet) -> dict:
     """ADR-017 acceptance numbers over REAL sockets: the request
     gateway (bounded render pool + priority admission + burn-rate shed
@@ -1239,19 +1316,7 @@ def bench_gateway(fleet) -> dict:
     threading.Thread(target=server.serve_forever, daemon=True).start()
 
     def get(path: str, conn: http.client.HTTPConnection | None = None):
-        own = conn is None
-        if own:
-            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
-        try:
-            t0 = time.perf_counter()
-            conn.request("GET", path)
-            resp = conn.getresponse()
-            body = resp.read()
-            elapsed_ms = (time.perf_counter() - t0) * 1000
-            return resp.status, body, elapsed_ms
-        finally:
-            if own:
-                conn.close()
+        return _bench_get(port, path, conn)
 
     out: dict = {}
     try:
@@ -1272,38 +1337,10 @@ def bench_gateway(fleet) -> dict:
         unloaded_p50 = statistics.median(unloaded)
         out["gateway_unloaded_p50_ms"] = round(unloaded_p50, 2)
 
-        # Saturation curve — unique queries per request defeat
-        # coalescing so concurrency lands on the pool, not the
+        # Saturation curve (shared driver) — unique queries per request
+        # defeat coalescing so concurrency lands on the pool, not the
         # single-flight table.
-        for c in (1, 4, 16, 32):
-            lat: list[float] = []
-            lock = threading.Lock()
-            barrier = threading.Barrier(c)
-
-            def client(worker: int, c: int = c) -> None:
-                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
-                barrier.wait()
-                mine = []
-                for i in range(8):
-                    status, _, ms = get(f"/tpu?c={c}&w={worker}&i={i}", conn)
-                    assert status in (200, 503)
-                    mine.append(ms)
-                conn.close()
-                with lock:
-                    lat.extend(mine)
-
-            threads = [
-                threading.Thread(target=client, args=(w,)) for w in range(c)
-            ]
-            for th in threads:
-                th.start()
-            for th in threads:
-                th.join()
-            lat.sort()
-            out[f"gateway_p50_ms_c{c}"] = round(statistics.median(lat), 2)
-            out[f"gateway_p99_ms_c{c}"] = round(
-                lat[max(0, int(len(lat) * 0.99) - 1)], 2
-            )
+        out.update(_saturation_curve([port], "gateway"))
 
         # Identical burst: 100 genuinely in-flight requests for the
         # SAME page must cost ≤ 2 renders (a second render is
@@ -1376,6 +1413,173 @@ def bench_gateway(fleet) -> dict:
         server.shutdown()
         server.server_close()
         gateway.close()
+    return out
+
+
+def bench_replication(fleet) -> dict:
+    """ADR-025 acceptance numbers over REAL sockets: one sync leader
+    publishing the snapshot bus, 1/2/4 stateless replicas each serving
+    the full gateway+push+ETag path from applied records, driven by the
+    same saturation-curve driver as ``bench_gateway``. Reports:
+
+    - ``replication_r{R}_p50/p99_ms_c{c}`` and
+      ``replication_r{R}_agg_rps_c{c}`` — the ``bench_gateway`` curve
+      against R replicas, clients round-robined across them. NOTE: this
+      container has ONE core, so in-process replicas share a GIL and
+      the ISSUE's ≥3× multi-replica scaling is not physically
+      observable here — the numbers are recorded honestly and the
+      scaling claim is asserted only as non-regression (replicas must
+      not be SLOWER than one process at c=32 beyond noise). On a
+      multi-core host, run the CLI ``--replica`` subprocesses instead.
+    - ``replication_apply_generations_per_sec`` /
+      ``replication_frames_per_sec`` — bus apply throughput on a
+      replica: a full backlog of mutated generations applied in one
+      poll, push frames counted at the replica hub.
+    - ``replication_failover_to_first_paint_ms`` — scripted leader-kill
+      drill: leader killed mid-serve, replica keeps answering
+      stale-stamped (zero 5xx), a new leader starts in the next fencing
+      band, and the clock stops at the replica's first paint of the new
+      leader's generation.
+    """
+    import http.client
+    import json as _json
+    import threading
+
+    from headlamp_tpu.fleet import fixtures as fx
+    from headlamp_tpu.replicate import (
+        GENERATION_STRIDE,
+        BusConsumer,
+        BusPublisher,
+        ReplicaApp,
+        decode_snapshot,
+        encode_snapshot,
+        pool_fetch,
+    )
+    from headlamp_tpu.server import DashboardApp
+    from headlamp_tpu.server.app import add_demo_prometheus
+
+    def start_leader(floor: int = 0):
+        t = fx.fleet_transport(fleet)
+        add_demo_prometheus(t, fleet)
+        app = DashboardApp(t, min_sync_interval_s=30.0)
+        pub = BusPublisher()
+        app.replication = pub
+        if floor:
+            pub.set_fencing(floor // GENERATION_STRIDE)
+            app._ctx.advance_generation_floor(floor)
+        server = app.serve(port=0)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return app, pub, server, port
+
+    app, pub, server, port = start_leader()
+    out: dict = {}
+    replicas: list = []
+    servers: list = [server]
+    consumers: list = []
+    try:
+        # Warm the leader (sync + caches) and prime the metrics peek so
+        # later published generations ship metrics/forecast payloads.
+        status, body, _ = _bench_get(port, "/tpu")
+        assert status == 200 and body
+        _bench_get(port, "/tpu/metrics")
+
+        def start_replica():
+            rep = ReplicaApp()
+            consumer = BusConsumer(rep, pool_fetch(f"http://127.0.0.1:{port}"))
+            consumer.poll_once()
+            assert rep.snapshot_generation() >= 1, "replica missed the bus"
+            rep_server = rep.serve(port=0)
+            rep_port = rep_server.server_address[1]
+            threading.Thread(target=rep_server.serve_forever, daemon=True).start()
+            servers.append(rep_server)
+            replicas.append(rep)
+            # Warm the replica's render caches off the measured path.
+            _bench_get(rep_port, "/tpu")
+            return rep, consumer, rep_port
+
+        ports: list[int] = []
+        for r_count in (1, 2, 4):
+            while len(ports) < r_count:
+                _, consumer, rep_port = start_replica()
+                ports.append(rep_port)
+                consumers.append(consumer)
+            out.update(_saturation_curve(ports, f"replication_r{r_count}"))
+
+        # Bus apply throughput on one replica: fill the backlog with
+        # mutated generations (errors list changes, so every page model
+        # diffs) and time a single catch-up poll.
+        rep, consumer = replicas[0], consumers[0]
+        base = pub.last_generation
+        snap_payload = encode_snapshot(app._last_snapshot)
+        n_gens = pub.backlog_limit
+        for k in range(n_gens):
+            mutated = _json.loads(_json.dumps(snap_payload))
+            # The differ models errors as a COUNT — vary the length so
+            # every consecutive generation actually diffs into frames.
+            mutated["errors"] = ["synthetic-churn"] * (k % 3 + 1)
+            g = base + k + 1
+            pub.publish(decode_snapshot(mutated, generation=g), generation=g)
+        frames_before = rep.push.counters()["frames_built"]
+        t0 = time.perf_counter()
+        applied = consumer.poll_once()
+        apply_s = max(time.perf_counter() - t0, 1e-9)
+        frames = rep.push.counters()["frames_built"] - frames_before
+        assert applied == n_gens, f"applied {applied}/{n_gens} generations"
+        out["replication_apply_generations_per_sec"] = round(applied / apply_s, 1)
+        out["replication_frames_per_sec"] = round(frames / apply_s, 1)
+
+        # Scripted leader-kill drill: kill the leader, prove the
+        # replica answers stale-stamped with zero 5xx, then start a new
+        # leader in the next fencing band and stop the clock at the
+        # replica's first paint of its generation.
+        server.shutdown()
+        server.server_close()
+        rep.stale_after_s = 0.0  # feed is dead NOW; paints must say so
+        drill_port = ports[0]
+        conn = http.client.HTTPConnection("127.0.0.1", drill_port, timeout=30)
+        statuses = []
+        stale_stamped = 0
+        for i in range(10):
+            conn.request("GET", f"/tpu?drill={i}")
+            resp = conn.getresponse()
+            resp.read()
+            statuses.append(resp.status)
+            if resp.headers.get("X-Headlamp-Stale") == "1":
+                stale_stamped += 1
+        conn.close()
+        assert all(s < 500 for s in statuses), f"5xx during leader loss: {statuses}"
+        out["replication_drill_stale_paint_rate"] = round(stale_stamped / 10, 2)
+
+        floor = (pub.last_generation // GENERATION_STRIDE + 1) * GENERATION_STRIDE
+        t0 = time.perf_counter()
+        app2, pub2, server2, port2 = start_leader(floor=floor)
+        servers.append(server2)
+        _bench_get(port2, "/tpu")  # first sync → first banded generation
+        consumer2 = BusConsumer(rep, pool_fetch(f"http://127.0.0.1:{port2}"))
+        consumers.append(consumer2)
+        while consumer2.poll_once() == 0:
+            pass  # leader just published during its warm GET; one poll lands it
+        rep.stale_after_s = 30.0
+        status, body, _ = _bench_get(drill_port, "/tpu?post=failover")
+        failover_ms = (time.perf_counter() - t0) * 1000
+        assert status == 200 and body
+        assert rep.snapshot_generation() >= floor, "replica did not converge"
+        out["replication_failover_to_first_paint_ms"] = round(failover_ms, 2)
+    finally:
+        for consumer in consumers:
+            consumer.stop()
+        for s in servers:
+            try:
+                s.shutdown()
+                s.server_close()
+            except Exception:
+                pass
+        for rep in replicas:
+            if rep.gateway is not None:
+                rep.gateway.close()
+        if app.gateway is not None:
+            app.gateway.close()
     return out
 
 
@@ -2257,6 +2461,7 @@ def main() -> None:
     slo = bench_slo(fleet)
     transport_pool = bench_transport_pool(fleet)
     gateway = bench_gateway(fleet)
+    replication = bench_replication(fleet)
     push = bench_push(fleet)
     history = bench_history()
     profiler_numbers = bench_profiler()
@@ -2304,6 +2509,7 @@ def main() -> None:
             **slo,
             **transport_pool,
             **gateway,
+            **replication,
             **push,
             **history,
             **profiler_numbers,

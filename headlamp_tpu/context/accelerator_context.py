@@ -226,6 +226,20 @@ class AcceleratorDataContext:
         #: sync.
         self._changed = True
 
+    def advance_generation_floor(self, floor: int) -> None:
+        """Jump the generation counter to at least ``floor`` (ADR-025
+        fencing): a newly elected leader floors its context at
+        ``fencing × GENERATION_STRIDE`` so every generation it publishes
+        carries its leadership term in the high digits — the bus and
+        replicas then reject a deposed leader's lower-band generations
+        with the plain monotonicity check. Never moves backwards, so a
+        re-election of the same process is harmless."""
+        if floor > self._snapshot_generation:
+            self._snapshot_generation = int(floor)
+            # The cached snapshot's views carry pre-floor versions; the
+            # next build must restamp, not reuse them.
+            self._changed = True
+
     # ------------------------------------------------------------------
     # Track 1: reactive lists
     # ------------------------------------------------------------------

@@ -91,6 +91,12 @@ class ShedPolicy:
         self._engine = engine or slo_mod.engine
         self.ttl_s = ttl_s
         self._monotonic = monotonic or time.monotonic
+        #: Extra degrade condition beyond burn rate (ADR-025): a
+        #: replica whose bus feed has gone stale degrades EVERY
+        #: interactive render — same stale-only cache reads, same
+        #: ``X-Headlamp-Stale: 1`` stamp — so leader loss is honest at
+        #: the HTTP layer without a second degradation mechanism.
+        self.degraded_probe: Callable[[], bool] | None = None
         self._cached_at: float | None = None
         self._cached_states: dict[str, str] = {}
         #: Route labels governed by a currently-PAGING request-backed
@@ -133,6 +139,17 @@ class ShedPolicy:
         from .pool import PRIORITY_DEBUG, PRIORITY_INTERACTIVE
 
         states = self.states()
+        probe = self.degraded_probe
+        if probe is not None and priority == PRIORITY_INTERACTIVE:
+            try:
+                probe_degraded = bool(probe())
+            except Exception:  # noqa: BLE001 — probe must never fail a request
+                probe_degraded = False
+            if probe_degraded:
+                # Replica stale-feed degrade (ADR-025): unconditional
+                # for interactive routes — the data itself is stale, not
+                # one SLO's route set.
+                return Decision(degraded=True, burn_state=states)
         paging_routes: set[str] = getattr(self, "_paging_routes", set())
         if not paging_routes:
             return Decision(burn_state=states)

@@ -1,0 +1,81 @@
+"""Horizontal read tier (ADR-025): one sync leader, N stateless
+paint/push replicas.
+
+Everything downstream of a snapshot generation is a pure function of
+(snapshot, metrics peek, history window) — the seam the ROADMAP's
+read-tier item names. This package splits the process along it:
+
+- **bus.py** — the snapshot-distribution bus: each generation (plus
+  the metrics/forecast peeks and the history rows it contributed) is
+  serialized as one ADR-018-style versioned JSONL record, retained in
+  a bounded backlog, and served to replicas resumable by a
+  ``Last-Generation`` cursor — the same ``g<N>`` grammar as the push
+  hub's ``Last-Event-ID``.
+- **leader.py** — lease-based leader election on the injected
+  monotonic clock. The lease fencing token fences snapshot GENERATION
+  BANDS (``generation = fencing × GENERATION_STRIDE + local``), so a
+  deposed leader's stale publishes are rejected by the same
+  generation-monotonicity check that already keys ETags, coalesce
+  keys, and push frames.
+- **replica.py** — a replica-mode :class:`DashboardApp` whose
+  reactive/imperative tracks are replaced by a bus consumer: each
+  applied record feeds ``push.on_snapshot`` and the history tier, and
+  the full gateway + AOT-warmed render + push hub + ETag/304
+  conditional tier serve unchanged. During leader loss replicas keep
+  answering with stale-honest paints (``X-Headlamp-Stale: 1`` through
+  the ADR-017 degraded scope) and converge as soon as a new leader's
+  first generation lands.
+"""
+
+from __future__ import annotations
+
+from .bus import (
+    BUS_FORMAT,
+    BUS_VERSION,
+    BusPublisher,
+    build_record,
+    decode_forecast,
+    decode_metrics,
+    decode_snapshot,
+    dumps_record,
+    encode_forecast,
+    encode_metrics,
+    encode_snapshot,
+    history_rows,
+    parse_payload,
+)
+from .leader import (
+    DEFAULT_LEASE_TTL_S,
+    GENERATION_STRIDE,
+    LeaderElector,
+    Lease,
+    LeaseStore,
+    generation_floor,
+)
+from .replica import BusConsumer, ReplicaApp, pool_fetch, set_active_consumer
+
+__all__ = [
+    "BUS_FORMAT",
+    "BUS_VERSION",
+    "BusConsumer",
+    "BusPublisher",
+    "DEFAULT_LEASE_TTL_S",
+    "GENERATION_STRIDE",
+    "LeaderElector",
+    "Lease",
+    "LeaseStore",
+    "ReplicaApp",
+    "build_record",
+    "decode_forecast",
+    "decode_metrics",
+    "decode_snapshot",
+    "dumps_record",
+    "encode_forecast",
+    "encode_metrics",
+    "encode_snapshot",
+    "generation_floor",
+    "history_rows",
+    "parse_payload",
+    "pool_fetch",
+    "set_active_consumer",
+]

@@ -1,0 +1,474 @@
+"""Snapshot-distribution bus (ADR-025 part 1).
+
+One record per published snapshot generation, in the ADR-018 JSONL
+shape: a versioned header line, then generation records. Every record
+is SELF-CONTAINED — the full raw snapshot (node/pod object lists plus
+the per-provider imperative-track state), the metrics/forecast peeks
+current at publish time, and the history rows this generation
+contributed — so resume can never fabricate state: a replica that
+missed generations simply applies the newest retained record, the
+bus-level analogue of the push hub's per-page ``paint`` fallback.
+
+Wire format (one JSON object per line, canonical encoding — sorted
+keys, compact separators — so re-encoding a parsed record reproduces
+its bytes exactly):
+
+    {"format": "headlamp-tpu-bus", "kind": "header", "note": <str>,
+     "recorded_unix": <float>, "v": 1}
+    {"fencing": <int>, "generation": <int>, "history": [[metric,
+     [labels...], value], ...], "kind": "generation", "metrics":
+     <obj|null>, "forecast": <obj|null>, "snapshot": <obj>}
+
+Resume: replicas pull ``GET /replicate/bus`` with a ``Last-Generation:
+g<N>`` cursor — the exact grammar of the push hub's ``Last-Event-ID``
+(ADR-021), parsed by the same function — and receive only records
+newer than the cursor.
+
+Rebuild contract: views are pure functions of the raw object lists
+(``classify_fleet``), so the bus ships LISTS, not views — a replica
+reclassifies locally and stamps ``view.version`` with the record's
+generation, which is what makes replica ETags, coalesce keys, and
+push frames byte-identical to leader-local serving for the same
+generation.
+
+ADR-013: backlog/lag math runs on the injected monotonic; the one
+wall reading (``recorded_unix`` in the header) is provenance metadata
+through the injectable ``wall`` seam, same as the ADR-018 recorder.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import asdict
+from typing import Any, Callable, Iterable
+
+from ..context.accelerator_context import ClusterSnapshot, ProviderState
+from ..domain.accelerator import PROVIDERS, classify_fleet
+from ..obs.metrics import registry as _metrics_registry
+from ..obs.trace import span
+
+BUS_VERSION = 1
+BUS_FORMAT = "headlamp-tpu-bus"
+
+#: Generations of full-snapshot records retained for cursor catch-up.
+#: Small on purpose: records are self-contained, so a replica behind
+#: the backlog loses nothing — it applies the newest record and is
+#: current (full state, not a delta chain).
+BACKLOG_LIMIT = 16
+
+_GENERATIONS = _metrics_registry.counter(
+    "headlamp_tpu_replicate_generations_total",
+    "Snapshot generations moved through the replication bus, by role "
+    "(published by the leader / applied by a replica / "
+    "rejected_stale by fencing).",
+    labels=("role",),
+)
+_BYTES = _metrics_registry.counter(
+    "headlamp_tpu_replicate_bytes_total",
+    "Bus payload bytes, by role (served by the leader endpoint / "
+    "applied by a replica consumer).",
+    labels=("role",),
+)
+
+
+# ---------------------------------------------------------------------------
+# Codec
+# ---------------------------------------------------------------------------
+
+def _dumps(obj: Any) -> str:
+    """Canonical line encoding: sorted keys + compact separators, so
+    ``_dumps(json.loads(line)) == line`` — the byte-exact re-encode
+    property the recorder round-trip test pins."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def dumps_record(record: dict[str, Any]) -> str:
+    """One record dict → its canonical wire line (no newline)."""
+    return _dumps(record)
+
+
+def header_line(*, wall: Callable[[], float] = time.time, note: str = "") -> str:
+    return _dumps(
+        {
+            "v": BUS_VERSION,
+            "kind": "header",
+            "format": BUS_FORMAT,
+            "recorded_unix": wall(),
+            "note": note,
+        }
+    )
+
+
+def encode_snapshot(snap: Any) -> dict[str, Any]:
+    """ClusterSnapshot → JSON-able payload: the raw object lists plus
+    the per-provider imperative-track state the classifier cannot
+    rebuild (workloads, fallback-merged plugin pods, degradation
+    markers). Views are deliberately NOT shipped — they are pure
+    functions of the lists and rebuild locally."""
+    providers: dict[str, Any] = {}
+    for name, state in (getattr(snap, "providers", {}) or {}).items():
+        providers[name] = {
+            "workloads": list(state.workloads),
+            "workload_available": bool(state.workload_available),
+            "plugin_pods_error": state.plugin_pods_error,
+            # The view's plugin-pod list already merged the imperative
+            # track's fallback pods (UID-deduped) — ship it verbatim so
+            # the replica's rebuild is exact, not re-derived.
+            "plugin_pods": list(state.view.plugin_pods),
+        }
+    return {
+        "all_nodes": snap.all_nodes,
+        "all_pods": snap.all_pods,
+        "errors": list(snap.errors),
+        "fetched_at": snap.fetched_at,
+        "refresh_count": snap.refresh_count,
+        "providers": providers,
+    }
+
+
+def decode_snapshot(payload: dict[str, Any], *, generation: int) -> ClusterSnapshot:
+    """Rebuild a ClusterSnapshot on the replica: reclassify the raw
+    lists, stamp every view with the record's generation (the
+    replica-agnostic ETag/coalesce/push key), and restore the shipped
+    per-provider state."""
+    views = classify_fleet(
+        payload.get("all_nodes") or [], payload.get("all_pods") or []
+    )
+    shipped = payload.get("providers") or {}
+    providers: dict[str, ProviderState] = {}
+    for p in PROVIDERS:
+        view = views[p.name]
+        view.version = int(generation)
+        extra = shipped.get(p.name) or {}
+        plugin_pods = extra.get("plugin_pods")
+        if plugin_pods is not None:
+            view.plugin_pods = list(plugin_pods)
+        providers[p.name] = ProviderState(
+            provider=p,
+            view=view,
+            workloads=list(extra.get("workloads") or []),
+            workload_available=bool(extra.get("workload_available", True)),
+            plugin_pods_error=extra.get("plugin_pods_error"),
+        )
+    return ClusterSnapshot(
+        all_nodes=payload.get("all_nodes"),
+        all_pods=payload.get("all_pods"),
+        providers=providers,
+        errors=list(payload.get("errors") or []),
+        fetched_at=float(payload.get("fetched_at") or 0.0),
+        refresh_count=int(payload.get("refresh_count") or 0),
+    )
+
+
+def encode_metrics(metrics: Any) -> dict[str, Any] | None:
+    """TpuMetricsSnapshot → JSON-able dict (dataclass fields verbatim,
+    nested chips included); None passes through — an absent peek is an
+    honest state, not an error."""
+    if metrics is None:
+        return None
+    return asdict(metrics)
+
+
+def decode_metrics(payload: dict[str, Any] | None) -> Any:
+    if payload is None:
+        return None
+    from ..metrics.client import TpuChipMetrics, TpuMetricsSnapshot
+
+    chips = [TpuChipMetrics(**chip) for chip in payload.get("chips") or []]
+    fields = {k: v for k, v in payload.items() if k != "chips"}
+    return TpuMetricsSnapshot(chips=chips, **fields)
+
+
+def encode_forecast(forecast: Any) -> dict[str, Any] | None:
+    if forecast is None:
+        return None
+    return asdict(forecast)
+
+
+def decode_forecast(payload: dict[str, Any] | None) -> Any:
+    if payload is None:
+        return None
+    from ..models.service import ChipForecast, ForecastView
+
+    chips = [ChipForecast(**chip) for chip in payload.get("chips") or []]
+    fields = {k: v for k, v in payload.items() if k != "chips"}
+    return ForecastView(chips=chips, **fields)
+
+
+def history_rows(
+    snap: Any,
+    generation: int,
+    *,
+    metrics: Any = None,
+    include_scrape: bool = False,
+) -> list[list[Any]]:
+    """The history-window slice this generation contributes: the
+    ``sync.*`` rows the leader's store captured for it, plus — when the
+    metrics peek is FRESH (first record shipping this scrape) — the
+    per-chip/fleet scrape rows, mirroring ``HistoryStore.record_scrape``
+    so replica trend pages answer from the same series. JSON-able
+    ``[metric, [labels...], value]`` triples; replicas ``append_many``
+    them on their own injected monotonic (ages are relative by
+    construction — ADR-018)."""
+    rows: list[list[Any]] = [
+        ["sync.generation", [], float(generation)],
+        ["sync.nodes", [], float(len(getattr(snap, "all_nodes", None) or []))],
+        ["sync.errors", [], float(len(getattr(snap, "errors", []) or []))],
+    ]
+    if not include_scrape or metrics is None:
+        return rows
+    chips = getattr(metrics, "chips", None) or []
+    util_sum, util_n = 0.0, 0
+    for chip in chips:
+        chip_key = [str(chip.node), str(chip.accelerator_id)]
+        if chip.tensorcore_utilization is not None:
+            rows.append(
+                ["chip.tensorcore_utilization", chip_key, chip.tensorcore_utilization]
+            )
+            util_sum += chip.tensorcore_utilization
+            util_n += 1
+        if chip.duty_cycle is not None:
+            rows.append(["chip.duty_cycle", chip_key, chip.duty_cycle])
+    rows.append(["fleet.chips_reporting", [], float(len(chips))])
+    if util_n:
+        rows.append(["fleet.mean_tensorcore_utilization", [], util_sum / util_n])
+    return rows
+
+
+def build_record(
+    snap: Any,
+    *,
+    generation: int,
+    fencing: int = 0,
+    metrics: Any = None,
+    forecast: Any = None,
+    history: list[list[Any]] | None = None,
+) -> dict[str, Any]:
+    """One self-contained generation record (not yet encoded)."""
+    return {
+        "kind": "generation",
+        "generation": int(generation),
+        "fencing": int(fencing),
+        "snapshot": encode_snapshot(snap),
+        "metrics": encode_metrics(metrics),
+        "forecast": encode_forecast(forecast),
+        "history": history if history is not None else history_rows(snap, generation),
+    }
+
+
+def parse_payload(text: str, *, origin: str = "<bus>") -> tuple[dict[str, Any], list[dict[str, Any]]]:
+    """Parse a bus payload (header line + records), enforcing the same
+    version gate as ADR-018's ``load_recording``: a future-version or
+    foreign-format payload is refused, never half-applied. Unknown
+    record kinds are skipped (forward-compat), exactly like the
+    recorder's parser."""
+    lines = [line for line in text.splitlines() if line.strip()]
+    if not lines:
+        raise ValueError(f"{origin}: empty bus payload")
+    header = json.loads(lines[0])
+    if header.get("kind") != "header" or header.get("format") != BUS_FORMAT:
+        raise ValueError(f"{origin}: not a {BUS_FORMAT} payload")
+    version = header.get("v")
+    if version != BUS_VERSION:
+        raise ValueError(
+            f"{origin}: bus version {version!r} not supported "
+            f"(this build reads v{BUS_VERSION})"
+        )
+    records: list[dict[str, Any]] = []
+    for line in lines[1:]:
+        entry = json.loads(line)
+        if entry.get("kind") != "generation":
+            continue  # forward-compat: unknown kinds skipped, not fatal
+        records.append(entry)
+    return header, records
+
+
+# ---------------------------------------------------------------------------
+# Publisher (leader side)
+# ---------------------------------------------------------------------------
+
+class BusPublisher:
+    """The leader's half of the bus: encodes each published generation
+    once and retains a bounded backlog of encoded lines for cursor
+    catch-up. Hooked beside ``_record_sync`` exactly like the push
+    pipeline (same ``on_snapshot`` shape, same absorb-everything
+    stance: replication is a scale-out optimization and must never
+    break the sync heartbeat).
+
+    Fencing: ``publish`` rejects any generation ≤ the last published
+    one. Combined with the elector's generation-band fencing
+    (``leader.GENERATION_STRIDE``), a deposed leader — whose fencing
+    token, and therefore generation band, is lower than the incumbent's
+    — can never overwrite newer state, even through a shared store.
+
+    Thread shape: ``on_snapshot`` runs on whichever thread syncs
+    (background loop or an inline render worker); ``payload_after``
+    runs on request-handler threads. All mutable state is guarded by
+    one lock, same discipline as the broadcast hub."""
+
+    def __init__(
+        self,
+        *,
+        backlog_limit: int = BACKLOG_LIMIT,
+        monotonic: Callable[[], float] | None = None,
+        wall: Callable[[], float] = time.time,
+        note: str = "leader",
+    ) -> None:
+        self._mono = monotonic or time.monotonic
+        self._lock = threading.Lock()
+        self.backlog_limit = backlog_limit
+        self._header = header_line(wall=wall, note=note)
+        #: (generation, encoded line) in publish order.
+        self._backlog: deque[tuple[int, str]] = deque()
+        self.last_generation = 0
+        #: Fencing token of the current leadership term (set by the
+        #: elector's on_elected hook); informational on the wire — the
+        #: generation band it fences is what enforces rejection.
+        self.fencing = 0
+        self._last_scrape_stamp: float | None = None
+        self._last_publish_mono: float | None = None
+        # Monotone per-instance ints (healthz block + flight deltas).
+        self.published = 0
+        self.rejected_stale = 0
+        self.pulls = 0
+        self.bytes_served = 0
+
+    def set_fencing(self, fencing: int) -> None:
+        self.fencing = int(fencing)
+
+    # -- publish ---------------------------------------------------------
+
+    def on_snapshot(
+        self,
+        snap: Any,
+        *,
+        generation: int,
+        metrics: Callable[[], Any] | None = None,
+        forecast: Callable[[], Any] | None = None,
+    ) -> bool:
+        """Publish hook beside the push differ: evaluate the peeks
+        once, build the record, retain it. Returns whether the
+        generation was accepted. Exception-absorbed end to end."""
+        try:
+            if snap is None:
+                return False
+            metrics_value = metrics() if callable(metrics) else metrics
+            forecast_value = forecast() if callable(forecast) else forecast
+            return self.publish(
+                snap,
+                generation=generation,
+                metrics=metrics_value,
+                forecast=forecast_value,
+            )
+        except Exception:  # noqa: BLE001 — replication must never break sync
+            return False
+
+    def publish(
+        self,
+        snap: Any,
+        *,
+        generation: int,
+        metrics: Any = None,
+        forecast: Any = None,
+    ) -> bool:
+        """Encode and retain one generation. Stale generations (≤ last
+        published) are rejected — the fencing check."""
+        generation = int(generation)
+        with span("replicate.publish", generation=generation):
+            with self._lock:
+                if generation <= self.last_generation:
+                    self.rejected_stale += 1
+                    _GENERATIONS.inc(role="rejected_stale")
+                    return False
+                stamp = getattr(metrics, "fetched_at", None)
+                fresh_scrape = (
+                    metrics is not None and stamp != self._last_scrape_stamp
+                )
+                record = build_record(
+                    snap,
+                    generation=generation,
+                    fencing=self.fencing,
+                    metrics=metrics,
+                    forecast=forecast,
+                    history=history_rows(
+                        snap,
+                        generation,
+                        metrics=metrics,
+                        include_scrape=fresh_scrape,
+                    ),
+                )
+                if fresh_scrape:
+                    self._last_scrape_stamp = stamp
+                self._backlog.append((generation, dumps_record(record)))
+                while len(self._backlog) > self.backlog_limit:
+                    self._backlog.popleft()
+                self.last_generation = generation
+                self._last_publish_mono = self._mono()
+                self.published += 1
+            _GENERATIONS.inc(role="published")
+            return True
+
+    # -- serve -----------------------------------------------------------
+
+    def payload_after(self, cursor: int | None) -> str:
+        """The JSONL payload for one replica pull: header + every
+        retained record newer than ``cursor`` (None → everything
+        retained). Records are self-contained, so a cursor behind the
+        backlog simply catches up from what remains — full state, never
+        a fabricated delta chain."""
+        after = int(cursor) if cursor is not None else 0
+        with self._lock:
+            lines = [self._header]
+            lines.extend(
+                line for generation, line in self._backlog if generation > after
+            )
+            self.pulls += 1
+            payload = "\n".join(lines) + "\n"
+            self.bytes_served += len(payload)
+        _BYTES.inc(len(payload), role="served")
+        return payload
+
+    # -- observability ---------------------------------------------------
+
+    def counters(self) -> dict[str, int]:
+        return {
+            "published": self.published,
+            "rejected_stale": self.rejected_stale,
+            "pulls": self.pulls,
+            "bytes_served": self.bytes_served,
+        }
+
+    def snapshot(self) -> dict[str, Any]:
+        """The /healthz ``runtime.replication`` block (leader role)."""
+        out: dict[str, Any] = {"role": "leader", **self.counters()}
+        with self._lock:
+            out["last_generation"] = self.last_generation
+            out["fencing"] = self.fencing
+            out["backlog"] = len(self._backlog)
+            mono = self._last_publish_mono
+            out["last_publish_age_s"] = (
+                round(max(self._mono() - mono, 0.0), 3) if mono is not None else None
+            )
+        return out
+
+
+__all__ = [
+    "BACKLOG_LIMIT",
+    "BUS_FORMAT",
+    "BUS_VERSION",
+    "BusPublisher",
+    "build_record",
+    "decode_forecast",
+    "decode_metrics",
+    "decode_snapshot",
+    "dumps_record",
+    "encode_forecast",
+    "encode_metrics",
+    "encode_snapshot",
+    "header_line",
+    "history_rows",
+    "parse_payload",
+]

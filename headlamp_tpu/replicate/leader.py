@@ -1,0 +1,263 @@
+"""Lease-based leader election (ADR-025 part 2).
+
+One leadership term = one lease = one **fencing token**, a monotone
+integer minted by the store on every acquisition. The token does not
+ride beside the data — it fences the snapshot **generation band**
+itself: a newly elected leader floors its context's generation counter
+at ``fencing × GENERATION_STRIDE``, so every generation it publishes
+carries its term in the high digits. A deposed leader's publishes sit
+in a *lower* band and are rejected by the same generation-monotonicity
+check that already keys ETags, coalesce keys, and push frames — no
+second token to thread through the serving tier ("fencing token =
+generation").
+
+ADR-013: every TTL comparison runs on the injected monotonic clock;
+tests drive acquire → expire → takeover → stale-publish-rejected with
+a fake clock and zero sleeps. The store here is in-memory (drills and
+single-host supervisors); a distributed store only needs the same
+four methods with compare-and-swap semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ..obs.metrics import registry as _metrics_registry
+
+#: Lease duration. A failed leader is detectable (and replaceable)
+#: within one TTL; renewal ticks should run at a fraction of it.
+DEFAULT_LEASE_TTL_S = 15.0
+
+#: Width of one leadership term's generation band. Local generations
+#: count syncs (one per several seconds at minimum sync interval), so
+#: a term would need ~weeks of continuous syncing to overflow its
+#: band; overflow would only weaken fencing between adjacent terms,
+#: never break monotonicity within one.
+GENERATION_STRIDE = 1_000_000
+
+_FAILOVERS = _metrics_registry.counter(
+    "headlamp_tpu_replicate_failovers_total",
+    "Leadership transitions observed: elections won plus depositions "
+    "noticed, by kind.",
+    labels=("kind",),
+)
+
+
+@dataclass
+class Lease:
+    """One leadership term: who holds it, its fencing token, and the
+    monotonic instant it expires."""
+
+    holder: str
+    fencing: int
+    expires_at: float
+
+    def expired(self, now: float) -> bool:
+        return now >= self.expires_at
+
+
+class LeaseStore:
+    """In-memory lease store with compare-and-swap semantics on the
+    injected monotonic clock. ``try_acquire`` succeeds only when the
+    lease is free or expired and always mints a fresh, strictly larger
+    fencing token; ``renew`` succeeds only for the exact lease object
+    currently held and unexpired — a deposed leader renewing its old
+    lease loses, even if it raced the clock."""
+
+    def __init__(self, *, monotonic: Callable[[], float] | None = None) -> None:
+        self._mono = monotonic or time.monotonic
+        self._lock = threading.Lock()
+        self._lease: Lease | None = None
+        self._fence = 0
+
+    def try_acquire(self, holder: str, ttl_s: float = DEFAULT_LEASE_TTL_S) -> Lease | None:
+        now = self._mono()
+        with self._lock:
+            current = self._lease
+            if current is not None and not current.expired(now):
+                return None
+            self._fence += 1
+            lease = Lease(holder=holder, fencing=self._fence, expires_at=now + ttl_s)
+            self._lease = lease
+            return lease
+
+    def renew(self, lease: Lease, ttl_s: float = DEFAULT_LEASE_TTL_S) -> bool:
+        now = self._mono()
+        with self._lock:
+            current = self._lease
+            if current is None or current.fencing != lease.fencing:
+                return False  # superseded: someone else holds a newer term
+            if current.expired(now):
+                return False  # too late: the term lapsed before renewal
+            current.expires_at = now + ttl_s
+            return True
+
+    def release(self, lease: Lease) -> bool:
+        """Voluntary step-down (clean shutdown): frees the lease early
+        so a successor need not wait out the TTL."""
+        with self._lock:
+            current = self._lease
+            if current is None or current.fencing != lease.fencing:
+                return False
+            self._lease = None
+            return True
+
+    def holder(self) -> Lease | None:
+        """The current lease if live, else None (expired leases read
+        as free — there is no reaper thread to clear them)."""
+        now = self._mono()
+        with self._lock:
+            current = self._lease
+            if current is None or current.expired(now):
+                return None
+            return Lease(current.holder, current.fencing, current.expires_at)
+
+
+class LeaderElector:
+    """Drives one node's participation: each ``tick()`` either renews
+    the held lease or tries to acquire a free one, firing
+    ``on_elected(fencing)`` / ``on_deposed()`` on transitions. The tick
+    is the whole protocol — tests call it directly against a fake
+    clock; production calls ``start()`` for a renewal thread ticking at
+    a fraction of the TTL (a sanctioned THR001 seam)."""
+
+    def __init__(
+        self,
+        store: LeaseStore,
+        node_id: str,
+        *,
+        ttl_s: float = DEFAULT_LEASE_TTL_S,
+        monotonic: Callable[[], float] | None = None,
+        on_elected: Callable[[int], None] | None = None,
+        on_deposed: Callable[[], None] | None = None,
+    ) -> None:
+        self.store = store
+        self.node_id = node_id
+        self.ttl_s = ttl_s
+        self._mono = monotonic or time.monotonic
+        self._on_elected = on_elected
+        self._on_deposed = on_deposed
+        self._lease: Lease | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.elections = 0
+        self.depositions = 0
+
+    @property
+    def is_leader(self) -> bool:
+        lease = self._lease
+        return lease is not None and not lease.expired(self._mono())
+
+    @property
+    def fencing(self) -> int:
+        lease = self._lease
+        return lease.fencing if lease is not None else 0
+
+    def tick(self) -> bool:
+        """One election-protocol step; returns leadership after it."""
+        lease = self._lease
+        if lease is not None:
+            if self.store.renew(lease, self.ttl_s):
+                return True
+            # Deposed: superseded or lapsed. Drop the lease before the
+            # callback so is_leader reads False inside it.
+            self._lease = None
+            self.depositions += 1
+            _FAILOVERS.inc(kind="deposed")
+            if self._on_deposed is not None:
+                try:
+                    self._on_deposed()
+                except Exception:  # noqa: BLE001 — election must keep ticking
+                    pass
+        acquired = self.store.try_acquire(self.node_id, self.ttl_s)
+        if acquired is None:
+            return False
+        self._lease = acquired
+        self.elections += 1
+        _FAILOVERS.inc(kind="elected")
+        if self._on_elected is not None:
+            try:
+                self._on_elected(acquired.fencing)
+            except Exception:  # noqa: BLE001
+                pass
+        return True
+
+    def resign(self) -> None:
+        """Voluntary step-down: release the lease (successor skips the
+        TTL wait) and report deposed."""
+        lease = self._lease
+        if lease is None:
+            return
+        self.store.release(lease)
+        self._lease = None
+        self.depositions += 1
+        _FAILOVERS.inc(kind="resigned")
+        if self._on_deposed is not None:
+            try:
+                self._on_deposed()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- renewal thread (sanctioned THR001 seam) -------------------------
+
+    def start(self, interval_s: float | None = None) -> None:
+        if self._thread is not None:
+            return
+        interval = interval_s if interval_s is not None else self.ttl_s / 3.0
+        self._stop.clear()
+
+        def _renewal_loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — keep electing
+                    pass
+                self._stop.wait(interval)
+
+        thread = threading.Thread(
+            target=_renewal_loop, name="replicate-lease-renewal", daemon=True
+        )
+        self._thread = thread
+        thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=2.0)
+            self._thread = None
+
+    def snapshot(self) -> dict[str, Any]:
+        lease = self._lease
+        return {
+            "node_id": self.node_id,
+            "is_leader": self.is_leader,
+            "fencing": self.fencing,
+            "ttl_s": self.ttl_s,
+            "elections": self.elections,
+            "depositions": self.depositions,
+            "lease_remaining_s": (
+                round(max(lease.expires_at - self._mono(), 0.0), 3)
+                if lease is not None
+                else None
+            ),
+        }
+
+
+def generation_floor(fencing: int) -> int:
+    """The first generation of a term's band; a new leader floors its
+    context here so its publishes fence out every earlier term."""
+    return int(fencing) * GENERATION_STRIDE
+
+
+__all__ = [
+    "DEFAULT_LEASE_TTL_S",
+    "GENERATION_STRIDE",
+    "LeaderElector",
+    "Lease",
+    "LeaseStore",
+    "generation_floor",
+]

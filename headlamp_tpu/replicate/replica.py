@@ -1,0 +1,352 @@
+"""Replica-mode DashboardApp + bus consumer (ADR-025 part 3).
+
+A replica is a :class:`DashboardApp` whose reactive/imperative tracks
+are replaced by bus records: no cluster transport, no Prometheus probe
+chain, no forecast fits — every applied record delivers the snapshot,
+the metrics/forecast peeks, and the history rows the leader already
+paid for. Everything DOWNSTREAM is stock: the full gateway (admission,
+coalescing, shedding), the AOT-warmed render path, the push hub, and
+the ETag/304 conditional tier serve unchanged, because all of them key
+on the snapshot generation — which the bus record carries.
+
+Staleness honesty: when the feed goes quiet past ``stale_after_s``
+(leader dead, partition), the replica keeps answering — it wires its
+``stale()`` probe into the gateway's shed policy, so every interactive
+paint rides the ADR-017 degraded scope and carries
+``X-Headlamp-Stale: 1`` until a new leader's first generation lands.
+Zero 5xx during failover; never a fabricated generation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Callable
+
+from ..context.accelerator_context import ClusterSnapshot, ProviderState
+from ..domain.accelerator import PROVIDERS, classify_fleet
+from ..obs.metrics import registry as _metrics_registry
+from ..obs.trace import span
+from ..server.app import DashboardApp
+from ..transport import ApiError, ConnectionPool
+from .bus import _BYTES, _GENERATIONS, decode_forecast, decode_metrics, decode_snapshot, parse_payload
+
+#: Bus silence after which a replica stamps its paints stale. Default
+#: = two leader lease TTLs: one missed generation is routine (quiet
+#: cluster ticks publish nothing new), but silence spanning a whole
+#: failover window means the data can no longer claim freshness.
+DEFAULT_STALE_AFTER_S = 30.0
+
+
+class _ReplicaTransport:
+    """The replica's transport slot: any cluster request is a bug —
+    replicas have no reactive track. Raising (rather than returning
+    empty lists) makes an accidental sync path loudly visible instead
+    of silently publishing an empty fleet."""
+
+    def request(self, path: str, timeout_s: float = 2.0) -> Any:
+        raise ApiError(path, "replica mode: no cluster transport", status=503)
+
+
+class ReplicaApp(DashboardApp):
+    """DashboardApp fed by bus records instead of ``ctx.sync()``."""
+
+    def __init__(
+        self,
+        *,
+        registry: Any = None,
+        clock: Callable[[], float] = time.time,
+        monotonic: Callable[[], float] = time.monotonic,
+        stale_after_s: float = DEFAULT_STALE_AFTER_S,
+    ) -> None:
+        super().__init__(
+            _ReplicaTransport(),
+            registry=registry,
+            # Inline sync must never trigger; _synced_snapshot is
+            # overridden outright (the base's -inf last-sync stamp
+            # makes even an inf interval pass the elapsed check).
+            min_sync_interval_s=float("inf"),
+            clock=clock,
+            monotonic=monotonic,
+        )
+        self.stale_after_s = stale_after_s
+        #: Monotonic stamp of the last applied record — the staleness
+        #: and lag anchor (never the record's wall fetched_at: the
+        #: leader's wall clock is not ours — ADR-013).
+        self._last_apply_mono: float | None = None
+        #: Peeks decoded from the last applied record; served where the
+        #: base class would consult the refresher caches.
+        self._bus_metrics: Any = None
+        self._bus_forecast: Any = None
+        self.applied = 0
+        self.rejected_stale = 0
+        self._empty_snapshot: ClusterSnapshot | None = None
+
+    # -- feed ------------------------------------------------------------
+
+    def apply_record(self, record: dict[str, Any]) -> bool:
+        """Apply one bus generation record: rebuild the snapshot, stamp
+        it, refresh the peeks, append the history rows, and hand the
+        snapshot to the push differ — the replica-side mirror of the
+        leader's ``_record_sync``. Stale generations (≤ current) are
+        rejected: with generation-band fencing this is what discards a
+        deposed leader's records."""
+        generation = int(record.get("generation") or 0)
+        with span("replicate.apply", generation=generation) as node:
+            if generation <= self.snapshot_generation():
+                self.rejected_stale += 1
+                _GENERATIONS.inc(role="rejected_stale")
+                if node is not None:
+                    node.attrs["outcome"] = "rejected_stale"
+                return False
+            snap = decode_snapshot(record["snapshot"], generation=generation)
+            metrics = decode_metrics(record.get("metrics"))
+            forecast = decode_forecast(record.get("forecast"))
+            rows = [
+                (str(metric), tuple(labels), float(value))
+                for metric, labels, value in record.get("history") or []
+            ]
+            if rows:
+                self.history.append_many(rows)
+            self.history.syncs += 1
+            # Publish order matters: the snapshot reference flips first
+            # (atomic — /healthz and renders read it lock-free), then
+            # the peeks, then the push differ broadcasts. A request
+            # racing the flip serves either generation consistently.
+            self._last_snapshot = snap
+            self._last_snapshot_mono = self._mono()
+            self._last_apply_mono = self._mono()
+            self._bus_metrics = metrics
+            self._bus_forecast = forecast
+            self._sync_failures = 0
+            self.applied += 1
+            self.push.on_snapshot(
+                snap, generation=generation, metrics=metrics, forecast=forecast
+            )
+        _GENERATIONS.inc(role="applied")
+        return True
+
+    def stale(self) -> bool:
+        """Has the bus feed gone quiet past ``stale_after_s``? True
+        before the first record too — a replica that has never heard a
+        leader must not claim freshness."""
+        mono = self._last_apply_mono
+        return mono is None or self._mono() - mono > self.stale_after_s
+
+    def lag_s(self) -> float | None:
+        """Seconds since the last applied record (None before the
+        first) — the ``replicate_lag_seconds`` gauge sample and the
+        runbook's lag-triage number."""
+        mono = self._last_apply_mono
+        return max(self._mono() - mono, 0.0) if mono is not None else None
+
+    # -- base-class seams replaced by the bus ----------------------------
+
+    def _synced_snapshot(self) -> ClusterSnapshot:
+        # No reactive track: serve the last applied record, or an
+        # honest loading-state snapshot (all_nodes/all_pods None →
+        # every page renders its loading skeleton) before the first.
+        snap = self._last_snapshot
+        if snap is not None:
+            return snap
+        if self._empty_snapshot is None:
+            views = classify_fleet([], [])
+            self._empty_snapshot = ClusterSnapshot(
+                all_nodes=None,
+                all_pods=None,
+                providers={
+                    p.name: ProviderState(provider=p, view=views[p.name])
+                    for p in PROVIDERS
+                },
+                errors=[],
+                fetched_at=0.0,
+                refresh_count=0,
+            )
+        return self._empty_snapshot
+
+    def _cached_metrics(self) -> Any:
+        return self._bus_metrics
+
+    def _peek_metrics(self) -> Any:
+        return self._bus_metrics
+
+    def _peek_forecast(self) -> Any:
+        return self._bus_forecast
+
+    def _forecast_for(self, metrics: Any) -> Any:
+        # Forecasts arrive on the bus; a replica never fits.
+        return self._bus_forecast
+
+    def start_background_sync(self, interval_s: float | None = None) -> threading.Event:
+        raise RuntimeError("replica mode: feed comes from the bus, not a sync loop")
+
+    def ensure_gateway(self, **overrides: Any) -> Any:
+        gateway = super().ensure_gateway(**overrides)
+        # Stale-feed probe → ADR-017 degraded scope: every interactive
+        # paint during leader loss reads stale-only caches and carries
+        # X-Headlamp-Stale: 1, with zero code in the render path itself.
+        gateway.shed_policy.degraded_probe = self.stale
+        return gateway
+
+
+class BusConsumer:
+    """Pulls the leader's bus endpoint and applies records to one
+    replica. ``poll_once`` is the whole protocol — deterministic tests
+    call it directly; production calls ``start()`` for a poll thread
+    (a sanctioned THR001 seam). Fetch/parse failures are absorbed and
+    counted: a dead leader must degrade the replica to stale-honest
+    serving, never crash it."""
+
+    def __init__(
+        self,
+        app: ReplicaApp,
+        fetch: Callable[[int], str],
+        *,
+        monotonic: Callable[[], float] | None = None,
+        interval_s: float = 1.0,
+    ) -> None:
+        self.app = app
+        self._fetch = fetch
+        self._mono = monotonic or time.monotonic
+        self.interval_s = interval_s
+        self.cursor = 0
+        self.fetch_failures = 0
+        self.polls = 0
+        self.bytes_applied = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # The /healthz runtime.replication block reads the consumer.
+        app.replication = self
+        set_active_consumer(self)
+
+    def poll_once(self) -> int:
+        """One pull: fetch everything past the cursor, apply in order,
+        advance the cursor past every record SEEN (applied or fenced
+        out — a rejected generation must not be re-fetched forever).
+        Returns the number of records applied."""
+        self.polls += 1
+        try:
+            payload = self._fetch(self.cursor)
+            _, records = parse_payload(payload, origin="<bus-consumer>")
+        except Exception:  # noqa: BLE001 — dead leader degrades, never crashes
+            self.fetch_failures += 1
+            return 0
+        self.bytes_applied += len(payload)
+        _BYTES.inc(len(payload), role="applied")
+        applied = 0
+        for record in records:
+            if self.app.apply_record(record):
+                applied += 1
+            self.cursor = max(self.cursor, int(record.get("generation") or 0))
+        return applied
+
+    # -- poll thread (sanctioned THR001 seam) ----------------------------
+
+    def start(self, interval_s: float | None = None) -> None:
+        if self._thread is not None:
+            return
+        interval = interval_s if interval_s is not None else self.interval_s
+        self._stop.clear()
+
+        def _consume_loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001 — keep pulling
+                    pass
+                self._stop.wait(interval)
+
+        thread = threading.Thread(
+            target=_consume_loop, name="replicate-bus-consumer", daemon=True
+        )
+        self._thread = thread
+        thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=2.0)
+            self._thread = None
+
+    def snapshot(self) -> dict[str, Any]:
+        """The /healthz ``runtime.replication`` block (replica role)."""
+        app = self.app
+        lag = app.lag_s()
+        return {
+            "role": "replica",
+            "cursor": self.cursor,
+            "last_generation": app.snapshot_generation(),
+            "applied": app.applied,
+            "rejected_stale": app.rejected_stale,
+            "polls": self.polls,
+            "fetch_failures": self.fetch_failures,
+            "bytes_applied": self.bytes_applied,
+            "stale": app.stale(),
+            "lag_s": round(lag, 3) if lag is not None else None,
+        }
+
+
+def pool_fetch(
+    base_url: str,
+    *,
+    pool: ConnectionPool | None = None,
+    timeout_s: float = 5.0,
+) -> Callable[[int], str]:
+    """Fetch callable for :class:`BusConsumer` over the ADR-014
+    connection pool: ``GET {base_url}/replicate/bus`` with the cursor
+    in ``Last-Generation`` (the push hub's ``g<N>`` grammar). Keeps a
+    long-lived socket to the leader across polls."""
+    pool = pool or ConnectionPool()
+    base = base_url.rstrip("/")
+
+    def fetch(cursor: int) -> str:
+        with pool.request(
+            f"{base}/replicate/bus",
+            headers={"Last-Generation": f"g{cursor}"},
+            timeout_s=timeout_s,
+        ) as resp:
+            body = resp.read()
+            if resp.status != 200:
+                raise ApiError(
+                    "/replicate/bus", f"bus pull failed: HTTP {resp.status}",
+                    status=resp.status,
+                )
+            return body.decode("utf-8")
+
+    return fetch
+
+
+# -- active-consumer gauge (same weakref pattern as the push pipeline) ----
+
+_ACTIVE: weakref.ref | None = None
+
+
+def set_active_consumer(consumer: "BusConsumer | None") -> None:
+    global _ACTIVE
+    _ACTIVE = weakref.ref(consumer) if consumer is not None else None
+
+
+def _lag_sample() -> float | None:
+    consumer = _ACTIVE() if _ACTIVE is not None else None
+    if consumer is None:
+        return None
+    return consumer.app.lag_s()
+
+
+_metrics_registry.gauge_fn(
+    "headlamp_tpu_replicate_lag_seconds",
+    "Seconds since the active replica applied a bus record "
+    "(absent on leaders and before the first record).",
+    _lag_sample,
+)
+
+
+__all__ = [
+    "BusConsumer",
+    "DEFAULT_STALE_AFTER_S",
+    "ReplicaApp",
+    "pool_fetch",
+    "set_active_consumer",
+]

@@ -5,6 +5,14 @@ Modes:
 - ``--apiserver URL``                 — real cluster (e.g. http://127.0.0.1:8001
   from ``kubectl proxy``).
 - ``--in-cluster``                    — service-account auth inside a pod.
+
+Read-tier roles (ADR-025):
+- ``--replication-leader``            — publish every snapshot generation on
+  the ``/replicate/bus`` endpoint (and run leader election on an
+  in-process lease store, so the fencing/generation-band machinery is
+  exercised even single-host).
+- ``--replica URL``                   — no cluster access: consume the bus of
+  the leader at URL and serve paints/push/ETags from applied records.
 """
 
 from __future__ import annotations
@@ -34,7 +42,37 @@ def main(argv: list[str] | None = None) -> None:
         help="server-side fieldSelector dropping Succeeded/Failed pods "
         "from the reactive list (batch-heavy fleets)",
     )
+    parser.add_argument(
+        "--replication-leader", action="store_true",
+        help="publish snapshot generations on /replicate/bus for read "
+        "replicas (ADR-025)",
+    )
+    parser.add_argument(
+        "--replica", metavar="LEADER_URL", default=None,
+        help="run as a stateless read replica consuming the bus of the "
+        "leader at LEADER_URL (no cluster access; ADR-025)",
+    )
     args = parser.parse_args(argv)
+
+    if args.replica:
+        if args.demo or args.apiserver or args.in_cluster or args.replication_leader:
+            parser.error("--replica excludes cluster modes and --replication-leader")
+        from ..replicate import BusConsumer, ReplicaApp, pool_fetch
+
+        app = ReplicaApp()
+        consumer = BusConsumer(app, pool_fetch(args.replica))
+        consumer.start()
+        server = app.serve(args.host, args.port)
+        print(
+            f"TPU dashboard REPLICA on http://{args.host}:{args.port}/tpu "
+            f"(bus: {args.replica})"
+        )
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:  # analysis: disable=EXC001
+            consumer.stop()
+            server.shutdown()  # top-of-process Ctrl-C: clean stop IS the handling
+        return
 
     if args.demo:
         transport = make_demo_transport(args.demo)
@@ -46,7 +84,8 @@ def main(argv: list[str] | None = None) -> None:
         transport = KubeTransport(args.apiserver)
         mode = args.apiserver
     else:
-        parser.error("choose one of --demo, --apiserver URL, --in-cluster")
+        parser.error("choose one of --demo, --apiserver URL, --in-cluster, "
+                     "--replica URL")
 
     from ..context.sources import ACTIVE_PODS_FIELD_SELECTOR
 
@@ -56,6 +95,30 @@ def main(argv: list[str] | None = None) -> None:
             ACTIVE_PODS_FIELD_SELECTOR if args.active_pods_only else None
         ),
     )
+    elector = None
+    if args.replication_leader:
+        from ..replicate import (
+            BusPublisher,
+            LeaderElector,
+            LeaseStore,
+            generation_floor,
+        )
+
+        publisher = BusPublisher(note=f"{args.host}:{args.port}")
+        app.replication = publisher
+
+        def _elected(fencing: int) -> None:
+            # Fencing token → generation band: everything this term
+            # publishes outranks every earlier term (ADR-025).
+            publisher.set_fencing(fencing)
+            app._ctx.advance_generation_floor(generation_floor(fencing))
+
+        elector = LeaderElector(
+            LeaseStore(), f"{args.host}:{args.port}", on_elected=_elected
+        )
+        elector.tick()
+        elector.start()
+        mode += ", replication leader"
     if args.background_sync:
         app.start_background_sync(args.background_sync)
     server = app.serve(args.host, args.port)
@@ -63,6 +126,9 @@ def main(argv: list[str] | None = None) -> None:
     try:
         server.serve_forever()
     except KeyboardInterrupt:  # analysis: disable=EXC001
+        if elector is not None:
+            elector.stop()
+            elector.resign()
         server.shutdown()  # top-of-process Ctrl-C: clean stop IS the handling
 
 

@@ -121,6 +121,7 @@ def _runtime_health(
     gateway: Any = None,
     history: Any = None,
     push: Any = None,
+    replication: Any = None,
 ) -> dict[str, Any]:
     """Transfer-funnel, device-cache, transport-pool, and refresher
     counters for /healthz: how many blocking device_gets the process
@@ -165,6 +166,10 @@ def _runtime_health(
             # frames sent, evictions, resume fallbacks — the live-wall
             # triage block.
             out["push"] = push.snapshot()
+        if replication is not None:
+            # Read-tier view (ADR-025): leader publish/backlog state or
+            # replica cursor/lag/staleness, depending on role.
+            out["replication"] = replication.snapshot()
         # Burn-rate states per declared SLO (ADR-016): the one-line
         # answer a probe reader wants before opening /sloz.
         out["slo"] = slo_mod.engine().health_block()
@@ -432,6 +437,12 @@ class DashboardApp:
         #: only feeds the connected-clients gauge; latest app wins.
         self.push = PushPipeline(monotonic=monotonic)
         set_active_push(self.push)
+        #: Read-tier hook (ADR-025). On a leader: a BusPublisher —
+        #: _record_sync hands it every published generation, and
+        #: /replicate/bus serves its backlog. On a replica: the
+        #: BusConsumer (set by its constructor). None (default) keeps
+        #: single-process serving byte-identical to pre-replication.
+        self.replication: Any = None
 
     @property
     def registry(self) -> Registry:
@@ -609,6 +620,18 @@ class DashboardApp:
                 metrics=self._peek_metrics,
                 forecast=self._peek_forecast,
             )
+            # Replication publish hook (ADR-025): a leader's bus gets
+            # the same (snapshot, peeks) the differ just got — same
+            # non-blocking peek stance, same absorb-everything contract
+            # (BusPublisher.on_snapshot never raises).
+            replication = self.replication
+            if replication is not None and hasattr(replication, "on_snapshot"):
+                replication.on_snapshot(
+                    snap,
+                    generation=generation,
+                    metrics=self._peek_metrics,
+                    forecast=self._peek_forecast,
+                )
         if snap is not None and not snap.errors:
             self._sync_failures = 0
         else:
@@ -1081,6 +1104,7 @@ class DashboardApp:
                             gateway=self.gateway,
                             history=self.history,
                             push=self.push,
+                            replication=self.replication,
                         ),
                     }
                 )
@@ -1119,6 +1143,7 @@ class DashboardApp:
                         gateway=self.gateway,
                         history=self.history,
                         push=self.push,
+                        replication=self.replication,
                     ),
                 }
             )
@@ -1436,6 +1461,13 @@ class DashboardApp:
                     # must not occupy render capacity.
                     self._serve_events()
                     return
+                if urlparse(self.path).path.rstrip("/") == "/replicate/bus":
+                    # Snapshot bus pull (ADR-025): replicas resume by
+                    # Last-Generation cursor. Bypasses the gateway —
+                    # payload_after is a backlog copy (microseconds),
+                    # and replica pulls must not queue behind renders.
+                    self._serve_bus()
+                    return
                 response = gateway.handle(
                     self.path,
                     accept=self.headers.get("Accept"),
@@ -1474,6 +1506,25 @@ class DashboardApp:
                     self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _serve_bus(self) -> None:
+                replication = app.replication
+                if replication is None or not hasattr(replication, "payload_after"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                from ..push.hub import parse_last_event_id
+
+                cursor = parse_last_event_id(self.headers.get("Last-Generation"))
+                payload = replication.payload_after(cursor).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header(
+                    "X-Headlamp-Generation", str(replication.last_generation)
+                )
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
 
             def _serve_events(self) -> None:
                 sub = app.open_event_stream(

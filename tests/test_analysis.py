@@ -364,6 +364,35 @@ class TestThreadSpawnMutations:
         assert self._diags(src, "headlamp_tpu/gateway/pool.py") == []
         assert len(self._diags(src, "headlamp_tpu/push/mut.py")) == 1
 
+    def test_read_tier_seams_clean_same_code_elsewhere_flagged(self):
+        # ADR-025 sanctioned seams: the leader's lease-renewal ticker
+        # and the replica's bus poll loop — and ONLY their start
+        # methods; the same spawns outside those files (or outside
+        # start) stay findings.
+        lease = (
+            "import threading\n"
+            "class LeaderElector:\n"
+            "    def start(self, interval_s=None):\n"
+            "        self._t = threading.Thread(target=self._renewal_loop)\n"
+        )
+        consumer = (
+            "import threading\n"
+            "class BusConsumer:\n"
+            "    def start(self, interval_s=None):\n"
+            "        self._t = threading.Thread(target=self._consume_loop)\n"
+        )
+        assert self._diags(lease, "headlamp_tpu/replicate/leader.py") == []
+        assert self._diags(consumer, "headlamp_tpu/replicate/replica.py") == []
+        assert len(self._diags(lease, "headlamp_tpu/replicate/bus.py")) == 1
+        assert len(self._diags(consumer, "headlamp_tpu/replicate/leader.py")) == 1
+        stray = (
+            "import threading\n"
+            "class BusPublisher:\n"
+            "    def publish(self, snap):\n"
+            "        threading.Thread(target=self._fanout).start()\n"
+        )
+        assert len(self._diags(stray, "headlamp_tpu/replicate/bus.py")) == 1
+
 
 class TestMetricsAllowlistMutations:
     """SYN001 — quiet-family allowlist ↔ registry-literal sync."""

@@ -211,6 +211,15 @@ class TestFormat:
             "headlamp_tpu_push_not_modified_total",
             "headlamp_tpu_push_gzip_bytes_total",
             "headlamp_tpu_push_clients_count",
+            # ADR-025 read tier: labeled counters render no samples
+            # until a bus generation is actually published/applied or a
+            # leadership transition happens (the socketless fixture runs
+            # neither role), and the lag gauge reports None with no
+            # active replica consumer.
+            "headlamp_tpu_replicate_generations_total",
+            "headlamp_tpu_replicate_bytes_total",
+            "headlamp_tpu_replicate_failovers_total",
+            "headlamp_tpu_replicate_lag_seconds",
         }, f"unexpected sample-free families: {sorted(quiet)}"
 
     def test_name_grammar_and_unit_suffixes(self, exposition):

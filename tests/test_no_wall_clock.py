@@ -129,15 +129,20 @@ class TestMutations:
         push = tmp_path / "headlamp_tpu" / "push"
         push.mkdir(parents=True)
         (push / "bad_hub.py").write_text("import time\nnow = time.time()\n")
+        # ADR-025: the read tier's lease-expiry/staleness timing too.
+        replicate = tmp_path / "headlamp_tpu" / "replicate"
+        replicate.mkdir(parents=True)
+        (replicate / "bad_lease.py").write_text("import time\nnow = time.time()\n")
         outside = tmp_path / "headlamp_tpu" / "server"
         outside.mkdir(parents=True)
         (outside / "app.py").write_text("import time\nnow = time.time()\n")
         diags = check_tree(str(tmp_path))
-        assert len(diags) == 3
+        assert len(diags) == 4
         assert {os.path.basename(d.path) for d in diags} == {
             "bad.py",
             "bad_store.py",
             "bad_hub.py",
+            "bad_lease.py",
         }
 
     def test_hub_heartbeat_on_wall_clock_flagged(self):
@@ -189,6 +194,31 @@ class TestMutations:
             "def sample_once(self):\n"
             "    t0 = time.perf_counter()\n"
             "    return time.perf_counter() - t0\n"
+        )
+        assert diags == []
+
+    def test_lease_expiry_on_wall_clock_flagged(self):
+        # The ADR-025 mistake the replicate scope guards in leader.py:
+        # judging lease expiry on the wall clock — an NTP step would
+        # depose (or immortalize) a leader, and the failover drill
+        # could never run on an injected clock.
+        diags = self._diags(
+            "import time\n"
+            "def expired(self):\n"
+            "    return time.time() >= self.expires_at\n"
+        )
+        assert len(diags) == 1
+        assert diags[0].line == 3
+
+    def test_lease_sanctioned_forms_allowed(self):
+        # The real LeaseStore/BusConsumer shape: injected-monotonic seam
+        # default, expiry and staleness math on self._mono() only.
+        diags = self._diags(
+            "import time\n"
+            "def __init__(self, *, monotonic=None):\n"
+            "    self._mono = monotonic or time.monotonic\n"
+            "def expired(self):\n"
+            "    return self._mono() >= self.expires_at\n"
         )
         assert diags == []
 

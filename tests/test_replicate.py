@@ -1,0 +1,712 @@
+"""ADR-025 horizontal read tier: bus codec, fencing, leader election,
+replica byte-identity, and failover drills.
+
+Everything timed runs on injected clocks — the failover drill advances
+a fake monotonic through lease expiry and staleness windows with zero
+sleeps. Byte-identity assertions compare a replica's paints, ETags,
+and push frames against leader-local serving for the SAME generation,
+because the whole tier rests on that seam: everything downstream of a
+snapshot generation is a pure function of (snapshot, peeks, history).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from headlamp_tpu.fleet import fixtures as fx
+from headlamp_tpu.gateway.shed import ShedPolicy
+from headlamp_tpu.history.record import Recorder, ReplaySource, load_recording
+from headlamp_tpu.metrics.client import TpuChipMetrics, TpuMetricsSnapshot
+from headlamp_tpu.models.service import ChipForecast, ForecastView
+from headlamp_tpu.push.hub import format_event
+from headlamp_tpu.replicate import (
+    BUS_FORMAT,
+    BUS_VERSION,
+    GENERATION_STRIDE,
+    BusConsumer,
+    BusPublisher,
+    LeaderElector,
+    LeaseStore,
+    ReplicaApp,
+    decode_forecast,
+    decode_metrics,
+    decode_snapshot,
+    dumps_record,
+    encode_forecast,
+    encode_metrics,
+    encode_snapshot,
+    generation_floor,
+    parse_payload,
+    pool_fetch,
+)
+from headlamp_tpu.server.app import DashboardApp, add_demo_prometheus
+from headlamp_tpu.transport import ApiError
+
+
+class FakeClock:
+    def __init__(self, now: float = 1000.0) -> None:
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def make_leader(**kwargs) -> tuple[DashboardApp, BusPublisher]:
+    fleet = fx.fleet_v5e4()
+    t = fx.fleet_transport(fleet)
+    add_demo_prometheus(t, fleet)
+    app = DashboardApp(t, min_sync_interval_s=30.0, **kwargs)
+    pub = BusPublisher()
+    app.replication = pub
+    return app, pub
+
+
+def force_new_generation(app: DashboardApp) -> None:
+    """Drive the leader through one more snapshot generation: bump the
+    context's floor (marks the cached snapshot dirty) and re-open the
+    inline-sync window."""
+    app._ctx.advance_generation_floor(app.snapshot_generation() + 1)
+    app._last_sync = float("-inf")
+    app._synced_snapshot()
+
+
+# ---------------------------------------------------------------------------
+# Bus codec
+# ---------------------------------------------------------------------------
+
+class TestBusCodec:
+    def test_payload_round_trip_is_byte_exact(self):
+        app, pub = make_leader()
+        app._synced_snapshot()
+        payload = pub.payload_after(None)
+        header, records = parse_payload(payload)
+        assert header["format"] == BUS_FORMAT and header["v"] == BUS_VERSION
+        assert len(records) == 1
+        # Canonical encoding: re-encoding a parsed record reproduces
+        # its wire bytes exactly (the recorder round-trip contract).
+        for line, record in zip(payload.splitlines()[1:], records):
+            assert dumps_record(record) == line
+
+    def test_snapshot_decode_rebuilds_equivalent_views(self):
+        app, pub = make_leader()
+        snap = app._synced_snapshot()
+        payload = encode_snapshot(snap)
+        rebuilt = decode_snapshot(payload, generation=snap.providers["tpu"].view.version)
+        assert rebuilt.all_nodes == snap.all_nodes
+        assert rebuilt.fetched_at == snap.fetched_at
+        for name, state in snap.providers.items():
+            other = rebuilt.providers[name]
+            assert other.view.version == state.view.version
+            assert other.view.allocation_summary() == state.view.allocation_summary()
+            assert [n.get("metadata", {}).get("name") for n in other.view.nodes] == [
+                n.get("metadata", {}).get("name") for n in state.view.nodes
+            ]
+            assert other.workloads == state.workloads
+            assert other.workload_available == state.workload_available
+
+    def test_metrics_and_forecast_round_trip(self):
+        metrics = TpuMetricsSnapshot(
+            namespace="monitoring",
+            service="prometheus-k8s:9090",
+            chips=[
+                TpuChipMetrics(
+                    node="n1", accelerator_id="0",
+                    tensorcore_utilization=55.0, hbm_bytes_used=8.0e9,
+                    hbm_bytes_total=1.6e10, duty_cycle=90.0,
+                )
+            ],
+            availability={"tensorcore_utilization": True},
+            resolved_series={"tensorcore_utilization": "x"},
+            fetched_at=123.0,
+            fetch_ms=0.7,
+        )
+        assert decode_metrics(encode_metrics(metrics)) == metrics
+        forecast = ForecastView(
+            horizon_s=480.0, window_s=3600.0,
+            chips=[
+                ChipForecast(
+                    node="n1", accelerator_id="0", current=55.0,
+                    predicted_peak=70.0, predicted_mean=60.0,
+                    saturation_risk=0.1,
+                )
+            ],
+            fit_ms=12.0, fit_mse=0.01,
+        )
+        assert decode_forecast(encode_forecast(forecast)) == forecast
+        assert encode_metrics(None) is None and decode_metrics(None) is None
+        assert encode_forecast(None) is None and decode_forecast(None) is None
+
+    def test_version_gate_refuses_future_bus_format(self):
+        header = json.dumps(
+            {"v": BUS_VERSION + 1, "kind": "header", "format": BUS_FORMAT}
+        )
+        with pytest.raises(ValueError, match="not supported"):
+            parse_payload(header + "\n")
+
+    def test_foreign_format_refused(self):
+        header = json.dumps({"v": 1, "kind": "header", "format": "other-bus"})
+        with pytest.raises(ValueError, match="not a"):
+            parse_payload(header + "\n")
+
+    def test_empty_payload_refused(self):
+        with pytest.raises(ValueError, match="empty"):
+            parse_payload("")
+
+    def test_unknown_record_kinds_skipped(self):
+        app, pub = make_leader()
+        app._synced_snapshot()
+        payload = pub.payload_after(None)
+        future_kind = json.dumps({"kind": "checksum", "value": 1})
+        _, records = parse_payload(payload + future_kind + "\n")
+        assert len(records) == 1  # forward-compat: skipped, not fatal
+
+
+# ---------------------------------------------------------------------------
+# Publisher fencing + cursor
+# ---------------------------------------------------------------------------
+
+class TestBusPublisher:
+    def test_stale_generation_rejected(self):
+        app, pub = make_leader()
+        snap = app._synced_snapshot()
+        assert pub.last_generation == 1
+        assert not pub.publish(snap, generation=1)
+        assert not pub.publish(snap, generation=0)
+        assert pub.rejected_stale == 2
+        assert pub.publish(snap, generation=2)
+
+    def test_cursor_resume_serves_only_newer(self):
+        app, pub = make_leader()
+        snap = app._synced_snapshot()
+        pub.publish(snap, generation=2)
+        pub.publish(snap, generation=3)
+        _, all_records = parse_payload(pub.payload_after(None))
+        assert [r["generation"] for r in all_records] == [1, 2, 3]
+        _, newer = parse_payload(pub.payload_after(2))
+        assert [r["generation"] for r in newer] == [3]
+        _, caught_up = parse_payload(pub.payload_after(3))
+        assert caught_up == []  # header-only payload still parses
+
+    def test_backlog_bounded_and_resumable_past_eviction(self):
+        app, pub = make_leader()
+        snap = app._synced_snapshot()
+        for g in range(2, pub.backlog_limit + 10):
+            pub.publish(snap, generation=g)
+        _, records = parse_payload(pub.payload_after(0))
+        assert len(records) == pub.backlog_limit
+        # Records are self-contained: a cursor behind the backlog still
+        # catches up to the NEWEST generation from what remains.
+        assert records[-1]["generation"] == pub.last_generation
+
+
+# ---------------------------------------------------------------------------
+# Leader election (injected clock, no sleeps)
+# ---------------------------------------------------------------------------
+
+class TestLeaderElection:
+    def test_acquire_expire_takeover_fencing_monotone(self):
+        clock = FakeClock()
+        store = LeaseStore(monotonic=clock)
+        a = store.try_acquire("a", ttl_s=15.0)
+        assert a is not None and a.fencing == 1
+        assert store.try_acquire("b", ttl_s=15.0) is None  # held
+        assert store.renew(a, ttl_s=15.0)
+        clock.advance(16.0)  # past the renewed TTL
+        b = store.try_acquire("b", ttl_s=15.0)
+        assert b is not None and b.fencing == 2  # strictly newer term
+        assert not store.renew(a, ttl_s=15.0)  # deposed leader loses
+
+    def test_release_frees_early(self):
+        clock = FakeClock()
+        store = LeaseStore(monotonic=clock)
+        a = store.try_acquire("a")
+        assert store.release(a)
+        b = store.try_acquire("b")  # no TTL wait
+        assert b is not None and b.fencing == 2
+
+    def test_elector_transitions_fire_callbacks(self):
+        clock = FakeClock()
+        store = LeaseStore(monotonic=clock)
+        events: list = []
+        a = LeaderElector(
+            store, "a", ttl_s=10.0, monotonic=clock,
+            on_elected=lambda f: events.append(("a-elected", f)),
+            on_deposed=lambda: events.append(("a-deposed",)),
+        )
+        b = LeaderElector(
+            store, "b", ttl_s=10.0, monotonic=clock,
+            on_elected=lambda f: events.append(("b-elected", f)),
+        )
+        assert a.tick() and a.is_leader
+        assert not b.tick() and not b.is_leader
+        clock.advance(11.0)  # a's lease lapses un-renewed
+        assert b.tick() and b.is_leader
+        assert not a.tick()  # deposed: renew fails, b holds the lease
+        assert events == [("a-elected", 1), ("b-elected", 2), ("a-deposed",)]
+        assert a.depositions == 1 and b.elections == 1
+
+    def test_generation_band_fences_deposed_leader(self):
+        # The "fencing token = generation" mechanism end to end: term 2
+        # publishes in a higher band, so term 1's late records are
+        # rejected by plain generation monotonicity.
+        app, pub = make_leader()
+        snap = app._synced_snapshot()
+        rep = ReplicaApp()
+        _, records = parse_payload(pub.payload_after(None))
+        assert rep.apply_record(records[0])
+        floor = generation_floor(2)
+        pub2 = BusPublisher()
+        pub2.set_fencing(2)
+        assert pub2.publish(snap, generation=floor + 1)
+        _, banded = parse_payload(pub2.payload_after(None))
+        assert rep.apply_record(banded[0])
+        # Deposed term-1 leader keeps syncing locally: generation 2,
+        # far below the new band — rejected, never overwrites.
+        stale = dict(records[0], generation=2)
+        assert not rep.apply_record(stale)
+        assert rep.rejected_stale == 1
+        assert rep.snapshot_generation() == floor + 1
+
+    def test_context_floor_never_moves_backwards(self):
+        app, _ = make_leader()
+        app._synced_snapshot()
+        app._ctx.advance_generation_floor(500)
+        app._ctx.advance_generation_floor(100)  # no-op, never backwards
+        force_new_generation(app)
+        assert app.snapshot_generation() == 501
+
+
+# ---------------------------------------------------------------------------
+# Replica byte-identity with leader-local serving
+# ---------------------------------------------------------------------------
+
+class TestReplicaIdentity:
+    def make_pair(self) -> tuple[DashboardApp, BusPublisher, ReplicaApp]:
+        app, pub = make_leader()
+        # Prime the metrics/forecast peeks FIRST, so the published
+        # record ships them and the replica's metrics page has the
+        # same inputs as the leader's.
+        app._synced_snapshot()
+        app.handle("/tpu/metrics")
+        force_new_generation(app)
+        rep = ReplicaApp()
+        _, records = parse_payload(pub.payload_after(None))
+        for record in records:
+            rep.apply_record(record)
+        return app, pub, rep
+
+    def test_paints_byte_identical_for_same_generation(self):
+        app, _, rep = self.make_pair()
+        assert rep.snapshot_generation() == app.snapshot_generation()
+        for path in ("/tpu", "/tpu/nodes", "/tpu/pods", "/tpu/topology",
+                     "/tpu/metrics", "/tpu/deviceplugins"):
+            assert rep.handle(path) == app.handle(path), path
+
+    def test_gateway_etag_and_304_identical(self):
+        app, _, rep = self.make_pair()
+        leader_gw = app.ensure_gateway(workers=1)
+        replica_gw = rep.ensure_gateway(workers=1)
+        try:
+            lead = leader_gw.handle("/tpu")
+            repl = replica_gw.handle("/tpu")
+            assert lead.status == repl.status == 200
+            assert lead.body == repl.body
+            etag = dict(lead.headers)["ETag"]
+            assert dict(repl.headers)["ETag"] == etag
+            assert dict(repl.headers)["X-Headlamp-Stale"] == "0"
+            # The conditional tier answers 304 against the leader's
+            # ETag on BOTH — a client can fail over mid-session and its
+            # validator keeps working.
+            assert leader_gw.handle("/tpu", if_none_match=etag).status == 304
+            assert replica_gw.handle("/tpu", if_none_match=etag).status == 304
+        finally:
+            leader_gw.close()
+            replica_gw.close()
+
+    def test_push_frames_byte_identical(self):
+        app, pub = make_leader()
+        app._synced_snapshot()  # generation 1 = baseline on the leader
+        rep = ReplicaApp()
+        _, records = parse_payload(pub.payload_after(None))
+        rep.apply_record(records[0])  # generation 1 = baseline on the replica
+        leader_sub = app.push.hub.subscribe(("/tpu", "/tpu/nodes"))
+        replica_sub = rep.push.hub.subscribe(("/tpu", "/tpu/nodes"))
+        # Real fleet churn between generations — the differ only frames
+        # actual model changes, so a content-identical re-sync would
+        # vacuously pass this test with two empty wires.
+        pod = json.loads(json.dumps(app._last_snapshot.all_pods[0]))
+        pod["status"]["phase"] = "Failed"
+        app._transport.pod_feed.push("MODIFIED", pod)
+        force_new_generation(app)  # generation 2 → frames on the leader
+        _, newer = parse_payload(pub.payload_after(rep.snapshot_generation()))
+        for record in newer:
+            rep.apply_record(record)
+
+        def drain(hub, sub) -> list[str]:
+            out = []
+            while True:
+                event = hub.poll(sub)
+                if event is None:
+                    return out
+                out.append(format_event(event))
+
+        leader_wire = drain(app.push.hub, leader_sub)
+        replica_wire = drain(rep.push.hub, replica_sub)
+        assert leader_wire and leader_wire == replica_wire
+
+    def test_history_rows_flow_to_replica_store(self):
+        app, _, rep = self.make_pair()
+        _, leader_gens = app.history.series("sync.generation")
+        _, replica_gens = rep.history.series("sync.generation")
+        assert replica_gens == leader_gens[-len(replica_gens):]
+        assert rep.history.syncs == rep.applied
+
+
+# ---------------------------------------------------------------------------
+# Failover drill (injected clocks, zero sleeps, zero 5xx)
+# ---------------------------------------------------------------------------
+
+class TestFailoverDrill:
+    def test_replica_serves_stale_honest_then_converges(self):
+        mono = FakeClock()
+        app, pub = make_leader()
+        app._synced_snapshot()
+        rep = ReplicaApp(monotonic=mono, stale_after_s=30.0)
+        consumer = BusConsumer(
+            rep, lambda cursor: pub.payload_after(cursor), monotonic=mono
+        )
+        assert consumer.poll_once() == 1
+        gw = rep.ensure_gateway(workers=1)
+        try:
+            fresh = gw.handle("/tpu?t=0")
+            assert fresh.status == 200
+            assert dict(fresh.headers)["X-Headlamp-Stale"] == "0"
+
+            # Leader dies: the bus stops answering. The replica keeps
+            # serving, and once the feed is stale past the window every
+            # interactive paint is stamped stale — zero 5xx throughout.
+            def dead_fetch(cursor: int) -> str:
+                raise ApiError("/replicate/bus", "connection refused")
+
+            dead = BusConsumer(rep, dead_fetch, monotonic=mono)
+            mono.advance(31.0)
+            assert dead.poll_once() == 0 and dead.fetch_failures == 1
+            assert rep.stale()
+            gw.shed_policy.invalidate()
+            statuses = []
+            for i in range(5):
+                resp = gw.handle(f"/tpu?loss={i}")
+                statuses.append(resp.status)
+                assert dict(resp.headers)["X-Headlamp-Stale"] == "1"
+            assert all(s == 200 for s in statuses)
+
+            # New leader elected on the shared store: next fencing term
+            # → next generation band. Its FIRST generation converges the
+            # replica and clears the stale stamp — within one lease TTL
+            # on the same fake clock (the drill advanced 31 s total;
+            # convergence is one poll after the new leader's first
+            # publish, no further time passes).
+            clock = FakeClock()
+            store = LeaseStore(monotonic=clock)
+            store.try_acquire("old-leader", ttl_s=15.0)
+            clock.advance(16.0)  # old lease lapses un-renewed
+            elector = LeaderElector(store, "new-leader", ttl_s=15.0, monotonic=clock)
+            assert elector.tick()
+            assert elector.fencing == 2
+            app2, pub2 = make_leader()
+            pub2.set_fencing(elector.fencing)
+            app2._ctx.advance_generation_floor(generation_floor(elector.fencing))
+            app2._synced_snapshot()
+            takeover = BusConsumer(
+                rep, lambda cursor: pub2.payload_after(cursor), monotonic=mono
+            )
+            assert takeover.poll_once() == 1
+            assert rep.snapshot_generation() > generation_floor(elector.fencing)
+            assert not rep.stale()
+            gw.shed_policy.invalidate()
+            resp = gw.handle("/tpu?recovered=1")
+            assert resp.status == 200
+            assert dict(resp.headers)["X-Headlamp-Stale"] == "0"
+            assert dict(resp.headers)["X-Headlamp-Generation"] == str(
+                rep.snapshot_generation()
+            )
+        finally:
+            gw.close()
+
+    def test_sse_resume_across_band_gap_falls_back_to_paint(self):
+        # A push client that resumed with a pre-failover Last-Event-ID
+        # gets the honest per-page paint fallback (never a fabricated
+        # delta chain across the generation band jump).
+        app, pub = make_leader()
+        app._synced_snapshot()
+        rep = ReplicaApp()
+        _, records = parse_payload(pub.payload_after(None))
+        rep.apply_record(records[0])
+        band = dict(records[0], generation=generation_floor(2) + 1)
+        rep.apply_record(band)
+        sub = rep.push.hub.subscribe(("/tpu",), last_event_id="g1")
+        event = rep.push.hub.poll(sub)
+        assert event is not None and event["kind"] == "paint"
+        assert event["data"]["reason"] == "resync"
+        assert event["data"]["generation"] == generation_floor(2) + 1
+
+
+# ---------------------------------------------------------------------------
+# Consumer + staleness plumbing
+# ---------------------------------------------------------------------------
+
+class TestBusConsumer:
+    def test_cursor_advances_past_rejected_records(self):
+        app, pub = make_leader()
+        snap = app._synced_snapshot()
+        pub.publish(snap, generation=2)
+        rep = ReplicaApp()
+        consumer = BusConsumer(rep, lambda cursor: pub.payload_after(cursor))
+        assert consumer.poll_once() == 2
+        assert consumer.cursor == 2
+        # Re-poll: caught up, nothing re-applied, cursor stable.
+        assert consumer.poll_once() == 0
+        assert consumer.cursor == 2 and rep.rejected_stale == 0
+
+    def test_version_gate_counts_as_fetch_failure(self):
+        rep = ReplicaApp()
+        future = json.dumps(
+            {"v": BUS_VERSION + 1, "kind": "header", "format": BUS_FORMAT}
+        ) + "\n"
+        consumer = BusConsumer(rep, lambda cursor: future)
+        assert consumer.poll_once() == 0
+        assert consumer.fetch_failures == 1
+        assert rep.applied == 0  # refused wholesale, never half-applied
+
+    def test_replica_transport_refuses_cluster_requests(self):
+        rep = ReplicaApp()
+        with pytest.raises(ApiError, match="replica mode"):
+            rep._transport.request("/api/v1/nodes")
+        with pytest.raises(RuntimeError, match="replica mode"):
+            rep.start_background_sync(1.0)
+
+    def test_loading_page_before_first_record(self):
+        rep = ReplicaApp()
+        status, _, body = rep.handle("/tpu")
+        assert status == 200 and body  # honest loading state, not a 5xx
+
+    def test_lag_and_stale_on_injected_clock(self):
+        mono = FakeClock()
+        rep = ReplicaApp(monotonic=mono, stale_after_s=30.0)
+        assert rep.stale() and rep.lag_s() is None
+        app, pub = make_leader()
+        app._synced_snapshot()
+        consumer = BusConsumer(rep, lambda c: pub.payload_after(c), monotonic=mono)
+        consumer.poll_once()
+        assert not rep.stale() and rep.lag_s() == 0.0
+        mono.advance(12.5)
+        assert rep.lag_s() == 12.5 and not rep.stale()
+        mono.advance(20.0)
+        assert rep.stale()
+        block = consumer.snapshot()
+        assert block["role"] == "replica" and block["stale"] is True
+        assert block["lag_s"] == 32.5
+
+    def test_shed_policy_probe_only_degrades_interactive(self):
+        from headlamp_tpu.gateway.pool import PRIORITY_DEBUG, PRIORITY_INTERACTIVE
+
+        policy = ShedPolicy(engine=lambda: None)
+        policy.degraded_probe = lambda: True
+        assert policy.decide("/tpu", PRIORITY_INTERACTIVE).degraded
+        assert not policy.decide("/debug/flightz", PRIORITY_DEBUG).degraded
+
+
+# ---------------------------------------------------------------------------
+# Real sockets: /replicate/bus endpoint + pool_fetch
+# ---------------------------------------------------------------------------
+
+class TestBusOverSockets:
+    def test_pool_fetch_consumer_and_healthz_blocks(self):
+        import threading
+
+        app, pub = make_leader()
+        server = app.serve(port=0)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        rep = ReplicaApp()
+        gw = None
+        try:
+            app._synced_snapshot()
+            consumer = BusConsumer(rep, pool_fetch(f"http://127.0.0.1:{port}"))
+            assert consumer.poll_once() == 1
+            assert rep.snapshot_generation() == app.snapshot_generation()
+            # /healthz blocks carry the replication role on both sides.
+            leader_health = json.loads(app.handle("/healthz")[2])
+            assert leader_health["runtime"]["replication"]["role"] == "leader"
+            assert leader_health["runtime"]["replication"]["published"] == 1
+            replica_health = json.loads(rep.handle("/healthz")[2])
+            assert replica_health["runtime"]["replication"]["role"] == "replica"
+            assert replica_health["runtime"]["replication"]["cursor"] == rep.snapshot_generation()
+        finally:
+            server.shutdown()
+            server.server_close()
+            if app.gateway is not None:
+                app.gateway.close()
+
+    def test_bus_endpoint_404_without_publisher(self):
+        import http.client
+        import threading
+
+        fleet = fx.fleet_v5e4()
+        app = DashboardApp(fx.fleet_transport(fleet))  # no replication role
+        server = app.serve(port=0)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            conn.request("GET", "/replicate/bus")
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 404
+            conn.close()
+        finally:
+            server.shutdown()
+            server.server_close()
+            if app.gateway is not None:
+                app.gateway.close()
+
+
+# ---------------------------------------------------------------------------
+# ADR-018 Recorder round-trip + deterministic replay
+# ---------------------------------------------------------------------------
+
+class TestRecorderRoundTrip:
+    def test_bus_payloads_record_and_replay_deterministically(self, tmp_path):
+        # Record a leader stream — pre- and post-failover payloads — as
+        # ADR-018 exchanges, then replay it into a FRESH replica: the
+        # failover drill becomes a deterministic artifact.
+        app, pub = make_leader()
+        app._synced_snapshot()
+        payload_term1 = pub.payload_after(None)
+        pub2 = BusPublisher()
+        pub2.set_fencing(2)
+        pub2.publish(app._last_snapshot, generation=generation_floor(2) + 1)
+        payload_term2 = pub2.payload_after(None)
+
+        mono = FakeClock()
+        path = tmp_path / "bus-stream.jsonl"
+        with open(path, "w") as fh:
+            recorder = Recorder(fh, monotonic=mono, wall=lambda: 0.0, note="drill")
+            recorder.record_ok("/replicate/bus", payload_term1)
+            mono.advance(1.0)
+            recorder.record_ok("/replicate/bus", payload_term2)
+        recording = load_recording(str(path))
+        assert recording.exchanges[0].response == payload_term1  # byte-exact
+
+        source = ReplaySource(recording)  # sequential mode
+        rep = ReplicaApp()
+        consumer = BusConsumer(
+            rep, lambda cursor: source.request("/replicate/bus")
+        )
+        assert consumer.poll_once() == 1
+        assert rep.snapshot_generation() == 1
+        assert consumer.poll_once() == 1  # replayed failover lands term 2
+        assert rep.snapshot_generation() == generation_floor(2) + 1
+
+    def test_future_recording_version_refused(self, tmp_path):
+        path = tmp_path / "future.jsonl"
+        path.write_text(
+            json.dumps(
+                {"v": 99, "kind": "header", "format": "headlamp-tpu-recording"}
+            )
+            + "\n"
+        )
+        with pytest.raises(ValueError, match="not supported"):
+            load_recording(str(path))
+
+
+# ---------------------------------------------------------------------------
+# bench.py fail-soft comparator over the replication metrics
+# ---------------------------------------------------------------------------
+
+class TestBenchComparator:
+    def _compare(self, tmp_path, monkeypatch, prev_extra, cur_extra):
+        import bench
+
+        (tmp_path / "BENCH_r99.json").write_text(
+            json.dumps({"value": 100.0, "extra": prev_extra})
+        )
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        return bench.compare_prev_round({"value": 100.0, "extra": cur_extra})
+
+    def test_replication_metrics_compared_direction_aware(
+        self, tmp_path, monkeypatch
+    ):
+        prev = {
+            "replication_r4_agg_rps_c32": 120.0,
+            "replication_r4_p99_ms_c32": 200.0,
+            "replication_frames_per_sec": 30.0,
+            "replication_failover_to_first_paint_ms": 80.0,
+        }
+        # Throughput halved, tail doubled, failover tripled: every
+        # replication headline regresses in ITS OWN direction.
+        cur = {
+            "replication_r4_agg_rps_c32": 55.0,
+            "replication_r4_p99_ms_c32": 450.0,
+            "replication_frames_per_sec": 31.0,
+            "replication_failover_to_first_paint_ms": 260.0,
+        }
+        flagged = self._compare(tmp_path, monkeypatch, prev, cur)
+        assert "replication_r4_agg_rps_c32" in flagged
+        assert "replication_r4_p99_ms_c32" in flagged
+        assert "replication_failover_to_first_paint_ms" in flagged
+        assert "replication_frames_per_sec" not in flagged  # within band
+
+    def test_steady_replication_round_is_quiet(self, tmp_path, monkeypatch):
+        prev = {
+            "replication_r2_agg_rps_c16": 60.0,
+            "replication_apply_generations_per_sec": 35.0,
+            "replication_drill_stale_paint_rate": 1.0,
+        }
+        flagged = self._compare(tmp_path, monkeypatch, prev, dict(prev))
+        assert flagged == []
+
+    def test_missing_history_is_fail_soft(self, tmp_path, monkeypatch):
+        import bench
+
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        assert bench.compare_prev_round(
+            {"value": 1.0, "extra": {"replication_frames_per_sec": 1.0}}
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# Analysis-scope registration (satellite: WCK001/THR001 coverage)
+# ---------------------------------------------------------------------------
+
+class TestAnalysisScopes:
+    def test_replicate_in_wall_clock_scope(self):
+        import os
+        import sys
+
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__), "..", "tools")
+        )
+        from analysis.rules.wall_clock import WallClockRule
+
+        assert "headlamp_tpu/replicate" in WallClockRule.scope_dirs
+
+    def test_replicate_threads_are_sanctioned_and_role_mapped(self):
+        import os
+        import sys
+
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__), "..", "tools")
+        )
+        from analysis.flow.threads import STATIC_ROLE_ENTRIES
+        from analysis.rules.thread_spawn import SPAWN_ALLOWLIST
+
+        assert ("headlamp_tpu/replicate/leader.py", "LeaderElector.start") in SPAWN_ALLOWLIST
+        assert ("headlamp_tpu/replicate/replica.py", "BusConsumer.start") in SPAWN_ALLOWLIST
+        roles = {row[0] for row in STATIC_ROLE_ENTRIES}
+        assert {"lease-renewal", "bus-consumer"} <= roles

@@ -79,6 +79,18 @@ STATIC_ROLE_ENTRIES: tuple[tuple[str, str, str], ...] = (
      "FanoutScheduler.map.<locals>.run_chunk"),
     # ADR-019 sampling profiler tick thread.
     ("profiler", "headlamp_tpu/obs/profiler.py", "SamplingProfiler._run"),
+    # ADR-025 read tier: the leader's lease-renewal ticker and the
+    # replica's bus poll loop. Bridges: both loops reach their work
+    # through closure/attribute dispatch the resolver records as
+    # unresolved (``self.tick`` inside the nested loop,
+    # ``self.app.apply_record`` across objects).
+    ("lease-renewal", "headlamp_tpu/replicate/leader.py",
+     "LeaderElector.start.<locals>."),
+    ("lease-renewal", "headlamp_tpu/replicate/leader.py", "LeaderElector.tick"),
+    ("bus-consumer", "headlamp_tpu/replicate/replica.py",
+     "BusConsumer.start.<locals>."),
+    ("bus-consumer", "headlamp_tpu/replicate/replica.py", "BusConsumer.poll_once"),
+    ("bus-consumer", "headlamp_tpu/replicate/replica.py", "ReplicaApp.apply_record"),
     # ADR-015 background refit worker, plus the foreground fill path
     # serving threads take through ``Refresher.get`` (bridged: callers
     # reach it through an attribute the resolver cannot follow).

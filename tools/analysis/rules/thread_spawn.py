@@ -13,7 +13,10 @@ construction (``ThreadPoolExecutor`` / ``ProcessPoolExecutor`` /
 - ``RenderPool`` (ADR-017's bounded worker pool),
 - ``FanoutScheduler`` (ADR-014's persistent fan-out executor),
 - the profiler seam (``SamplingProfiler`` — its daemon sampler is
-  started by serve()).
+  started by serve()),
+- the read-tier seams (ADR-025): the lease-renewal ticker
+  (``LeaderElector.start``) and the replica's bus poll loop
+  (``BusConsumer.start``).
 
 Every other spawn is a finding. Deliberate ones (the ADR-015 refresher
 refit worker, the ADR-020 startup compile thread, the thread-per-call
@@ -38,6 +41,8 @@ SPAWN_ALLOWLIST = (
     ("headlamp_tpu/gateway/pool.py", "RenderPool."),
     ("headlamp_tpu/transport/pool.py", "FanoutScheduler."),
     ("headlamp_tpu/obs/profiler.py", "SamplingProfiler."),
+    ("headlamp_tpu/replicate/leader.py", "LeaderElector.start"),
+    ("headlamp_tpu/replicate/replica.py", "BusConsumer.start"),
 )
 
 MESSAGE = (

@@ -43,6 +43,7 @@ class WallClockRule(Rule):
         "headlamp_tpu/history",
         "headlamp_tpu/obs",
         "headlamp_tpu/push",
+        "headlamp_tpu/replicate",
         "headlamp_tpu/runtime",
         "headlamp_tpu/transport",
     )
