@@ -1789,6 +1789,133 @@ def bench_push(fleet) -> dict:
     return out
 
 
+def bench_viewport() -> dict:
+    """ADR-026 acceptance numbers: serving stays O(viewport) as the
+    fleet grows 1k → 4k → 16k. Socketless ``app.handle`` on purpose —
+    the claim under test is render-path cost, and bench_push already
+    owns the wire. Reports:
+
+    - ``viewport_paint_ms_{1k,4k,16k}`` — warm ``/tpu/nodes?limit=64``
+      windowed paint p50 (acceptance: 16k ≤ 3× 1k; the per-generation
+      sort is memoized, so steady state is seek + 64 rows).
+    - ``viewport_fleet_paint_ms_{1k,4k,16k}`` — the ``/tpu/fleet``
+      drill-down root (device rollups; same ≤ 3× envelope).
+    - ``viewport_cursor_page_ms_16k`` — following the minted
+      next-cursor link at 16k (a bisect, not an offset walk).
+    - ``viewport_frame_bytes_{1k,16k}`` — per-region SSE frame for one
+      node Ready flip (acceptance: byte-identical across fleet sizes —
+      a region frame tracks the CHANGE, not the fleet).
+    - ``viewport_request_compiles`` — ledger delta across every paint
+      above (acceptance: 0; the extended bucket table keeps 4k/16k
+      shapes AOT-warm)."""
+    import re
+    import statistics
+
+    from headlamp_tpu.context import AcceleratorDataContext
+    from headlamp_tpu.fleet import fixtures as fx
+    from headlamp_tpu.push.differ import (
+        REGION_PAGE_PREFIX,
+        build_page_models,
+        diff_models,
+    )
+    from headlamp_tpu.server import DashboardApp
+    from headlamp_tpu.viewport import region_path
+
+    led = None
+    compiles_before = 0
+    try:
+        from headlamp_tpu.models import aot
+        from headlamp_tpu.obs import jaxcost
+
+        aot.registry().compile_startup(block=True)  # idempotent
+        led = jaxcost.ledger()
+        compiles_before = led.snapshot()["request_compiles"]
+    except Exception:
+        pass
+
+    out: dict = {}
+    sizes = (("1k", 1024), ("4k", 4096), ("16k", 16384))
+    body_16k = ""
+    app_16k = None
+    for tag, n in sizes:
+        fleet = fx.fleet_viewport(n)
+        app = DashboardApp(
+            fx.fleet_transport(fleet), min_sync_interval_s=3600.0
+        )
+        # Warm: one sync + device encode + the per-generation sort memo
+        # — after this every windowed paint is the steady state a
+        # viewer scrolling the fleet actually pays.
+        status, _, _ = app.handle("/tpu/nodes?limit=64")
+        assert status == 200
+        app.handle("/tpu/fleet")
+        for path, key in (
+            ("/tpu/nodes?limit=64", f"viewport_paint_ms_{tag}"),
+            ("/tpu/fleet", f"viewport_fleet_paint_ms_{tag}"),
+        ):
+            samples = []
+            for _ in range(9):
+                t0 = time.perf_counter()
+                status, _, body = app.handle(path)
+                samples.append((time.perf_counter() - t0) * 1000)
+                assert status == 200
+            out[key] = round(statistics.median(samples), 2)
+        if tag == "16k":
+            _, _, body_16k = app.handle("/tpu/nodes?limit=64")
+            app_16k = app
+
+    # Sublinear growth: a 16x fleet may not cost more than 3x the paint.
+    for key in ("viewport_paint_ms", "viewport_fleet_paint_ms"):
+        big, small = out[f"{key}_16k"], out[f"{key}_1k"]
+        assert big <= max(3.0 * small, small + 50.0), (key, small, big)
+
+    # Cursor-follow latency at 16k: seek windows never walk offsets.
+    match = re.search(r"cursor=([A-Za-z0-9_\-]+)", body_16k)
+    assert match, "16k windowed paint minted no next-cursor link"
+    samples = []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        status, _, _ = app_16k.handle(
+            f"/tpu/nodes?limit=64&cursor={match.group(1)}"
+        )
+        samples.append((time.perf_counter() - t0) * 1000)
+        assert status == 200
+    out["viewport_cursor_page_ms_16k"] = round(statistics.median(samples), 2)
+
+    # Per-region frame bytes for ONE node Ready flip, 1k vs 16k. The
+    # flipped node lives in the same 32-host slice at every fleet size
+    # (fleet_viewport is deterministic), so the slice-region frame must
+    # come out byte-identical — frame size tracks the change.
+    slice_page = REGION_PAGE_PREFIX + region_path("0", "c0-slice-0")
+    for tag, n in (("1k", 1024), ("16k", 16384)):
+        fleet = fx.fleet_viewport(n)
+        before = build_page_models(
+            AcceleratorDataContext(fx.fleet_transport(fleet)).sync()
+        )
+        for cond in fleet["nodes"][0]["status"]["conditions"]:
+            if cond["type"] == "Ready":
+                cond["status"] = (
+                    "False" if cond["status"] == "True" else "True"
+                )
+        after = build_page_models(
+            AcceleratorDataContext(fx.fleet_transport(fleet)).sync()
+        )
+        frame = diff_models(before, after).get(slice_page)
+        assert frame is not None, "ready flip framed no slice region"
+        out[f"viewport_frame_bytes_{tag}"] = len(
+            json.dumps(frame, separators=(",", ":"))
+        )
+    assert (
+        out["viewport_frame_bytes_16k"] == out["viewport_frame_bytes_1k"]
+    ), out
+
+    if led is not None:
+        out["viewport_request_compiles"] = (
+            led.snapshot()["request_compiles"] - compiles_before
+        )
+        assert out["viewport_request_compiles"] == 0, out
+    return out
+
+
 def bench_paint_1024() -> tuple[float, str]:
     """/tpu overview paint at 1024 TPU nodes — past XLA_ROLLUP_MIN_NODES,
     so the warm-up request triggers the calibration probe and the timed
@@ -2463,6 +2590,10 @@ def main() -> None:
     gateway = bench_gateway(fleet)
     replication = bench_replication(fleet)
     push = bench_push(fleet)
+    # Not exception-wrapped: bench_viewport's own AOT/ledger block is
+    # the only jax-dependent part and it degrades internally, so any
+    # raise here is a real ADR-026 acceptance failure.
+    viewport = bench_viewport()
     history = bench_history()
     profiler_numbers = bench_profiler()
     analysis = bench_analysis()
@@ -2511,6 +2642,7 @@ def main() -> None:
             **gateway,
             **replication,
             **push,
+            **viewport,
             **history,
             **profiler_numbers,
             **analysis,

@@ -150,6 +150,174 @@ def fleet_rollup(
     }
 
 
+#: Static cluster-axis segment count for the region rollup (ADR-026).
+#: Fixed — not shape-derived — so the program key stays the familiar
+#: (node_pad, pod_pad) pair and the ADR-020 bucket table covers region
+#: programs with no new dimension. Fleets with more clusters clamp the
+#: overflow into the last segment (visible as a "+more" row host-side);
+#: 64 federated clusters is far past the ROADMAP's 16k-node target.
+REGION_CLUSTER_SEGMENTS = 64
+
+
+def local_region_aggregates(
+    node_capacity: jax.Array,
+    node_allocatable: jax.Array,
+    node_ready: jax.Array,
+    node_valid: jax.Array,
+    node_cluster: jax.Array,
+    node_slice: jax.Array,
+    pod_request: jax.Array,
+    pod_phase: jax.Array,
+    pod_node_idx: jax.Array,
+    pod_valid: jax.Array,
+    *,
+    n_nodes_pad: int,
+    n_clusters: int = REGION_CLUSTER_SEGMENTS,
+    cluster_ext: jax.Array | None = None,
+    slice_ext: jax.Array | None = None,
+) -> dict[str, jax.Array]:
+    """Per-region sums for BOTH drill-down levels in one fused pass
+    (ADR-026): cluster-level vectors [n_clusters] and slice-level
+    vectors [n_nodes_pad] (a slice holds ≥1 node, so the node axis
+    bounds the slice count and the program key stays (node_pad,
+    pod_pad)). Shared by the single-device ``region_rollup`` and the
+    sharded mesh variant — same one-definition discipline as
+    :func:`local_aggregates`. Pod rows reach their region through their
+    node's ids: the id columns are extended with one sentinel row so
+    the encoder's "unscheduled pods point at the padding row" trick
+    needs no masking here either (sentinel segments are sliced off)."""
+    cluster = jnp.clip(node_cluster, 0, n_clusters - 1) * node_valid
+    slc = node_slice * node_valid
+    running = ((pod_phase == _RUNNING) & (pod_valid == 1)).astype(jnp.int32)
+    pending = (
+        (pod_phase == PHASE_IDS.index("Pending")) & (pod_valid == 1)
+    ).astype(jnp.int32)
+    req_running = pod_request * running
+    # Pod → region: index the sentinel-extended id columns by the pod's
+    # node row (n_nodes_pad = "no node" → the sentinel segment). The
+    # sharded mesh path passes replicated full-fleet ext columns because
+    # pod_node_idx is a *global* row index that a local node shard
+    # cannot answer; single-device callers leave them None.
+    if cluster_ext is None:
+        cluster_ext = jnp.concatenate(
+            [cluster, jnp.array([n_clusters], dtype=jnp.int32)]
+        )
+    if slice_ext is None:
+        slice_ext = jnp.concatenate(
+            [slc, jnp.array([n_nodes_pad], dtype=jnp.int32)]
+        )
+    pod_cluster = cluster_ext[pod_node_idx]
+    pod_slice = slice_ext[pod_node_idx]
+
+    def per_cluster(values: jax.Array) -> jax.Array:
+        return jax.ops.segment_sum(values, cluster, num_segments=n_clusters)
+
+    def per_slice(values: jax.Array) -> jax.Array:
+        return jax.ops.segment_sum(values, slc, num_segments=n_nodes_pad)
+
+    return {
+        "cluster_capacity": per_cluster(node_capacity * node_valid),
+        "cluster_allocatable": per_cluster(node_allocatable * node_valid),
+        "cluster_nodes": per_cluster(node_valid),
+        "cluster_ready": per_cluster(node_ready * node_valid),
+        "cluster_in_use": jax.ops.segment_sum(
+            req_running, pod_cluster, num_segments=n_clusters + 1
+        )[:n_clusters],
+        "cluster_pending": jax.ops.segment_sum(
+            pending, pod_cluster, num_segments=n_clusters + 1
+        )[:n_clusters],
+        "slice_capacity": per_slice(node_capacity * node_valid),
+        "slice_allocatable": per_slice(node_allocatable * node_valid),
+        "slice_nodes": per_slice(node_valid),
+        "slice_ready": per_slice(node_ready * node_valid),
+        "slice_in_use": jax.ops.segment_sum(
+            req_running, pod_slice, num_segments=n_nodes_pad + 1
+        )[:n_nodes_pad],
+        "slice_pending": jax.ops.segment_sum(
+            pending, pod_slice, num_segments=n_nodes_pad + 1
+        )[:n_nodes_pad],
+    }
+
+
+@jax.jit
+def region_rollup(
+    node_capacity: jax.Array,
+    node_allocatable: jax.Array,
+    node_ready: jax.Array,
+    node_valid: jax.Array,
+    node_cluster: jax.Array,
+    node_slice: jax.Array,
+    pod_request: jax.Array,
+    pod_phase: jax.Array,
+    pod_node_idx: jax.Array,
+    pod_valid: jax.Array,
+) -> dict[str, jax.Array]:
+    """Both drill-down levels of the viewport tree in one fused XLA
+    program — the aggregate-before-transfer discipline of ADR-012/020
+    applied to navigation: what crosses the device boundary is a few
+    region-sized vectors, never 16k node rows."""
+    n_nodes_pad = node_capacity.shape[0]
+    return local_region_aggregates(
+        node_capacity,
+        node_allocatable,
+        node_ready,
+        node_valid,
+        node_cluster,
+        node_slice,
+        pod_request,
+        pod_phase,
+        pod_node_idx,
+        pod_valid,
+        n_nodes_pad=n_nodes_pad,
+    )
+
+
+def region_rollup_arrays(
+    fleet: FleetArrays, node_cluster: Any, node_slice: Any
+) -> dict[str, jax.Array]:
+    """Dispatch :func:`region_rollup` through the ADR-020 registry —
+    the same ledger-keyed AOT pattern as :func:`rollup_arrays`, under
+    the program name ``analytics.region_rollup`` with the identical
+    (node_pad, pod_pad) key, so the extended bucket table keeps 4k/16k
+    viewport paints compile-free. ``node_cluster``/``node_slice`` are
+    the host-built per-node region ids (viewport/tree.py), padded to
+    the fleet's node bucket."""
+    from ..models.aot import registry as _aot_registry
+    from ..obs.jaxcost import track as _jax_track
+
+    cols = (
+        jnp.asarray(fleet.node_capacity),
+        jnp.asarray(fleet.node_allocatable),
+        jnp.asarray(fleet.node_ready),
+        jnp.asarray(fleet.node_valid),
+        jnp.asarray(node_cluster),
+        jnp.asarray(node_slice),
+        jnp.asarray(fleet.pod_request),
+        jnp.asarray(fleet.pod_phase),
+        jnp.asarray(fleet.pod_node_idx),
+        jnp.asarray(fleet.pod_valid),
+    )
+    ledger_key = (
+        tuple(fleet.node_capacity.shape), tuple(fleet.pod_request.shape)
+    )
+    reg = _aot_registry()
+    exe = (
+        reg.executable("analytics.region_rollup", ledger_key)
+        if reg.ready()
+        else None
+    )
+    with _jax_track("analytics.region_rollup", ledger_key):
+        if exe is not None:
+            try:
+                return exe(*cols)
+            except Exception as exc:  # noqa: BLE001 — AOT is an optimization
+                reg.note_exec_failure(
+                    "analytics.region_rollup",
+                    f"{type(exc).__name__}: {exc}"[:200],
+                )
+        return region_rollup(*cols)
+
+
 def rollup_arrays(fleet: FleetArrays) -> dict[str, jax.Array]:
     from ..models.aot import registry as _aot_registry
     from ..obs.jaxcost import track as _jax_track

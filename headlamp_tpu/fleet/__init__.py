@@ -5,6 +5,7 @@ from .fixtures import (  # noqa: F401
     FIXTURE_NOW_ISO,
     fleet_large,
     fleet_mixed,
+    fleet_viewport,
     fleet_v5e4,
     fleet_v5p32,
     make_intel_node,

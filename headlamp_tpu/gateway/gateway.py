@@ -47,6 +47,7 @@ from ..push.conditional import (
     count_not_modified,
     etag_for,
     if_none_match_matches,
+    window_token,
 )
 from .coalesce import RenderCoalescer
 from .pool import (
@@ -235,7 +236,7 @@ class RenderGateway:
     # -- responses -------------------------------------------------------
 
     def _page_headers(
-        self, generation: int, degraded: bool
+        self, generation: int, degraded: bool, window: str = ""
     ) -> tuple[tuple[str, str], ...]:
         """The ADR-021 page-response header set. ``X-Headlamp-Generation``
         is the SSE resume anchor (a live-wall client records it from its
@@ -245,7 +246,7 @@ class RenderGateway:
         intermediaries to revalidate through the ETag path instead of
         serving stale paints around it."""
         return (
-            ("ETag", etag_for(generation, self._epoch(), degraded)),
+            ("ETag", etag_for(generation, self._epoch(), degraded, window=window)),
             ("Cache-Control", "no-cache"),
             ("X-Headlamp-Generation", str(int(generation))),
             ("X-Headlamp-Stale", "1" if degraded else "0"),
@@ -316,7 +317,14 @@ class RenderGateway:
             # observed as a good render latency would dilute
             # bad_fraction exactly when paints are slow).
             generation = self._generation()
-            etag = etag_for(generation, self._epoch(), decision.degraded)
+            # The window token folds the query (limit/cursor/region/…)
+            # into the ETag: since ADR-026, two same-generation paints
+            # of one route differ across windows, so the invariant set
+            # must include which window the client holds.
+            window = window_token(path)
+            etag = etag_for(
+                generation, self._epoch(), decision.degraded, window=window
+            )
             if if_none_match_matches(if_none_match, etag):
                 self.not_modified += 1
                 _REQUESTS.inc(priority=pname, outcome="not_modified")
@@ -326,7 +334,7 @@ class RenderGateway:
                     304,
                     "text/html",
                     "",
-                    self._page_headers(generation, decision.degraded),
+                    self._page_headers(generation, decision.degraded, window),
                 )
 
         key = self._coalesce_key(path, route, decision.degraded)
@@ -440,7 +448,9 @@ class RenderGateway:
             # key and the ETag ingredients are the key's own fields.
             response = response._replace(
                 headers=response.headers
-                + self._page_headers(self._generation(), degraded)
+                + self._page_headers(
+                    self._generation(), degraded, window_token(path)
+                )
             )
         return response
 

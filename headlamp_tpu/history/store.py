@@ -374,7 +374,13 @@ class HistoryStore:
         )
 
     def trend_view(
-        self, *, window_s: float, max_series_per_metric: int = 8
+        self,
+        *,
+        window_s: float,
+        max_series_per_metric: int = 8,
+        metric: str = "",
+        series_cursor: str | None = None,
+        series_limit: int | None = None,
     ) -> dict[str, Any]:
         """Page-ready view for ``/tpu/trends``: per-metric groups of
         windowed series with stats, plus the store's own health numbers.
@@ -386,47 +392,86 @@ class HistoryStore:
         grow, so a shard has in-window points iff its newest stamp
         does), then only the winners materialize point lists and stats
         — at 8k full shards this is the difference between ~10 ms and
-        ~10 s for one render."""
+        ~10 s for one render.
+
+        With ``metric`` set the view is the BROWSE mode instead
+        (ADR-026): a label-sorted cursor window over EVERY in-window
+        series of that one metric, so nothing the grouped view's
+        busiest-N cap hides is unreachable — only the window's series
+        materialize points, keeping the render O(limit)."""
         window_s = min(max(window_s, 1.0), self.retention_s)
         now = self._monotonic()
         cutoff = now - window_s
         candidates: dict[str, list[tuple[float, tuple[str, ...], _Shard]]] = {}
         with self._lock:
-            for (metric, labels), shard in self._shards.items():
+            for (m, labels), shard in self._shards.items():
                 if shard.size == 0 or shard.last_mono < cutoff:
                     continue
                 newest = shard.values[shard.head - 1]
-                candidates.setdefault(metric, []).append(
-                    (newest, labels, shard)
-                )
+                candidates.setdefault(m, []).append((newest, labels, shard))
+
+        def materialize(
+            labels: tuple[str, ...], shard: _Shard
+        ) -> dict[str, Any] | None:
+            with self._lock:
+                stamps, values = shard.ordered()
+            points = [
+                (now - stamp, value)
+                for stamp, value in zip(stamps, values)
+                if stamp >= cutoff
+            ]
+            if not points:
+                return None  # evicted between the passes
+            return {
+                "label": "/".join(labels) or "fleet",
+                "points": points,
+                "stats": self._stats([v for _, v in points]),
+            }
+
+        if metric:
+            from ..viewport import window_series
+
+            rows = candidates.get(metric, [])
+            pairs = [
+                ("/".join(labels) or "fleet", (labels, shard))
+                for _newest, labels, shard in rows
+            ]
+            win = window_series(
+                pairs,
+                limit=series_limit if series_limit is not None else 64,
+                cursor=series_cursor,
+            )
+            series = [
+                s
+                for labels, shard in win.rows
+                if (s := materialize(labels, shard)) is not None
+            ]
+            return {
+                "window_s": window_s,
+                "retention_s": self.retention_s,
+                "groups": [],
+                "browse": {
+                    "metric": metric,
+                    "series": series,
+                    "window": win,
+                },
+                "store": self.snapshot(),
+            }
         groups = []
-        for metric in sorted(candidates):
-            rows = candidates[metric]
+        for group_metric in sorted(candidates):
+            rows = candidates[group_metric]
             # Busiest series first; the cap keeps a 4096-chip fleet's
             # trend page a page, not a dump.
             rows.sort(key=lambda r: (-r[0], r[1]))
-            series = []
-            for _newest, labels, shard in rows[:max_series_per_metric]:
-                with self._lock:
-                    stamps, values = shard.ordered()
-                points = [
-                    (now - stamp, value)
-                    for stamp, value in zip(stamps, values)
-                    if stamp >= cutoff
-                ]
-                if not points:
-                    continue  # evicted between the passes
-                series.append(
-                    {
-                        "label": "/".join(labels) or "fleet",
-                        "points": points,
-                        "stats": self._stats([v for _, v in points]),
-                    }
-                )
+            series = [
+                s
+                for _newest, labels, shard in rows[:max_series_per_metric]
+                if (s := materialize(labels, shard)) is not None
+            ]
             if series:
                 groups.append(
                     {
-                        "metric": metric,
+                        "metric": group_metric,
                         "series": series,
                         "series_total": len(rows),
                     }

@@ -48,15 +48,36 @@ from ..obs.metrics import registry as _metrics_registry
 CHIP_BUCKETS: tuple[int, ...] = (8, 64, 256)
 
 #: (node_pad, pod_pad) column buckets precompiled for the rollup and
-#: the fused rollup+forecast — the encoder's power-of-two padding for
-#: the 256-node bench fleet and the 1024-node large fixture, i.e. the
-#: at-scale shapes (below ``XLA_ROLLUP_MIN_NODES`` Python serves the
-#: rollup anyway). The TPU view's pod list pads to the SAME power of
-#: two as its node list at both fixture sizes (measured: 248 nodes/180
-#: pods → (256, 256); 991/704 → (1024, 1024)), hence the square pairs.
-#: Other observed shapes arrive via
-#: :meth:`AotProgramRegistry.ensure_rollup_shapes`.
-ROLLUP_BUCKETS: tuple[tuple[int, int], ...] = ((256, 256), (1024, 1024))
+#: the viewport region rollup — the encoder's power-of-two padding for
+#: the 256-node bench fleet, the 1024-node large fixture, and the
+#: 4k/16k viewport fixtures (ADR-026), i.e. the at-scale shapes (below
+#: ``XLA_ROLLUP_MIN_NODES`` Python serves the rollup anyway). The TPU
+#: view's pod list pads to the SAME power of two as its node list at
+#: every fixture size (measured: 248 nodes/180 pods → (256, 256);
+#: 991/704 → (1024, 1024); ``fleet_viewport`` keeps pods ≤ nodes by
+#: construction), hence the square pairs. Other observed shapes arrive
+#: via :meth:`AotProgramRegistry.ensure_rollup_shapes`.
+ROLLUP_BUCKETS: tuple[tuple[int, int], ...] = (
+    (256, 256),
+    (1024, 1024),
+    (4096, 4096),
+    (16384, 16384),
+)
+
+#: Buckets the FUSED rollup+forecast precompiles at — deliberately only
+#: the pre-viewport sizes. The fusion exists for the dashboard
+#: forecast path, which the 4k/16k viewport paints never take (they
+#: serve windowed rows + region rollups); compiling the fused program
+#: at 16384 would roughly double startup compile time for a shape with
+#: no caller. A 4k+ fleet that DOES hit the fused path falls back to
+#: the split rollup→forecast programs (both AOT-warm).
+FUSED_BUCKETS: tuple[tuple[int, int], ...] = ROLLUP_BUCKETS[:2]
+
+#: Fleet sizes ``bench_viewport`` paints (ADR-026). Startup asserts the
+#: bucket table covers every one of them — the guard that keeps
+#: ``request_compiles()==0`` at 16k from silently regressing if the
+#: bucket table shrinks.
+VIEWPORT_FLEET_SIZES: tuple[int, ...] = (1024, 4096, 16384)
 
 #: History length of the live-window range query (window_s=3600,
 #: step_s=60 → 61 samples) — THE page-forecast series length.
@@ -93,6 +114,20 @@ def _build_fleet_rollup(key: Any) -> Any:
     pod = jax.ShapeDtypeStruct(tuple(pod_shape), jnp.int32)
     return fleet_rollup.lower(
         node, node, node, node, node, pod, pod, pod, pod
+    ).compile()
+
+
+def _build_region_rollup(key: Any) -> Any:
+    import jax
+    import jax.numpy as jnp
+
+    from ..analytics.fleet_jax import region_rollup
+
+    node_shape, pod_shape = key
+    node = jax.ShapeDtypeStruct(tuple(node_shape), jnp.int32)
+    pod = jax.ShapeDtypeStruct(tuple(pod_shape), jnp.int32)
+    return region_rollup.lower(
+        node, node, node, node, node, node, pod, pod, pod, pod
     ).compile()
 
 
@@ -178,8 +213,34 @@ def _build_mesh_rollup(key: Any) -> Any:
         return lowered.compile()
 
 
+def _build_mesh_region_rollup(key: Any) -> Any:
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel import mesh as mesh_mod
+
+    reducer, dev_shape, node_shape, pod_shape = key
+    mesh = mesh_mod.fleet_mesh()
+    if tuple(mesh.devices.shape) != tuple(dev_shape):
+        raise ValueError(
+            f"device topology {tuple(mesh.devices.shape)} != spec {dev_shape}"
+        )
+    n_nodes_pad = int(node_shape[0])
+    shard = mesh_mod.build_region_rollup_shard(mesh, reducer, n_nodes_pad)
+    node = jax.ShapeDtypeStruct(tuple(node_shape), jnp.int32)
+    ext = jax.ShapeDtypeStruct((n_nodes_pad + 1,), jnp.int32)
+    pod = jax.ShapeDtypeStruct(tuple(pod_shape), jnp.int32)
+    with mesh:
+        lowered = jax.jit(shard).lower(
+            node, node, node, node, node, node, ext, ext, pod, pod, pod, pod
+        )
+        return lowered.compile()
+
+
 _BUILDERS: dict[str, Callable[[Any], Any]] = {
     "analytics.fleet_rollup": _build_fleet_rollup,
+    "analytics.region_rollup": _build_region_rollup,
+    "mesh.region_rollup": _build_mesh_region_rollup,
     "forecast.aot_fit_forecast_state": lambda key: _build_bucketed_forecast(
         "forecast.aot_fit_forecast_state", key
     ),
@@ -195,9 +256,11 @@ def default_specs() -> list[tuple[str, Any]]:
     """The canonical startup set — every hot program at the shapes the
     demo, the bench fixtures, and the SLO engine actually serve. Built
     lazily (imports jax through forecast) so module import stays
-    jax-free. ~9 programs, ≈4–6 s of background compile on the CI host
-    (measured r14) — absorbed before the first at-scale request in any
-    realistic startup."""
+    jax-free. ~17 programs, ≈6–9 s of background compile on the CI host
+    (the 4k/16k rollup + region-rollup shapes added by ADR-026 are
+    element-wise/segment-sum programs, far cheaper per shape than the
+    fused forecast, which stays at :data:`FUSED_BUCKETS`) — absorbed
+    before the first at-scale request in any realistic startup."""
     import jax
 
     from .forecast import WARM_STEPS, ForecastConfig
@@ -206,6 +269,7 @@ def default_specs() -> list[tuple[str, Any]]:
     specs: list[tuple[str, Any]] = []
     for node, pod in ROLLUP_BUCKETS:
         specs.append(("analytics.fleet_rollup", ((node,), (pod,))))
+        specs.append(("analytics.region_rollup", ((node,), (pod,))))
     for bucket, length in ((64, LIVE_WINDOW_SAMPLES), (8, SLO_SERIES_STEADY)):
         specs.append(
             ("forecast.aot_fit_forecast_state",
@@ -215,7 +279,7 @@ def default_specs() -> list[tuple[str, Any]]:
             ("forecast.aot_warm_fit_forecast",
              (bucket, length, cfg, WARM_STEPS, "xla", 0))
         )
-    for node, pod in ROLLUP_BUCKETS:
+    for node, pod in FUSED_BUCKETS:
         specs.append(
             ("fused.rollup_and_forecast",
              ((node,), (pod,), 64, LIVE_WINDOW_SAMPLES, cfg, WARM_STEPS,
@@ -226,6 +290,38 @@ def default_specs() -> list[tuple[str, Any]]:
          ("psum", (len(jax.devices()),), (256,), (256,)))
     )
     return specs
+
+
+def _pow2_bucket(n: int, minimum: int = 8) -> int:
+    """Pure-python twin of the encoder's ``_bucket`` (power-of-two pad,
+    floor ``minimum``) — duplicated here so the coverage check keeps
+    module scope stdlib-only. Pinned equal to the encoder's by test."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+def viewport_bucket_gaps(
+    specs: list[tuple[str, Any]] | None = None,
+    fleet_sizes: tuple[int, ...] = VIEWPORT_FLEET_SIZES,
+) -> list[tuple[str, tuple[int, int]]]:
+    """Every (program, (node_pad, pod_pad)) a ``bench_viewport`` fleet
+    size needs but ``specs`` does not compile. Empty list == the bucket
+    table covers the viewport matrix and no benched paint can pay a
+    request-path compile. The startup pass records a non-empty result
+    as a compile error (fail-soft, visible on ``/healthz``); the test
+    suite asserts it is empty (fail-loud)."""
+    if specs is None:
+        specs = default_specs()
+    have = {(name, key) for name, key in specs}
+    gaps: list[tuple[str, tuple[int, int]]] = []
+    for n in fleet_sizes:
+        pad = _pow2_bucket(n)
+        for program in ("analytics.fleet_rollup", "analytics.region_rollup"):
+            if (program, ((pad,), (pad,))) not in have:
+                gaps.append((program, (pad, pad)))
+    return gaps
 
 
 # ---------------------------------------------------------------------------
@@ -295,6 +391,17 @@ class AotProgramRegistry:
                 self._state = "unavailable"
             self._ready_event.set()
             return
+        if self._specs is None:
+            # ADR-026 startup assertion: the default bucket table must
+            # cover every bench_viewport fleet size. Fail-soft at
+            # runtime (serving still works, the plain jit path pays the
+            # compile) but loudly surfaced — and the test suite asserts
+            # the gap list is empty, which is where a bucket-table
+            # regression actually fails.
+            gaps = viewport_bucket_gaps(specs)
+            if gaps:
+                self.compile_errors += 1
+                self.last_error = f"viewport buckets uncovered: {gaps}"[:200]
         for name, key in specs:
             self._compile_one(name, key)
         with self._lock:
@@ -402,8 +509,11 @@ class AotProgramRegistry:
         """Observed-shape backfill hook, called from the device-cache
         warm path: whatever (node, pod) buckets the live fleet actually
         encodes to get their rollup executable compiled off the request
-        path, even when they match no default spec."""
+        path, even when they match no default spec. The viewport region
+        rollup (ADR-026) shares the (node, pod) key, so one observed
+        shape warms both programs."""
         self.ensure("analytics.fleet_rollup", ((node_pad,), (pod_pad,)))
+        self.ensure("analytics.region_rollup", ((node_pad,), (pod_pad,)))
 
     # -- read surfaces ---------------------------------------------------
 

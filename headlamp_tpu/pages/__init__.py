@@ -15,6 +15,7 @@ from .device_plugins import device_plugins_page
 from .metrics_page import metrics_page
 from .topology_page import topology_page
 from .trends_page import trends_page
+from .viewport_page import viewport_page
 
 __all__ = [
     "overview_page",
@@ -24,4 +25,5 @@ __all__ = [
     "metrics_page",
     "topology_page",
     "trends_page",
+    "viewport_page",
 ]

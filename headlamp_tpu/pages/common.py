@@ -7,7 +7,7 @@ cells) — hoisted here so six pages don't carry six copies.
 
 from __future__ import annotations
 
-from typing import Any, Iterable, Mapping
+from typing import Any, Mapping
 
 from ..context.accelerator_context import ClusterSnapshot, ProviderState
 from ..domain import objects as obj
@@ -33,16 +33,6 @@ def phase_label(pod: Any) -> Element:
 
 def ready_label(ready: bool) -> Element:
     return StatusLabel("success" if ready else "error", "Ready" if ready else "Not Ready")
-
-
-def pods_by_node(pods: Iterable[Any]) -> dict[str, list[Any]]:
-    """nodeName -> pods map (`NodesPage.tsx:153-159`)."""
-    out: dict[str, list[Any]] = {}
-    for p in pods:
-        node = obj.pod_node_name(p)
-        if node:
-            out.setdefault(node, []).append(p)
-    return out
 
 
 def pod_namespaced_name(pod: Any) -> str:
@@ -99,20 +89,25 @@ NODES_TABLE_CAP = 512
 
 
 def cap_nodes_for_cards(
-    nodes: list[Any], cap: int = NODES_DETAIL_CAP, what: str = "node detail cards"
+    state: ProviderState,
+    cap: int = NODES_DETAIL_CAP,
+    what: str = "node detail cards",
 ) -> tuple[list[Any], Element | None]:
-    """Order nodes not-ready-first (the ones an operator opens the page
-    for), then by name, and cap. Returns (shown, truncation-hint) where
-    the hint is None when nothing was dropped."""
-    ordered = sorted(nodes, key=lambda n: (obj.is_node_ready(n), obj.name(n)))
-    if len(ordered) <= cap:
-        return ordered, None
+    """The first ``cap`` nodes not-ready-first (the ones an operator
+    opens the page for), then by name — served by the viewport layer
+    (ADR-026), so the sort is per-generation, not per-request. Returns
+    (shown, truncation-hint); hint is None when nothing was dropped."""
+    from ..viewport import window_nodes
+
+    window = window_nodes(state, limit=cap)
+    if window.total <= cap:
+        return window.rows, None
     hint = h(
         "p",
         {"class_": "hl-hint"},
-        f"Showing {cap} of {len(ordered)} {what} (not-ready first).",
+        f"Showing {cap} of {window.total} {what} (not-ready first).",
     )
-    return ordered[:cap], hint
+    return window.rows, hint
 
 
 def filter_and_page_nodes(
@@ -188,6 +183,54 @@ def filter_and_page_nodes(
         ),
     )
     return shown, controls
+
+
+def cursor_controls(
+    base_url: str,
+    window: Any,
+    *,
+    what: str,
+    query: str = "",
+    extra_params: "dict[str, str] | None" = None,
+) -> Element:
+    """Window position + continuation links for a cursor-windowed table
+    (ADR-026). The next link carries the opaque seek cursor; "start
+    over" drops it. ``extra_params`` (e.g. ``region=…``, ``metric=…``)
+    ride every link so drill-down context survives paging."""
+    import urllib.parse
+
+    def href(cursor: str | None) -> str:
+        params: list[tuple[str, str]] = []
+        for key, value in (extra_params or {}).items():
+            params.append((key, value))
+        if query:
+            params.append(("q", query))
+        params.append(("limit", str(window.limit)))
+        if cursor:
+            params.append(("cursor", cursor))
+        return f"{base_url}?{urllib.parse.urlencode(params)}"
+
+    first = window.start + 1 if window.rows else 0
+    last = window.start + len(window.rows)
+    bits: list[Any] = [f"rows {first}–{last} of {window.total} {what}"]
+    if window.start > 0:
+        bits.append(" — ")
+        bits.append(
+            h("a", {"href": href(None), "class_": "hl-res-link"}, "⇤ start")
+        )
+    if window.next_cursor:
+        bits.append(" — ")
+        bits.append(
+            h(
+                "a",
+                {
+                    "href": href(window.next_cursor),
+                    "class_": "hl-res-link hl-cursor-next",
+                },
+                "next →",
+            )
+        )
+    return h("p", {"class_": "hl-hint hl-cursor-window"}, *bits)
 
 
 def plugin_not_detected_box(state: ProviderState) -> Element:

@@ -34,6 +34,7 @@ from ..ui import (
     h,
 )
 from ..ui.vdom import Element
+from ..viewport import pods_by_node
 from .native import node_link, pod_link
 from .common import (
     age_cell,
@@ -41,7 +42,6 @@ from .common import (
     error_banner,
     filter_and_page_nodes,
     phase_label,
-    pods_by_node,
     ready_label,
     waiting_reason,
 )
@@ -292,7 +292,7 @@ def intel_nodes_page(
     if snap.loading:
         return h("div", {"class_": "hl-page hl-intel-nodes"}, Loader())
     state = snap.provider("intel")
-    by_node = pods_by_node(state.pods)
+    by_node = pods_by_node(state)
 
     if not state.nodes:
         return h(
@@ -349,7 +349,7 @@ def intel_nodes_page(
         ),
     )
 
-    shown, truncation = cap_nodes_for_cards(state.nodes)
+    shown, truncation = cap_nodes_for_cards(state)
     cards = []
     for node in shown:
         info = obj.node_info(node)

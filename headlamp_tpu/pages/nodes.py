@@ -23,13 +23,14 @@ from ..ui import (
     h,
 )
 from ..ui.vdom import Element
+from ..viewport import pods_by_node, window_nodes
 from .native import node_link
 from .common import (
     age_cell,
     cap_nodes_for_cards,
+    cursor_controls,
     error_banner,
     filter_and_page_nodes,
-    pods_by_node,
     ready_label,
 )
 
@@ -52,12 +53,14 @@ def nodes_page(
     provider_name: str = "tpu",
     page: int = 1,
     query: str = "",
+    limit: int | None = None,
+    cursor: str | None = None,
 ) -> Element:
     if snap.loading:
         return h("div", {"class_": "hl-page hl-nodes"}, Loader())
 
     state = snap.provider(provider_name)
-    by_node = pods_by_node(state.pods)
+    by_node = pods_by_node(state)
 
     if not state.nodes:
         # Empty state (`NodesPage.tsx:228-249`).
@@ -82,10 +85,26 @@ def nodes_page(
 
     # The summary table is paged + name-filterable past the cap (rows
     # are lighter than cards but 1024 of them still unbounds the
-    # response, and a cap alone made the tail unreachable).
-    table_nodes, table_controls = filter_and_page_nodes(
-        state.nodes, page=page, query=query, base_url="/tpu/nodes", what="TPU nodes"
-    )
+    # response, and a cap alone made the tail unreachable). With
+    # ``?limit=``/``?cursor=`` the selection instead comes from the
+    # viewport layer (ADR-026): an O(limit) seek window whose cursor
+    # survives fleet churn — the mode that keeps a 16k-node paint at
+    # 1k-node cost. The legacy ``?page=N`` offset pager stays untouched.
+    if limit is not None or cursor is not None:
+        window = window_nodes(
+            state,
+            limit=limit if limit is not None else 64,
+            cursor=cursor,
+            query=query,
+        )
+        table_nodes = window.rows
+        table_controls = cursor_controls(
+            "/tpu/nodes", window, what="TPU nodes", query=query
+        )
+    else:
+        table_nodes, table_controls = filter_and_page_nodes(
+            state.nodes, page=page, query=query, base_url="/tpu/nodes", what="TPU nodes"
+        )
     summary = SectionBox(
         "TPU Nodes",
         table_controls,
@@ -112,7 +131,7 @@ def nodes_page(
 
     # Per-node detail cards (`NodesPage.tsx:69-139,285-291`), capped
     # not-ready-first at fleet scale.
-    shown, truncation = cap_nodes_for_cards(state.nodes)
+    shown, truncation = cap_nodes_for_cards(state)
     cards = []
     for node in shown:
         info = obj.node_info(node)

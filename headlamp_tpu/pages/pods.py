@@ -23,7 +23,14 @@ from ..ui import (
     h,
 )
 from ..ui.vdom import Element
-from .common import age_cell, error_banner, phase_label, waiting_reason
+from ..viewport import pending_pods, running_chips, window_pods
+from .common import (
+    age_cell,
+    cursor_controls,
+    error_banner,
+    phase_label,
+    waiting_reason,
+)
 from .native import pod_link
 
 
@@ -46,7 +53,12 @@ def container_chip_list(pod: Any) -> Element:
 
 
 def pods_page(
-    snap: ClusterSnapshot, *, now: float, provider_name: str = "tpu"
+    snap: ClusterSnapshot,
+    *,
+    now: float,
+    provider_name: str = "tpu",
+    limit: int | None = None,
+    cursor: str | None = None,
 ) -> Element:
     if snap.loading:
         return h("div", {"class_": "hl-page hl-pods"}, Loader())
@@ -64,13 +76,11 @@ def pods_page(
             ),
         )
 
-    # Phase summary (`PodsPage.tsx:102-104,166-198`).
+    # Phase summary (`PodsPage.tsx:102-104,166-198`). Both aggregates
+    # come from the viewport layer's per-generation memos (ADR-026) —
+    # the page itself never walks the pod list.
     phases = tpu.count_pod_phases(state.pods)
-    total_chips = sum(
-        tpu.get_pod_chip_request(p)
-        for p in state.pods
-        if obj.pod_phase(p) == "Running"
-    )
+    total_chips = running_chips(state)
     summary = SectionBox(
         "TPU Workload Summary",
         NameValueTable(
@@ -82,8 +92,22 @@ def pods_page(
         ),
     )
 
+    # All-pods table: cursor-windowed through the viewport layer when
+    # ``?limit=``/``?cursor=`` is present (ADR-026 — O(limit) rows in
+    # namespaced-name order, churn-stable continuation); the full
+    # legacy table otherwise.
+    if limit is not None or cursor is not None:
+        window = window_pods(
+            state, limit=limit if limit is not None else 64, cursor=cursor
+        )
+        table_pods: Any = window.rows
+        pods_controls = cursor_controls("/tpu/pods", window, what="TPU pods")
+    else:
+        table_pods = state.pods
+        pods_controls = None
     all_pods = SectionBox(
         "All TPU Pods",
+        pods_controls,
         SimpleTable(
             [
                 {"label": "Pod", "getter": pod_link},
@@ -97,12 +121,12 @@ def pods_page(
                 {"label": "Restarts", "getter": obj.pod_restarts},
                 {"label": "Age", "getter": lambda p: age_cell(p, now)},
             ],
-            state.pods,
+            table_pods,
         ),
     )
 
     # Pending attention table (`PodsPage.tsx:239-268`).
-    pending = [p for p in state.pods if obj.pod_phase(p) == "Pending"]
+    pending = pending_pods(state)
     attention = None
     if pending:
         attention = SectionBox(

@@ -12,10 +12,12 @@ itself stateless and byte-stable for the replay parity test.
 
 from __future__ import annotations
 
+import urllib.parse
 from typing import Any
 
 from ..ui.components import NameValueTable, SectionBox
 from ..ui.vdom import Element, h
+from .common import cursor_controls
 
 #: Window links offered in the header. Values are seconds; the store
 #: clamps anything past its retention, so the 6 h link degrades to
@@ -124,11 +126,59 @@ def _window_nav(active_s: float) -> Element:
     return h("div", {"class_": "hl-trend-windows"}, "Window:", *links)
 
 
+def _browse_href(metric: str, window_s: float) -> str:
+    return (
+        "/tpu/trends?metric="
+        + urllib.parse.quote(metric, safe="")
+        + f"&window={int(window_s)}&limit=64"
+    )
+
+
+def _browse_section(view: dict[str, Any]) -> Element:
+    """Browse mode (ADR-026): EVERY in-window series of one metric,
+    label-sorted and cursor-windowed — the surface the grouped view's
+    busiest-N cap used to make unreachable."""
+    browse = view["browse"]
+    window_s = float(view["window_s"])
+    window = browse["window"]
+    controls = cursor_controls(
+        "/tpu/trends",
+        window,
+        what="series",
+        extra_params={
+            "metric": browse["metric"],
+            "window": str(int(window_s)),
+        },
+    )
+    children: list[Any] = [
+        h(
+            "p",
+            {"class_": "hl-hint"},
+            h("a", {"href": f"/tpu/trends?window={int(window_s)}", "class_": "hl-res-link"}, "← all metrics"),
+            " — every series, by label",
+        ),
+        controls,
+        *[_series_block(series, window_s) for series in browse["series"]],
+    ]
+    if not browse["series"]:
+        children.append(
+            h(
+                "p",
+                {"class_": "hl-hint"},
+                "No in-window series for this metric.",
+            )
+        )
+    return SectionBox(f"{browse['metric']} — all series", *children)
+
+
 def trends_page(view: dict[str, Any]) -> Element:
     """``view`` is ``HistoryStore.trend_view(window_s=...)``."""
     store = view["store"]
     window_s = float(view["window_s"])
     sections: list[Any] = [_window_nav(window_s)]
+    if view.get("browse"):
+        sections.append(_browse_section(view))
+        return h("div", {"class_": "hl-trends"}, *sections)
     if not view["groups"]:
         sections.append(
             h(
@@ -146,11 +196,21 @@ def trends_page(view: dict[str, Any]) -> Element:
             _series_block(series, window_s) for series in shown
         ]
         if hidden > 0:
+            # Not a dead-end hint: the hidden tail is reachable through
+            # the cursor-windowed browse mode (ADR-026).
             children.append(
                 h(
                     "p",
                     {"class_": "hl-hint"},
-                    f"+{hidden} more series (busiest {len(shown)} shown).",
+                    f"Busiest {len(shown)} shown — ",
+                    h(
+                        "a",
+                        {
+                            "href": _browse_href(group["metric"], window_s),
+                            "class_": "hl-res-link hl-browse-all",
+                        },
+                        f"browse all {group['series_total']} series",
+                    ),
                 )
             )
         sections.append(SectionBox(group["metric"], *children))

@@ -22,7 +22,12 @@ except ImportError:  # pragma: no cover - older jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..analytics.encode import FleetArrays
-from ..analytics.fleet_jax import aggregates_to_host_dict, local_aggregates
+from ..analytics.fleet_jax import (
+    REGION_CLUSTER_SEGMENTS,
+    aggregates_to_host_dict,
+    local_aggregates,
+    local_region_aggregates,
+)
 from ..obs.trace import span as _span
 from ..runtime import transfer
 
@@ -107,6 +112,143 @@ def build_rollup_shard(mesh: Mesh, reducer: str, n_nodes_pad: int) -> Any:
         if reducer == "ring"
         else shard_map(rollup_body, **specs)
     )
+
+
+def build_region_rollup_shard(mesh: Mesh, reducer: str, n_nodes_pad: int) -> Any:
+    """Sharded twin of the viewport region rollup (ADR-026): per-shard
+    :func:`local_region_aggregates` + one cross-host reduction per
+    region vector — the same one-definition discipline as
+    :func:`build_rollup_shard`. The two extra replicated inputs are the
+    sentinel-extended region-id columns: ``pod_node_idx`` is a *global*
+    row index, so the pod→region gather needs the full-fleet id columns
+    on every shard (a few KB, replicated), while node columns stay
+    row-sharded."""
+    n_hosts = mesh.shape["hosts"]
+
+    def region_body(
+        cap: jax.Array,
+        alloc: jax.Array,
+        ready: jax.Array,
+        nvalid: jax.Array,
+        cluster: jax.Array,
+        slc: jax.Array,
+        cluster_ext: jax.Array,
+        slice_ext: jax.Array,
+        req: jax.Array,
+        phase: jax.Array,
+        nidx: jax.Array,
+        pvalid: jax.Array,
+    ) -> dict[str, jax.Array]:
+        local = local_region_aggregates(
+            cap, alloc, ready, nvalid, cluster, slc,
+            req, phase, nidx, pvalid,
+            n_nodes_pad=n_nodes_pad,
+            cluster_ext=cluster_ext,
+            slice_ext=slice_ext,
+        )
+        if reducer == "ring":
+            return {
+                k: ring_allreduce(v, "hosts", n_hosts) for k, v in local.items()
+            }
+        return {k: jax.lax.psum(v, "hosts") for k, v in local.items()}
+
+    specs = dict(
+        mesh=mesh,
+        in_specs=(P("hosts"),) * 6 + (P(),) * 2 + (P("hosts"),) * 4,
+        out_specs=P(),  # fully replicated region vectors
+    )
+    return (
+        shard_map_unchecked(region_body, **specs)
+        if reducer == "ring"
+        else shard_map(region_body, **specs)
+    )
+
+
+def region_sharded_rollup(
+    fleet: FleetArrays,
+    node_cluster: Any,
+    node_slice: Any,
+    mesh: Mesh,
+    reducer: str = "psum",
+) -> dict[str, Any]:
+    """Viewport region rollup partitioned over ``hosts`` — column
+    assembly, padding, sentinel-extended id columns, and the AOT/ledger
+    dispatch under ``mesh.region_rollup``. Returns the fetched host dict
+    (same keys as :func:`~..analytics.fleet_jax.region_rollup`); slice
+    vectors are full ``[n_nodes_pad]`` — callers slice to the real
+    region count, exactly as with the single-device program."""
+    n_hosts = mesh.shape["hosts"]
+
+    node_cols = [
+        jnp.asarray(fleet.node_capacity),
+        jnp.asarray(fleet.node_allocatable),
+        jnp.asarray(fleet.node_ready),
+        jnp.asarray(fleet.node_valid),
+        jnp.asarray(node_cluster),
+        jnp.asarray(node_slice),
+    ]
+    pod_cols = [
+        jnp.asarray(fleet.pod_request),
+        jnp.asarray(fleet.pod_phase),
+        jnp.asarray(fleet.pod_node_idx),
+        jnp.asarray(fleet.pod_valid),
+    ]
+    # The sentinel-extended id columns are built from the UNPADDED
+    # masked ids: the encoder parks unscheduled pods at row np_nodes, so
+    # every index from there through the host-padded tail must resolve
+    # to the sentinel segment, not to whatever cluster id 0 the padding
+    # fill would alias.
+    masked_cluster = (
+        jnp.clip(node_cols[4], 0, REGION_CLUSTER_SEGMENTS - 1) * node_cols[3]
+    )
+    masked_slice = node_cols[5] * node_cols[3]
+    node_cols = [_pad_to_multiple(c, n_hosts) for c in node_cols]
+    pod_cols = [_pad_to_multiple(c, n_hosts) for c in pod_cols]
+    n_nodes_pad = int(node_cols[0].shape[0])
+    tail = n_nodes_pad + 1 - int(masked_cluster.shape[0])
+    cluster_ext = jnp.concatenate(
+        [masked_cluster,
+         jnp.full((tail,), REGION_CLUSTER_SEGMENTS, dtype=jnp.int32)]
+    )
+    slice_ext = jnp.concatenate(
+        [masked_slice, jnp.full((tail,), n_nodes_pad, dtype=jnp.int32)]
+    )
+
+    region_shard = build_region_rollup_shard(mesh, reducer, n_nodes_pad)
+    args = (*node_cols, cluster_ext, slice_ext, *pod_cols)
+    with mesh:
+        with _span(
+            "mesh.region_rollup", reducer=reducer, hosts=mesh.devices.size
+        ):
+            from ..models.aot import registry as _aot_registry
+            from ..obs.jaxcost import track as _jax_track
+
+            ledger_key = (
+                reducer,
+                tuple(mesh.devices.shape),
+                tuple(node_cols[0].shape),
+                tuple(pod_cols[0].shape),
+            )
+            reg = _aot_registry()
+            exe = (
+                reg.executable("mesh.region_rollup", ledger_key)
+                if reg.ready()
+                else None
+            )
+            with _jax_track("mesh.region_rollup", ledger_key):
+                if exe is not None:
+                    try:
+                        dispatched = exe(*args)
+                    except Exception as exc:  # noqa: BLE001 — AOT is an optimization
+                        reg.note_exec_failure(
+                            "mesh.region_rollup",
+                            f"{type(exc).__name__}: {exc}"[:200],
+                        )
+                        dispatched = region_shard(*args)
+                else:
+                    dispatched = region_shard(*args)
+            out = transfer.fetch(dispatched)
+    return dict(out)
 
 
 def _rollup_with_reducer(

@@ -21,6 +21,8 @@ stale AROUND it by an intermediary.
 from __future__ import annotations
 
 import gzip as _gzip
+import hashlib
+from urllib.parse import parse_qsl, urlparse
 
 from ..obs.metrics import registry as _metrics_registry
 
@@ -48,11 +50,35 @@ _NOT_MODIFIED = _metrics_registry.counter(
 )
 
 
-def etag_for(generation: int, epoch: int, degraded: bool) -> str:
+def etag_for(generation: int, epoch: int, degraded: bool, window: str = "") -> str:
     """Strong ETag (quoted, per RFC 7232) for the current paint
     invariants. Opaque to clients; the fields are ordered for operator
-    eyeballs in curl output, not for parsing."""
-    return f'"g{int(generation)}-e{int(epoch)}-d{1 if degraded else 0}"'
+    eyeballs in curl output, not for parsing.
+
+    ``window`` is the request's :func:`window_token` — required since
+    ADR-026, where two same-generation responses are no longer
+    byte-identical across cursor windows (``?limit=``/``?cursor=``/
+    ``?region=``/…). Empty for a bare path, which keeps windowless
+    ETags in their historic shape."""
+    tag = f"g{int(generation)}-e{int(epoch)}-d{1 if degraded else 0}"
+    if window:
+        tag += f"-w{window}"
+    return f'"{tag}"'
+
+
+def window_token(path: str) -> str:
+    """Collapse a request's query string into a short stable token for
+    :func:`etag_for` — the same sorted-params normalization the
+    coalesce key uses, hashed so the ETag stays compact and opaque.
+    ``""`` for a query-less path."""
+    query = urlparse(path).query
+    if not query:
+        return ""
+    pairs = sorted(parse_qsl(query, keep_blank_values=True))
+    if not pairs:
+        return ""
+    encoded = "&".join(f"{key}={value}" for key, value in pairs)
+    return hashlib.sha1(encoded.encode("utf-8")).hexdigest()[:8]
 
 
 def if_none_match_matches(header: str | None, etag: str) -> bool:
@@ -133,4 +159,5 @@ __all__ = [
     "etag_for",
     "gzip_accepted",
     "if_none_match_matches",
+    "window_token",
 ]

@@ -22,11 +22,23 @@ from __future__ import annotations
 
 from typing import Any, Mapping
 
+from ..viewport.tree import node_region, region_path
+
 #: The diffable page set: the live-wall surfaces whose content is a
 #: function of the snapshot generation (+ the metrics/forecast peeks).
 #: Debug/ops surfaces change per-request (live rings) and are excluded
 #: by design — a ring that describes traffic would broadcast forever.
+#: Region pages (ADR-026) are NOT listed here: their keys are dynamic
+#: (``region:cluster/<ck>[/slice/<sk>]``, one per drill-down region in
+#: the fleet) and a client opts into exactly one via ``?region=``.
 PAGES = ("/tpu", "/tpu/nodes", "/tpu/pods", "/tpu/metrics")
+
+#: Page-key prefix for per-region models/frames (ADR-026). A region
+#: page's rows are the SAME row lists as ``/tpu/nodes`` (shared
+#: references — partitioning costs pointers, not copies); its cells are
+#: the region's rollup scalars, so one node flipping Ready produces a
+#: frame whose size tracks the REGION, not the fleet.
+REGION_PAGE_PREFIX = "region:"
 
 
 def _node_ready(node: Mapping[str, Any]) -> bool:
@@ -58,6 +70,24 @@ def build_page_models(
     }
     node_rows: dict[str, list[Any]] = {}
     pod_rows: dict[str, list[Any]] = {}
+    region_models: dict[str, dict[str, Any]] = {}
+
+    def _region(key: str) -> dict[str, Any]:
+        model = region_models.get(key)
+        if model is None:
+            model = region_models[key] = {
+                "cells": {
+                    "nodes_total": 0,
+                    "nodes_ready": 0,
+                    "capacity": 0,
+                    "allocatable": 0,
+                    "in_use": 0,
+                    "pods_total": 0,
+                },
+                "rows": {},
+            }
+        return model
+
     for pname, state in (getattr(snap, "providers", {}) or {}).items():
         view = state.view
         summary = view.allocation_summary()
@@ -67,22 +97,43 @@ def build_page_models(
         overview_cells[f"{pname}.pods"] = len(view.pods)
         overview_cells[f"{pname}.plugin_installed"] = bool(view.plugin_installed)
         provider = view.provider
+        # Regions are a TPU-fleet concept (cluster label + GKE node
+        # pool); other providers' nodes stay out of the region models.
+        track_regions = pname == "tpu"
+        region_keys_of: dict[str, tuple[str, str]] = {}
         for node in view.nodes:
-            node_rows[_name(node)] = [
-                pname,
-                _node_ready(node),
-                int(provider.node_device_capacity(node)),
-                int(provider.node_device_allocatable(node)),
-            ]
+            name = _name(node)
+            ready = _node_ready(node)
+            capacity = int(provider.node_device_capacity(node))
+            allocatable = int(provider.node_device_allocatable(node))
+            row = [pname, ready, capacity, allocatable]
+            node_rows[name] = row
+            if track_regions:
+                ck, sk = node_region(node)
+                cluster_key = REGION_PAGE_PREFIX + region_path(ck)
+                slice_key = REGION_PAGE_PREFIX + region_path(ck, sk)
+                region_keys_of[name] = (cluster_key, slice_key)
+                for region_key in (cluster_key, slice_key):
+                    model = _region(region_key)
+                    model["rows"][name] = row  # shared reference
+                    cells = model["cells"]
+                    cells["nodes_total"] += 1
+                    cells["nodes_ready"] += 1 if ready else 0
+                    cells["capacity"] += capacity
+                    cells["allocatable"] += allocatable
         for pod in view.pods:
             meta = pod.get("metadata") or {}
             key = f"{meta.get('namespace', '')}/{meta.get('name', '')}"
-            pod_rows[key] = [
-                pname,
-                str(((pod.get("status") or {}).get("phase")) or ""),
-                str(((pod.get("spec") or {}).get("nodeName")) or ""),
-                int(provider.pod_device_request(pod)),
-            ]
+            phase = str(((pod.get("status") or {}).get("phase")) or "")
+            node_name = str(((pod.get("spec") or {}).get("nodeName")) or "")
+            request = int(provider.pod_device_request(pod))
+            pod_rows[key] = [pname, phase, node_name, request]
+            if track_regions and node_name in region_keys_of:
+                for region_key in region_keys_of[node_name]:
+                    cells = _region(region_key)["cells"]
+                    cells["pods_total"] += 1
+                    if phase == "Running":
+                        cells["in_use"] += request
 
     metrics_cells: dict[str, Any] = {"available": metrics is not None}
     metrics_rows: dict[str, list[Any]] = {}
@@ -109,12 +160,14 @@ def build_page_models(
                 bool(chip.saturation_risk),
             ]
 
-    return {
+    models: dict[str, dict[str, Any]] = {
         "/tpu": {"cells": overview_cells, "rows": {}},
         "/tpu/nodes": {"cells": {"total": len(node_rows)}, "rows": node_rows},
         "/tpu/pods": {"cells": {"total": len(pod_rows)}, "rows": pod_rows},
         "/tpu/metrics": {"cells": metrics_cells, "rows": metrics_rows},
     }
+    models.update(region_models)
+    return models
 
 
 def diff_models(
@@ -168,4 +221,4 @@ class _Missing:
 _MISSING = _Missing()
 
 
-__all__ = ["PAGES", "build_page_models", "diff_models"]
+__all__ = ["PAGES", "REGION_PAGE_PREFIX", "build_page_models", "diff_models"]

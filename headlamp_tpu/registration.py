@@ -32,6 +32,7 @@ from .pages import (
     pods_page,
     topology_page,
     trends_page,
+    viewport_page,
 )
 from .pages.native import native_nodes_page
 from .pages.intel import (
@@ -64,6 +65,11 @@ class Route:
     #: True for routes whose component accepts ``page=``/``query=`` —
     #: the big node tables. Hosts forward ?page=N&q=… only to these.
     paged: bool = False
+    #: True for routes whose component accepts ``limit=``/``cursor=`` —
+    #: the ADR-026 cursor-windowed tables. Hosts forward
+    #: ?limit=N&cursor=… only to these; absent params keep the legacy
+    #: full/offset-paged rendering byte-identical.
+    windowed: bool = False
 
 
 @dataclass(frozen=True)
@@ -118,6 +124,7 @@ def register_plugin(registry: Registry | None = None) -> Registry:
     entries = [
         SidebarEntry(SIDEBAR_ROOT, "Cloud TPU", "/tpu", parent=None),
         SidebarEntry("tpu-overview", "Overview", "/tpu", parent=SIDEBAR_ROOT),
+        SidebarEntry("tpu-fleet", "Fleet", "/tpu/fleet", parent=SIDEBAR_ROOT),
         SidebarEntry("tpu-nodes", "Nodes", "/tpu/nodes", parent=SIDEBAR_ROOT),
         SidebarEntry("tpu-pods", "Workloads", "/tpu/pods", parent=SIDEBAR_ROOT),
         SidebarEntry(
@@ -159,8 +166,15 @@ def register_plugin(registry: Registry | None = None) -> Registry:
     reg.routes.extend(
         [
             Route("/tpu", "tpu-overview", overview_page),
-            Route("/tpu/nodes", "tpu-nodes", nodes_page, paged=True),
-            Route("/tpu/pods", "tpu-pods", pods_page),
+            # Viewport drill-down (ADR-026): fleet → cluster → slice →
+            # node, every level O(viewport). Its kind dispatch forwards
+            # ?region= (the drill-down path, which doubles as the SSE
+            # region key) alongside the cursor-window params.
+            Route("/tpu/fleet", "tpu-fleet", viewport_page, kind="viewport"),
+            Route(
+                "/tpu/nodes", "tpu-nodes", nodes_page, paged=True, windowed=True
+            ),
+            Route("/tpu/pods", "tpu-pods", pods_page, windowed=True),
             Route("/tpu/deviceplugins", "tpu-deviceplugins", device_plugins_page),
             Route("/tpu/topology", "tpu-topology", topology_page, kind="topology"),
             Route("/tpu/metrics", "tpu-metrics", metrics_page, kind="metrics"),

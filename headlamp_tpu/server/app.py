@@ -1308,6 +1308,19 @@ class DashboardApp:
             # cluster string; cap its length so a hostile URL cannot
             # make the substring filter arbitrarily expensive.
             paging["query"] = params.get("q", [""])[0][:253]
+        if route.windowed:
+            # Cursor-window params (ADR-026). Forwarded only when
+            # present so their absence keeps the legacy rendering
+            # byte-identical; the viewport layer clamps the limit and
+            # treats any malformed cursor as "start over".
+            params = parse_qs(parsed.query)
+            if "limit" in params:
+                try:
+                    paging["limit"] = int(params["limit"][0])
+                except ValueError:
+                    pass
+            if "cursor" in params:
+                paging["cursor"] = params["cursor"][0][:512]
         with span("page.component", kind=route.kind):
             if route.kind == "metrics":
                 metrics, forecast = self._metrics_and_forecast()
@@ -1351,7 +1364,46 @@ class DashboardApp:
                     window_s = float(params.get("window", ["3600"])[0])
                 except ValueError:
                     window_s = 3600.0
-                el = route.component(self.history.trend_view(window_s=window_s))
+                # ?metric= switches the view to the ADR-026 browse mode
+                # (every series of one metric, label-sorted and
+                # cursor-windowed) — the escape hatch from the grouped
+                # view's busiest-N cap.
+                metric = params.get("metric", [""])[0][:253]
+                series_limit: int | None = None
+                if "limit" in params:
+                    try:
+                        series_limit = int(params["limit"][0])
+                    except ValueError:
+                        series_limit = None
+                series_cursor = params.get("cursor", [None])[0]
+                if series_cursor:
+                    series_cursor = series_cursor[:512]
+                el = route.component(
+                    self.history.trend_view(
+                        window_s=window_s,
+                        metric=metric,
+                        series_cursor=series_cursor,
+                        series_limit=series_limit,
+                    )
+                )
+            elif route.kind == "viewport":
+                # Drill-down surface (ADR-026): ?region= names the
+                # rollup level (also the SSE region key); the cursor
+                # window only applies at slice depth.
+                params = parse_qs(parsed.query)
+                region = params.get("region", [""])[0][:253]
+                vp_limit: int | None = None
+                if "limit" in params:
+                    try:
+                        vp_limit = int(params["limit"][0])
+                    except ValueError:
+                        vp_limit = None
+                vp_cursor = params.get("cursor", [None])[0]
+                if vp_cursor:
+                    vp_cursor = vp_cursor[:512]
+                el = route.component(
+                    snap, now=now, region=region, limit=vp_limit, cursor=vp_cursor
+                )
             else:
                 el = route.component(snap, now=now, **paging)
         with span("render.html"):
@@ -1419,10 +1471,26 @@ class DashboardApp:
         histogram — a connection's lifetime is not a paint latency, and
         frames ride the broadcast path, not renders."""
         query = parse_qs(urlparse(path).query)
-        requested = [
-            p for p in query.get("pages", [""])[0].split(",") if p
-        ]
-        pages = [p for p in requested if p in PUSH_PAGES] or list(PUSH_PAGES)
+        region = query.get("region", [""])[0][:253]
+        if region:
+            # Region-scoped stream (ADR-026): ?region=cluster/3/slice/7
+            # subscribes to that drill-down region's frames only —
+            # steady-state bytes scale with the region, not the fleet.
+            # The path is canonicalized through the viewport parser; an
+            # unparseable region falls back to the full page set (the
+            # stream still works, it just is not narrowed).
+            from ..viewport import parse_region, region_path
+
+            parsed_region = parse_region(region)
+            if parsed_region is not None:
+                pages = ["region:" + region_path(*parsed_region)]
+            else:
+                pages = list(PUSH_PAGES)
+        else:
+            requested = [
+                p for p in query.get("pages", [""])[0].split(",") if p
+            ]
+            pages = [p for p in requested if p in PUSH_PAGES] or list(PUSH_PAGES)
         priority = (
             "debug" if query.get("class", [""])[0] == "debug" else "interactive"
         )

@@ -1,0 +1,51 @@
+"""Viewport layer (ADR-026): O(what-the-viewer-sees) serving.
+
+Between the snapshot and the pages sits this package. Pages stopped
+iterating the fleet (machine-enforced by VPT001): they ask the viewport
+for a drill-down tree (``tree.viewport_tree`` — per-region rollups
+computed device-side at scale), a cursor-stable row window
+(``window.window_nodes`` / ``window_pods`` — seek cursors that survive
+fleet churn), or a memoized derived map (``window.pods_by_node``).
+Per-request cost is O(limit + regions), never O(fleet); the O(N) passes
+run once per snapshot generation and are memoized on the snapshot view
+itself, so leader and ADR-025 replicas each derive identical bytes from
+identical snapshots.
+"""
+
+from .cursor import decode_cursor, encode_cursor, query_hash
+from .tree import (
+    Region,
+    ViewportTree,
+    node_region,
+    parse_region,
+    region_path,
+    viewport_tree,
+)
+from .window import (
+    Window,
+    pending_pods,
+    pods_by_node,
+    running_chips,
+    window_nodes,
+    window_pods,
+    window_series,
+)
+
+__all__ = [
+    "Region",
+    "ViewportTree",
+    "Window",
+    "decode_cursor",
+    "encode_cursor",
+    "node_region",
+    "parse_region",
+    "pending_pods",
+    "pods_by_node",
+    "query_hash",
+    "region_path",
+    "running_chips",
+    "viewport_tree",
+    "window_nodes",
+    "window_pods",
+    "window_series",
+]

@@ -1,0 +1,317 @@
+"""Hierarchical drill-down tree: fleet → cluster → slice → node (ADR-026).
+
+Region identity is name-based and total: every node belongs to exactly
+one cluster (its :data:`~headlamp_tpu.domain.constants.HEADLAMP_CLUSTER_LABEL`
+value, ``"0"`` when unlabelled — i.e. every single-cluster deployment)
+and one slice (its GKE node pool, ``"-"`` for single-host/plain nodes).
+A drill-down path is ``cluster/<ck>`` or ``cluster/<ck>/slice/<sk>``;
+the same strings key region-scoped push subscriptions
+(``/events?region=...``) and the region page models the differ emits.
+
+Per-region rollups follow the ADR-012/020 aggregate-before-transfer
+discipline: at ``XLA_ROLLUP_MIN_NODES`` and above the sums come from
+the fused ``analytics.region_rollup`` program over the device-cached
+columns (both drill-down levels in ONE dispatch — what crosses the
+device boundary is a few region-sized vectors, never 16k node rows);
+below the floor, or when the device path fails, a single Python pass
+computes the identical numbers (pinned by test). Either way the result
+is memoized ON the snapshot view object, so the whole tree costs O(N)
+once per snapshot generation and O(regions) per request after that —
+and two processes holding byte-identical snapshots (leader and ADR-025
+replica) derive byte-identical trees.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+from ..domain import objects as obj
+from ..domain import tpu
+from ..domain.constants import HEADLAMP_CLUSTER_LABEL
+
+#: Cluster key for nodes without the federation label.
+DEFAULT_CLUSTER = "0"
+#: Slice key for nodes outside any GKE node pool.
+NO_SLICE = "-"
+
+_MEMO_LOCK = threading.Lock()
+
+#: Rollup stat keys, in render order — one vocabulary for the device
+#: vectors, the host fallback, and the region cells the differ pushes.
+STAT_KEYS = ("nodes", "ready", "capacity", "allocatable", "in_use", "pending")
+
+
+def node_region(node: Any) -> tuple[str, str]:
+    """(cluster key, slice key) for ``node`` — total over any fleet."""
+    cluster = obj.labels(node).get(HEADLAMP_CLUSTER_LABEL) or DEFAULT_CLUSTER
+    return cluster, tpu.get_node_pool(node) or NO_SLICE
+
+
+def region_path(cluster: str, slice_: str | None = None) -> str:
+    """Canonical drill-down path for a region."""
+    if slice_ is None:
+        return f"cluster/{cluster}"
+    return f"cluster/{cluster}/slice/{slice_}"
+
+
+def parse_region(path: str) -> tuple[str, str | None] | None:
+    """Parse a drill-down path back into (cluster, slice-or-None);
+    None for anything that is not a canonical region path. Keys are
+    opaque label values — only the path grammar is validated."""
+    parts = path.strip("/").split("/")
+    if len(parts) == 2 and parts[0] == "cluster" and parts[1]:
+        return parts[1], None
+    if (
+        len(parts) == 4
+        and parts[0] == "cluster"
+        and parts[2] == "slice"
+        and parts[1]
+        and parts[3]
+    ):
+        return parts[1], parts[3]
+    return None
+
+
+@dataclass(frozen=True)
+class Region:
+    """One drill-down region: its canonical path, display key, rollup
+    stats (:data:`STAT_KEYS`), and child regions (clusters carry their
+    slices; slices carry no children — node rows come from the window
+    layer, not the tree)."""
+
+    path: str
+    key: str
+    level: str  # "cluster" | "slice"
+    stats: dict[str, int]
+    children: tuple["Region", ...] = ()
+
+
+@dataclass(frozen=True)
+class ViewportTree:
+    """The whole drill-down hierarchy for one snapshot generation."""
+
+    generation: int | None
+    total: dict[str, int]
+    clusters: tuple[Region, ...]
+    #: node name -> (cluster key, slice key)
+    region_of: Mapping[str, tuple[str, str]]
+    #: region path -> member node names (both levels)
+    members: Mapping[str, tuple[str, ...]]
+    source: str  # "device" | "host"
+
+    def region(self, path: str) -> Region | None:
+        for cluster in self.clusters:
+            if cluster.path == path:
+                return cluster
+            for slc in cluster.children:
+                if slc.path == path:
+                    return slc
+        return None
+
+
+def _assignments(
+    nodes: list[Any],
+) -> tuple[
+    dict[str, tuple[str, str]],
+    list[str],
+    list[tuple[str, str]],
+    dict[str, int],
+    dict[tuple[str, str], int],
+]:
+    """One pass over the node list: per-node region, sorted cluster and
+    slice vocabularies, and key→ordinal maps (the segment ids the
+    device program sums into)."""
+    region_of: dict[str, tuple[str, str]] = {}
+    for node in nodes:
+        region_of[obj.name(node)] = node_region(node)
+    clusters = sorted({ck for ck, _sk in region_of.values()})
+    slices = sorted(set(region_of.values()))
+    cluster_id = {ck: i for i, ck in enumerate(clusters)}
+    slice_id = {pair: i for i, pair in enumerate(slices)}
+    return region_of, clusters, slices, cluster_id, slice_id
+
+
+def _device_sums(
+    view: Any,
+    cluster_id: dict[str, int],
+    slice_id: dict[tuple[str, str], int],
+    region_of: dict[str, tuple[str, str]],
+    segments_limit: int,
+) -> tuple[list[dict[str, int]], list[dict[str, int]]]:
+    """Per-cluster and per-slice stat dicts from ONE fused device
+    dispatch over the ADR-012 cached columns."""
+    import numpy as np
+
+    from ..analytics.fleet_jax import region_rollup_arrays
+    from ..runtime import transfer
+    from ..runtime.device_cache import fleet_cache
+
+    fleet = fleet_cache.fleet_for(view)
+    pad = int(fleet.node_capacity.shape[0])
+    node_cluster = np.zeros(pad, dtype=np.int32)
+    node_slice = np.zeros(pad, dtype=np.int32)
+    for i, name in enumerate(fleet.node_names):
+        ck, sk = region_of[name]
+        node_cluster[i] = min(cluster_id[ck], segments_limit - 1)
+        node_slice[i] = slice_id[(ck, sk)]
+    out = transfer.fetch(region_rollup_arrays(fleet, node_cluster, node_slice))
+
+    def stats_at(prefix: str, idx: int) -> dict[str, int]:
+        return {
+            "nodes": int(out[f"{prefix}_nodes"][idx]),
+            "ready": int(out[f"{prefix}_ready"][idx]),
+            "capacity": int(out[f"{prefix}_capacity"][idx]),
+            "allocatable": int(out[f"{prefix}_allocatable"][idx]),
+            "in_use": int(out[f"{prefix}_in_use"][idx]),
+            "pending": int(out[f"{prefix}_pending"][idx]),
+        }
+
+    cluster_stats = [
+        stats_at("cluster", min(cid, segments_limit - 1))
+        for cid in range(len(cluster_id))
+    ]
+    slice_stats = [stats_at("slice", sid) for sid in range(len(slice_id))]
+    return cluster_stats, slice_stats
+
+
+def _host_sums(
+    state: Any,
+    cluster_id: dict[str, int],
+    slice_id: dict[tuple[str, str], int],
+    region_of: dict[str, tuple[str, str]],
+    segments_limit: int,
+) -> tuple[list[dict[str, int]], list[dict[str, int]]]:
+    """Python twin of :func:`_device_sums` — the below-floor/fallback
+    path, and the oracle the device numbers are pinned against. The
+    viewport IS the aggregation layer, so this is one of the two places
+    a full-fleet loop is legitimate (the other is the encoder)."""
+    zeros = lambda: {k: 0 for k in STAT_KEYS}  # noqa: E731
+    cluster_stats = [zeros() for _ in cluster_id]
+    slice_stats = [zeros() for _ in slice_id]
+
+    def effective_cid(ck: str) -> int:
+        return min(cluster_id[ck], segments_limit - 1)
+
+    merged: dict[int, dict[str, int]] = {}
+    for node in state.nodes:
+        ck, sk = region_of[obj.name(node)]
+        cid, sid = effective_cid(ck), slice_id[(ck, sk)]
+        cstats = merged.setdefault(cid, zeros())
+        for stats in (cstats, slice_stats[sid]):
+            stats["nodes"] += 1
+            stats["ready"] += 1 if obj.is_node_ready(node) else 0
+            stats["capacity"] += tpu.get_node_chip_capacity(node)
+            stats["allocatable"] += tpu.get_node_chip_allocatable(node)
+    for pod in state.pods:
+        node_name = obj.pod_node_name(pod)
+        if not node_name or node_name not in region_of:
+            continue
+        ck, sk = region_of[node_name]
+        cid, sid = effective_cid(ck), slice_id[(ck, sk)]
+        cstats = merged.setdefault(cid, zeros())
+        phase = obj.pod_phase(pod)
+        if phase == "Running":
+            request = tpu.get_pod_chip_request(pod)
+            cstats["in_use"] += request
+            slice_stats[sid]["in_use"] += request
+        elif phase == "Pending":
+            cstats["pending"] += 1
+            slice_stats[sid]["pending"] += 1
+    # Clusters clamped into one segment all read the merged sums — the
+    # same aliasing the device's clip produces past the segment limit.
+    for ck, cid in cluster_id.items():
+        cluster_stats[cid] = dict(merged.get(effective_cid(ck), zeros()))
+    return cluster_stats, slice_stats
+
+
+def _build_tree(state: Any) -> ViewportTree:
+    from ..analytics.fleet_jax import REGION_CLUSTER_SEGMENTS
+    from ..analytics.stats import XLA_ROLLUP_MIN_NODES
+
+    view = state.view
+    nodes = state.nodes
+    region_of, clusters, slices, cluster_id, slice_id = _assignments(nodes)
+
+    source = "host"
+    if len(nodes) >= XLA_ROLLUP_MIN_NODES:
+        try:
+            cluster_stats, slice_stats = _device_sums(
+                view, cluster_id, slice_id, region_of, REGION_CLUSTER_SEGMENTS
+            )
+            source = "device"
+        except Exception:  # noqa: BLE001 — same fallback contract as fleet_stats
+            cluster_stats, slice_stats = _host_sums(
+                state, cluster_id, slice_id, region_of, REGION_CLUSTER_SEGMENTS
+            )
+    else:
+        cluster_stats, slice_stats = _host_sums(
+            state, cluster_id, slice_id, region_of, REGION_CLUSTER_SEGMENTS
+        )
+
+    members: dict[str, list[str]] = {}
+    for name, (ck, sk) in region_of.items():
+        members.setdefault(region_path(ck), []).append(name)
+        members.setdefault(region_path(ck, sk), []).append(name)
+    frozen_members = {
+        path: tuple(sorted(names)) for path, names in members.items()
+    }
+
+    cluster_regions: list[Region] = []
+    for ck in clusters:
+        child_regions = tuple(
+            Region(
+                path=region_path(ck, sk),
+                key=sk,
+                level="slice",
+                stats=slice_stats[slice_id[(ck, sk)]],
+            )
+            for ck2, sk in slices
+            if ck2 == ck
+        )
+        cluster_regions.append(
+            Region(
+                path=region_path(ck),
+                key=ck,
+                level="cluster",
+                stats=cluster_stats[cluster_id[ck]],
+                children=child_regions,
+            )
+        )
+
+    total = {key: 0 for key in STAT_KEYS}
+    for region in cluster_regions:
+        # Slice stats are exact per slice; cluster totals sum the
+        # SLICE rows so segment-limit aliasing never double-counts.
+        for child in region.children:
+            for key in STAT_KEYS:
+                total[key] += child.stats[key]
+
+    return ViewportTree(
+        generation=getattr(view, "version", None),
+        total=total,
+        clusters=tuple(cluster_regions),
+        region_of=region_of,
+        members=frozen_members,
+        source=source,
+    )
+
+
+def viewport_tree(state: Any) -> ViewportTree:
+    """The drill-down tree for ``state`` (a ``ProviderState``) —
+    memoized on the snapshot view, so every page/push/bench consumer of
+    one generation shares one O(N) build."""
+    view = state.view
+    cached = getattr(view, "_viewport_tree", None)
+    if cached is not None:
+        return cached
+    tree = _build_tree(state)
+    if getattr(view, "version", None) is not None:
+        with _MEMO_LOCK:
+            cached = getattr(view, "_viewport_tree", None)
+            if cached is None:
+                view._viewport_tree = tree
+            else:
+                tree = cached
+    return tree

@@ -1,0 +1,230 @@
+/**
+ * FleetPage — fleet → cluster → slice drill-down with per-region
+ * rollups.
+ *
+ * Headlamp-native rendering of `headlamp_tpu/pages/viewport_page.py`
+ * (ADR-026): the same name-based region identity — a node's cluster is
+ * its `headlamp.io/cluster` label (`"0"` unlabelled), its slice is its
+ * GKE node pool (`"-"` for plain hosts) — grouped client-side from the
+ * provider's node list. The dashboard server computes these rollups
+ * device-side from the ADR-012 cached columns; in the browser the
+ * provider has already shipped the nodes, so one grouping pass per
+ * render is the whole cost. Drill-down selection is local state (the
+ * plugin surface registers exact routes, no query routing).
+ */
+
+import {
+  NameValueTable,
+  SectionBox,
+  SimpleTable,
+} from '@kinvolk/headlamp-plugin/lib/CommonComponents';
+import React from 'react';
+import { getNodeChipAllocatable } from '../api/fleet';
+import { useTpuContext } from '../api/TpuDataContext';
+import {
+  getNodeChipCapacity,
+  getNodePool,
+  isNodeReady,
+  KubeNode,
+  nodeName,
+} from '../api/topology';
+import { PageHeader, readyLabel } from './common';
+
+/** Python twin: `domain/constants.py:HEADLAMP_CLUSTER_LABEL`. */
+const CLUSTER_LABEL = 'headlamp.io/cluster';
+/** Python twin: `viewport/tree.py` DEFAULT_CLUSTER / NO_SLICE. */
+const DEFAULT_CLUSTER = '0';
+const NO_SLICE = '-';
+/** Node-table cap per slice — the windowed-table analogue. */
+const SLICE_WINDOW = 64;
+
+interface RegionStats {
+  nodes: number;
+  ready: number;
+  capacity: number;
+  allocatable: number;
+  inUse: number;
+}
+
+interface SliceGroup {
+  key: string;
+  stats: RegionStats;
+  members: KubeNode[];
+}
+
+interface ClusterGroup {
+  key: string;
+  stats: RegionStats;
+  slices: Map<string, SliceGroup>;
+}
+
+function emptyStats(): RegionStats {
+  return { nodes: 0, ready: 0, capacity: 0, allocatable: 0, inUse: 0 };
+}
+
+function addNode(stats: RegionStats, node: KubeNode, inUse: number) {
+  stats.nodes += 1;
+  stats.ready += Number(isNodeReady(node));
+  stats.capacity += getNodeChipCapacity(node);
+  stats.allocatable += getNodeChipAllocatable(node);
+  stats.inUse += inUse;
+}
+
+function groupFleet(tpuNodes: KubeNode[], perNodeInUse: number[]): Map<string, ClusterGroup> {
+  const clusters = new Map<string, ClusterGroup>();
+  tpuNodes.forEach((node, i) => {
+    const ck = node?.metadata?.labels?.[CLUSTER_LABEL] ?? DEFAULT_CLUSTER;
+    const sk = getNodePool(node) ?? NO_SLICE;
+    let cluster = clusters.get(ck);
+    if (!cluster) {
+      cluster = { key: ck, stats: emptyStats(), slices: new Map() };
+      clusters.set(ck, cluster);
+    }
+    let slice = cluster.slices.get(sk);
+    if (!slice) {
+      slice = { key: sk, stats: emptyStats(), members: [] };
+      cluster.slices.set(sk, slice);
+    }
+    const inUse = perNodeInUse[i] ?? 0;
+    addNode(cluster.stats, node, inUse);
+    addNode(slice.stats, node, inUse);
+    slice.members.push(node);
+  });
+  return clusters;
+}
+
+function RollupTable({
+  what,
+  rows,
+  onDrill,
+}: {
+  what: string;
+  rows: { key: string; stats: RegionStats }[];
+  onDrill: (key: string) => void;
+}) {
+  return (
+    <SimpleTable
+      columns={[
+        {
+          label: what,
+          getter: (r: { key: string }) => (
+            <a
+              href="#"
+              onClick={e => {
+                e.preventDefault();
+                onDrill(r.key);
+              }}
+            >
+              {r.key}
+            </a>
+          ),
+        },
+        { label: 'Nodes', getter: (r: { stats: RegionStats }) => r.stats.nodes },
+        { label: 'Ready', getter: (r: { stats: RegionStats }) => r.stats.ready },
+        { label: 'Chips (capacity)', getter: (r: { stats: RegionStats }) => r.stats.capacity },
+        {
+          label: 'Chips (allocatable)',
+          getter: (r: { stats: RegionStats }) => r.stats.allocatable,
+        },
+        { label: 'Chips in use', getter: (r: { stats: RegionStats }) => r.stats.inUse },
+      ]}
+      data={rows}
+    />
+  );
+}
+
+export default function FleetPage() {
+  const { tpuNodes, stats, loading, error } = useTpuContext();
+  const [clusterKey, setClusterKey] = React.useState<string | null>(null);
+  const [sliceKey, setSliceKey] = React.useState<string | null>(null);
+
+  const clusters = React.useMemo(
+    () => groupFleet(tpuNodes, stats.per_node_in_use),
+    [tpuNodes, stats]
+  );
+
+  if (loading && !tpuNodes.length) {
+    return <PageHeader title="TPU Fleet" />;
+  }
+
+  const fleet = emptyStats();
+  for (const c of clusters.values()) {
+    fleet.nodes += c.stats.nodes;
+    fleet.ready += c.stats.ready;
+    fleet.capacity += c.stats.capacity;
+    fleet.allocatable += c.stats.allocatable;
+    fleet.inUse += c.stats.inUse;
+  }
+
+  const cluster = clusterKey !== null ? clusters.get(clusterKey) : undefined;
+  const slice = cluster && sliceKey !== null ? cluster.slices.get(sliceKey) : undefined;
+  const crumb = cluster
+    ? slice
+      ? `cluster/${cluster.key}/slice/${slice.key}`
+      : `cluster/${cluster.key}`
+    : 'fleet';
+
+  return (
+    <>
+      <PageHeader title="TPU Fleet" />
+      {error ? <p>Node list degraded: {error}</p> : null}
+      <SectionBox title={`Drill-down — ${crumb}`}>
+        {cluster ? (
+          <p>
+            <a
+              href="#"
+              onClick={e => {
+                e.preventDefault();
+                if (slice) setSliceKey(null);
+                else setClusterKey(null);
+              }}
+            >
+              ← up
+            </a>
+          </p>
+        ) : null}
+        {!cluster ? (
+          <>
+            <NameValueTable
+              rows={[
+                { name: 'Clusters', value: clusters.size },
+                { name: 'Nodes', value: `${fleet.ready} / ${fleet.nodes} ready` },
+                { name: 'Chips (capacity)', value: fleet.capacity },
+                { name: 'Chips (allocatable)', value: fleet.allocatable },
+                { name: 'Chips in use', value: fleet.inUse },
+              ]}
+            />
+            <RollupTable
+              what="Cluster"
+              rows={[...clusters.values()]}
+              onDrill={key => setClusterKey(key)}
+            />
+          </>
+        ) : !slice ? (
+          <RollupTable
+            what="Slice"
+            rows={[...cluster.slices.values()]}
+            onDrill={key => setSliceKey(key)}
+          />
+        ) : (
+          <>
+            <SimpleTable
+              columns={[
+                { label: 'Node', getter: (n: KubeNode) => nodeName(n) },
+                { label: 'Status', getter: (n: KubeNode) => readyLabel(n) },
+                { label: 'Chips (capacity)', getter: (n: KubeNode) => getNodeChipCapacity(n) },
+              ]}
+              data={slice.members.slice(0, SLICE_WINDOW)}
+            />
+            {slice.members.length > SLICE_WINDOW ? (
+              <p>
+                Showing {SLICE_WINDOW} of {slice.members.length} nodes — the dashboard
+                server serves the full slice through cursor windows.
+              </p>
+            ) : null}
+          </>
+        )}
+      </SectionBox>
+    </>
+  );
+}

@@ -2,7 +2,7 @@
  * Registration-surface test: importing the plugin entry must register
  * BOTH provider surfaces the Python registry declares
  * (`headlamp_tpu/registration.py`, checked structurally by
- * `tests/test_ts_parity.py`): 8 TPU + 6 Intel sidebar entries, 7 TPU +
+ * `tests/test_ts_parity.py`): 9 TPU + 6 Intel sidebar entries, 8 TPU +
  * 5 Intel routes, 4 kind-guarded detail sections, and the
  * 'headlamp-nodes' column processor carrying both providers' columns.
  */
@@ -29,6 +29,7 @@ describe('plugin registration surface', () => {
       ['tpu-topology', '/tpu/topology'],
       ['tpu-metrics', '/tpu/metrics'],
       ['tpu-trends', '/tpu/trends'],
+      ['tpu-fleet', '/tpu/fleet'],
       ['intel', '/intel'],
       ['intel-overview', '/intel'],
       ['intel-deviceplugins', '/intel/deviceplugins'],
@@ -38,11 +39,11 @@ describe('plugin registration surface', () => {
     ]);
     // TPU registers first: first-class provider, Intel compatibility.
     expect(captured.sidebarEntries[0].parent).toBeNull();
-    expect(captured.sidebarEntries[8].parent).toBeNull();
-    for (const child of captured.sidebarEntries.slice(1, 8)) {
+    expect(captured.sidebarEntries[9].parent).toBeNull();
+    for (const child of captured.sidebarEntries.slice(1, 9)) {
       expect(child.parent).toBe('tpu');
     }
-    for (const child of captured.sidebarEntries.slice(9)) {
+    for (const child of captured.sidebarEntries.slice(10)) {
       expect(child.parent).toBe('intel');
     }
   });
@@ -56,6 +57,7 @@ describe('plugin registration surface', () => {
       '/tpu/topology',
       '/tpu/metrics',
       '/tpu/trends',
+      '/tpu/fleet',
       '/intel',
       '/intel/deviceplugins',
       '/intel/nodes',

@@ -12,7 +12,7 @@
  *
  * Pages surfaced:
  *   - TPU sidebar: Overview / Nodes / Workloads / Device Plugin /
- *     Topology / Metrics
+ *     Topology / Metrics / Trends / Fleet
  *   - Intel sidebar: Overview / Device Plugins / Nodes / Pods / Metrics
  *     (the reference's five views)
  *   - Native Node detail page: Cloud TPU + Intel GPU sections
@@ -35,6 +35,7 @@ import { TpuDataProvider } from './api/TpuDataContext';
 import { buildNodeIntelColumns } from './components/integrations/IntelNodeColumns';
 import { buildNodeTpuColumns } from './components/integrations/NodeColumns';
 import DevicePluginsPage from './components/DevicePluginsPage';
+import FleetPage from './components/FleetPage';
 import IntelDevicePluginsPage from './components/intel/IntelDevicePluginsPage';
 import IntelMetricsPage from './components/intel/IntelMetricsPage';
 import IntelNodeDetailSection from './components/intel/IntelNodeDetailSection';
@@ -119,6 +120,14 @@ registerSidebarEntry({
   icon: 'mdi:chart-timeline-variant',
 });
 
+registerSidebarEntry({
+  parent: 'tpu',
+  name: 'tpu-fleet',
+  label: 'Fleet',
+  url: '/tpu/fleet',
+  icon: 'mdi:file-tree',
+});
+
 // ---------------------------------------------------------------------------
 // Routes (registration.py:156-163)
 // ---------------------------------------------------------------------------
@@ -201,6 +210,18 @@ registerRoute({
   // TrendsPage runs its own scrape cycle into a browser-side ring
   // (the client analogue of the server's ADR-018 history store).
   component: () => <TrendsPage />,
+});
+
+registerRoute({
+  path: '/tpu/fleet',
+  sidebar: 'tpu-fleet',
+  name: 'tpu-fleet',
+  exact: true,
+  component: () => (
+    <TpuDataProvider>
+      <FleetPage />
+    </TpuDataProvider>
+  ),
 });
 
 // ---------------------------------------------------------------------------

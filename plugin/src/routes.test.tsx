@@ -33,7 +33,7 @@ afterEach(() => {
 /** Mount every captured route, asserting the count first so a broken
  * registration can never turn these into zero-iteration green runs. */
 function mountAll() {
-  expect(captured.routes).toHaveLength(12);
+  expect(captured.routes).toHaveLength(13);
   for (const route of captured.routes) {
     const Component = route.component as React.ComponentType;
     const { container, unmount } = render(<Component />);
@@ -45,18 +45,18 @@ function mountAll() {
 }
 
 describe('route components', () => {
-  it('all twelve mount on the mixed fixture without throwing', () => {
+  it('all thirteen mount on the mixed fixture without throwing', () => {
     const { fleet } = loadFixture('mixed');
     setMockCluster({ nodes: fleet.nodes, pods: fleet.pods });
     mountAll();
   });
 
-  it('all twelve also mount on an empty cluster (empty-state branches)', () => {
+  it('all thirteen also mount on an empty cluster (empty-state branches)', () => {
     setMockCluster({ nodes: [], pods: [] });
     mountAll();
   });
 
-  it('all twelve survive a cluster that fails every imperative path', () => {
+  it('all thirteen survive a cluster that fails every imperative path', () => {
     // RBAC-style outage: reactive lists error, every ApiProxy call
     // throws. Pages must render their error/degraded branches, never
     // a crash — the ADR-003 contract end-to-end.

@@ -109,12 +109,12 @@ class TestNodeColumns:
 class TestRegistration:
     def test_full_surface_registered(self):
         reg = register_plugin()
-        # TPU: root + 7 children; Intel: root + 5 children; native
+        # TPU: root + 8 children; Intel: root + 5 children; native
         # Cluster surface: root + 1 child.
-        assert len(reg.sidebar_entries) == 16
+        assert len(reg.sidebar_entries) == 17
         tpu_paths = {
             "/tpu", "/tpu/nodes", "/tpu/pods", "/tpu/deviceplugins",
-            "/tpu/topology", "/tpu/metrics", "/tpu/trends",
+            "/tpu/topology", "/tpu/metrics", "/tpu/trends", "/tpu/fleet",
         }
         intel_paths = {
             "/intel", "/intel/nodes", "/intel/pods", "/intel/deviceplugins",

@@ -222,7 +222,7 @@ class TestHeadlampPluginSurface:
         assert "@kinvolk/headlamp-plugin" in pkg["devDependencies"]
         assert "react" in pkg["peerDependencies"]
 
-    @pytest.mark.parametrize("prefix, expected_count", [("/tpu", 7), ("/intel", 5)])
+    @pytest.mark.parametrize("prefix, expected_count", [("/tpu", 8), ("/intel", 5)])
     def test_every_provider_route_registered(
         self, index_source, python_registry, prefix, expected_count
     ):

@@ -1,0 +1,697 @@
+"""ADR-026 viewport layer: drill-down tree, seek cursors, windowed
+tables, per-region push, window-scoped ETags, and the VPT001 ratchet.
+
+The claims this file pins, in the order the layer serves them:
+
+  1. Region identity is total and canonical — every node lands in
+     exactly one cluster/slice path, and the path grammar round-trips.
+  2. Seek cursors survive churn: a surviving row is never skipped or
+     repeated when nodes appear or vanish between windows, and for a
+     pinned generation the windows tile the fleet exactly.
+  3. The drill-down rollups match a direct Python sum over the same
+     snapshot — whatever source ("device" or "host") produced them.
+  4. Per-region push frames name only the regions a change touched,
+     and a region subscriber's resume fallback is a REGION paint, not
+     a full-fleet one.
+  5. Windowed responses get window-scoped ETags (two different windows
+     of one generation must not share a validator), while bare paths
+     keep the historic ETag shape byte-for-byte.
+  6. An ADR-025 replica serves windowed paints byte-identical to its
+     leader — the windowing layer is a pure function of the snapshot.
+  7. The AOT bucket table covers every bench_viewport fleet size, so a
+     benched paint never pays a request-path compile.
+  8. VPT001 fires on full-fleet iteration inside pages/ and stays
+     quiet on the viewport-routed twin.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from analysis.engine import Engine  # noqa: E402
+from analysis.rules.viewport import ViewportIterationRule  # noqa: E402
+
+from headlamp_tpu.context import AcceleratorDataContext
+from headlamp_tpu.fleet import fixtures as fx
+from headlamp_tpu.history.store import HistoryStore
+from headlamp_tpu.push.conditional import etag_for, window_token
+from headlamp_tpu.push.differ import (
+    PAGES,
+    REGION_PAGE_PREFIX,
+    build_page_models,
+    diff_models,
+)
+from headlamp_tpu.replicate import BusPublisher, ReplicaApp, parse_payload
+from headlamp_tpu.server import DashboardApp, make_demo_transport
+from headlamp_tpu.viewport import (
+    decode_cursor,
+    encode_cursor,
+    node_region,
+    parse_region,
+    region_path,
+    viewport_tree,
+    window_nodes,
+    window_pods,
+    window_series,
+)
+from headlamp_tpu.viewport.cursor import SORT_NODES
+from headlamp_tpu.viewport.tree import NO_SLICE, _assignments, _host_sums
+
+
+def state_of(fleet):
+    snap = AcceleratorDataContext(fx.fleet_transport(fleet)).sync()
+    return snap.provider("tpu")
+
+
+def snap_of(fleet):
+    return AcceleratorDataContext(fx.fleet_transport(fleet)).sync()
+
+
+def small_fleet(names_ready, pods=()):
+    nodes = [
+        fx.make_tpu_node(name, pool="pool-a", ready=ready)
+        for name, ready in names_ready
+    ]
+    return {"nodes": nodes, "pods": list(pods), "daemonsets": []}
+
+
+# ---------------------------------------------------------------------------
+# 1. Region identity
+# ---------------------------------------------------------------------------
+
+
+class TestRegionIdentity:
+    def test_path_grammar_round_trips(self):
+        assert parse_region(region_path("3")) == ("3", None)
+        assert parse_region(region_path("3", "pool-x")) == ("3", "pool-x")
+        assert parse_region("/cluster/a/slice/b/") == ("a", "b")
+
+    def test_non_canonical_paths_parse_to_none(self):
+        for bad in (
+            "",
+            "cluster",
+            "cluster/",
+            "cluster/a/slice/",
+            "cluster//slice/b",
+            "slice/b",
+            "cluster/a/b/c",
+            "nodes/all",
+        ):
+            assert parse_region(bad) is None, bad
+
+    def test_node_region_is_total(self):
+        labelled = fx.make_tpu_node("n1", pool="p1", cluster="east")
+        assert node_region(labelled) == ("east", "p1")
+        # No federation label, no pool: the single-cluster defaults.
+        bare = fx.make_tpu_node("n2", pool=None)
+        assert node_region(bare) == ("0", NO_SLICE)
+
+
+# ---------------------------------------------------------------------------
+# 2. Cursor codec
+# ---------------------------------------------------------------------------
+
+
+class TestCursorCodec:
+    def test_round_trip(self):
+        token = encode_cursor(
+            generation=7, sort=SORT_NODES, query="abc", last_key=(1, "n05")
+        )
+        cur = decode_cursor(token)
+        assert cur is not None
+        assert cur.generation == 7
+        assert cur.sort == SORT_NODES
+        assert cur.last_key == (1, "n05")
+        # Bound to the filter, not carrying it: only the hash rides.
+        assert cur.query_hash == decode_cursor(
+            encode_cursor(generation=0, sort="x", query="abc", last_key=())
+        ).query_hash
+
+    def test_malformed_tokens_decode_to_none(self):
+        good = encode_cursor(
+            generation=1, sort=SORT_NODES, query="", last_key=(1, "a")
+        )
+        for bad in (
+            "",
+            "!!!not-base64!!!",
+            good[:-4] + "XXXX",  # tampered payload
+            "x" * 600,  # over the hard cap
+            encode_cursor(generation=1, sort="s", query="", last_key=()).replace(
+                "e", "Q"
+            ),
+        ):
+            assert decode_cursor(bad) is None or bad == good
+
+    def test_wrong_shapes_rejected(self):
+        import base64
+
+        def tok(payload):
+            raw = json.dumps(payload).encode()
+            return base64.urlsafe_b64encode(raw).decode().rstrip("=")
+
+        assert decode_cursor(tok([1, 2, 3])) is None
+        assert decode_cursor(tok({"g": "1", "s": "rn", "q": "x", "k": []})) is None
+        assert decode_cursor(tok({"g": 1, "s": "rn", "q": "x", "k": [[1]]})) is None
+        assert decode_cursor(tok({"g": 1, "s": "rn", "q": "x"})) is None
+
+
+# ---------------------------------------------------------------------------
+# 3. Windowing: tiling, churn, filters
+# ---------------------------------------------------------------------------
+
+
+class TestWindowNodes:
+    NAMES = [f"n{i:02d}" for i in range(10)]
+
+    def test_windows_tile_a_pinned_generation_exactly(self):
+        state = state_of(small_fleet([(n, True) for n in self.NAMES]))
+        seen, cursor, pages = [], None, 0
+        while True:
+            win = window_nodes(state, limit=3, cursor=cursor)
+            seen.extend(
+                n["metadata"]["name"] for n in win.rows
+            )
+            pages += 1
+            assert win.total == 10
+            if win.next_cursor is None:
+                break
+            cursor = win.next_cursor
+        assert seen == self.NAMES  # every node once, in sort order
+        assert pages == 4  # 3+3+3+1
+
+    def test_not_ready_sorts_first(self):
+        state = state_of(
+            small_fleet([("n00", True), ("n01", False), ("n02", True)])
+        )
+        win = window_nodes(state, limit=10)
+        names = [n["metadata"]["name"] for n in win.rows]
+        assert names == ["n01", "n00", "n02"]
+
+    def test_churn_never_skips_or_repeats_survivors(self):
+        state1 = state_of(small_fleet([(n, True) for n in self.NAMES]))
+        first = window_nodes(state1, limit=3)
+        page1 = [n["metadata"]["name"] for n in first.rows]
+        assert page1 == ["n00", "n01", "n02"]
+        # Churn between requests: n04 vanishes, n021 appears (sorts
+        # inside the unseen remainder).
+        churned = [n for n in self.NAMES if n != "n04"] + ["n021"]
+        state2 = state_of(small_fleet([(n, True) for n in churned]))
+        rest = window_nodes(state2, limit=100, cursor=first.next_cursor)
+        names = [n["metadata"]["name"] for n in rest.rows]
+        assert names == ["n021", "n03", "n05", "n06", "n07", "n08", "n09"]
+        # No survivor skipped or repeated across the two windows.
+        assert not (set(page1) & set(names))
+        assert set(page1) | set(names) == (set(churned) | {"n04"}) - {"n04"}
+
+    def test_cursor_ignored_across_filters_and_sorts(self):
+        state = state_of(small_fleet([(n, True) for n in self.NAMES]))
+        first = window_nodes(state, limit=3)
+        # Replayed under a different filter: starts from the top.
+        refiltered = window_nodes(
+            state, limit=100, cursor=first.next_cursor, query="n0"
+        )
+        assert refiltered.start == 0
+        # A pods cursor never seeks a nodes window.
+        pods_cursor = encode_cursor(
+            generation=0, sort="nn", query="", last_key=("zzz",)
+        )
+        assert window_nodes(state, limit=3, cursor=pods_cursor).start == 0
+
+    def test_malformed_cursor_degrades_to_page_one(self):
+        state = state_of(small_fleet([(n, True) for n in self.NAMES]))
+        win = window_nodes(state, limit=4, cursor="%%%garbage%%%")
+        assert win.start == 0 and len(win.rows) == 4
+
+    def test_limit_clamped_to_bounds(self):
+        state = state_of(small_fleet([(n, True) for n in self.NAMES]))
+        assert window_nodes(state, limit=0).limit == 1
+        assert window_nodes(state, limit=10_000).limit == 512
+
+    def test_query_filters_before_windowing(self):
+        state = state_of(small_fleet([(n, True) for n in self.NAMES]))
+        win = window_nodes(state, limit=100, query="n0")
+        assert win.total == 10  # all names share the prefix
+        win = window_nodes(state, limit=100, query="n09")
+        assert win.total == 1
+
+
+class TestWindowPodsAndSeries:
+    def test_pods_sorted_by_namespaced_name(self):
+        pods = [
+            fx.make_tpu_pod("b-pod", namespace="zz", node="n00"),
+            fx.make_tpu_pod("a-pod", namespace="ml", node="n00"),
+            fx.make_tpu_pod("c-pod", namespace="ml", node="n01"),
+        ]
+        state = state_of(
+            small_fleet([("n00", True), ("n01", True)], pods=pods)
+        )
+        win = window_pods(state, limit=10)
+        labels = [
+            f"{p['metadata']['namespace']}/{p['metadata']['name']}"
+            for p in win.rows
+        ]
+        assert labels == ["ml/a-pod", "ml/c-pod", "zz/b-pod"]
+
+    def test_series_window_pages_by_label(self):
+        # Inserted in reverse; windows come out in label order and tile.
+        pairs = [(f"s{i:02d}", i) for i in reversed(range(7))]
+        items_seen, cursor = [], None
+        while True:
+            win = window_series(pairs, limit=3, cursor=cursor)
+            items_seen.extend(win.rows)
+            assert win.total == 7
+            if win.next_cursor is None:
+                break
+            cursor = win.next_cursor
+        assert items_seen == [0, 1, 2, 3, 4, 5, 6]
+
+
+# ---------------------------------------------------------------------------
+# 4. Drill-down tree vs the Python oracle
+# ---------------------------------------------------------------------------
+
+
+class TestViewportTree:
+    @pytest.fixture(scope="class")
+    def state(self):
+        return state_of(fx.fleet_viewport(256, clusters=4))
+
+    def test_rollups_match_direct_sums(self, state):
+        tree = viewport_tree(state)
+        assert tree.source in ("device", "host")
+        region_of, _clusters, _slices, cluster_id, slice_id = _assignments(
+            state.nodes
+        )
+        cluster_oracle, slice_oracle = _host_sums(
+            state, cluster_id, slice_id, region_of, 64
+        )
+        for cluster in tree.clusters:
+            assert cluster.stats == cluster_oracle[cluster_id[cluster.key]]
+            for slc in cluster.children:
+                pair = (cluster.key, slc.key)
+                assert slc.stats == slice_oracle[slice_id[pair]]
+
+    def test_cluster_totals_are_slice_sums(self, state):
+        tree = viewport_tree(state)
+        for cluster in tree.clusters:
+            for key in ("nodes", "ready", "capacity", "in_use", "pending"):
+                assert cluster.stats[key] == sum(
+                    c.stats[key] for c in cluster.children
+                ), (cluster.path, key)
+        assert tree.total["nodes"] == len(state.nodes) == 256
+
+    def test_members_partition_the_fleet(self, state):
+        tree = viewport_tree(state)
+        slice_members = [
+            tree.members[slc.path]
+            for cluster in tree.clusters
+            for slc in cluster.children
+        ]
+        flat = [name for names in slice_members for name in names]
+        assert sorted(flat) == sorted(tree.region_of)
+        assert len(flat) == len(set(flat))  # disjoint
+
+    def test_tree_memoized_on_view(self, state):
+        assert viewport_tree(state) is viewport_tree(state)
+
+    def test_region_windowing_restricts_to_members(self, state):
+        tree = viewport_tree(state)
+        slc = tree.clusters[0].children[0]
+        win = window_nodes(state, limit=512, region=slc.path)
+        assert win.total == slc.stats["nodes"]
+        member = set(tree.members[slc.path])
+        assert all(n["metadata"]["name"] in member for n in win.rows)
+
+    def test_small_fleet_uses_host_source(self):
+        state = state_of(fx.fleet_mixed())
+        tree = viewport_tree(state)
+        assert tree.source == "host"
+        assert tree.total["nodes"] == len(state.nodes)
+
+
+# ---------------------------------------------------------------------------
+# 5. Per-region push frames
+# ---------------------------------------------------------------------------
+
+
+def two_cluster_fleet(flip_ready: bool = False):
+    nodes, pods = [], []
+    for ck in ("0", "1"):
+        for sk in ("a", "b"):
+            for w in range(3):
+                name = f"c{ck}{sk}-w{w}"
+                ready = not (
+                    flip_ready and (ck, sk, w) == ("0", "a", 0)
+                )
+                nodes.append(
+                    fx.make_tpu_node(
+                        name, pool=f"pool-{sk}", cluster=ck, ready=ready
+                    )
+                )
+                pods.append(
+                    fx.make_tpu_pod(f"job-{name}", namespace="ml", node=name)
+                )
+    return {"nodes": nodes, "pods": pods, "daemonsets": []}
+
+
+class TestRegionPush:
+    def test_models_carry_region_pages_with_rollup_cells(self):
+        models = build_page_models(snap_of(two_cluster_fleet()))
+        assert set(PAGES) <= set(models)
+        cluster_key = REGION_PAGE_PREFIX + region_path("0")
+        slice_key = REGION_PAGE_PREFIX + region_path("0", "pool-a")
+        assert cluster_key in models and slice_key in models
+        slice_model = models[slice_key]
+        assert slice_model["cells"]["nodes_total"] == 3
+        assert slice_model["cells"]["nodes_ready"] == 3
+        assert slice_model["cells"]["in_use"] == 12  # 3 pods x 4 chips
+        assert models[cluster_key]["cells"]["nodes_total"] == 6
+        assert len(slice_model["rows"]) == 3
+
+    def test_single_node_change_frames_only_its_regions(self):
+        before = build_page_models(snap_of(two_cluster_fleet()))
+        after = build_page_models(snap_of(two_cluster_fleet(flip_ready=True)))
+        frames = diff_models(before, after)
+        touched = {k for k in frames if k.startswith(REGION_PAGE_PREFIX)}
+        assert touched == {
+            REGION_PAGE_PREFIX + region_path("0"),
+            REGION_PAGE_PREFIX + region_path("0", "pool-a"),
+        }
+        slice_frame = frames[REGION_PAGE_PREFIX + region_path("0", "pool-a")]
+        # One row, one changed cell — the frame tracks the CHANGE, not
+        # the fleet (the bench pins byte independence across sizes).
+        assert list(slice_frame["rows"]) == ["c0a-w0"]
+        assert slice_frame["cells"] == {"nodes_ready": 2}
+
+    def test_open_event_stream_scopes_to_region(self):
+        app = DashboardApp(make_demo_transport(), min_sync_interval_s=0.0)
+        state = AcceleratorDataContext(make_demo_transport()).sync().provider(
+            "tpu"
+        )
+        path = viewport_tree(state).clusters[0].path
+        sub = app.open_event_stream(f"/events?region={path}")
+        assert sub.pages == frozenset({REGION_PAGE_PREFIX + path})
+        # Unparseable region: honest full-fleet stream, never a 500.
+        full = app.open_event_stream("/events?region=not/a/region")
+        assert full.pages == frozenset(PAGES)
+
+    def test_region_resume_fallback_paints_the_region_only(self):
+        app = DashboardApp(make_demo_transport(), min_sync_interval_s=0.0)
+        region = "cluster/0/slice/v5e16-pool"
+        sub = app.open_event_stream(
+            f"/events?region={region}", last_event_id="g40"
+        )
+        events = list(sub.outbox)
+        assert [e["kind"] for e in events] == ["paint"]
+        assert events[0]["data"]["page"] == REGION_PAGE_PREFIX + region
+        assert events[0]["data"]["reason"] == "resync"
+        # The full-fleet subscriber's fallback repaints every page —
+        # the region subscriber's stays one region-sized event.
+        full = app.open_event_stream("/events", last_event_id="g40")
+        assert len(list(full.outbox)) == len(PAGES) > 1
+
+
+# ---------------------------------------------------------------------------
+# 6. Window-scoped ETags
+# ---------------------------------------------------------------------------
+
+
+class TestWindowedETags:
+    def test_bare_path_keeps_historic_etag_shape(self):
+        assert etag_for(3, 2, False) == '"g3-e2-d0"'
+        assert window_token("/tpu/nodes") == ""
+        assert window_token("/tpu/nodes?") == ""
+
+    def test_window_token_is_order_insensitive_and_bound_to_params(self):
+        a = window_token("/tpu/nodes?limit=64&cursor=abc")
+        b = window_token("/tpu/nodes?cursor=abc&limit=64")
+        c = window_token("/tpu/nodes?limit=65&cursor=abc")
+        assert a == b != ""
+        assert a != c
+        assert etag_for(3, 2, False, window=a) == f'"g3-e2-d0-w{a}"'
+
+    def test_gateway_etags_differ_across_windows(self):
+        # min_sync 30 s: one generation serves every request below, so
+        # the validators compare windows, not sync-bumped generations.
+        app = DashboardApp(make_demo_transport(), min_sync_interval_s=30.0)
+        gw = app.ensure_gateway(workers=1)
+        try:
+            bare = gw.handle("/tpu/nodes")
+            windowed = gw.handle("/tpu/nodes?limit=2")
+            assert bare.status == windowed.status == 200
+            bare_etag = dict(bare.headers)["ETag"]
+            win_etag = dict(windowed.headers)["ETag"]
+            assert bare_etag != win_etag
+            # Each validator answers 304 only for ITS window.
+            assert gw.handle("/tpu/nodes", if_none_match=bare_etag).status == 304
+            assert (
+                gw.handle("/tpu/nodes?limit=2", if_none_match=win_etag).status
+                == 304
+            )
+            assert (
+                gw.handle("/tpu/nodes?limit=2", if_none_match=bare_etag).status
+                == 200
+            )
+        finally:
+            gw.close()
+
+
+# ---------------------------------------------------------------------------
+# 7. Routes: /tpu/fleet drill-down and windowed dispatch
+# ---------------------------------------------------------------------------
+
+
+class TestFleetRoutes:
+    @pytest.fixture(scope="class")
+    def app(self):
+        fleet = fx.fleet_viewport(128, clusters=4)
+        return DashboardApp(fx.fleet_transport(fleet), min_sync_interval_s=0.0)
+
+    def test_root_paints_cluster_rollups_not_node_rows(self, app):
+        status, ctype, body = app.handle("/tpu/fleet")
+        assert status == 200 and "html" in ctype
+        assert "hl-breadcrumbs" in body
+        assert "Rollup source" in body
+        # 128 nodes, none named in the root paint.
+        assert "gke-c0-s0-w0" not in body
+
+    def test_cluster_level_lists_slices(self, app):
+        status, _, body = app.handle("/tpu/fleet?region=cluster/0")
+        assert status == 200
+        assert "Cluster 0" in body and "Slice" in body
+        assert "/events?region=cluster/0" in body
+
+    def test_slice_level_windows_node_rows(self, app):
+        status, _, body = app.handle(
+            "/tpu/fleet?region=cluster/0/slice/c0-slice-0&limit=5"
+        )
+        assert status == 200
+        assert "hl-cursor-window" in body
+        assert body.count("gke-c0-s0-w") <= 2 * 5  # windowed, not all 32
+        assert "/events?region=cluster/0/slice/c0-slice-0" in body
+
+    def test_unknown_region_is_a_page_not_an_error(self, app):
+        status, _, body = app.handle("/tpu/fleet?region=cluster/999")
+        assert status == 200 and "No such region" in body
+        status, _, body = app.handle("/tpu/fleet?region=bogus%2Fpath")
+        assert status == 200 and "No such region" in body
+
+    def test_nodes_windowed_dispatch_and_cursor_walk(self, app):
+        status, _, body = app.handle("/tpu/nodes?limit=5")
+        assert status == 200 and "hl-cursor-window" in body
+        match = re.search(r"cursor=([A-Za-z0-9_\-]+)", body)
+        assert match, "expected a next-cursor link"
+        status, _, page2 = app.handle(f"/tpu/nodes?limit=5&cursor={match.group(1)}")
+        assert status == 200
+        # The window position advances — the table walked, not reset.
+        # (Node NAMES recur in the body: the capped detail-card section
+        # is cursor-independent by design.)
+        assert "rows 1–5 of 128" in body
+        assert "rows 6–10 of 128" in page2
+        assert "⇤ start" in page2 and "⇤ start" not in body
+
+    def test_legacy_offset_paging_untouched(self, app):
+        # No limit/cursor: the pre-ADR-026 offset pager, byte-pinned by
+        # test_scale, still answers.
+        status, _, body = app.handle("/tpu/nodes?page=2")
+        assert status == 200
+        assert "hl-cursor-window" not in body
+
+    def test_pods_windowed_dispatch(self, app):
+        status, _, body = app.handle("/tpu/pods?limit=5")
+        assert status == 200 and "hl-cursor-window" in body
+
+
+# ---------------------------------------------------------------------------
+# 8. Trends browse mode
+# ---------------------------------------------------------------------------
+
+
+class TestTrendsBrowse:
+    def make_store(self):
+        clock = {"now": 1000.0}
+        store = HistoryStore(monotonic=lambda: clock["now"])
+        for i in range(12):
+            store.append("m", float(i), labels=(f"n{i:02d}",))
+        clock["now"] += 1.0
+        return store
+
+    def test_browse_view_windows_every_series(self):
+        store = self.make_store()
+        view = store.trend_view(window_s=3600.0, metric="m", series_limit=5)
+        assert view["groups"] == []
+        browse = view["browse"]
+        assert browse["metric"] == "m"
+        win = browse["window"]
+        assert win.total == 12 and len(browse["series"]) == 5
+        labels = [s["label"] for s in browse["series"]]
+        assert labels == sorted(labels)
+        # The cursor reaches everything the busiest-N cap would hide.
+        seen = list(labels)
+        cursor = win.next_cursor
+        while cursor:
+            view = store.trend_view(
+                window_s=3600.0, metric="m", series_limit=5, series_cursor=cursor
+            )
+            seen.extend(s["label"] for s in view["browse"]["series"])
+            cursor = view["browse"]["window"].next_cursor
+        assert seen == sorted(f"n{i:02d}" for i in range(12))
+
+    def test_unknown_metric_browses_empty(self):
+        store = self.make_store()
+        view = store.trend_view(window_s=3600.0, metric="nope")
+        assert view["browse"]["window"].total == 0
+
+    def test_trends_page_links_grouped_and_browse_modes(self):
+        app = DashboardApp(make_demo_transport("v5p32"), min_sync_interval_s=0.0)
+        app.handle("/tpu/metrics")  # capture per-chip series
+        status, _, grouped = app.handle("/tpu/trends")
+        assert status == 200 and "hl-browse-all" in grouped
+        status, _, browse = app.handle(
+            "/tpu/trends?metric=chip.tensorcore_utilization&limit=2"
+        )
+        assert status == 200
+        assert "all metrics" in browse
+        assert "hl-cursor-window" in browse
+
+
+# ---------------------------------------------------------------------------
+# 9. Leader/replica windowed byte-identity (ADR-025 x ADR-026)
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaWindowedParity:
+    def make_pair(self):
+        fleet = fx.fleet_viewport(128, clusters=4)
+        app = DashboardApp(fx.fleet_transport(fleet), min_sync_interval_s=30.0)
+        pub = BusPublisher()
+        app.replication = pub
+        app._synced_snapshot()
+        rep = ReplicaApp()
+        _, records = parse_payload(pub.payload_after(None))
+        for record in records:
+            rep.apply_record(record)
+        return app, rep
+
+    def test_windowed_paints_byte_identical(self):
+        app, rep = self.make_pair()
+        assert rep.snapshot_generation() == app.snapshot_generation()
+        paths = [
+            "/tpu/nodes?limit=7",
+            "/tpu/pods?limit=7",
+            "/tpu/fleet",
+            "/tpu/fleet?region=cluster/0",
+            "/tpu/fleet?region=cluster/0/slice/c0-slice-0&limit=5",
+        ]
+        for path in paths:
+            assert rep.handle(path) == app.handle(path), path
+        # Cursors minted by the leader seek identically on the replica.
+        _, _, body = app.handle("/tpu/nodes?limit=7")
+        token = re.search(r"cursor=([A-Za-z0-9_\-]+)", body).group(1)
+        follow = f"/tpu/nodes?limit=7&cursor={token}"
+        assert rep.handle(follow) == app.handle(follow)
+
+
+# ---------------------------------------------------------------------------
+# 10. AOT bucket coverage (the request_compiles()==0 guarantee)
+# ---------------------------------------------------------------------------
+
+
+class TestBucketCoverage:
+    def test_viewport_buckets_have_no_gaps(self):
+        from headlamp_tpu.models.aot import viewport_bucket_gaps
+
+        assert viewport_bucket_gaps() == []
+
+    def test_pow2_twin_matches_encoder_bucket(self):
+        from headlamp_tpu.analytics.encode import _bucket
+        from headlamp_tpu.models.aot import _pow2_bucket
+
+        for n in (0, 1, 7, 8, 9, 255, 256, 257, 1000, 1024, 4096, 12288, 16384):
+            assert _pow2_bucket(n) == _bucket(n), n
+
+    def test_viewport_fixture_shapes_land_on_square_buckets(self):
+        from headlamp_tpu.analytics.encode import _bucket
+        from headlamp_tpu.models.aot import ROLLUP_BUCKETS
+
+        for n in (1024, 4096):
+            fleet = fx.fleet_viewport(n)
+            pair = (_bucket(len(fleet["nodes"])), _bucket(len(fleet["pods"])))
+            assert pair == (n, n)
+            assert pair in ROLLUP_BUCKETS
+
+
+# ---------------------------------------------------------------------------
+# 11. VPT001 mutation pairs
+# ---------------------------------------------------------------------------
+
+
+FIRES = '''\
+def page(state, snap):
+    for n in state.nodes:
+        print(n)
+    names = [p for p in state.pods]
+    ordered = sorted(snap.all_nodes or [])
+    return names, ordered
+'''
+
+CLEAN = '''\
+from headlamp_tpu.viewport import window_nodes, window_pods
+
+def page(state):
+    win = window_nodes(state, limit=64)
+    pods = window_pods(state, limit=64)
+    return len(state.nodes), win.rows, pods.rows
+'''
+
+
+class TestVPT001:
+    def run_on(self, tmp_path, source, relpath="headlamp_tpu/pages/x.py"):
+        target = tmp_path / relpath
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(source)
+        result = Engine([ViewportIterationRule()], root=str(tmp_path)).run()
+        return result.diagnostics
+
+    def test_fires_on_loops_comprehensions_and_builtins(self, tmp_path):
+        diags = self.run_on(tmp_path, FIRES)
+        assert len(diags) == 3
+        assert {d.line for d in diags} == {2, 4, 5}
+        assert all(d.rule == "VPT001" for d in diags)
+        assert "O(fleet)" in diags[0].message
+
+    def test_clean_on_viewport_routed_twin(self, tmp_path):
+        assert self.run_on(tmp_path, CLEAN) == []
+
+    def test_scope_is_pages_only(self, tmp_path):
+        diags = self.run_on(
+            tmp_path, FIRES, relpath="headlamp_tpu/viewport/x.py"
+        )
+        assert diags == []

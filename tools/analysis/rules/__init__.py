@@ -21,6 +21,7 @@ from .slo_observation import SloObservationRule
 from .thread_spawn import ThreadSpawnRule
 from .transitive_blocking import TransitiveLockBlockingRule
 from .unregistered_jit import UnregisteredJitRule
+from .viewport import ViewportIterationRule
 from .wall_clock import WallClockRule
 
 
@@ -47,6 +48,9 @@ def all_rules() -> list[Rule]:
         GuardedByRule(),
         CheckThenActRule(),
         PublishThenMutateRule(),
+        # ADR-026 viewport discipline: pages paint O(viewport), not
+        # O(fleet); legacy full-fleet surfaces are baselined.
+        ViewportIterationRule(),
     ]
 
 
@@ -67,4 +71,5 @@ RULE_IDS = {
     "GRD001": GuardedByRule,
     "GRD002": CheckThenActRule,
     "PUB001": PublishThenMutateRule,
+    "VPT001": ViewportIterationRule,
 }

@@ -1,0 +1,113 @@
+"""VPT001 — pages must not iterate the full node/pod list.
+
+ADR-026's contract is O(viewport): what a page renders is bounded by
+what the viewer sees, never by fleet size. The enforcement half lives
+here: inside ``headlamp_tpu/pages/`` any direct iteration over a
+``nodes``/``pods``/``all_nodes``/``all_pods`` collection — a ``for``
+loop, a comprehension generator, or an iterating builtin call
+(``sorted``/``list``/``sum``/…) — is a paint whose cost grows with the
+fleet, and belongs in the viewport layer's per-generation memos
+instead. ``len()`` stays legal: counting is O(1) and every summary
+header needs it.
+
+Legacy full-fleet surfaces (the offset pager, the Intel provider pages,
+native drill-downs) are grandfathered through the baseline with
+reasons, so the rule ratchets: existing debt is inventoried, new debt
+fails the run.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Diagnostic, FileContext, Rule
+
+#: Collection names whose full iteration the rule gates. Terminal-name
+#: matching on purpose: ``state.nodes``, ``view.pods``,
+#: ``snap.all_nodes`` and a bare ``nodes`` parameter are all the same
+#: O(fleet) walk to a page.
+TARGET_NAMES = frozenset({"nodes", "pods", "all_nodes", "all_pods"})
+
+#: Builtins that consume their iterable argument in full. ``len`` is
+#: deliberately absent (O(1) on lists).
+ITERATING_BUILTINS = frozenset(
+    {
+        "all",
+        "any",
+        "enumerate",
+        "filter",
+        "list",
+        "map",
+        "max",
+        "min",
+        "reversed",
+        "set",
+        "sorted",
+        "sum",
+        "tuple",
+    }
+)
+
+MESSAGE = (
+    "page iterates the full {name} list — O(fleet) paint; route the "
+    "selection through the viewport layer (window_*/pods_by_node, "
+    "ADR-026)"
+)
+
+
+def _target_name(expr: ast.AST) -> str | None:
+    """The gated collection name if ``expr`` reads one, else None.
+    Unwraps the ``xs or []`` / ``xs or ()`` default idiom — the guard
+    changes emptiness handling, not the O(fleet) walk."""
+    if isinstance(expr, ast.BoolOp):
+        for value in expr.values:
+            name = _target_name(value)
+            if name is not None:
+                return name
+        return None
+    if isinstance(expr, ast.Name) and expr.id in TARGET_NAMES:
+        return expr.id
+    if isinstance(expr, ast.Attribute) and expr.attr in TARGET_NAMES:
+        return expr.attr
+    return None
+
+
+class ViewportIterationRule(Rule):
+    rule_id = "VPT001"
+    name = "no-full-fleet-iteration-in-pages"
+    description = "Pages render O(viewport), never O(fleet) (ADR-026)"
+    top_dirs = ("headlamp_tpu",)
+    scope_dirs = ("headlamp_tpu/pages",)
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        out: list[Diagnostic] = []
+
+        def flag(expr: ast.AST, line: int) -> None:
+            name = _target_name(expr)
+            if name is not None:
+                out.append(
+                    Diagnostic(
+                        self.rule_id,
+                        ctx.relpath,
+                        line,
+                        MESSAGE.format(name=name),
+                        context=ctx.enclosing_qualname(line),
+                    )
+                )
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                flag(node.iter, node.lineno)
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+            ):
+                for gen in node.generators:
+                    flag(gen.iter, node.lineno)
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ITERATING_BUILTINS
+            ):
+                for arg in node.args:
+                    flag(arg, node.lineno)
+        return out
