@@ -1789,6 +1789,81 @@ def bench_push(fleet) -> dict:
     return out
 
 
+def bench_fragment_cache(fleet) -> dict:
+    """ADR-027 acceptance numbers: the incremental fragment renderer in
+    its steady state — one shared app, injected frozen clock, long
+    min-sync, so repeated paints exercise splice-from-cache instead of
+    resync + rebuild. Reports:
+
+    - ``fragment_cache_hit_rate`` — boundary-cache hit rate across the
+      warm window (acceptance: ≈ 1.0 on a quiet fleet; every row/card/
+      cell-group boundary splices from cached bytes).
+    - ``fragment_paint_warm_ms`` / ``fragment_paint_nofrag_ms`` — warm
+      5-page paint p50 with the fragment cache on vs the non-incremental
+      oracle (``fragments=False``), same fixture and frozen clock; the
+      ratio is what O(changed) rendering is worth per quiet paint.
+    - ``fragment_paint_identical`` — byte-equality of the warm
+      ``/tpu/nodes`` paint across the two apps (the ADR-027 correctness
+      contract, spot-checked in-run; tests own the full matrix)."""
+    from headlamp_tpu.fleet import fixtures as fx
+    from headlamp_tpu.server import DashboardApp
+    from headlamp_tpu.server.app import add_demo_prometheus
+
+    paths = ("/tpu", "/tpu/nodes", "/tpu/pods", "/tpu/metrics", "/tpu/fleet")
+
+    def shared_app(**kwargs):
+        t = fx.fleet_transport(fleet)
+        add_demo_prometheus(t, fleet)
+        now = [50_000.0]
+        return DashboardApp(
+            t,
+            min_sync_interval_s=3600.0,
+            clock=lambda: now[0],
+            monotonic=lambda: now[0],
+            **kwargs,
+        )
+
+    def warm_p50(app) -> float:
+        for p in paths:  # cold fill: sync + caches + first render
+            status, _, body = app.handle(p)
+            assert status == 200 and body
+        samples = []
+        for _ in range(9):
+            t0 = time.perf_counter()
+            for p in paths:
+                status, _, body = app.handle(p)
+                assert status == 200 and body
+            samples.append((time.perf_counter() - t0) * 1000)
+        return statistics.median(samples)
+
+    app = shared_app()
+    hits0, misses0 = None, None
+    for p in paths:
+        app.handle(p)
+    hits0, misses0 = app.fragments.hits, app.fragments.misses
+    warm_ms = warm_p50(app)
+    d_hits = app.fragments.hits - hits0
+    d_misses = app.fragments.misses - misses0
+    hit_rate = d_hits / (d_hits + d_misses) if (d_hits + d_misses) else None
+
+    oracle = shared_app(fragments=False)
+    nofrag_ms = warm_p50(oracle)
+
+    _, _, warm_body = app.handle("/tpu/nodes")
+    _, _, oracle_body = oracle.handle("/tpu/nodes")
+    identical = warm_body == oracle_body
+    assert identical, "incremental /tpu/nodes diverged from the oracle paint"
+
+    return {
+        "fragment_cache_hit_rate": round(hit_rate, 4) if hit_rate is not None else None,
+        "fragment_paint_warm_ms": round(warm_ms, 2),
+        "fragment_paint_nofrag_ms": round(nofrag_ms, 2),
+        "fragment_paint_identical": identical,
+        "fragment_cache_entries": len(app.fragments),
+        "fragment_cache_bytes": app.fragments.bytes,
+    }
+
+
 def bench_viewport() -> dict:
     """ADR-026 acceptance numbers: serving stays O(viewport) as the
     fleet grows 1k → 4k → 16k. Socketless ``app.handle`` on purpose —
@@ -2590,6 +2665,7 @@ def main() -> None:
     gateway = bench_gateway(fleet)
     replication = bench_replication(fleet)
     push = bench_push(fleet)
+    fragments = bench_fragment_cache(fleet)
     # Not exception-wrapped: bench_viewport's own AOT/ledger block is
     # the only jax-dependent part and it degrades internally, so any
     # raise here is a real ADR-026 acceptance failure.
@@ -2642,6 +2718,7 @@ def main() -> None:
             **gateway,
             **replication,
             **push,
+            **fragments,
             **viewport,
             **history,
             **profiler_numbers,

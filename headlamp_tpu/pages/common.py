@@ -7,6 +7,7 @@ cells) — hoisted here so six pages don't carry six copies.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Mapping
 
 from ..context.accelerator_context import ClusterSnapshot, ProviderState
@@ -237,8 +238,15 @@ def plugin_not_detected_box(state: ProviderState) -> Element:
     """Install guidance when no plugin evidence exists
     (`OverviewPage.tsx:171-196` shows the Helm hint for Intel; the TPU
     guidance points at GKE node-pool creation, which installs the
-    device plugin automatically)."""
-    if state.provider.name == "tpu":
+    device plugin automatically). Pure function of the provider's
+    (name, display_name) — built once per provider, not per paint
+    (elements are immutable, so sharing the tree is safe)."""
+    return _plugin_not_detected_box(state.provider.name, state.provider.display_name)
+
+
+@functools.lru_cache(maxsize=16)
+def _plugin_not_detected_box(name: str, display_name: str) -> Element:
+    if name == "tpu":
         hint = (
             "TPU device plugin not detected. On GKE, create a TPU node pool "
             "(gcloud container node-pools create --machine-type=ct5lp-hightpu-4t …); "
@@ -253,7 +261,7 @@ def plugin_not_detected_box(state: ProviderState) -> Element:
     return h(
         "div",
         {"class_": "hl-notice hl-plugin-missing"},
-        h("h3", None, f"{state.provider.display_name} Plugin Not Detected"),
+        h("h3", None, f"{display_name} Plugin Not Detected"),
         h("p", None, hint),
     )
 

@@ -24,6 +24,7 @@ from ..ui import (
     SimpleTable,
     StatusLabel,
     UtilizationBar,
+    fragment,
     h,
 )
 from ..ui.vdom import Element
@@ -119,6 +120,61 @@ def no_data_box(snap: TpuMetricsSnapshot) -> Element:
             "returned no TPU series. Check that the tpu-device-plugin "
             "metrics endpoint is being scraped (PodMonitoring/ServiceMonitor) "
             "and that TPU workloads have run recently.",
+        ),
+    )
+
+
+def _availability_salt(snap: TpuMetricsSnapshot | None) -> Any:
+    """Complete render inputs of :func:`availability_matrix` — the
+    ADR-027 salt rule: every value the subtree paints, so a stale hit
+    is impossible even if invalidation misses."""
+    if snap is None:
+        return None
+    return (
+        tuple(sorted(snap.availability.items())),
+        tuple(sorted(snap.resolved_series.items())),
+    )
+
+
+def _chip_salt(chip: Any) -> tuple:
+    """Everything :func:`chip_card` renders, in one comparable tuple."""
+    return (
+        chip.node,
+        chip.accelerator_id,
+        chip.tensorcore_utilization,
+        chip.memory_bandwidth_utilization,
+        chip.hbm_bytes_used,
+        chip.hbm_bytes_total,
+        chip.duty_cycle,
+    )
+
+
+def _forecast_salt(view: Any) -> tuple:
+    """Complete render inputs of :func:`forecast_section`. ``fit_ms``
+    is included deliberately: a refit legitimately changes the hint
+    text, so the boundary re-renders on refit and hits between them."""
+    return (
+        view.horizon_s,
+        view.window_s,
+        view.fit_ms,
+        view.fit_mse,
+        getattr(view, "data_source", "live-window"),
+        getattr(view, "inference_path", "xla"),
+        getattr(view, "inference_fallback_reason", None),
+        len(view.at_risk),
+        tuple(
+            (c.node, c.accelerator_id, c.saturation_risk) for c in view.at_risk[:5]
+        ),
+        tuple(
+            (
+                c.node,
+                c.accelerator_id,
+                c.current,
+                c.predicted_peak,
+                c.predicted_mean,
+                c.saturation_risk,
+            )
+            for c in view.chips[:16]
         ),
     )
 
@@ -243,7 +299,16 @@ def _inference_label(view: Any) -> str:
 def metrics_page(
     metrics: TpuMetricsSnapshot | None, forecast: Any | None = None
 ) -> Element:
-    children: list[Any] = [availability_matrix(metrics)]
+    # The availability matrix keys on the differ's ``cell:available``
+    # vocabulary: push evicts it when metric availability flips, and
+    # its salt covers the resolved-series map for everything subtler.
+    children: list[Any] = [
+        fragment(
+            "cell:available",
+            _availability_salt(metrics),
+            lambda: availability_matrix(metrics),
+        )
+    ]
 
     if metrics is None:
         children.append(prometheus_unreachable_box())
@@ -289,7 +354,19 @@ def metrics_page(
     )
 
     if forecast is not None:
-        children.append(forecast_section(forecast))
+        children.append(
+            fragment(
+                "cell:forecast",
+                _forecast_salt(forecast),
+                lambda: forecast_section(forecast),
+            )
+        )
 
-    children.extend(chip_card(c) for c in metrics.chips)
+    # One boundary per chip card, keyed exactly as the differ keys
+    # metrics rows — a single chip's sample moving evicts ONE card;
+    # the other 255 splice from cached bytes (ADR-027).
+    children.extend(
+        fragment(f"{c.node}/{c.accelerator_id}", _chip_salt(c), lambda c=c: chip_card(c))
+        for c in metrics.chips
+    )
     return h("div", {"class_": "hl-page hl-metrics"}, children)

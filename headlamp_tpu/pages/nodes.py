@@ -20,6 +20,7 @@ from ..ui import (
     SectionBox,
     SimpleTable,
     UtilizationBar,
+    fragment,
     h,
 )
 from ..ui.vdom import Element
@@ -83,6 +84,25 @@ def nodes_page(
         in_use, allocatable = _node_allocation(node, by_node.get(obj.name(node), []))
         return UtilizationBar(in_use, allocatable, unit="chips")
 
+    def row_salt(node: Any) -> tuple:
+        """Every summary-row cell input (ADR-027 salt-completeness):
+        the formatted age string is in here ON PURPOSE — ages tick
+        with the clock, not the generation, and a salt that omitted
+        them would splice yesterday's \"5m\" forever."""
+        name = obj.name(node)
+        in_use, allocatable = _node_allocation(node, by_node.get(name, []))
+        return (
+            name,
+            obj.is_node_ready(node),
+            tpu.get_node_accelerator(node),
+            tpu.get_node_topology(node),
+            tpu.get_node_chip_capacity(node),
+            in_use,
+            allocatable,
+            len(by_node.get(name, [])),
+            age_cell(node, now),
+        )
+
     # The summary table is paged + name-filterable past the cap (rows
     # are lighter than cards but 1024 of them still unbounds the
     # response, and a cap alone made the tail unreachable). With
@@ -126,38 +146,65 @@ def nodes_page(
                 {"label": "Age", "getter": lambda n: age_cell(n, now)},
             ],
             table_nodes,
+            row_key=obj.name,
+            row_salt=row_salt,
         ),
     )
 
     # Per-node detail cards (`NodesPage.tsx:69-139,285-291`), capped
     # not-ready-first at fleet scale.
     shown, truncation = cap_nodes_for_cards(state)
-    cards = []
-    for node in shown:
+
+    def node_card(node: Any) -> Element:
         info = obj.node_info(node)
         worker = tpu.get_node_worker_id(node)
         in_use, allocatable = _node_allocation(node, by_node.get(obj.name(node), []))
-        cards.append(
-            SectionBox(
-                obj.name(node),
-                NameValueTable(
-                    [
-                        ("Generation", tpu.format_accelerator(tpu.get_node_accelerator(node))),
-                        ("Accelerator label", tpu.get_node_accelerator(node) or "—"),
-                        ("Topology", tpu.get_node_topology(node) or "—"),
-                        ("Node pool", tpu.get_node_pool(node) or "—"),
-                        ("Worker index", worker if worker is not None else "—"),
-                        ("Chips (capacity)", tpu.get_node_chip_capacity(node)),
-                        ("Chips (allocatable)", allocatable),
-                        ("Chips in use", in_use),
-                        ("OS", info.get("osImage", "—")),
-                        ("Kernel", info.get("kernelVersion", "—")),
-                        ("Kubelet", info.get("kubeletVersion", "—")),
-                    ]
-                ),
-                class_="hl-node-card",
-            )
+        return SectionBox(
+            obj.name(node),
+            NameValueTable(
+                [
+                    ("Generation", tpu.format_accelerator(tpu.get_node_accelerator(node))),
+                    ("Accelerator label", tpu.get_node_accelerator(node) or "—"),
+                    ("Topology", tpu.get_node_topology(node) or "—"),
+                    ("Node pool", tpu.get_node_pool(node) or "—"),
+                    ("Worker index", worker if worker is not None else "—"),
+                    ("Chips (capacity)", tpu.get_node_chip_capacity(node)),
+                    ("Chips (allocatable)", allocatable),
+                    ("Chips in use", in_use),
+                    ("OS", info.get("osImage", "—")),
+                    ("Kernel", info.get("kernelVersion", "—")),
+                    ("Kubelet", info.get("kubeletVersion", "—")),
+                ]
+            ),
+            class_="hl-node-card",
         )
+
+    def card_salt(node: Any) -> tuple:
+        info = obj.node_info(node)
+        in_use, allocatable = _node_allocation(node, by_node.get(obj.name(node), []))
+        return (
+            obj.name(node),
+            tpu.get_node_accelerator(node),
+            tpu.get_node_topology(node),
+            tpu.get_node_pool(node),
+            tpu.get_node_worker_id(node),
+            tpu.get_node_chip_capacity(node),
+            allocatable,
+            in_use,
+            info.get("osImage"),
+            info.get("kernelVersion"),
+            info.get("kubeletVersion"),
+        )
+
+    # Cards key with a ``card:`` prefix: the cache namespace is shared
+    # with the summary rows above, and the same node renders DIFFERENT
+    # bytes in each. Push eviction targets the bare row key; card
+    # staleness is caught by the salt (complete inputs, compared on
+    # every paint), which is the ADR-027 correctness backstop.
+    cards = [
+        fragment(f"card:{obj.name(node)}", card_salt(node), lambda node=node: node_card(node))
+        for node in shown
+    ]
 
     return h(
         "div",

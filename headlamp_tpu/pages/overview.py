@@ -25,6 +25,7 @@ from ..ui import (
     SimpleTable,
     StatusLabel,
     UtilizationBar,
+    fragment,
     h,
 )
 from ..ui.vdom import Element
@@ -114,11 +115,15 @@ def overview_page(
     stats = state.fleet_stats()
 
     # Node summary + generation distribution (`OverviewPage.tsx:275-312`).
+    # A cell-group boundary (ADR-027): keyed on the differ's
+    # ``cell:tpu.nodes`` vocabulary, salted with every rollup value the
+    # section paints, so a stable fleet splices it from cached bytes.
     gen_counts = {
         tpu.format_generation(g): c for g, c in stats["generation_counts"].items()
     }
-    children.append(
-        SectionBox(
+
+    def nodes_section() -> Element:
+        return SectionBox(
             "TPU Nodes",
             NameValueTable(
                 [
@@ -129,12 +134,19 @@ def overview_page(
             ),
             PercentageBar(sorted(gen_counts.items())) if gen_counts else None,
         )
+
+    children.append(
+        fragment(
+            "cell:tpu.nodes",
+            (stats["nodes_total"], stats["nodes_ready"], tuple(sorted(gen_counts.items()))),
+            nodes_section,
+        )
     )
 
     # Allocation summary (`OverviewPage.tsx:316-357`) plus the fleet
     # pressure signals the rollup computes (hot = node util ≥ 90%).
-    children.append(
-        SectionBox(
+    def allocation_section() -> Element:
+        return SectionBox(
             "Chip Allocation",
             NameValueTable(
                 [
@@ -151,6 +163,20 @@ def overview_page(
             ),
             UtilizationBar(stats["in_use"], stats["capacity"], unit="chips"),
         )
+
+    children.append(
+        fragment(
+            "cell:tpu.in_use",
+            (
+                stats["capacity"],
+                stats["allocatable"],
+                stats["in_use"],
+                stats["free"],
+                stats["hot_nodes"],
+                stats["max_node_util_pct"],
+            ),
+            allocation_section,
+        )
     )
 
     # Slice health — TPU-first addition (SURVEY.md §2.3: the slice, not
@@ -159,16 +185,26 @@ def overview_page(
     if slices:
         ssum = summarize_slices(slices)
         children.append(
-            SectionBox(
-                "Pod Slices",
-                NameValueTable(
-                    [
-                        ("Slices", ssum["total"]),
-                        ("Healthy", ssum["healthy"]),
-                        ("Degraded", ssum["degraded"]),
-                        ("Incomplete", ssum["incomplete"]),
-                        ("Multi-host", ssum["multi_host"]),
-                    ]
+            fragment(
+                "slices",
+                (
+                    ssum["total"],
+                    ssum["healthy"],
+                    ssum["degraded"],
+                    ssum["incomplete"],
+                    ssum["multi_host"],
+                ),
+                lambda: SectionBox(
+                    "Pod Slices",
+                    NameValueTable(
+                        [
+                            ("Slices", ssum["total"]),
+                            ("Healthy", ssum["healthy"]),
+                            ("Degraded", ssum["degraded"]),
+                            ("Incomplete", ssum["incomplete"]),
+                            ("Multi-host", ssum["multi_host"]),
+                        ]
+                    ),
                 ),
             )
         )
@@ -176,9 +212,13 @@ def overview_page(
     # Workload phases (`OverviewPage.tsx:360-390`).
     phases = stats["phase_counts"]
     children.append(
-        SectionBox(
-            "TPU Workloads",
-            NameValueTable([(k, v) for k, v in phases.items() if v or k != "Other"]),
+        fragment(
+            "cell:tpu.pods",
+            tuple(phases.items()),
+            lambda: SectionBox(
+                "TPU Workloads",
+                NameValueTable([(k, v) for k, v in phases.items() if v or k != "Other"]),
+            ),
         )
     )
 
@@ -202,6 +242,17 @@ def overview_page(
                 ],
                 running[:ACTIVE_PODS_CAP],
                 empty_message="No running TPU pods",
+                # Bare ``ns/name`` keys — the differ's pod vocabulary —
+                # so a pod change evicts this row via the cache's
+                # key→pages index even though it lives under ``/tpu``.
+                row_key=lambda p: f"{obj.namespace(p)}/{obj.name(p)}",
+                row_salt=lambda p: (
+                    obj.namespace(p),
+                    obj.name(p),
+                    obj.pod_node_name(p),
+                    tpu.get_pod_chip_request(p),
+                    age_cell(p, now),
+                ),
             ),
         )
     )

@@ -22,6 +22,23 @@ from ..ui import (
     SimpleTable,
     h,
 )
+
+
+def _pod_key(pod: Any) -> str:
+    """The differ's pod-row vocabulary (``ns/name``) — boundary keys
+    must match it exactly for push eviction to land (ADR-027)."""
+    return f"{obj.namespace(pod)}/{obj.name(pod)}"
+
+
+def _container_chips(pod: Any) -> tuple:
+    return tuple(
+        (
+            c.get("name"),
+            obj.parse_int(obj.container_requests(c).get(TPU_RESOURCE)),
+            obj.parse_int(obj.container_limits(c).get(TPU_RESOURCE)),
+        )
+        for c in obj.pod_containers(pod)
+    )
 from ..ui.vdom import Element
 from ..viewport import pending_pods, running_chips, window_pods
 from .common import (
@@ -122,6 +139,16 @@ def pods_page(
                 {"label": "Age", "getter": lambda p: age_cell(p, now)},
             ],
             table_pods,
+            row_key=_pod_key,
+            row_salt=lambda p: (
+                _pod_key(p),
+                obj.pod_phase(p),
+                obj.pod_node_name(p),
+                _container_chips(p),
+                tpu.get_pod_chip_request(p),
+                obj.pod_restarts(p),
+                age_cell(p, now),
+            ),
         ),
     )
 
@@ -144,6 +171,17 @@ def pods_page(
                     {"label": "Age", "getter": lambda p: age_cell(p, now)},
                 ],
                 pending,
+                # ``pending:`` prefix: the same pod renders different
+                # bytes here than in the all-pods table, and the two
+                # share the page's cache namespace. Staleness is the
+                # salt's job; the prefix only prevents key collision.
+                row_key=lambda p: f"pending:{_pod_key(p)}",
+                row_salt=lambda p: (
+                    _pod_key(p),
+                    tpu.get_pod_chip_request(p),
+                    waiting_reason(p),
+                    age_cell(p, now),
+                ),
             ),
             class_="hl-attention",
         )

@@ -25,6 +25,22 @@ from ..ui import (
     UtilizationBar,
     h,
 )
+
+
+def _region_salt(region: "Region") -> tuple:
+    """Everything a rollup row paints (ADR-027 salt rule). The stats
+    dict comes from the viewport tree's per-generation memo, so this
+    costs six dict reads, not a re-rollup."""
+    return (
+        region.path,
+        region.key,
+        region.stats["nodes"],
+        region.stats["ready"],
+        region.stats["capacity"],
+        region.stats["allocatable"],
+        region.stats["in_use"],
+        region.stats["pending"],
+    )
 from ..ui.vdom import Element
 from ..viewport import parse_region, viewport_tree, window_nodes
 from ..viewport.tree import Region
@@ -166,7 +182,16 @@ def viewport_page(
         body.append(
             SectionBox(
                 "Clusters",
-                SimpleTable(_stats_columns("Cluster"), list(tree.clusters)),
+                # Region rows key on the drill-down path — exactly the
+                # key the push pipeline derives from a changed
+                # ``region:<path>`` frame, so one region's churn evicts
+                # one row (ADR-027).
+                SimpleTable(
+                    _stats_columns("Cluster"),
+                    list(tree.clusters),
+                    row_key=lambda r: r.path,
+                    row_salt=_region_salt,
+                ),
             )
         )
         return h("div", {"class_": "hl-page hl-fleet"}, *body)
@@ -195,7 +220,12 @@ def viewport_page(
                         ("Pending pods", cluster.stats["pending"]),
                     ]
                 ),
-                SimpleTable(_stats_columns("Slice"), list(cluster.children)),
+                SimpleTable(
+                    _stats_columns("Slice"),
+                    list(cluster.children),
+                    row_key=lambda r: r.path,
+                    row_salt=_region_salt,
+                ),
             )
         )
         body.append(_events_hint(cluster.path))
@@ -252,6 +282,13 @@ def viewport_page(
                     },
                 ],
                 window.rows,
+                row_key=obj.name,
+                row_salt=lambda n: (
+                    obj.name(n),
+                    obj.is_node_ready(n),
+                    tpu.get_node_chip_capacity(n),
+                    tpu.get_node_worker_id(n),
+                ),
             ),
         )
     )

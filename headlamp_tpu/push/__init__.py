@@ -38,7 +38,14 @@ from .conditional import (
     gzip_accepted,
     if_none_match_matches,
 )
-from .differ import PAGES, build_page_models, diff_models
+from .differ import (
+    PAGES,
+    REGION_PAGE_PREFIX,
+    ChangeLog,
+    build_page_models,
+    diff_models,
+    frame_changed_keys,
+)
 from .hub import (
     BACKLOG_LIMIT,
     HEARTBEAT_S,
@@ -93,6 +100,7 @@ class PushPipeline:
         outbox_limit: int = OUTBOX_LIMIT,
         backlog_limit: int = BACKLOG_LIMIT,
         shed_check: Callable[[], bool] | None = None,
+        fragments: Any = None,
     ) -> None:
         self._mono = monotonic or time.monotonic
         self.hub = BroadcastHub(
@@ -104,11 +112,20 @@ class PushPipeline:
         )
         self._models: dict[str, dict[str, Any]] | None = None
         self.generation = 0
+        #: Per-generation change sets (ADR-027), recorded from the
+        #: frames this pipeline already built — queryable via
+        #: :meth:`changed_keys`, never a second diff pass.
+        self.changes = ChangeLog()
+        #: The app's fragment cache (ui.fragment.FragmentCache), when
+        #: one is wired: every diffed generation evicts exactly the
+        #: keys its change set names, at diff time, on the sync thread.
+        self._fragments = fragments
         # Monotone per-instance ints (healthz block + flight deltas).
         self.diffs = 0
         self.baselines = 0
         self.frames_built = 0
         self.skipped_stale = 0
+        self.fragment_invalidations = 0
 
     def on_snapshot(
         self,
@@ -145,6 +162,22 @@ class PushPipeline:
                 self.baselines += 1
                 return 0
             self.diffs += 1
+            # Fragment invalidation (ADR-027): the change set derives
+            # from the frames just built — no second diff pass — and
+            # evicts the renderer's cached bytes for exactly the keys
+            # that changed, before broadcast, so a paint racing this
+            # sync never splices bytes the differ knows are stale.
+            changed = self.changes.record(int(generation), frames)
+            if self._fragments is not None and changed:
+                keys: set[str] = set()
+                for page, page_keys in changed.items():
+                    keys |= page_keys
+                    if page.startswith(REGION_PAGE_PREFIX):
+                        # A changed region page also evicts the region's
+                        # OWN boundary (viewport rows key on the bare
+                        # drill-down path, not the page name).
+                        keys.add(page[len(REGION_PAGE_PREFIX):])
+                self.fragment_invalidations += self._fragments.invalidate(keys)
             for frame in frames.values():
                 frame["generation"] = int(generation)
             self.frames_built += len(frames)
@@ -175,12 +208,20 @@ class PushPipeline:
         except Exception:  # noqa: BLE001 — push must never break the sync path
             return 0
 
+    def changed_keys(self, page: str, gen: int) -> set[str] | None:
+        """Which of ``page``'s keys changed since generation ``gen``
+        (ADR-027) — the queryable view of the change sets this pipeline
+        already recorded at diff time. ``None`` = unknown (``gen``
+        predates the ring; treat everything as changed)."""
+        return self.changes.changed_keys(page, gen)
+
     def counters(self) -> dict[str, int]:
         out = {
             "diffs": self.diffs,
             "baselines": self.baselines,
             "frames_built": self.frames_built,
             "skipped_stale": self.skipped_stale,
+            "fragment_invalidations": self.fragment_invalidations,
         }
         out.update(self.hub.counters())
         return out
@@ -193,6 +234,7 @@ class PushPipeline:
             "baselines": self.baselines,
             "frames_built": self.frames_built,
             "skipped_stale": self.skipped_stale,
+            "fragment_invalidations": self.fragment_invalidations,
         }
         out.update(self.hub.snapshot())
         return out
@@ -207,10 +249,13 @@ __all__ = [
     "MIN_GZIP_SIZE",
     "OUTBOX_LIMIT",
     "PAGES",
+    "REGION_PAGE_PREFIX",
     "BroadcastHub",
+    "ChangeLog",
     "PushPipeline",
     "Subscription",
     "build_page_models",
+    "frame_changed_keys",
     "count_not_modified",
     "diff_models",
     "encode_body",

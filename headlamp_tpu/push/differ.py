@@ -205,6 +205,68 @@ def diff_models(
     return frames
 
 
+#: Change-set key prefix for cell changes. Rows already carry stable
+#: keys; a changed CELL is reported as ``cell:<name>`` so consumers can
+#: distinguish "row node-0007 changed" from "the overview total moved".
+CELL_KEY_PREFIX = "cell:"
+
+
+def frame_changed_keys(frame: Mapping[str, Any]) -> set[str]:
+    """The change-set view of one patch frame: every row key added,
+    changed, or removed, plus ``cell:``-prefixed names for changed
+    cells. Derived from the frame the differ already built — never a
+    second diff pass (ADR-027)."""
+    keys: set[str] = set(frame.get("rows") or ())
+    keys.update(frame.get("removed") or ())
+    keys.update(CELL_KEY_PREFIX + name for name in (frame.get("cells") or ()))
+    return keys
+
+
+class ChangeLog:
+    """Bounded per-generation change-set ring (ADR-027).
+
+    ``record`` runs at diff time on the sync thread; ``changed_keys``
+    answers "which of page P's keys changed since generation G" for
+    renderers/tests that want the invalidation set without replaying
+    diffs. Returns ``None`` — unknown, treat everything as changed —
+    when G predates the ring (the honest answer once history is gone;
+    the fragment cache's salts make over-invalidation safe)."""
+
+    def __init__(self, limit: int = 64) -> None:
+        self._limit = max(1, int(limit))
+        #: generation -> {page: set(keys)}, insertion-ordered (syncs
+        #: are monotone in generation, enforced by the pipeline).
+        self._gens: "dict[int, dict[str, set[str]]]" = {}
+
+    def record(
+        self, generation: int, frames: Mapping[str, Mapping[str, Any]]
+    ) -> dict[str, set[str]]:
+        changed = {page: frame_changed_keys(frame) for page, frame in frames.items()}
+        self._gens[int(generation)] = changed
+        while len(self._gens) > self._limit:
+            del self._gens[next(iter(self._gens))]
+        return changed
+
+    def oldest(self) -> int | None:
+        return next(iter(self._gens)) if self._gens else None
+
+    def changed_keys(self, page: str, gen: int) -> set[str] | None:
+        """Keys of ``page`` changed in any generation AFTER ``gen``
+        (i.e. since a fragment cached at generation ``gen`` was
+        rendered). ``None`` = unknown: ``gen`` is older than the ring's
+        horizon, so the caller must assume everything changed."""
+        gens = self._gens
+        if gens:
+            oldest = next(iter(gens))
+            if gen < oldest - 1:
+                return None
+        out: set[str] = set()
+        for generation, pages in gens.items():
+            if generation > gen:
+                out |= pages.get(page, set())
+        return out
+
+
 class _Missing:
     """Sentinel distinct from every model value (None is a legitimate
     cell value — an absent metric sample)."""
@@ -221,4 +283,12 @@ class _Missing:
 _MISSING = _Missing()
 
 
-__all__ = ["PAGES", "REGION_PAGE_PREFIX", "build_page_models", "diff_models"]
+__all__ = [
+    "CELL_KEY_PREFIX",
+    "PAGES",
+    "REGION_PAGE_PREFIX",
+    "ChangeLog",
+    "build_page_models",
+    "diff_models",
+    "frame_changed_keys",
+]

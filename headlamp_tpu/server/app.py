@@ -28,6 +28,7 @@ the full UI runs with zero cluster.
 
 from __future__ import annotations
 
+import functools
 import html
 import json
 import re
@@ -54,7 +55,7 @@ from ..runtime.transfer import TransferBatch
 from ..pages.native import native_node_page, native_pod_page
 from ..registration import Registry, register_plugin
 from ..transport.api_proxy import MockTransport, Transport
-from ..ui import render_html
+from ..ui import FragmentCache, FragmentPaint, render_html, set_active_fragments
 from .style import STYLESHEET
 
 #: Dynamic native-detail paths: /node/<name> and /pod/<ns>/<name>.
@@ -63,6 +64,21 @@ from .style import STYLESHEET
 #: reaching a renderer with attacker-shaped input.
 _NODE_DETAIL_RE = re.compile(r"^/node/([a-z0-9.-]{1,253})$")
 _POD_DETAIL_RE = re.compile(r"^/pod/([a-z0-9.-]{1,253})/([a-z0-9.-]{1,253})$")
+
+
+@functools.lru_cache(maxsize=64)
+def _nav_html(entries: tuple[tuple[str, str], ...], active: str) -> str:
+    """Sidebar nav markup, memoized per (registry entries, active
+    route): the entry set is fixed after plugin registration and there
+    are ~a dozen routes, so in steady state every paint reuses one of
+    a handful of strings instead of re-joining the nav (the invariant-
+    subtree hoist from ISSUE 16)."""
+    return "".join(
+        f'<a href="{url}"'
+        + (' class="active"' if url == active else "")
+        + f">{label}</a>"
+        for url, label in entries
+    )
 
 
 def _analytics_health() -> dict[str, Any]:
@@ -122,6 +138,7 @@ def _runtime_health(
     history: Any = None,
     push: Any = None,
     replication: Any = None,
+    fragments: Any = None,
 ) -> dict[str, Any]:
     """Transfer-funnel, device-cache, transport-pool, and refresher
     counters for /healthz: how many blocking device_gets the process
@@ -170,6 +187,10 @@ def _runtime_health(
             # Read-tier view (ADR-025): leader publish/backlog state or
             # replica cursor/lag/staleness, depending on role.
             out["replication"] = replication.snapshot()
+        if fragments is not None:
+            # Fragment-cache view (ADR-027): entries/bytes/hit-rate —
+            # the first stop when page.component dominates --attribute.
+            out["render"] = fragments.snapshot()
         # Burn-rate states per declared SLO (ADR-016): the one-line
         # answer a probe reader wants before opening /sloz.
         out["slo"] = slo_mod.engine().health_block()
@@ -300,6 +321,7 @@ class DashboardApp:
         clock: Any = time.time,
         monotonic: Any = time.monotonic,
         pod_field_selector: str | None = None,
+        fragments: Any = None,
     ) -> None:
         self._ctx = AcceleratorDataContext(
             transport, pod_field_selector=pod_field_selector, clock=clock
@@ -435,7 +457,18 @@ class DashboardApp:
         #: handler threads belong to the socket server, and the differ
         #: runs on whichever thread syncs). The module-level weakref
         #: only feeds the connected-clients gauge; latest app wins.
-        self.push = PushPipeline(monotonic=monotonic)
+        #: Fragment cache (ADR-027): rendered HTML per differ key,
+        #: keyed by the same (generation, epoch, degraded) invariants
+        #: as the ADR-021 ETag. Per-app (bench/tests build many apps
+        #: per process; two fleets must never share bytes); pass
+        #: ``fragments=False`` to disable — the non-incremental oracle
+        #: the byte-identity tests compare against.
+        if fragments is False:
+            self.fragments = None
+        else:
+            self.fragments = fragments if fragments is not None else FragmentCache()
+            set_active_fragments(self.fragments)
+        self.push = PushPipeline(monotonic=monotonic, fragments=self.fragments)
         set_active_push(self.push)
         #: Read-tier hook (ADR-025). On a leader: a BusPublisher —
         #: _record_sync hands it every published generation, and
@@ -1105,6 +1138,7 @@ class DashboardApp:
                             history=self.history,
                             push=self.push,
                             replication=self.replication,
+                            fragments=self.fragments,
                         ),
                     }
                 )
@@ -1144,6 +1178,7 @@ class DashboardApp:
                         history=self.history,
                         push=self.push,
                         replication=self.replication,
+                        fragments=self.fragments,
                     ),
                 }
             )
@@ -1321,16 +1356,29 @@ class DashboardApp:
                     pass
             if "cursor" in params:
                 paging["cursor"] = params["cursor"][0][:512]
+        # Data acquisition runs under its own span (ADR-027): the old
+        # layout billed the Prometheus fetch + forecast fit to
+        # page.component, so --attribute pointed at the renderer when
+        # the cost was the data path. page.component now means
+        # component build + changed-fragment re-render, nothing else.
+        page_data: Any = None
+        if route.kind == "metrics":
+            with span("page.data", kind=route.kind):
+                page_data = self._metrics_and_forecast()
+        elif route.kind == "intel-metrics":
+            from ..metrics.intel_client import fetch_intel_gpu_metrics
+
+            with span("page.data", kind=route.kind):
+                page_data = fetch_intel_gpu_metrics(
+                    self._transport, clock=self._clock
+                )
+        paint = self._fragment_paint(route_path)
         with span("page.component", kind=route.kind):
             if route.kind == "metrics":
-                metrics, forecast = self._metrics_and_forecast()
+                metrics, forecast = page_data
                 el = route.component(metrics, forecast)
             elif route.kind == "intel-metrics":
-                from ..metrics.intel_client import fetch_intel_gpu_metrics
-
-                el = route.component(
-                    fetch_intel_gpu_metrics(self._transport, clock=self._clock)
-                )
+                el = route.component(page_data)
             elif route.kind == "topology":
                 # Cache PEEK only: the heatmap is a progressive
                 # enhancement; the topology paint must never pay the
@@ -1406,17 +1454,53 @@ class DashboardApp:
                 )
             else:
                 el = route.component(snap, now=now, **paging)
+            if paint is not None:
+                # Changed-fragment re-render (ADR-027): resolve every
+                # stale boundary into the cache HERE, so the build span
+                # keeps covering all tree construction work…
+                paint.prerender(el)
+        if paint is not None:
+            # …while cached-byte assembly bills to its own stage. A
+            # warm paint spends ~nothing here; a paint that shows
+            # fragment.splice dominating has a salt churning per
+            # request (see OPERATIONS.md triage).
+            with span(
+                "fragment.splice",
+                rendered=paint.rendered,
+                spliced=paint.spliced,
+            ):
+                inner = paint.splice(el)
+        else:
+            inner = None
         with span("render.html"):
-            body = self._page_html(route.name, render_html(el), route_path)
+            if inner is None:
+                inner = render_html(el)
+            body = self._page_html(route.name, inner, route_path)
         return 200, "text/html", body
 
+    def _fragment_paint(self, page: str) -> Any:
+        """The paint-scoped fragment context for ``page`` (None when
+        fragments are disabled): the cache plus this paint's ADR-021
+        ETag invariants — generation, /refresh epoch, degraded flag."""
+        cache = self.fragments
+        if cache is None:
+            return None
+        return FragmentPaint(
+            cache,
+            page=page,
+            generation=self.snapshot_generation(),
+            epoch=self._cache_epoch,
+            degraded=degraded_active(),
+        )
+
     def _page_html(self, title: str, body: str, active: str = "") -> str:
-        nav = "".join(
-            f'<a href="{e.url}"'
-            + (' class="active"' if e.url == active else "")
-            + f">{e.label}</a>"
-            for e in self._registry.sidebar_entries
-            if e.parent is not None
+        nav = _nav_html(
+            tuple(
+                (e.url, e.label)
+                for e in self._registry.sidebar_entries
+                if e.parent is not None
+            ),
+            active,
         )
         refresh = f'<a class="hl-refresh" href="/refresh?back={active or "/tpu"}">Refresh</a>'
         return (

@@ -11,6 +11,13 @@ separation React gives the reference.
 """
 
 from .vdom import Element, find_all, h, render_html, render_text, text_content
+from .fragment import (
+    FragmentBoundary,
+    FragmentCache,
+    FragmentPaint,
+    fragment,
+    set_active_fragments,
+)
 from .components import (
     BAR_CRIT_PCT,
     BAR_WARN_PCT,
@@ -33,6 +40,11 @@ __all__ = [
     "render_text",
     "text_content",
     "find_all",
+    "FragmentBoundary",
+    "FragmentCache",
+    "FragmentPaint",
+    "fragment",
+    "set_active_fragments",
     "BAR_CRIT_PCT",
     "BAR_WARN_PCT",
     "EmptyContent",

@@ -39,9 +39,22 @@ def SectionHeader(title: str, *actions: Any) -> Element:
     )
 
 
-def SimpleTable(columns: Sequence[Column], data: Iterable[Any], *, empty_message: str = "No data") -> Element:
+def SimpleTable(
+    columns: Sequence[Column],
+    data: Iterable[Any],
+    *,
+    empty_message: str = "No data",
+    row_key: Callable[[Any], str] | None = None,
+    row_salt: Callable[[Any], Any] | None = None,
+) -> Element:
     """Column-spec table (`SimpleTable` semantics: columns with label +
-    getter, empty state built in)."""
+    getter, empty state built in).
+
+    With ``row_key``/``row_salt`` each ``<tr>`` becomes a
+    :class:`~headlamp_tpu.ui.fragment.FragmentBoundary` (ADR-027): the
+    key must speak the differ's row vocabulary and the salt must cover
+    every cell input, so an unchanged row splices from cached bytes
+    instead of re-running its getters."""
     rows = list(data)
     if not rows:
         return h("p", {"class_": "hl-empty"}, empty_message)
@@ -55,14 +68,24 @@ def SimpleTable(columns: Sequence[Column], data: Iterable[Any], *, empty_message
             return row.get(key, "")
         return ""
 
+    def tr(row: Any) -> Any:
+        return h("tr", None, [h("td", None, cell(c, row)) for c in columns])
+
+    if row_key is not None and row_salt is not None:
+        from .fragment import fragment
+
+        body = [
+            fragment(row_key(row), row_salt(row), lambda row=row: tr(row))
+            for row in rows
+        ]
+    else:
+        body = [tr(row) for row in rows]
+
     return h(
         "table",
         {"class_": "hl-table"},
         h("tr", None, [h("th", None, c["label"]) for c in columns]),
-        [
-            h("tr", None, [h("td", None, cell(c, row)) for c in columns])
-            for row in rows
-        ],
+        body,
     )
 
 

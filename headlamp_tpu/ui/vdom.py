@@ -24,6 +24,23 @@ class Element:
     children: tuple[Any, ...] = ()
 
 
+class BoundaryNode:
+    """Marker base for lazy subtree nodes (``ui.fragment`` — ADR-027).
+
+    A boundary stands in for a subtree that may be served from the
+    fragment cache instead of being rebuilt. Every walker in this
+    module treats boundaries TRANSPARENTLY by descending through
+    :meth:`built`, so text projection, assertions, and the plain
+    ``render_html`` oracle see exactly the tree the boundary would
+    build — only the incremental renderer (which passes a ``resolve``
+    hook) ever skips the descent."""
+
+    __slots__ = ()
+
+    def built(self) -> "Child":
+        raise NotImplementedError
+
+
 def h(tag: str, props: dict[str, Any] | None = None, *children: Child) -> Element:
     """Hyperscript constructor. Nested lists/tuples and None children are
     flattened/dropped so callers can build conditionally:
@@ -63,8 +80,22 @@ def render_html(node: Child) -> str:
     return "".join(out)
 
 
-def _render_html_into(node: Child, out: list[str]) -> None:
+def _render_html_into(
+    node: Child,
+    out: list[str],
+    resolve: "Callable[[BoundaryNode], str] | None" = None,
+) -> None:
     if node is None:
+        return
+    if isinstance(node, BoundaryNode):
+        # ``resolve`` is the fragment-cache hook (ADR-027): it returns
+        # the boundary's bytes (cached or freshly rendered). Without
+        # one, descend — plain render_html IS the non-incremental
+        # oracle the byte-identity tests pin against.
+        if resolve is not None:
+            out.append(resolve(node))
+        else:
+            _render_html_into(node.built(), out)
         return
     if not isinstance(node, Element):
         out.append(html.escape(str(node)))
@@ -84,7 +115,7 @@ def _render_html_into(node: Child, out: list[str]) -> None:
         return
     out.append(f"<{node.tag}{attr_str}>")
     for c in node.children:
-        _render_html_into(c, out)
+        _render_html_into(c, out, resolve)
     out.append(f"</{node.tag}>")
 
 
@@ -101,6 +132,9 @@ def render_text(node: Child) -> str:
 
     def walk(n: Child) -> None:
         if n is None:
+            return
+        if isinstance(n, BoundaryNode):
+            walk(n.built())
             return
         if not isinstance(n, Element):
             out.append(str(n))
@@ -132,6 +166,9 @@ def text_content(node: Child) -> str:
     def walk(n: Child) -> None:
         if n is None:
             return
+        if isinstance(n, BoundaryNode):
+            walk(n.built())
+            return
         if not isinstance(n, Element):
             parts.append(str(n))
             return
@@ -147,6 +184,9 @@ def find_all(node: Child, predicate: Callable[[Element], bool]) -> list[Element]
     found: list[Element] = []
 
     def walk(n: Child) -> None:
+        if isinstance(n, BoundaryNode):
+            walk(n.built())
+            return
         if not isinstance(n, Element):
             return
         if predicate(n):
@@ -159,7 +199,9 @@ def find_all(node: Child, predicate: Callable[[Element], bool]) -> list[Element]
 
 
 def iter_elements(node: Child) -> Iterator[Element]:
-    if isinstance(node, Element):
+    if isinstance(node, BoundaryNode):
+        yield from iter_elements(node.built())
+    elif isinstance(node, Element):
         yield node
         for c in node.children:
             yield from iter_elements(c)

@@ -1,0 +1,463 @@
+"""ADR-027 incremental fragment rendering: cache semantics, change-set
+invalidation, and the byte-identity contract.
+
+The contract under test: a paint assembled from cached fragment bytes
+is byte-identical to the non-incremental render of the same element
+tree — across fleet churn, clock advance, and on a replica inheriting
+the cache through the apply_record seam. Identity is asserted two
+ways: ``splice(el) == render_html(el)`` on every paint (the plain
+renderer descends boundaries, so it IS the oracle for the exact tree a
+request built), and whole-body equality against a ``fragments=False``
+app on pages whose bytes carry no per-request timing text.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from headlamp_tpu.fleet import fixtures as fx
+from headlamp_tpu.push import PushPipeline
+from headlamp_tpu.push.differ import (
+    CELL_KEY_PREFIX,
+    ChangeLog,
+    frame_changed_keys,
+)
+from headlamp_tpu.replicate import BusPublisher, ReplicaApp, parse_payload
+from headlamp_tpu.server import DashboardApp, make_demo_transport
+from headlamp_tpu.server.app import add_demo_prometheus
+from headlamp_tpu.ui import (
+    FragmentCache,
+    FragmentPaint,
+    fragment,
+    h,
+    render_html,
+    render_text,
+    text_content,
+)
+from headlamp_tpu.ui.vdom import find_all
+
+PAGE_PATHS = ("/tpu", "/tpu/nodes", "/tpu/pods", "/tpu/metrics", "/tpu/fleet")
+
+#: Pages safe for cross-app whole-body comparison: /tpu/metrics paints
+#: a per-request "scrape→join took N ms" figure (wall-measured, not
+#: injected-clock), so two independent apps legitimately differ there;
+#: its identity is still pinned per-tree by the checked_splice oracle.
+COMPARABLE_PATHS = tuple(p for p in PAGE_PATHS if p != "/tpu/metrics")
+
+
+def make_apps(**kwargs):
+    """(incremental app, oracle app, now-cell, fleet) over one fixture
+    fleet with injected frozen clocks — same snapshot inputs, same
+    ages, separate transports (mutate BOTH feeds to churn)."""
+    fleet = fx.fleet_v5e4()
+    now = [50_000.0]
+
+    def build(**extra):
+        t = fx.fleet_transport(fleet)
+        add_demo_prometheus(t, fleet)
+        return DashboardApp(
+            t,
+            min_sync_interval_s=0.0,
+            clock=lambda: now[0],
+            monotonic=lambda: now[0],
+            **kwargs,
+            **extra,
+        )
+
+    return build(), build(fragments=False), now, fleet
+
+
+def force_new_generation(app: DashboardApp) -> None:
+    app._ctx.advance_generation_floor(app.snapshot_generation() + 1)
+    app._last_sync = float("-inf")
+    app._synced_snapshot()
+
+
+def flip_node_ready(node: dict, ready: bool = False) -> dict:
+    node = json.loads(json.dumps(node))
+    for cond in node["status"]["conditions"]:
+        if cond["type"] == "Ready":
+            cond["status"] = "True" if ready else "False"
+    return node
+
+
+@pytest.fixture
+def checked_splice(monkeypatch):
+    """Assert ``splice(el) == render_html(el)`` on EVERY paint of the
+    test — render_html descends boundaries, so it is the byte oracle
+    for the exact tree the request built."""
+    orig = FragmentPaint.splice
+
+    def checking(self, node):
+        out = orig(self, node)
+        assert out == render_html(node), "splice diverged from render_html"
+        return out
+
+    monkeypatch.setattr(FragmentPaint, "splice", checking)
+
+
+# ---------------------------------------------------------------------------
+# FragmentCache unit semantics
+# ---------------------------------------------------------------------------
+
+class TestFragmentCache:
+    def test_miss_then_hit(self):
+        cache = FragmentCache()
+        assert cache.get("/p", "k", "s1", generation=1, epoch=0, degraded=False) is None
+        cache.put("/p", "k", "s1", "<tr>x</tr>", generation=1, epoch=0, degraded=False)
+        assert (
+            cache.get("/p", "k", "s1", generation=7, epoch=0, degraded=False)
+            == "<tr>x</tr>"
+        )
+        assert cache.hits == 1 and cache.misses == 1
+
+    def test_salt_epoch_degraded_mismatches_all_miss(self):
+        cache = FragmentCache()
+        cache.put("/p", "k", "s1", "<b>1</b>", generation=1, epoch=0, degraded=False)
+        assert cache.get("/p", "k", "s2", generation=1, epoch=0, degraded=False) is None
+        assert cache.get("/p", "k", "s1", generation=1, epoch=1, degraded=False) is None
+        assert cache.get("/p", "k", "s1", generation=1, epoch=0, degraded=True) is None
+        # In-place replace on salt change: same (page, key), new bytes.
+        cache.put("/p", "k", "s2", "<b>2</b>", generation=2, epoch=0, degraded=False)
+        assert (
+            cache.get("/p", "k", "s2", generation=2, epoch=0, degraded=False)
+            == "<b>2</b>"
+        )
+        assert len(cache) == 1
+
+    def test_bounded_lru_evicts_and_counts(self):
+        cache = FragmentCache(max_entries=3)
+        for i in range(5):
+            cache.put(
+                "/p", f"k{i}", i, f"<i>{i}</i>", generation=1, epoch=0, degraded=False
+            )
+        assert len(cache) == 3 and cache.evictions == 2
+        assert cache.get("/p", "k0", 0, generation=1, epoch=0, degraded=False) is None
+        assert cache.get("/p", "k4", 4, generation=1, epoch=0, degraded=False)
+
+    def test_invalidate_drops_key_across_all_pages(self):
+        cache = FragmentCache()
+        cache.put("/tpu/nodes", "node-1", "a", "<tr>row</tr>", generation=1, epoch=0, degraded=False)
+        cache.put("/tpu/fleet", "node-1", "b", "<tr>win</tr>", generation=1, epoch=0, degraded=False)
+        cache.put("/tpu/nodes", "node-2", "c", "<tr>keep</tr>", generation=1, epoch=0, degraded=False)
+        assert cache.invalidate({"node-1", "never-cached"}) == 2
+        assert len(cache) == 1 and cache.evictions == 2
+        assert cache.get("/tpu/nodes", "node-2", "c", generation=1, epoch=0, degraded=False)
+
+    def test_bytes_accounting_follows_entries(self):
+        cache = FragmentCache()
+        cache.put("/p", "k", "s", "abcd", generation=1, epoch=0, degraded=False)
+        assert cache.bytes == 4
+        cache.put("/p", "k", "s2", "ab", generation=1, epoch=0, degraded=False)
+        assert cache.bytes == 2
+        cache.invalidate({"k"})
+        assert cache.bytes == 0 and len(cache) == 0
+
+    def test_snapshot_shape(self):
+        cache = FragmentCache(max_entries=9)
+        snap = cache.snapshot()
+        assert set(snap) == {
+            "entries", "max_entries", "bytes", "hits", "misses",
+            "evictions", "hit_rate",
+        }
+        assert snap["max_entries"] == 9 and snap["hit_rate"] is None
+
+
+class TestFragmentPaint:
+    def test_warm_paint_splices_without_rebuilding(self):
+        cache = FragmentCache()
+        built = []
+
+        def make(i):
+            def build(i=i):
+                built.append(i)
+                return h("b", None, str(i))
+
+            return fragment(f"k{i}", i, build)
+
+        el = h("div", None, [make(0), make(1)])
+        paint = FragmentPaint(cache, page="/p", generation=1, epoch=0, degraded=False)
+        paint.prerender(el)
+        out = paint.splice(el)
+        assert out == "<div><b>0</b><b>1</b></div>"
+        assert sorted(built) == [0, 1]
+        assert paint.rendered == 2 and paint.spliced == 0
+        # Warm paint: fresh boundary nodes, same keys/salts — all
+        # spliced from cache, no build callbacks run, one lookup per
+        # boundary (the per-node _html memo covers splice-after-
+        # prerender).
+        el2 = h("div", None, [make(0), make(1)])
+        paint2 = FragmentPaint(cache, page="/p", generation=2, epoch=0, degraded=False)
+        paint2.prerender(el2)
+        assert paint2.splice(el2) == out
+        assert sorted(built) == [0, 1]  # no rebuilds
+        assert paint2.spliced == 2 and paint2.rendered == 0
+        assert cache.hits == 2 and cache.misses == 2
+
+    def test_nested_boundaries_resolve_through_parent(self):
+        cache = FragmentCache()
+        inner = fragment("inner", 1, lambda: h("i", None, "x"))
+        outer = fragment("outer", 1, lambda: h("p", None, inner))
+        el = h("div", None, outer)
+        paint = FragmentPaint(cache, page="/p", generation=1, epoch=0, degraded=False)
+        paint.prerender(el)
+        assert paint.splice(el) == "<div><p><i>x</i></p></div>"
+        assert render_html(el) == "<div><p><i>x</i></p></div>"
+
+
+class TestVdomTransparency:
+    def test_walkers_descend_boundaries(self):
+        el = h(
+            "div",
+            None,
+            fragment("k", 1, lambda: h("span", {"class_": "x"}, "hello")),
+        )
+        assert render_text(el).strip() == "hello"
+        assert text_content(el) == "hello"
+        assert [e.tag for e in find_all(el, lambda e: e.tag == "span")] == ["span"]
+        assert render_html(el) == '<div><span class="x">hello</span></div>'
+
+
+# ---------------------------------------------------------------------------
+# ChangeLog + pipeline invalidation
+# ---------------------------------------------------------------------------
+
+class TestChangeLog:
+    def frame(self, rows=(), removed=(), cells=()):
+        return {
+            "rows": {k: [1] for k in rows},
+            "removed": list(removed),
+            "cells": {k: 1 for k in cells},
+        }
+
+    def test_frame_changed_keys_unions_rows_removed_cells(self):
+        keys = frame_changed_keys(
+            self.frame(rows=["a"], removed=["b"], cells=["total"])
+        )
+        assert keys == {"a", "b", CELL_KEY_PREFIX + "total"}
+
+    def test_changed_keys_since_generation(self):
+        log = ChangeLog()
+        log.record(2, {"/p": self.frame(rows=["a"])})
+        log.record(3, {"/p": self.frame(rows=["b"]), "/q": self.frame(rows=["z"])})
+        assert log.changed_keys("/p", 2) == {"b"}
+        assert log.changed_keys("/p", 1) == {"a", "b"}
+        assert log.changed_keys("/q", 2) == {"z"}
+        assert log.changed_keys("/p", 3) == set()
+
+    def test_horizon_returns_none_for_unknown_past(self):
+        log = ChangeLog(limit=2)
+        for gen in (5, 6, 7):
+            log.record(gen, {"/p": self.frame(rows=[f"r{gen}"])})
+        assert log.oldest() == 6
+        # gen 5 = oldest-1 is still answerable (every change since gen
+        # 5 is in the ring); anything older is unknown.
+        assert log.changed_keys("/p", 5) == {"r6", "r7"}
+        assert log.changed_keys("/p", 4) is None
+
+
+class TestPipelineInvalidation:
+    def test_sync_evicts_changed_keys_including_region_paths(self):
+        fleet = fx.fleet_v5e4()
+        t = fx.fleet_transport(fleet)
+        cache = FragmentCache()
+        app = DashboardApp(t, min_sync_interval_s=0.0, fragments=cache)
+        assert app.fragments is cache and app.push._fragments is cache
+        # Fill the cache: node rows under /tpu/nodes, region rollup
+        # rows (keyed by drill-down path) under /tpu/fleet.
+        app.handle("/tpu/nodes")
+        app.handle("/tpu/fleet")
+        name = fleet["nodes"][0]["metadata"]["name"]
+        assert name in cache._pages_of
+        assert "cluster/0" in cache._pages_of  # fixture's default cluster
+        # Flip the node NotReady → next sync's differ emits frames for
+        # the node row AND its region rollups; the pipeline evicts the
+        # bare row key and strips ``region:`` page keys down to the
+        # drill-down paths the fleet page keys its rows on.
+        t.node_feed.push("MODIFIED", flip_node_ready(fleet["nodes"][0]))
+        gen_before = app.snapshot_generation()
+        force_new_generation(app)
+        assert app.snapshot_generation() > gen_before
+        assert app.push.fragment_invalidations >= 2
+        assert name not in cache._pages_of
+        assert "cluster/0" not in cache._pages_of
+        changed = app.push.changed_keys("/tpu/nodes", gen_before)
+        assert changed is not None and name in changed
+
+    def test_counters_expose_invalidations(self):
+        pipe = PushPipeline(fragments=FragmentCache())
+        assert pipe.counters()["fragment_invalidations"] == 0
+        assert pipe.snapshot()["fragment_invalidations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Byte identity end to end
+# ---------------------------------------------------------------------------
+
+class TestByteIdentity:
+    def test_warm_paints_identical_to_oracle(self, checked_splice):
+        app, oracle, now, _ = make_apps()
+        for _ in range(3):  # cold, warm, warm
+            for path in PAGE_PATHS:
+                s1, _, b1 = app.handle(path)
+                s2, _, b2 = oracle.handle(path)
+                assert s1 == s2 == 200
+                if path in COMPARABLE_PATHS:
+                    assert b1 == b2, path
+        snap = app.fragments.snapshot()
+        assert snap["hits"] > 0 and snap["entries"] > 0
+
+    def test_identity_across_churn_and_clock(self, checked_splice):
+        app, oracle, now, fleet = make_apps()
+        for path in PAGE_PATHS:
+            app.handle(path)
+            oracle.handle(path)
+        pod = json.loads(json.dumps(fleet["pods"][0]))
+        pod["status"]["phase"] = "Failed"
+        bad_node = flip_node_ready(fleet["nodes"][0])
+
+        def churn_pod():
+            for a in (app, oracle):
+                a._transport.pod_feed.push("MODIFIED", pod)
+
+        def churn_node():
+            for a in (app, oracle):
+                a._transport.node_feed.push("MODIFIED", bad_node)
+
+        def advance_clock():
+            # Far enough that every formatted age string changes — the
+            # salt-completeness rule (ADR-027) is what keeps cached row
+            # bytes from serving a stale age.
+            now[0] += 601.0
+
+        for mutate in (churn_pod, advance_clock, churn_node):
+            mutate()
+            for a in (app, oracle):
+                force_new_generation(a)
+            for path in PAGE_PATHS:
+                s1, _, b1 = app.handle(path)
+                s2, _, b2 = oracle.handle(path)
+                assert s1 == s2 == 200
+                if path in COMPARABLE_PATHS:
+                    assert b1 == b2, (mutate.__name__, path)
+
+    def test_oracle_mode_disables_cache(self):
+        _, oracle, _, _ = make_apps()
+        assert oracle.fragments is None
+        status, _, body = oracle.handle("/tpu/nodes")
+        assert status == 200 and "hl-table" in body
+
+    def test_demo_app_smoke_with_checked_splice(self, checked_splice):
+        app = DashboardApp(make_demo_transport("v5p32"), min_sync_interval_s=0.0)
+        for path in PAGE_PATHS + ("/tpu/fleet?region=cluster/demo",):
+            for _ in range(2):
+                status, _, body = app.handle(path)
+                assert status == 200 and body
+
+
+class TestReplicaInheritsCache:
+    def make_leader(self):
+        fleet = fx.fleet_v5e4()
+        t = fx.fleet_transport(fleet)
+        add_demo_prometheus(t, fleet)
+        app = DashboardApp(t, min_sync_interval_s=30.0)
+        pub = BusPublisher()
+        app.replication = pub
+        return app, pub
+
+    def test_replica_warm_paints_match_leader(self, checked_splice):
+        app, pub = self.make_leader()
+        app._synced_snapshot()
+        app.handle("/tpu/metrics")  # prime peeks so the record ships them
+        force_new_generation(app)
+        rep = ReplicaApp()
+        _, records = parse_payload(pub.payload_after(None))
+        for record in records:
+            rep.apply_record(record)
+        assert rep.fragments is not None
+        assert rep.snapshot_generation() == app.snapshot_generation()
+        for path in ("/tpu", "/tpu/nodes", "/tpu/pods", "/tpu/metrics"):
+            cold = rep.handle(path)
+            assert cold == app.handle(path), path
+            # Warm replica paint: spliced from the replica's own cache,
+            # still byte-identical to leader-local serving.
+            assert rep.handle(path) == cold, path
+        assert rep.fragments.hits > 0
+
+    def test_apply_record_evicts_on_replica(self):
+        app, pub = self.make_leader()
+        snap = app._synced_snapshot()
+        rep = ReplicaApp()
+        _, records = parse_payload(pub.payload_after(None))
+        for record in records:
+            rep.apply_record(record)
+        rep.handle("/tpu/pods")
+        assert len(rep.fragments) > 0
+        pod = json.loads(json.dumps(snap.all_pods[0]))
+        pod_key = (
+            f"{pod['metadata']['namespace']}/{pod['metadata']['name']}"
+        )
+        assert pod_key in rep.fragments._pages_of
+        pod["status"]["phase"] = "Failed"
+        app._transport.pod_feed.push("MODIFIED", pod)
+        force_new_generation(app)
+        _, newer = parse_payload(pub.payload_after(rep.snapshot_generation()))
+        assert newer
+        for record in newer:
+            rep.apply_record(record)
+        # The replica's own differ saw the same change set and evicted
+        # the changed pod row from the inherited cache (apply_record
+        # seam — no replica-specific invalidation code path).
+        assert rep.push.fragment_invalidations >= 1
+        assert pod_key not in rep.fragments._pages_of
+
+
+# ---------------------------------------------------------------------------
+# Observability surfaces
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_healthz_runtime_render_block(self):
+        app, _, _, _ = make_apps()
+        app.handle("/tpu/nodes")
+        status, _, body = app.handle("/healthz")
+        assert status == 200
+        render = json.loads(body)["runtime"]["render"]
+        assert render["entries"] > 0
+        assert set(render) >= {
+            "entries", "max_entries", "bytes", "hits", "misses",
+            "evictions", "hit_rate",
+        }
+
+    def test_healthz_omits_render_block_in_oracle_mode(self):
+        _, oracle, _, _ = make_apps()
+        oracle.handle("/tpu/nodes")
+        status, _, body = oracle.handle("/healthz")
+        assert status == 200
+        assert "render" not in json.loads(body)["runtime"]
+
+    def test_metricsz_exposes_fragment_families(self):
+        app, _, _, _ = make_apps()
+        for _ in range(2):
+            app.handle("/tpu/nodes")
+        status, _, body = app.handle("/metricsz")
+        assert status == 200
+        for family in (
+            "headlamp_tpu_render_fragment_hits_total",
+            "headlamp_tpu_render_fragment_misses_total",
+            "headlamp_tpu_render_fragment_evictions_total",
+            "headlamp_tpu_render_fragment_cache_bytes",
+        ):
+            assert family in body, family
+
+    def test_paint_spans_in_flight_stages(self):
+        from headlamp_tpu.obs import flight_recorder
+
+        app, _, _, _ = make_apps()
+        app.handle("/tpu/nodes")
+        app.handle("/tpu/nodes")
+        stages = flight_recorder.snapshot()["recent"][0]["stages"]
+        for stage in ("page.component", "fragment.splice", "render.html"):
+            assert stage in stages, stage

@@ -220,6 +220,11 @@ class TestFormat:
             "headlamp_tpu_replicate_bytes_total",
             "headlamp_tpu_replicate_failovers_total",
             "headlamp_tpu_replicate_lag_seconds",
+            # ADR-027 fragment cache: the memory gauge is the same
+            # weakref latest-cache-wins wiring as the history gauges —
+            # quiet when the active cache belongs to a dropped app. The
+            # hit/miss/eviction counters are unlabeled and always emit.
+            "headlamp_tpu_render_fragment_cache_bytes",
         }, f"unexpected sample-free families: {sorted(quiet)}"
 
     def test_name_grammar_and_unit_suffixes(self, exposition):
