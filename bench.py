@@ -300,6 +300,12 @@ def load_prev_round_p50() -> dict:
 
 #: Keys where MORE is better; everything else numeric is latency-like.
 _HIGHER_IS_BETTER_MARKERS = ("rate", "reuse", "vs_baseline", "hit", "rps", "per_sec")
+#: Keys where LESS is always better even when a higher-better marker
+#: also matches (e.g. "…lag_ms…rate" never happens today, but the
+#: ledger metrics must stay latency-like regardless of future naming):
+#: checked FIRST, so generation lag and age-at-paint regress by
+#: GROWING (ADR-028).
+_LOWER_IS_BETTER_MARKERS = ("lag_ms", "age_at_paint")
 #: Informational / environment keys a regression flag would mislabel:
 #: tunnel noise, sample counts, prior-round echoes, static budgets.
 _COMPARE_SKIP_PREFIXES = (
@@ -350,7 +356,9 @@ def compare_prev_round(record: dict) -> list[str]:
                 for v in (pv, cv)
             ) or pv <= 0:
                 continue
-            higher_better = any(m in key for m in _HIGHER_IS_BETTER_MARKERS)
+            higher_better = not any(
+                m in key for m in _LOWER_IS_BETTER_MARKERS
+            ) and any(m in key for m in _HIGHER_IS_BETTER_MARKERS)
             ratio = cv / pv
             worse = ratio < 0.75 if higher_better else ratio > 1.25
             if worse:
@@ -865,8 +873,17 @@ def bench_telemetry(fleet) -> dict:
       global tracing switch on vs off, same app and snapshot; the
       on/off delta over the off figure is the ≤5% acceptance check.
     - ``trace_ring_memory_kb`` — deep size of the ring after the on-leg
-      requests, bounding what a full ring costs resident."""
+      requests, bounding what a full ring costs resident.
+    - ``trace_propagation_overhead_us_per_request`` — what the ADR-028
+      header injection adds to one outbound pool request (headers copy
+      + current_traceparent + header set + counter), amortized; the
+      acceptance budget is ≤ 50 µs/request."""
     from headlamp_tpu.obs import span, set_tracing, trace_ring, trace_request
+    from headlamp_tpu.obs.propagate import (
+        TRACEPARENT_HEADER,
+        current_traceparent,
+        record_injected,
+    )
 
     # Per-span: real spans under a live trace, amortized over a batch.
     set_tracing(True)
@@ -878,6 +895,20 @@ def bench_telemetry(fleet) -> dict:
             with span("bench.span", idx=1):
                 pass
         per_span_ns = (time.perf_counter() - t0) / n * 1e9
+
+    # Propagation: the exact per-request work transport/pool.py adds —
+    # measured under an active trace (the expensive leg: the header IS
+    # formatted), against the ADR-028 50 µs acceptance budget.
+    with trace_request("/bench/propagate"):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            send_headers = dict({"accept": "application/json"})
+            if TRACEPARENT_HEADER not in send_headers:
+                tp = current_traceparent()
+                if tp is not None:
+                    send_headers[TRACEPARENT_HEADER] = tp
+                    record_injected()
+        propagate_us = (time.perf_counter() - t0) / n * 1e6
 
     app = make_app(fleet)
     app.handle("/tpu")  # warm: sync + rollup compile outside the timing
@@ -903,6 +934,8 @@ def bench_telemetry(fleet) -> dict:
         "handle_ms_tracing_on": round(on_ms, 2),
         "handle_ms_tracing_off": round(off_ms, 2),
         "trace_ring_memory_kb": round(ring_kb, 1),
+        "trace_propagation_overhead_us_per_request": round(propagate_us, 3),
+        "trace_propagation_within_budget": propagate_us <= 50.0,
     }
 
 
@@ -1462,7 +1495,9 @@ def bench_replication(fleet) -> dict:
         t = fx.fleet_transport(fleet)
         add_demo_prometheus(t, fleet)
         app = DashboardApp(t, min_sync_interval_s=30.0)
-        pub = BusPublisher()
+        # ADR-028: the publisher stamps "published" through the leader's
+        # ledger so bus records carry provenance (``obs``) downstream.
+        pub = BusPublisher(ledger=app.ledger)
         app.replication = pub
         if floor:
             pub.set_fencing(floor // GENERATION_STRIDE)
@@ -1528,6 +1563,27 @@ def bench_replication(fleet) -> dict:
         assert applied == n_gens, f"applied {applied}/{n_gens} generations"
         out["replication_apply_generations_per_sec"] = round(applied / apply_s, 1)
         out["replication_frames_per_sec"] = round(frames / apply_s, 1)
+
+        # ADR-028 provenance numbers: paint the replica's tip generation
+        # (first_paint stamps only on the FIRST paint of a generation —
+        # the backlog's tip has not been served yet), then read the
+        # replica ledger. Both processes share this host's wall clock,
+        # so the cross-process publish→paint delta is honest here.
+        _bench_get(ports[0], "/tpu?ledger=paint")
+        led = replicas[0].ledger.snapshot()
+        e2e_lags_ms: list[float] = []
+        ages_ms: list[float] = []
+        for entry in led["generations"]:
+            paint = entry["stages"].get("first_paint")
+            origin = entry.get("origin") or {}
+            pub_wall = origin.get("published_wall")
+            if paint is not None and isinstance(pub_wall, (int, float)):
+                e2e_lags_ms.append(max(paint["wall"] - pub_wall, 0.0) * 1000)
+            if entry["age_at_paint_ms"] is not None:
+                ages_ms.append(entry["age_at_paint_ms"])
+        assert ages_ms, "replica ledger recorded no paints"
+        out["generation_e2e_lag_ms"] = round(statistics.median(e2e_lags_ms), 3)
+        out["age_at_paint_p50_ms"] = round(statistics.median(ages_ms), 3)
 
         # Scripted leader-kill drill: kill the leader, prove the
         # replica answers stale-stamped with zero 5xx, then start a new

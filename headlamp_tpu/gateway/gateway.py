@@ -286,6 +286,7 @@ class RenderGateway:
         *,
         accept: str | None = None,
         if_none_match: str | None = None,
+        traceparent: str | None = None,
     ) -> GatewayResponse:
         route = self._route_label(path)
         if route == "/healthz":
@@ -294,7 +295,14 @@ class RenderGateway:
             # operator learns the pool is wedged.
             self.bypassed += 1
             _REQUESTS.inc(priority="ops", outcome="bypass")
-            return GatewayResponse(*self._handle(path, accept=accept))
+            # traceparent passed only when present: handle callables
+            # predating ADR-028 (test fakes, plugins) keep working.
+            # Keyword forwarding, not header construction — the wire
+            # header is written only by the pool (TRC001).
+            extra = dict(traceparent=traceparent) if traceparent else {}
+            return GatewayResponse(
+                *self._handle(path, accept=accept, **extra)
+            )
         priority = self.classify(route)
         pname = PRIORITY_NAMES[priority]
         decision = self.shed_policy.decide(route, priority)
@@ -344,14 +352,18 @@ class RenderGateway:
                 return self._follow(flight, route, pname, decision.burn_state)
             try:
                 response = self._render(
-                    path, route, priority, pname, accept, decision
+                    path, route, priority, pname, accept, decision,
+                    traceparent=traceparent,
                 )
             except BaseException as exc:
                 self.coalescer.finish(key, flight, error=exc)
                 raise
             self.coalescer.finish(key, flight, result=response)
             return response
-        return self._render(path, route, priority, pname, accept, decision)
+        return self._render(
+            path, route, priority, pname, accept, decision,
+            traceparent=traceparent,
+        )
 
     def _follow(
         self,
@@ -392,6 +404,8 @@ class RenderGateway:
         pname: str,
         accept: str | None,
         decision: Any,
+        *,
+        traceparent: str | None = None,
     ) -> GatewayResponse:
         """Admit into the pool and wait. All the 503 paths below are
         gateway-synthesized: requests_total only, no histogram (the
@@ -408,7 +422,17 @@ class RenderGateway:
                 "degraded": degraded,
             }
             with degraded_scope(degraded):
-                return self._handle(path, accept=accept, gateway_info=info)
+                # The LEADER's traceparent rides into the render; a
+                # coalesced follower's is honestly dropped — its bytes
+                # came from the leader's flight, and stitching it to a
+                # render it did not cause would lie (ADR-028). Passed
+                # only when present so pre-ADR-028 handle callables
+                # keep working; keyword forwarding, not header
+                # construction (TRC001).
+                extra = dict(traceparent=traceparent) if traceparent else {}
+                return self._handle(
+                    path, accept=accept, gateway_info=info, **extra
+                )
 
         try:
             job = self.pool.submit(route, priority, run)

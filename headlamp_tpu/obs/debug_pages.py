@@ -376,3 +376,141 @@ def slo_page(report: dict[str, Any]) -> Element:
         if ordered
         else h("div", {"class_": "hl-empty-content"}, "No SLOs declared."),
     )
+
+
+def _generation_section(entry: dict[str, Any], threshold_s: float) -> Element:
+    """One generation's lifecycle as a waterfall: stage bars positioned
+    by their wall stamps relative to the generation's first stamp
+    (display only — the LAG numbers alongside each bar came from the
+    injected monotonic, ADR-013), trace ids linking each stage to its
+    request waterfall."""
+    stages = entry.get("stages", {})
+    walls = [s["wall"] for s in stages.values()]
+    first_wall = min(walls) if walls else 0.0
+    total_ms = max((max(walls) - first_wall) * 1000.0, 1e-6) if walls else 1.0
+    trace_ids = entry.get("trace_ids", {})
+    rows: list[Element] = []
+    for stage, stamp in stages.items():
+        left = min((stamp["wall"] - first_wall) * 1000.0 / total_ms * 100.0, 100.0)
+        width = 0.5
+        if stamp.get("lag_ms"):
+            width = max(min(stamp["lag_ms"] / total_ms * 100.0, left), 0.5)
+        trace_id = trace_ids.get(stage)
+        rows.append(
+            h(
+                "div",
+                {"class_": "hl-span-row"},
+                h("span", {"class_": "hl-span-label"}, stage),
+                h(
+                    "span",
+                    {"class_": "hl-span-track"},
+                    h(
+                        "span",
+                        {
+                            "class_": "hl-span-bar",
+                            "style": (
+                                f"margin-left:{max(left - width, 0.0):.2f}%;"
+                                f"width:{width:.2f}%"
+                            ),
+                        },
+                    ),
+                ),
+                h(
+                    "span",
+                    {"class_": "hl-span-ms"},
+                    _fmt_ms(stamp["lag_ms"]) if stamp.get("lag_ms") is not None else "—",
+                ),
+                trace_id
+                and h(
+                    "a",
+                    {
+                        "class_": "hl-span-attrs",
+                        "href": f"/debug/traces/html#trace-{trace_id}",
+                    },
+                    f"trace {trace_id}",
+                ),
+            )
+        )
+    age_ms = entry.get("age_at_paint_ms")
+    breached = bool(entry.get("breached"))
+    status_class = "hl-status-err" if breached else "hl-status-ok"
+    badge = "STALE" if breached else entry.get("role", "?")
+    origin = entry.get("origin") or {}
+    origin_trace = origin.get("trace_id")
+    hint = (
+        f"age at first paint {_fmt_ms(age_ms)} (threshold "
+        f"{threshold_s * 1000:.0f} ms)"
+        if age_ms is not None
+        else "not painted yet"
+    )
+    if origin_trace:
+        hint += f" · origin trace {origin_trace}"
+    return h(
+        "section",
+        {"class_": "hl-section hl-trace"},
+        h(
+            "header",
+            {"class_": "hl-trace-header"},
+            h("span", {"class_": f"hl-status {status_class}"}, badge),
+            h("strong", None, f"generation {entry['generation']}"),
+            h("span", {"class_": "hl-hint"}, hint),
+        ),
+        rows
+        or h("p", {"class_": "hl-hint"}, "No lifecycle stages recorded."),
+    )
+
+
+def _transition_line(transition: dict[str, Any]) -> Element:
+    stamp = time.strftime(
+        "%H:%M:%S", time.localtime(transition.get("wall", 0.0))
+    )  # display only (ADR-013)
+    return h(
+        "p",
+        {"class_": "hl-hint"},
+        f"{stamp} · {transition.get('kind', '?')} "
+        f"(fencing {transition.get('fencing', 0)})",
+    )
+
+
+def generations_page(snapshot: dict[str, Any]) -> Element:
+    """The generation-provenance timeline (ADR-028). ``snapshot`` is
+    ``GenerationLedger.snapshot()`` — freshness-SLO breaches pinned
+    first (they are why the page was opened), then recent generations
+    newest-first, leadership transitions at the bottom where a
+    failover explains a lag cliff."""
+    pinned = snapshot.get("pinned", [])
+    recent = snapshot.get("generations", [])
+    threshold_s = float(snapshot.get("freshness_threshold_s", 0.0))
+    transitions = snapshot.get("transitions", [])
+    return h(
+        "div",
+        {"class_": "hl-traces hl-generations"},
+        h("h1", None, "Generation Provenance"),
+        h(
+            "p",
+            {"class_": "hl-hint"},
+            f"role {snapshot.get('role', '?')} · {len(recent)} recent "
+            f"generation(s) · {snapshot.get('breaches', 0)} freshness "
+            f"breach(es), threshold {threshold_s:g} s. Raw JSON: "
+            "/debug/generationz · stage lags: "
+            "headlamp_tpu_generation_stage_seconds on /metricsz "
+            "(OPERATIONS.md runbook).",
+        ),
+        pinned
+        and [
+            h("h2", None, "Pinned freshness breaches"),
+            [_generation_section(e, threshold_s) for e in pinned],
+        ],
+        [_generation_section(e, threshold_s) for e in recent]
+        if recent
+        else h(
+            "div",
+            {"class_": "hl-empty-content"},
+            "No generations recorded yet — sync once, then refresh.",
+        ),
+        transitions
+        and [
+            h("h2", None, "Leadership transitions"),
+            [_transition_line(t) for t in reversed(transitions)],
+        ],
+    )

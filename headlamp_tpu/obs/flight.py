@@ -71,6 +71,7 @@ def wide_event(
     counters_before: Mapping[str, Any] | None = None,
     counters_after: Mapping[str, Any] | None = None,
     gateway: Mapping[str, Any] | None = None,
+    replication: Mapping[str, Any] | None = None,
 ) -> dict[str, Any]:
     """Collapse one request into its flight-recorder event. ``trace``
     is the already-frozen trace dict (the same one the trace ring
@@ -101,6 +102,11 @@ def wide_event(
         # degraded flag — the triage question "was this slow render
         # actually a slow QUEUE" answered without opening the trace.
         event["gateway"] = dict(gateway)
+    if replication is not None:
+        # Replication-side context (ADR-028): role, applied generation,
+        # bus cursor — "was this paint serving stale data" answered
+        # from the event itself.
+        event["replication"] = dict(replication)
     return event
 
 

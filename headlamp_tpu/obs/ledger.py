@@ -1,0 +1,344 @@
+"""Generation provenance ledger (ADR-028).
+
+Every snapshot generation in the read tier lives a six-stage life:
+scraped on the leader (``scrape_start``), classified into a snapshot
+(``synced``), encoded onto the bus (``published``), decoded on a
+replica (``applied``), diffed into push frames (``diff_framed``), and
+finally painted for a user (``first_paint``). Before this ledger the
+only end-to-end number was the coarse ``replicate_lag_seconds`` gauge —
+"how stale is the paint a user just saw" was unanswerable.
+
+The :class:`GenerationLedger` stamps each stage on the INJECTED clocks
+(ADR-013: monotonic for every elapsed number, the injected wall only
+for display stamps and for the one delta no single process can measure
+monotonically — a replica-side stage whose predecessor happened in the
+leader). Each stamp observes the lag since the generation's previous
+lifecycle event into ``headlamp_tpu_generation_stage_seconds{stage}``;
+the first paint of a generation observes its total data age into
+``headlamp_tpu_generation_age_at_paint_seconds{role}`` — inside the
+painting request's trace, so the histogram's OpenMetrics exemplars
+link straight to the waterfall. That histogram feeds the
+``data_freshness`` SLOSpec (obs/slo.py); generations whose age breaches
+:data:`FRESHNESS_THRESHOLD_S` are pinned here so ``/debug/generationz``
+keeps the evidence after the ring rotates.
+
+Strictly observational: stamps happen AFTER bytes are built (paint,
+ETag, push frame bytes are byte-identical with the ledger active), and
+the ledger never raises into a serving path — stage math is a dict
+insert plus one histogram observe.
+
+Cross-process linkage: the leader's ledger contributes a ``provenance``
+dict (trace id + wall stamps) that rides the ADR-025 bus record as an
+optional ``obs`` field — v1 consumers ignore it (unknown record FIELDS
+are forward-compatible by the ``.get`` discipline; only unknown KINDS
+are skipped) — and the replica's ledger stores it as each generation's
+``origin``, closing the loop the traceparent seam (obs/propagate.py)
+opens for live requests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Mapping
+
+from .metrics import registry
+
+#: Lifecycle stages in nominal order. The order is documentation — lag
+#: is measured against the generation's most RECENT prior stamp, not a
+#: fixed predecessor, because roles legitimately reorder (a leader
+#: diff-frames before it publishes; a replica never syncs).
+STAGES = (
+    "scrape_start",
+    "synced",
+    "published",
+    "applied",
+    "diff_framed",
+    "first_paint",
+)
+
+#: Recent generations retained per process — same sizing rationale as
+#: the trace ring: O(capacity) memory, always answers "what happened
+#: recently".
+LEDGER_CAPACITY = 64
+
+#: Freshness-breaching generations pinned past rotation.
+PINNED_CAPACITY = 16
+
+#: Data age at first paint beyond which a generation breaches the
+#: ``data_freshness`` SLO (threshold_s of the obs/slo.py spec). Sits
+#: between the leader's 5 s metrics TTL and the replica's 30 s
+#: stale-paint threshold: one missed bus poll is fine, three are not.
+FRESHNESS_THRESHOLD_S = 10.0
+
+STAGE_SECONDS_NAME = "headlamp_tpu_generation_stage_seconds"
+AGE_AT_PAINT_NAME = "headlamp_tpu_generation_age_at_paint_seconds"
+
+_STAGE_SECONDS = registry.histogram(
+    STAGE_SECONDS_NAME,
+    "Lag between consecutive lifecycle stages of a snapshot generation",
+    labels=("stage",),
+)
+_AGE_AT_PAINT = registry.histogram(
+    AGE_AT_PAINT_NAME,
+    "Age of a generation's data (since scrape start) at its first paint",
+    labels=("role",),
+)
+
+
+class GenerationLedger:
+    """Per-process lifecycle ledger. One instance per app (leader or
+    replica), wired by ``DashboardApp.__init__``; the publisher, push
+    pipeline, and paint path all stamp through it. Thread-safe — the
+    sync loop, bus consumer, and request threads all write."""
+
+    def __init__(
+        self,
+        *,
+        monotonic: Callable[[], float] | None = None,
+        wall: Callable[[], float] = time.time,
+        role: str = "leader",
+        capacity: int = LEDGER_CAPACITY,
+        pinned_capacity: int = PINNED_CAPACITY,
+        freshness_threshold_s: float = FRESHNESS_THRESHOLD_S,
+    ) -> None:
+        self._mono = monotonic or time.monotonic
+        self._wall = wall
+        self.role = role
+        self.capacity = int(capacity)
+        self.freshness_threshold_s = float(freshness_threshold_s)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[int, dict[str, Any]] = OrderedDict()
+        self._pinned: OrderedDict[int, dict[str, Any]] = OrderedDict()
+        self._pinned_capacity = int(pinned_capacity)
+        #: (mono, wall) of the scrape that will become the NEXT synced
+        #: generation — stamped before the generation number exists.
+        self._pending_scrape: tuple[float, float] | None = None
+        #: Leadership transitions (ADR-025 elector hook) interleaved on
+        #: the generationz timeline — a failover explains a lag spike.
+        self._transitions: deque[dict[str, Any]] = deque(maxlen=16)
+        self.breaches = 0
+
+    # -- stamping ---------------------------------------------------------
+
+    def _entry(self, generation: int) -> dict[str, Any]:
+        entry = self._entries.get(generation)
+        if entry is None:
+            entry = {
+                "generation": int(generation),
+                "role": self.role,
+                "stages": {},
+                "trace_ids": {},
+                "origin": None,
+                "age_at_paint_ms": None,
+                "breached": False,
+            }
+            self._entries[generation] = entry
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+        return entry
+
+    def _stamp(
+        self,
+        generation: int,
+        stage: str,
+        *,
+        trace_id: str | None = None,
+        origin_wall: float | None = None,
+    ) -> bool:
+        """Record ``stage`` for ``generation`` (first stamp wins) and
+        observe the lag since the generation's most recent prior stamp
+        — or, for the first replica-side stage, since ``origin_wall``
+        (the leader's wall stamp: the one cross-process delta only the
+        shared wall clock can provide; clamped at 0 against skew).
+        Returns True iff this call freshly stamped the stage."""
+        if generation is None or generation <= 0:
+            return False
+        now_mono, now_wall = self._mono(), self._wall()
+        with self._lock:
+            entry = self._entry(generation)
+            stages = entry["stages"]
+            if stage in stages:
+                return False
+            lag_s: float | None = None
+            prior = max(
+                (s["mono"] for s in stages.values()), default=None
+            )
+            if prior is not None:
+                lag_s = max(now_mono - prior, 0.0)
+            elif origin_wall is not None:
+                lag_s = max(now_wall - origin_wall, 0.0)
+            stages[stage] = {
+                "mono": now_mono,
+                "wall": now_wall,
+                "lag_ms": None if lag_s is None else round(lag_s * 1000, 3),
+            }
+            if trace_id:
+                entry["trace_ids"][stage] = trace_id
+        if lag_s is not None:
+            _STAGE_SECONDS.observe(lag_s, stage=stage)
+        return True
+
+    def scrape_started(self) -> None:
+        """A scrape is in flight; the generation it will become is not
+        known yet. Latest wins — a failed scrape's stamp is simply
+        superseded by the retry that produces the generation."""
+        with self._lock:
+            self._pending_scrape = (self._mono(), self._wall())
+
+    def synced(self, generation: int, *, trace_id: str | None = None) -> None:
+        """The scrape classified into snapshot ``generation``. Attaches
+        the pending scrape stamp as the generation's ``scrape_start``
+        anchor, then stamps ``synced``."""
+        if generation is None or generation <= 0:
+            return
+        with self._lock:
+            pending, self._pending_scrape = self._pending_scrape, None
+            entry = self._entry(generation)
+            if pending is not None and "scrape_start" not in entry["stages"]:
+                entry["stages"]["scrape_start"] = {
+                    "mono": pending[0],
+                    "wall": pending[1],
+                    "lag_ms": None,
+                }
+        self._stamp(generation, "synced", trace_id=trace_id)
+
+    def published(self, generation: int, *, trace_id: str | None = None) -> None:
+        self._stamp(generation, "published", trace_id=trace_id)
+
+    def applied(
+        self,
+        generation: int,
+        *,
+        origin: Mapping[str, Any] | None = None,
+        trace_id: str | None = None,
+    ) -> None:
+        """Replica-side: record the leader's provenance (the bus
+        record's ``obs`` field) as this generation's origin and stamp
+        ``applied`` — lag measured against the leader's publish wall
+        stamp, the first cross-process edge."""
+        if generation is None or generation <= 0:
+            return
+        origin_wall = None
+        if origin:
+            with self._lock:
+                self._entry(generation)["origin"] = dict(origin)
+            for key in ("published_wall", "synced_wall", "scrape_start_wall"):
+                if isinstance(origin.get(key), (int, float)):
+                    origin_wall = float(origin[key])
+                    break
+        self._stamp(
+            generation, "applied", trace_id=trace_id, origin_wall=origin_wall
+        )
+
+    def diff_framed(self, generation: int) -> None:
+        self._stamp(generation, "diff_framed")
+
+    def paint(
+        self, generation: int, *, trace_id: str | None = None
+    ) -> float | None:
+        """First paint of ``generation`` — stamps ``first_paint`` and
+        observes the end-to-end data age (scrape start → this paint).
+        Subsequent paints of the same generation are no-ops: the SLO
+        counts each generation's freshness ONCE, at the moment a user
+        first saw it. Returns the age in seconds (None off the first
+        paint or when no scrape anchor exists, e.g. a leaderless
+        restart)."""
+        if not self._stamp(generation, "first_paint", trace_id=trace_id):
+            return None
+        with self._lock:
+            entry = self._entries.get(generation)
+            if entry is None:
+                return None
+            stamp = entry["stages"]["first_paint"]
+            age_s: float | None = None
+            anchor = entry["stages"].get("scrape_start")
+            if anchor is not None:
+                age_s = max(stamp["mono"] - anchor["mono"], 0.0)
+            else:
+                origin = entry["origin"] or {}
+                origin_scrape = origin.get("scrape_start_wall")
+                if isinstance(origin_scrape, (int, float)):
+                    age_s = max(stamp["wall"] - float(origin_scrape), 0.0)
+            if age_s is None:
+                return None
+            entry["age_at_paint_ms"] = round(age_s * 1000, 3)
+            breached = age_s > self.freshness_threshold_s
+            entry["breached"] = breached
+            if breached:
+                self.breaches += 1
+                self._pinned[entry["generation"]] = entry
+                while len(self._pinned) > self._pinned_capacity:
+                    self._pinned.popitem(last=False)
+        _AGE_AT_PAINT.observe(age_s, role=self.role)
+        return age_s
+
+    def note_transition(self, kind: str, *, fencing: int = 0) -> None:
+        """ADR-025 elector hook: elections/depositions land on the
+        generationz timeline, where they explain lag cliffs."""
+        with self._lock:
+            self._transitions.append(
+                {"kind": kind, "fencing": int(fencing), "wall": self._wall()}
+            )
+
+    # -- reading ----------------------------------------------------------
+
+    def provenance(self, generation: int) -> dict[str, Any] | None:
+        """The compact cross-process record the bus ships as ``obs``:
+        the publishing trace id plus leader wall stamps. None when the
+        generation is unknown (publishers without a wired ledger ship
+        no field at all — existing payload bytes unchanged)."""
+        with self._lock:
+            entry = self._entries.get(generation)
+            if entry is None:
+                return None
+            out: dict[str, Any] = {}
+            trace_id = entry["trace_ids"].get("published") or entry[
+                "trace_ids"
+            ].get("synced")
+            if trace_id:
+                out["trace_id"] = trace_id
+            for stage in ("scrape_start", "synced", "published"):
+                stamp = entry["stages"].get(stage)
+                if stamp is not None:
+                    out[f"{stage}_wall"] = round(stamp["wall"], 6)
+            return out or None
+
+    def _render(self, entry: dict[str, Any]) -> dict[str, Any]:
+        stages = {
+            stage: {
+                "wall": round(stamp["wall"], 3),
+                "lag_ms": stamp["lag_ms"],
+            }
+            for stage, stamp in entry["stages"].items()
+        }
+        return {
+            "generation": entry["generation"],
+            "role": entry["role"],
+            "stages": {s: stages[s] for s in STAGES if s in stages},
+            "trace_ids": dict(entry["trace_ids"]),
+            "origin": dict(entry["origin"]) if entry["origin"] else None,
+            "age_at_paint_ms": entry["age_at_paint_ms"],
+            "breached": entry["breached"],
+        }
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-ready view for ``/debug/generationz`` — recent
+        generations newest-first, freshness breaches pinned past
+        rotation, leadership transitions interleaved."""
+        with self._lock:
+            return {
+                "role": self.role,
+                "freshness_threshold_s": self.freshness_threshold_s,
+                "breaches": self.breaches,
+                "generations": [
+                    self._render(e) for e in reversed(self._entries.values())
+                ],
+                "pinned": [
+                    self._render(e)
+                    for e in reversed(self._pinned.values())
+                    if e["generation"] not in self._entries
+                ],
+                "transitions": list(self._transitions),
+            }

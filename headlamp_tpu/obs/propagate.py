@@ -1,0 +1,122 @@
+"""W3C-style ``traceparent`` propagation (ADR-028).
+
+Cross-process trace stitching for the read tier: the single ADR-014
+transport seam (``transport/pool.py``) injects the calling context's
+trace id as a ``traceparent`` request header on every outbound request,
+and the app layer extracts it so a replica's bus poll, a fan-out
+scrape, and a gateway request all join one logical trace — each process
+minting its OWN trace id (obs/trace.py) and recording the caller's as
+``remote_parent``.
+
+Format: the standard ``00-<trace-id 32 hex>-<parent-id 16 hex>-<flags
+2 hex>``. This repo's native trace ids are 16 hex chars (os.urandom(8),
+pinned by the /metricsz exemplar grammar), so formatting LEFT-PADS to
+the 32-hex wire field and parsing takes the LAST 16 — a round trip is
+identity for native ids, while headers minted by full-width W3C
+tracers still parse (their low 64 bits become the link, honestly
+lossy). The parent-id field carries the native trace id too: this repo
+spans have no individual ids, so the request root IS the parent.
+
+Seam discipline (TRC001): this module owns the header NAME, the format
+and the parse — but never writes a header mapping. The only place in
+``headlamp_tpu/`` allowed to construct the ``traceparent`` request
+header is ``transport/pool.py``; everyone else only *reads* inbound
+headers. A second injection site would double-stamp retries and forks,
+and the analysis rule keeps the seam single.
+
+Every injection/extraction/rejection is counted
+(``headlamp_tpu_trace_propagation_total{direction}``) so a
+misconfigured fleet — replicas polling a leader that never stamps, a
+proxy mangling headers — shows up on /metricsz instead of as silently
+unjoined traces.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import NamedTuple
+
+from .metrics import registry
+from .trace import current_trace_id
+
+#: The one header name. Lower-case on the wire; http.server's message
+#: objects match case-insensitively on read.
+TRACEPARENT_HEADER = "traceparent"
+
+#: version 00 only — the only version defined; anything else is
+#: forward-compatibly rejected (counted, never raised).
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+#: All-zero ids are explicitly invalid per the W3C grammar.
+_ZERO_TRACE = "0" * 32
+_ZERO_SPAN = "0" * 16
+
+_PROPAGATION = registry.counter(
+    "headlamp_tpu_trace_propagation_total",
+    "traceparent headers injected at the transport seam, extracted by "
+    "the app layer, or rejected as malformed",
+    labels=("direction",),
+)
+
+
+class RemoteParent(NamedTuple):
+    """A successfully parsed inbound ``traceparent``. ``trace_id`` is
+    the 16-hex native form (low 64 bits of the wire field) — what
+    ``Trace.remote_parent`` stores and the debug pages link on."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool
+
+
+def format_traceparent(
+    trace_id: str, span_id: str | None = None, *, sampled: bool = True
+) -> str:
+    """Render a native 16-hex (or full 32-hex) trace id as a wire
+    ``traceparent`` value. ``span_id`` defaults to the trace id — the
+    request root is the parent span in this repo's model."""
+    span_part = (span_id or trace_id)[-16:].rjust(16, "0")
+    return (
+        f"00-{trace_id[-32:].rjust(32, '0')}-{span_part}-"
+        f"{'01' if sampled else '00'}"
+    )
+
+
+def parse_traceparent(value: str | None) -> RemoteParent | None:
+    """Parse an inbound header value; None (counted ``invalid``) for
+    anything malformed, future-versioned, or zero-id. A missing header
+    (value None/empty) is NOT an error — it is simply not counted."""
+    if not value:
+        return None
+    m = _TRACEPARENT_RE.match(value.strip())
+    if m is None:
+        _PROPAGATION.inc(direction="invalid")
+        return None
+    trace_hex, span_hex, flags = m.group(1), m.group(2), m.group(3)
+    if trace_hex == _ZERO_TRACE or span_hex == _ZERO_SPAN:
+        _PROPAGATION.inc(direction="invalid")
+        return None
+    _PROPAGATION.inc(direction="extracted")
+    return RemoteParent(
+        trace_id=trace_hex[-16:],
+        span_id=span_hex,
+        sampled=bool(int(flags, 16) & 0x01),
+    )
+
+
+def current_traceparent() -> str | None:
+    """The wire value for the calling context's active trace, or None
+    outside one. One ContextVar.get + one f-string — the per-request
+    injection cost the ≤50 µs propagation budget bounds."""
+    trace_id = current_trace_id()
+    if trace_id is None:
+        return None
+    return format_traceparent(trace_id)
+
+
+def record_injected() -> None:
+    """Count one outbound injection — called ONLY by the transport
+    seam, right where it writes the header."""
+    _PROPAGATION.inc(direction="injected")

@@ -42,6 +42,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping
 
 from .exemplars import exemplars_matching
+from .ledger import AGE_AT_PAINT_NAME, FRESHNESS_THRESHOLD_S
 from .metrics import registry as _metrics_registry
 
 # -- instrument names the feeds subscribe to (mirrors of the producers'
@@ -53,6 +54,7 @@ FIT_DURATION = "headlamp_tpu_refresh_fit_duration_seconds"
 CONNECT_LATENCY = "headlamp_tpu_transport_connect_latency_seconds"
 CONNECT_FAILURES = "headlamp_tpu_transport_connect_failures_total"
 STALE_RETRIES = "headlamp_tpu_transport_stale_retries_total"
+AGE_AT_PAINT = AGE_AT_PAINT_NAME
 
 #: (name, help, labels) for every histogram the engine observes.
 _LATENCY_SOURCES: tuple[tuple[str, str, tuple[str, ...]], ...] = (
@@ -72,6 +74,11 @@ _LATENCY_SOURCES: tuple[tuple[str, str, tuple[str, ...]], ...] = (
         CONNECT_LATENCY,
         "TCP(+TLS) connection establishment latency, per host.",
         ("host",),
+    ),
+    (
+        AGE_AT_PAINT,
+        "Age of a generation's data (since scrape start) at its first paint",
+        ("role",),
     ),
 )
 
@@ -245,6 +252,15 @@ def default_specs() -> tuple[SLOSpec, ...]:
             latency_metric=CONNECT_LATENCY,
             latency_where={},
             error_feeds=((CONNECT_FAILURES, {}), (STALE_RETRIES, {})),
+        ),
+        SLOSpec(
+            name="data_freshness",
+            description="Painted data younger than the freshness "
+            "threshold at each generation's first paint, end to end",
+            target=0.99,
+            threshold_s=FRESHNESS_THRESHOLD_S,
+            latency_metric=AGE_AT_PAINT,
+            latency_where={},
         ),
     )
 

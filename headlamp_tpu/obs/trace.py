@@ -140,6 +140,16 @@ class span:
         return False
 
 
+def set_remote_parent(trace_id: str | None) -> None:
+    """Link the calling context's trace to a trace in another process
+    (no-op outside a trace or with a None id). The seam the replica's
+    apply path uses when the leader's trace id only becomes known
+    mid-trace — from the bus record, after the poll trace opened."""
+    trace = _TRACE.get()
+    if trace is not None and trace_id:
+        trace.remote_parent = trace_id
+
+
 def annotate(**attrs: Any) -> None:
     """Attach attributes to the innermost open span (no-op without
     one). Lets producers that don't own a span — the device cache
@@ -167,17 +177,31 @@ class Trace:
         "path",
         "started_at",
         "trace_id",
+        "remote_parent",
         "root",
         "route",
         "status",
         "device_gets",
     )
 
-    def __init__(self, path: str, *, started_at: float = 0.0) -> None:
+    def __init__(
+        self,
+        path: str,
+        *,
+        started_at: float = 0.0,
+        remote_parent: str | None = None,
+    ) -> None:
         self.path = path
         self.started_at = started_at
         self.trace_id = os.urandom(8).hex()
         self.root = Span("request", {})
+        #: Trace id of the request in ANOTHER process this trace is a
+        #: continuation of (ADR-028): a leader's bus-serve joins the
+        #: polling replica's trace, a replica's apply joins the leader's
+        #: publishing trace. Local ids stay process-minted — the remote
+        #: parent is a link, never an identity override, so exemplar and
+        #: flight-recorder plumbing is untouched.
+        self.remote_parent = remote_parent
         self.route = path
         self.status = 0
         self.device_gets = 0
@@ -192,7 +216,7 @@ class Trace:
     def to_dict(self) -> dict[str, Any]:
         t0 = self.root.t0
         end = self.root.t1 if self.root.t1 is not None else t0
-        return {
+        out = {
             "trace_id": self.trace_id,
             "path": self.path,
             "route": self.route,
@@ -202,6 +226,9 @@ class Trace:
             "device_gets": self.device_gets,
             "spans": [_span_dict(c, t0) for c in self.root.children],
         }
+        if self.remote_parent is not None:
+            out["remote_parent"] = self.remote_parent
+        return out
 
 
 def _span_dict(s: Span, t0: float) -> dict[str, Any]:
@@ -225,22 +252,44 @@ class trace_request:
 
     ``wall`` supplies the display-only started_at stamp — the app layer
     passes its injected clock; the ``time.time`` default is a seam
-    reference, never called on an injected path (no-wall-clock gate)."""
+    reference, never called on an injected path (no-wall-clock gate).
 
-    __slots__ = ("_path", "_enabled", "_wall", "_trace", "_token", "_trace_token")
+    ``remote_parent`` carries the 16-hex trace id extracted from an
+    inbound ``traceparent`` header (obs/propagate.py), stitching this
+    trace under the caller's in another process (ADR-028)."""
+
+    __slots__ = (
+        "_path",
+        "_enabled",
+        "_wall",
+        "_remote_parent",
+        "_trace",
+        "_token",
+        "_trace_token",
+    )
 
     def __init__(
-        self, path: str, *, enabled: bool = True, wall: Any = time.time
+        self,
+        path: str,
+        *,
+        enabled: bool = True,
+        wall: Any = time.time,
+        remote_parent: str | None = None,
     ) -> None:
         self._path = path
         self._enabled = enabled
         self._wall = wall
+        self._remote_parent = remote_parent
         self._trace: Trace | None = None
 
     def __enter__(self) -> Trace | None:
         if not (_enabled and self._enabled) or _ACTIVE.get() is not None:
             return None
-        trace = Trace(self._path, started_at=self._wall())
+        trace = Trace(
+            self._path,
+            started_at=self._wall(),
+            remote_parent=self._remote_parent,
+        )
         self._trace = trace
         self._token = _ACTIVE.set(trace.root)
         self._trace_token = _TRACE.set(trace)

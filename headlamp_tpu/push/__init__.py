@@ -101,6 +101,7 @@ class PushPipeline:
         backlog_limit: int = BACKLOG_LIMIT,
         shed_check: Callable[[], bool] | None = None,
         fragments: Any = None,
+        ledger: Any = None,
     ) -> None:
         self._mono = monotonic or time.monotonic
         self.hub = BroadcastHub(
@@ -120,6 +121,10 @@ class PushPipeline:
         #: one is wired: every diffed generation evicts exactly the
         #: keys its change set names, at diff time, on the sync thread.
         self._fragments = fragments
+        #: Optional GenerationLedger (ADR-028): each diffed generation
+        #: stamps ``diff_framed`` — observational only, after the
+        #: frames are built.
+        self._ledger = ledger
         # Monotone per-instance ints (healthz block + flight deltas).
         self.diffs = 0
         self.baselines = 0
@@ -158,6 +163,8 @@ class PushPipeline:
             self._models = models
             self.generation = int(generation)
             _DIFF_SECONDS.observe(max(self._mono() - t0, 0.0))
+            if self._ledger is not None:
+                self._ledger.diff_framed(int(generation))
             if baseline:
                 self.baselines += 1
                 return 0

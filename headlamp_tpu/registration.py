@@ -15,7 +15,12 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
-from .obs.debug_pages import profile_page, slo_page, traces_page
+from .obs.debug_pages import (
+    generations_page,
+    profile_page,
+    slo_page,
+    traces_page,
+)
 from .integrations import (
     build_node_intel_columns,
     build_node_tpu_columns,
@@ -234,6 +239,15 @@ def register_plugin(registry: Registry | None = None) -> Registry:
                 "debug-profile",
                 profile_page,
                 kind="profile",
+            ),
+            # Generation-provenance timeline (ADR-028): same operator-
+            # tool posture; the host's kind dispatch hands it the
+            # ledger snapshot. JSON twin is /debug/generationz.
+            Route(
+                "/debug/generationz/html",
+                "debug-generations",
+                generations_page,
+                kind="generations",
             ),
         ]
     )

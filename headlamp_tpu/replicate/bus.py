@@ -48,7 +48,7 @@ from typing import Any, Callable, Iterable
 from ..context.accelerator_context import ClusterSnapshot, ProviderState
 from ..domain.accelerator import PROVIDERS, classify_fleet
 from ..obs.metrics import registry as _metrics_registry
-from ..obs.trace import span
+from ..obs.trace import current_trace_id, span
 
 BUS_VERSION = 1
 BUS_FORMAT = "headlamp-tpu-bus"
@@ -246,9 +246,18 @@ def build_record(
     metrics: Any = None,
     forecast: Any = None,
     history: list[list[Any]] | None = None,
+    obs: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
-    """One self-contained generation record (not yet encoded)."""
-    return {
+    """One self-contained generation record (not yet encoded).
+
+    ``obs`` is the optional ADR-028 provenance block (the leader's
+    trace id plus wall stamps of the generation's lifecycle stages,
+    from ``GenerationLedger.provenance``). Field-evolution contract:
+    new fields are OPTIONAL and OMITTED when absent — a v1 consumer
+    reading with ``.get`` ignores them, and a record built without
+    provenance re-encodes byte-identically to pre-ADR-028 builds.
+    ``BUS_VERSION`` bumps only for incompatible shape changes."""
+    record = {
         "kind": "generation",
         "generation": int(generation),
         "fencing": int(fencing),
@@ -257,6 +266,9 @@ def build_record(
         "forecast": encode_forecast(forecast),
         "history": history if history is not None else history_rows(snap, generation),
     }
+    if obs:
+        record["obs"] = obs
+    return record
 
 
 def parse_payload(text: str, *, origin: str = "<bus>") -> tuple[dict[str, Any], list[dict[str, Any]]]:
@@ -316,8 +328,13 @@ class BusPublisher:
         monotonic: Callable[[], float] | None = None,
         wall: Callable[[], float] = time.time,
         note: str = "leader",
+        ledger: Any = None,
     ) -> None:
         self._mono = monotonic or time.monotonic
+        #: Optional GenerationLedger (ADR-028): when present, each
+        #: accepted publish is stamped and the record carries the
+        #: ledger's provenance block for replica-side stitching.
+        self._ledger = ledger
         self._lock = threading.Lock()
         self.backlog_limit = backlog_limit
         self._header = header_line(wall=wall, note=note)
@@ -387,6 +404,15 @@ class BusPublisher:
                 fresh_scrape = (
                     metrics is not None and stamp != self._last_scrape_stamp
                 )
+                obs = None
+                if self._ledger is not None:
+                    # Stamp BEFORE building the record so the record's
+                    # provenance block carries this publish (trace id +
+                    # lifecycle wall stamps) to the replicas.
+                    self._ledger.published(
+                        generation, trace_id=current_trace_id()
+                    )
+                    obs = self._ledger.provenance(generation)
                 record = build_record(
                     snap,
                     generation=generation,
@@ -399,6 +425,7 @@ class BusPublisher:
                         metrics=metrics,
                         include_scrape=fresh_scrape,
                     ),
+                    obs=obs,
                 )
                 if fresh_scrape:
                     self._last_scrape_stamp = stamp

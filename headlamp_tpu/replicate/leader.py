@@ -133,6 +133,7 @@ class LeaderElector:
         monotonic: Callable[[], float] | None = None,
         on_elected: Callable[[int], None] | None = None,
         on_deposed: Callable[[], None] | None = None,
+        ledger: Any = None,
     ) -> None:
         self.store = store
         self.node_id = node_id
@@ -140,6 +141,10 @@ class LeaderElector:
         self._mono = monotonic or time.monotonic
         self._on_elected = on_elected
         self._on_deposed = on_deposed
+        #: Optional GenerationLedger (ADR-028): leadership transitions
+        #: land on the /debug/generationz timeline, where a failover
+        #: explains a stage-lag cliff.
+        self._ledger = ledger
         self._lease: Lease | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -167,6 +172,8 @@ class LeaderElector:
             self._lease = None
             self.depositions += 1
             _FAILOVERS.inc(kind="deposed")
+            if self._ledger is not None:
+                self._ledger.note_transition("deposed", fencing=lease.fencing)
             if self._on_deposed is not None:
                 try:
                     self._on_deposed()
@@ -178,6 +185,8 @@ class LeaderElector:
         self._lease = acquired
         self.elections += 1
         _FAILOVERS.inc(kind="elected")
+        if self._ledger is not None:
+            self._ledger.note_transition("elected", fencing=acquired.fencing)
         if self._on_elected is not None:
             try:
                 self._on_elected(acquired.fencing)
@@ -195,6 +204,8 @@ class LeaderElector:
         self._lease = None
         self.depositions += 1
         _FAILOVERS.inc(kind="resigned")
+        if self._ledger is not None:
+            self._ledger.note_transition("resigned", fencing=lease.fencing)
         if self._on_deposed is not None:
             try:
                 self._on_deposed()

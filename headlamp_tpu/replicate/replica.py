@@ -27,7 +27,14 @@ from typing import Any, Callable
 from ..context.accelerator_context import ClusterSnapshot, ProviderState
 from ..domain.accelerator import PROVIDERS, classify_fleet
 from ..obs.metrics import registry as _metrics_registry
-from ..obs.trace import span
+from ..obs.trace import (
+    annotate,
+    current_trace_id,
+    set_remote_parent,
+    span,
+    trace_request,
+    trace_ring,
+)
 from ..server.app import DashboardApp
 from ..transport import ApiError, ConnectionPool
 from .bus import _BYTES, _GENERATIONS, decode_forecast, decode_metrics, decode_snapshot, parse_payload
@@ -71,6 +78,9 @@ class ReplicaApp(DashboardApp):
             monotonic=monotonic,
         )
         self.stale_after_s = stale_after_s
+        # Re-role the base class's ledger before the first stamp:
+        # replica entries (and the age_at_paint role label) must say so.
+        self.ledger.role = "replica"
         #: Monotonic stamp of the last applied record — the staleness
         #: and lag anchor (never the record's wall fetched_at: the
         #: leader's wall clock is not ours — ADR-013).
@@ -93,7 +103,14 @@ class ReplicaApp(DashboardApp):
         rejected: with generation-band fencing this is what discards a
         deposed leader's records."""
         generation = int(record.get("generation") or 0)
+        obs = record.get("obs") or None
         with span("replicate.apply", generation=generation) as node:
+            if obs and obs.get("trace_id"):
+                # ADR-028 stitch: the record's provenance names the
+                # leader trace that published this generation — link
+                # the poll trace under it and annotate the apply span.
+                set_remote_parent(obs["trace_id"])
+                annotate(origin_trace_id=obs["trace_id"])
             if generation <= self.snapshot_generation():
                 self.rejected_stale += 1
                 _GENERATIONS.inc(role="rejected_stale")
@@ -121,6 +138,9 @@ class ReplicaApp(DashboardApp):
             self._bus_forecast = forecast
             self._sync_failures = 0
             self.applied += 1
+            self.ledger.applied(
+                generation, origin=obs, trace_id=current_trace_id()
+            )
             self.push.on_snapshot(
                 snap, generation=generation, metrics=metrics, forecast=forecast
             )
@@ -224,21 +244,33 @@ class BusConsumer:
         """One pull: fetch everything past the cursor, apply in order,
         advance the cursor past every record SEEN (applied or fenced
         out — a rejected generation must not be re-fetched forever).
-        Returns the number of records applied."""
+        Returns the number of records applied.
+
+        Runs under its own ``/replicate/poll`` trace (ADR-028): the
+        ADR-014 pool stamps its trace id onto the bus pull as
+        ``traceparent`` (so the leader's bus-serve joins it), and an
+        applied record's ``obs.trace_id`` links it under the leader's
+        publishing trace. Only polls that actually applied a record
+        land in the trace ring — a 1 Hz stream of empty polls would
+        rotate every interesting trace out of the 64-slot ring."""
         self.polls += 1
-        try:
-            payload = self._fetch(self.cursor)
-            _, records = parse_payload(payload, origin="<bus-consumer>")
-        except Exception:  # noqa: BLE001 — dead leader degrades, never crashes
-            self.fetch_failures += 1
-            return 0
-        self.bytes_applied += len(payload)
-        _BYTES.inc(len(payload), role="applied")
-        applied = 0
-        for record in records:
-            if self.app.apply_record(record):
-                applied += 1
-            self.cursor = max(self.cursor, int(record.get("generation") or 0))
+        with trace_request("/replicate/poll", wall=self.app._clock) as trace:
+            try:
+                payload = self._fetch(self.cursor)
+                _, records = parse_payload(payload, origin="<bus-consumer>")
+            except Exception:  # noqa: BLE001 — dead leader degrades, never crashes
+                self.fetch_failures += 1
+                return 0
+            self.bytes_applied += len(payload)
+            _BYTES.inc(len(payload), role="applied")
+            applied = 0
+            for record in records:
+                if self.app.apply_record(record):
+                    applied += 1
+                self.cursor = max(self.cursor, int(record.get("generation") or 0))
+            if trace is not None and applied:
+                trace.finish(route="/replicate/poll", status=200, device_gets=0)
+                trace_ring.record(trace.to_dict())
         return applied
 
     # -- poll thread (sanctioned THR001 seam) ----------------------------

@@ -45,9 +45,11 @@ from ..metrics.client import fetch_tpu_metrics
 from ..obs import slo as slo_mod
 from ..obs.flight import flight_recorder, wide_event
 from ..obs.jaxcost import ledger as jax_ledger
+from ..obs.ledger import GenerationLedger
 from ..obs.metrics import registry as metrics_registry
 from ..obs.profiler import attribution, profiler
-from ..obs.trace import annotate, span, trace_request, trace_ring
+from ..obs.propagate import parse_traceparent
+from ..obs.trace import annotate, current_trace_id, span, trace_request, trace_ring
 from ..push import PAGES as PUSH_PAGES
 from ..push import PushPipeline, encode_body, format_event, set_active_push
 from ..runtime.refresh import Refresher
@@ -468,7 +470,16 @@ class DashboardApp:
         else:
             self.fragments = fragments if fragments is not None else FragmentCache()
             set_active_fragments(self.fragments)
-        self.push = PushPipeline(monotonic=monotonic, fragments=self.fragments)
+        #: Generation provenance ledger (ADR-028): every lifecycle
+        #: stage of every snapshot generation this process touches —
+        #: scrape, sync, publish, apply, diff, first paint — stamped on
+        #: the injected clocks. ReplicaApp re-roles it to "replica".
+        self.ledger = GenerationLedger(
+            monotonic=monotonic, wall=clock, role="leader"
+        )
+        self.push = PushPipeline(
+            monotonic=monotonic, fragments=self.fragments, ledger=self.ledger
+        )
         set_active_push(self.push)
         #: Read-tier hook (ADR-025). On a leader: a BusPublisher —
         #: _record_sync hands it every published generation, and
@@ -545,18 +556,25 @@ class DashboardApp:
             self._ctx.enable_watch()
 
         def sync_once() -> None:
-            try:
-                with self._lock:
-                    self._ctx.sync()
-                    self._last_sync = self._mono()
-                    snap = self._ctx.snapshot()
-                    self._last_snapshot = snap
-                    self._last_snapshot_mono = self._mono()
-            except Exception:  # noqa: BLE001 — keep the heartbeat alive
-                self._record_sync(None)
-            else:
-                self._record_sync(snap)
-                self._warm_device_cache(snap)
+            # Each background tick runs under its own trace (ADR-028):
+            # the pool stamps the tick's trace id onto outbound scrapes
+            # and the publisher records it as the generation's
+            # provenance. Deliberately NOT ring-recorded — a quiet
+            # cluster's ticks would evict every real page trace.
+            with trace_request("/sync", wall=self._clock):
+                try:
+                    with self._lock:
+                        self.ledger.scrape_started()
+                        self._ctx.sync()
+                        self._last_sync = self._mono()
+                        snap = self._ctx.snapshot()
+                        self._last_snapshot = snap
+                        self._last_snapshot_mono = self._mono()
+                except Exception:  # noqa: BLE001 — keep the heartbeat alive
+                    self._record_sync(None)
+                else:
+                    self._record_sync(snap)
+                    self._warm_device_cache(snap)
 
         def loop() -> None:
             sync_once()  # hydrate immediately; first page view must not block
@@ -640,6 +658,10 @@ class DashboardApp:
                 nodes=len(snap.all_nodes or []),
                 errors=len(snap.errors),
             )
+            # Ledger stamp (ADR-028): the scrape became this generation
+            # — BEFORE the differ and publisher hooks, so their stamps
+            # (diff_framed, published) measure against it.
+            self.ledger.synced(generation, trace_id=current_trace_id())
             # Differ hook (ADR-021): a generation bump diffs the new
             # snapshot's page models against the previous generation's
             # and broadcasts patch frames to the connected SSE clients.
@@ -706,6 +728,7 @@ class DashboardApp:
                     not self._background_live()
                     and now - self._last_sync >= self._min_sync
                 ):
+                    self.ledger.scrape_started()
                     self._ctx.sync()
                     self._last_sync = now
                     snap = self._ctx.snapshot()
@@ -875,8 +898,11 @@ class DashboardApp:
         while background workers revalidate — so there is nothing left
         to overlap; the r07-era fetch∥forecast thread-pool overlap was
         retired with the blocking paths it hid."""
-        metrics = self._cached_metrics()
-        return metrics, self._forecast_for(metrics)
+        with span("page.data.metrics"):
+            metrics = self._cached_metrics()
+        with span("page.data.forecast"):
+            forecast = self._forecast_for(metrics)
+        return metrics, forecast
 
     def _compute_forecast(self, metrics: Any) -> Any:
         # Delegates to the shared host glue (models.service) so the CLI
@@ -947,6 +973,8 @@ class DashboardApp:
             "/debug/profilez",
             "/debug/profilez/folded",
             "/debug/profilez/html",
+            "/debug/generationz",
+            "/debug/generationz/html",
         }
     )
 
@@ -965,6 +993,7 @@ class DashboardApp:
             "/debug/flightz",
             "/debug/profilez",
             "/debug/profilez/folded",
+            "/debug/generationz",
             "/events",
         ):
             return route_path
@@ -982,6 +1011,7 @@ class DashboardApp:
         *,
         accept: str | None = None,
         gateway_info: dict[str, Any] | None = None,
+        traceparent: str | None = None,
     ) -> tuple[int, str, str]:
         """(status, content_type, body) for a GET. Pure enough to test
         without sockets. Never raises: route errors become a 500 page
@@ -1026,11 +1056,19 @@ class DashboardApp:
                 history=self.history,
                 push=self.push,
             )
+        # Inbound traceparent (ADR-028): a caller that already runs a
+        # trace — a replica polling the bus, a fan-out peer, a fronting
+        # gateway — names it here, and this request's trace records it
+        # as its remote parent. This process still mints its OWN id.
+        remote = parse_traceparent(traceparent)
         # attribution() publishes this thread's route + trace id for the
         # sampling profiler (ADR-019). Entered AFTER trace_request so
         # current_trace_id() resolves to this request's trace.
         with trace_request(
-            path, enabled=recorded, wall=self._clock
+            path,
+            enabled=recorded,
+            wall=self._clock,
+            remote_parent=remote.trace_id if remote is not None else None,
         ) as trace, attribution(route_label):
             try:
                 if gateway_info:
@@ -1088,6 +1126,28 @@ class DashboardApp:
                     violations = slo_mod.engine().violations(
                         route_label, duration_s, status
                     )
+                    # Replication context for the wide event (ADR-028
+                    # satellite): role + applied generation + bus
+                    # cursor, when a bus endpoint is wired. Subset of
+                    # the healthz block — the triage keys, not the
+                    # whole counter set.
+                    replication_info = None
+                    replication = self.replication
+                    if replication is not None:
+                        try:
+                            block = replication.snapshot()
+                            replication_info = {
+                                k: block[k]
+                                for k in (
+                                    "role",
+                                    "cursor",
+                                    "last_generation",
+                                    "applied",
+                                )
+                                if k in block
+                            }
+                        except Exception:  # noqa: BLE001 — triage only
+                            replication_info = None
                     flight_recorder.record(
                         wide_event(
                             path=path,
@@ -1099,6 +1159,7 @@ class DashboardApp:
                             counters_before=counters_before,
                             counters_after=counters_after,
                             gateway=gateway_info,
+                            replication=replication_info,
                         ),
                         pinned=bool(violations) or status >= 500,
                     )
@@ -1232,6 +1293,13 @@ class DashboardApp:
                 }
             )
             return 200, "application/json", body
+
+        if route_path == "/debug/generationz":
+            # Generation provenance ledger (ADR-028): recent
+            # generations' lifecycle stamps and stage lags, freshness
+            # breaches pinned past rotation, leadership transitions
+            # interleaved. JSON twin of /debug/generationz/html.
+            return 200, "application/json", json.dumps(self.ledger.snapshot())
 
         if route_path == "/debug/profilez":
             # Sampling-profiler state (ADR-019): counters, per-route
@@ -1400,6 +1468,11 @@ class DashboardApp:
                 # Flame view over the profiler snapshot — no cluster
                 # snapshot either, for the same reason.
                 el = route.component(profiler().snapshot())
+            elif route.kind == "generations":
+                # Provenance timeline over the ledger snapshot (ADR-
+                # 028) — no cluster snapshot, so it paints even when
+                # the feed being debugged is the thing that is stale.
+                el = route.component(self.ledger.snapshot())
             elif route.kind == "trends":
                 # Pure function of the store's windowed view (ADR-018):
                 # no snapshot, no sync — trends must paint even when
@@ -1476,6 +1549,13 @@ class DashboardApp:
             if inner is None:
                 inner = render_html(el)
             body = self._page_html(route.name, inner, route_path)
+        # First-paint stamp (ADR-028): AFTER the bytes are built —
+        # observational only, so paints/ETags/push frames stay byte-
+        # identical — and only the FIRST paint of a generation counts
+        # (the ledger dedupes; later paints are a no-op dict probe).
+        self.ledger.paint(
+            self.snapshot_generation(), trace_id=current_trace_id()
+        )
         return 200, "text/html", body
 
     def _fragment_paint(self, page: str) -> Any:
@@ -1624,6 +1704,7 @@ class DashboardApp:
                     self.path,
                     accept=self.headers.get("Accept"),
                     if_none_match=self.headers.get("If-None-Match"),
+                    traceparent=self.headers.get("traceparent"),
                 )
                 status, content_type, body = response[:3]
                 if status == 302:
@@ -1668,7 +1749,27 @@ class DashboardApp:
                 from ..push.hub import parse_last_event_id
 
                 cursor = parse_last_event_id(self.headers.get("Last-Generation"))
-                payload = replication.payload_after(cursor).encode()
+                # Leader-side stitch (ADR-028): the polling replica's
+                # traceparent names ITS poll trace — this serve joins
+                # it as a child across the process boundary. Ring-
+                # recorded only when records actually shipped; a 1 Hz
+                # stream of empty polls must not rotate real traces
+                # out of the 64-slot ring.
+                remote = parse_traceparent(self.headers.get("traceparent"))
+                with trace_request(
+                    "/replicate/bus",
+                    wall=app._clock,
+                    remote_parent=(
+                        remote.trace_id if remote is not None else None
+                    ),
+                ) as trace:
+                    with span("replicate.serve", cursor=cursor or 0):
+                        payload = replication.payload_after(cursor).encode()
+                    if trace is not None and payload.count(b"\n") > 1:
+                        trace.finish(
+                            route="/replicate/bus", status=200, device_gets=0
+                        )
+                        trace_ring.record(trace.to_dict())
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header(

@@ -38,6 +38,11 @@ from typing import Any, Callable, Iterator, Sequence, TypeVar
 from urllib.parse import urlsplit
 
 from ..obs.metrics import registry as _metrics_registry
+from ..obs.propagate import (
+    TRACEPARENT_HEADER,
+    current_traceparent,
+    record_injected,
+)
 from ..obs.trace import span as _span
 
 #: Concurrent checked-out connections per host. Matches the historical
@@ -467,6 +472,17 @@ class ConnectionPool:
         if parts.query:
             path += "?" + parts.query
 
+        # ADR-028: the ONE place headlamp_tpu writes a ``traceparent``
+        # request header (TRC001). Injected before the attempt loop so
+        # a stale-retry reuses the same value — a retry is the same
+        # logical request, not a new trace.
+        send_headers = dict(headers) if headers else {}
+        if TRACEPARENT_HEADER not in send_headers:
+            traceparent = current_traceparent()
+            if traceparent is not None:
+                send_headers[TRACEPARENT_HEADER] = traceparent
+                record_injected()
+
         slot = self._slot(key)
         for attempt in (0, 1):
             conn, reused = self._checkout(key, timeout_s, context)
@@ -475,7 +491,7 @@ class ConnectionPool:
                     pass
             t0 = time.perf_counter()
             try:
-                conn.raw.request(method, path, headers=headers or {})
+                conn.raw.request(method, path, headers=send_headers)
                 resp = conn.raw.getresponse()
             except _STALE_ERRORS:
                 self._discard(conn)

@@ -121,10 +121,14 @@ class TestRegistration:
             "/intel/metrics",
         }
         native_paths = {"/nodes"}
-        # ADR-013/016/019: the trace waterfall, the SLO page, and the
-        # profiler flame view register as routes (styling + registry
-        # dispatch) but add no sidebar entry.
-        debug_paths = {"/debug/traces/html", "/sloz/html", "/debug/profilez/html"}
+        # ADR-013/016/019/028: the trace waterfall, the SLO page, the
+        # profiler flame view, and the generation provenance timeline
+        # register as routes (styling + registry dispatch) but add no
+        # sidebar entry.
+        debug_paths = {
+            "/debug/traces/html", "/sloz/html", "/debug/profilez/html",
+            "/debug/generationz/html",
+        }
         expected = tpu_paths | intel_paths | native_paths | debug_paths
         assert {r.path for r in reg.routes} == expected
         # Both providers inject into Node and Pod detail views.

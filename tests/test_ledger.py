@@ -1,0 +1,428 @@
+"""ADR-028 generation provenance ledger + traceparent propagation.
+
+Every lifecycle test runs on injected clocks with zero sleeps: a
+FakeClock pair (monotonic + wall) drives stage lags, freshness
+breaches, and cross-process wall deltas deterministically. The
+stitching test runs a REAL leader and a REAL replica in one process —
+the leader's request trace id rides the bus record's ``obs`` field and
+must reappear as the replica poll trace's ``remote_parent``.
+
+The TRC001 mutation pairs pin the single-seam discipline: every
+header-construction shape fires, every read-side shape stays clean,
+and the one exempt file is exactly ``transport/pool.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from analysis.engine import Engine  # noqa: E402
+from analysis.rules.trace_propagation import TracePropagationRule  # noqa: E402
+
+from headlamp_tpu.fleet import fixtures as fx
+from headlamp_tpu.obs.ledger import (
+    FRESHNESS_THRESHOLD_S,
+    STAGES,
+    GenerationLedger,
+)
+from headlamp_tpu.obs.propagate import (
+    TRACEPARENT_HEADER,
+    _PROPAGATION,
+    current_traceparent,
+    format_traceparent,
+    parse_traceparent,
+)
+from headlamp_tpu.obs.trace import trace_request, trace_ring
+from headlamp_tpu.replicate import BusConsumer, BusPublisher, ReplicaApp, parse_payload
+from headlamp_tpu.server.app import DashboardApp, add_demo_prometheus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, now: float = 1000.0) -> None:
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def make_ledger(**kwargs):
+    """A ledger on an injected (mono, wall) clock pair. The wall clock
+    deliberately sits at a different epoch than the monotonic so a test
+    that confuses the two fails loudly."""
+    mono, wall = FakeClock(100.0), FakeClock(1_700_000_000.0)
+    return GenerationLedger(monotonic=mono, wall=wall, **kwargs), mono, wall
+
+
+# ---------------------------------------------------------------------------
+# Ledger lifecycle (injected clocks, zero sleeps)
+# ---------------------------------------------------------------------------
+
+class TestLedgerLifecycle:
+    def test_leader_lifecycle_stage_lags(self):
+        led, mono, wall = make_ledger()
+        led.scrape_started()
+        mono.advance(0.5); wall.advance(0.5)
+        led.synced(1, trace_id="aaaa")
+        mono.advance(0.25); wall.advance(0.25)
+        led.published(1, trace_id="aaaa")
+        mono.advance(0.1); wall.advance(0.1)
+        led.diff_framed(1)
+        mono.advance(0.15); wall.advance(0.15)
+        age = led.paint(1, trace_id="bbbb")
+        # Age = scrape_start → first_paint on the injected monotonic.
+        assert age == pytest.approx(1.0)
+        entry = led.snapshot()["generations"][0]
+        assert entry["generation"] == 1 and entry["role"] == "leader"
+        stages = entry["stages"]
+        assert list(stages) == [
+            "scrape_start", "synced", "published", "diff_framed", "first_paint",
+        ]
+        # The scrape anchor has no predecessor; every later stage lags
+        # against the most recent prior stamp.
+        assert stages["scrape_start"]["lag_ms"] is None
+        assert stages["synced"]["lag_ms"] == pytest.approx(500.0)
+        assert stages["published"]["lag_ms"] == pytest.approx(250.0)
+        assert stages["diff_framed"]["lag_ms"] == pytest.approx(100.0)
+        assert stages["first_paint"]["lag_ms"] == pytest.approx(150.0)
+        assert entry["age_at_paint_ms"] == pytest.approx(1000.0)
+        assert entry["breached"] is False
+        assert entry["trace_ids"]["synced"] == "aaaa"
+        assert entry["trace_ids"]["first_paint"] == "bbbb"
+
+    def test_first_paint_wins_and_observes_once(self):
+        led, mono, _ = make_ledger()
+        led.scrape_started()
+        led.synced(1)
+        mono.advance(2.0)
+        assert led.paint(1) == pytest.approx(2.0)
+        mono.advance(50.0)
+        # Later paints of the same generation are no-ops: the SLO
+        # counts each generation's freshness once.
+        assert led.paint(1) is None
+        assert led.snapshot()["generations"][0]["age_at_paint_ms"] == pytest.approx(
+            2000.0
+        )
+
+    def test_pending_scrape_latest_wins(self):
+        led, mono, wall = make_ledger()
+        led.scrape_started()  # a failed scrape...
+        mono.advance(5.0); wall.advance(5.0)
+        led.scrape_started()  # ...superseded by the retry
+        mono.advance(0.5); wall.advance(0.5)
+        led.synced(1)
+        assert led.snapshot()["generations"][0]["stages"]["synced"][
+            "lag_ms"
+        ] == pytest.approx(500.0)
+
+    def test_nonpositive_generations_ignored(self):
+        led, _, _ = make_ledger()
+        led.synced(0)
+        led.published(-3)
+        assert led.paint(0) is None
+        assert led.snapshot()["generations"] == []
+
+    def test_freshness_breach_pins_past_rotation(self):
+        led, mono, _ = make_ledger(capacity=4, freshness_threshold_s=1.0)
+        led.scrape_started()
+        led.synced(1)
+        mono.advance(5.0)  # well past the 1 s threshold
+        assert led.paint(1) == pytest.approx(5.0)
+        snap = led.snapshot()
+        assert snap["breaches"] == 1
+        assert snap["generations"][0]["breached"] is True
+        # Rotate generation 1 out of the recent ring entirely...
+        for g in range(2, 7):
+            led.synced(g)
+        snap = led.snapshot()
+        assert all(e["generation"] != 1 for e in snap["generations"])
+        # ...the breach evidence survives, pinned.
+        assert [e["generation"] for e in snap["pinned"]] == [1]
+
+    def test_capacity_rotation_is_fifo(self):
+        led, _, _ = make_ledger(capacity=3)
+        for g in range(1, 6):
+            led.synced(g)
+        assert [e["generation"] for e in led.snapshot()["generations"]] == [5, 4, 3]
+
+    def test_replica_applied_lags_against_leader_wall(self):
+        led, mono, wall = make_ledger(role="replica")
+        origin = {
+            "trace_id": "feedface00000000",
+            "scrape_start_wall": wall.now - 3.0,
+            "published_wall": wall.now - 1.5,
+        }
+        led.applied(7, origin=origin, trace_id="cccc")
+        entry = led.snapshot()["generations"][0]
+        # The first replica-side stamp has no local predecessor: the
+        # lag is the cross-process publish→apply delta on the shared
+        # wall clock.
+        assert entry["stages"]["applied"]["lag_ms"] == pytest.approx(1500.0)
+        assert entry["origin"] == origin
+        assert entry["role"] == "replica"
+        # Paint without a local scrape anchor: age falls back to the
+        # leader's scrape wall stamp.
+        wall.advance(1.0); mono.advance(1.0)
+        assert led.paint(7) == pytest.approx(4.0)
+
+    def test_clock_skew_clamps_at_zero(self):
+        led, _, wall = make_ledger(role="replica")
+        # A leader whose wall clock runs AHEAD of ours: the lag must
+        # clamp at zero, never go negative.
+        led.applied(3, origin={"published_wall": wall.now + 60.0})
+        assert led.snapshot()["generations"][0]["stages"]["applied"]["lag_ms"] == 0.0
+
+    def test_provenance_compact_record(self):
+        led, mono, wall = make_ledger()
+        assert led.provenance(99) is None
+        led.scrape_started()
+        mono.advance(0.2); wall.advance(0.2)
+        led.synced(1, trace_id="aaaa")
+        mono.advance(0.3); wall.advance(0.3)
+        led.published(1, trace_id="dddd")
+        prov = led.provenance(1)
+        # The publishing trace id wins over the syncing one, and only
+        # leader-side wall stamps ship.
+        assert prov["trace_id"] == "dddd"
+        assert set(prov) == {
+            "trace_id", "scrape_start_wall", "synced_wall", "published_wall",
+        }
+        assert prov["published_wall"] - prov["scrape_start_wall"] == pytest.approx(0.5)
+
+    def test_transitions_on_timeline(self):
+        led, _, _ = make_ledger()
+        led.note_transition("elected", fencing=3)
+        led.note_transition("deposed", fencing=3)
+        kinds = [t["kind"] for t in led.snapshot()["transitions"]]
+        assert kinds == ["elected", "deposed"]
+
+    def test_snapshot_is_json_ready(self):
+        led, mono, _ = make_ledger()
+        led.scrape_started()
+        led.synced(1, trace_id="aaaa")
+        mono.advance(0.1)
+        led.paint(1)
+        led.note_transition("elected", fencing=1)
+        snap = led.snapshot()
+        assert json.loads(json.dumps(snap)) == snap
+        assert snap["freshness_threshold_s"] == FRESHNESS_THRESHOLD_S
+
+    def test_stage_order_is_canonical_even_when_stamped_out_of_order(self):
+        led, _, _ = make_ledger()
+        led.diff_framed(1)
+        led.synced(1)
+        assert list(led.snapshot()["generations"][0]["stages"]) == [
+            s for s in STAGES if s in ("synced", "diff_framed")
+        ]
+
+
+# ---------------------------------------------------------------------------
+# traceparent format/parse
+# ---------------------------------------------------------------------------
+
+class TestTraceparent:
+    def test_native_id_round_trip(self):
+        native = "abcdef0123456789"
+        wire = format_traceparent(native)
+        assert wire == f"00-{'0' * 16}{native}-{native}-01"
+        parsed = parse_traceparent(wire)
+        assert parsed.trace_id == native
+        assert parsed.span_id == native
+        assert parsed.sampled is True
+
+    def test_full_width_w3c_id_keeps_low_64_bits(self):
+        wire = "00-" + "a" * 16 + "b" * 16 + "-" + "c" * 16 + "-00"
+        parsed = parse_traceparent(wire)
+        assert parsed.trace_id == "b" * 16
+        assert parsed.sampled is False
+
+    def test_missing_header_not_counted(self):
+        before = _PROPAGATION.value_for(direction="invalid")
+        assert parse_traceparent(None) is None
+        assert parse_traceparent("") is None
+        assert _PROPAGATION.value_for(direction="invalid") == before
+
+    @pytest.mark.parametrize(
+        "value",
+        [
+            "garbage",
+            "01-" + "a" * 32 + "-" + "b" * 16 + "-01",  # future version
+            "00-" + "0" * 32 + "-" + "b" * 16 + "-01",  # zero trace id
+            "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+            "00-" + "A" * 32 + "-" + "b" * 16 + "-01",  # upper-case hex
+        ],
+    )
+    def test_malformed_counted_invalid(self, value):
+        before = _PROPAGATION.value_for(direction="invalid")
+        assert parse_traceparent(value) is None
+        assert _PROPAGATION.value_for(direction="invalid") == before + 1
+
+    def test_extraction_counted(self):
+        before = _PROPAGATION.value_for(direction="extracted")
+        parse_traceparent(format_traceparent("abcdef0123456789"))
+        assert _PROPAGATION.value_for(direction="extracted") == before + 1
+
+    def test_current_traceparent_reflects_active_trace(self):
+        assert current_traceparent() is None
+        with trace_request("/x", wall=lambda: 0.0) as trace:
+            wire = current_traceparent()
+            assert wire is not None
+            assert parse_traceparent(wire).trace_id == trace.trace_id
+        assert current_traceparent() is None
+
+
+# ---------------------------------------------------------------------------
+# Leader + replica stitching — two real apps, one process, zero sleeps
+# ---------------------------------------------------------------------------
+
+def make_leader():
+    fleet = fx.fleet_v5e4()
+    t = fx.fleet_transport(fleet)
+    add_demo_prometheus(t, fleet)
+    app = DashboardApp(t, min_sync_interval_s=30.0)
+    pub = BusPublisher(ledger=app.ledger)
+    app.replication = pub
+    return app, pub
+
+
+class TestCrossProcessStitching:
+    def test_leader_trace_id_reappears_as_replica_remote_parent(self):
+        app, pub = make_leader()
+        trace_ring.clear()
+
+        # One leader request: the inline sync, publish, and paint all
+        # happen under this request's trace.
+        status, _, _ = app.handle("/tpu")
+        assert status == 200
+        leader_trace = next(
+            t for t in trace_ring.snapshot() if t["route"] == "/tpu"
+        )
+
+        # The bus record carries the provenance the leader's ledger
+        # assembled — including the publishing trace id.
+        _, records = parse_payload(pub.payload_after(None))
+        obs_records = [r for r in records if r.get("obs")]
+        assert obs_records, "no bus record carried provenance"
+        obs = obs_records[0]["obs"]
+        assert obs["trace_id"] == leader_trace["trace_id"]
+        assert {"scrape_start_wall", "synced_wall", "published_wall"} <= set(obs)
+
+        # A replica applies the record: its poll trace must link back
+        # to the leader's trace, and its ledger must adopt the origin.
+        rep = ReplicaApp()
+        consumer = BusConsumer(rep, lambda cursor: pub.payload_after(cursor))
+        applied = consumer.poll_once()
+        assert applied >= 1
+        poll_trace = next(
+            t for t in trace_ring.snapshot() if t["route"] == "/replicate/poll"
+        )
+        assert poll_trace["remote_parent"] == leader_trace["trace_id"]
+        apply_spans = [
+            s for s in poll_trace["spans"] if s["name"] == "replicate.apply"
+        ]
+        assert apply_spans
+        assert apply_spans[0]["attrs"]["origin_trace_id"] == leader_trace["trace_id"]
+
+        gen = rep.snapshot_generation()
+        rep_entry = next(
+            e
+            for e in rep.ledger.snapshot()["generations"]
+            if e["generation"] == gen
+        )
+        assert rep_entry["role"] == "replica"
+        assert rep_entry["origin"]["trace_id"] == leader_trace["trace_id"]
+        assert "applied" in rep_entry["stages"]
+
+        # First replica paint closes the loop: age-at-paint lands with
+        # the leader's scrape as the anchor.
+        status, _, _ = rep.handle("/tpu")
+        assert status == 200
+        rep_entry = next(
+            e
+            for e in rep.ledger.snapshot()["generations"]
+            if e["generation"] == gen
+        )
+        assert "first_paint" in rep_entry["stages"]
+        assert rep_entry["age_at_paint_ms"] is not None
+
+    def test_inbound_traceparent_links_leader_request(self):
+        app, _ = make_leader()
+        trace_ring.clear()
+        wire = format_traceparent("feedfacefeedface")
+        status, _, _ = app.handle("/tpu", traceparent=wire)
+        assert status == 200
+        trace = next(t for t in trace_ring.snapshot() if t["route"] == "/tpu")
+        assert trace["remote_parent"] == "feedfacefeedface"
+
+    def test_generationz_surfaces(self):
+        app, _ = make_leader()
+        status, ctype, body = app.handle("/debug/generationz")
+        assert status == 200 and ctype == "application/json"
+        snap = json.loads(body)
+        assert snap["role"] == "leader"
+        status, ctype, body = app.handle("/debug/generationz/html")
+        assert status == 200 and "text/html" in ctype
+        assert "Generation Provenance" in body
+
+
+# ---------------------------------------------------------------------------
+# TRC001 — single-seam mutation pairs
+# ---------------------------------------------------------------------------
+
+def _trc(src, relpath="headlamp_tpu/server/mut.py"):
+    rule = TracePropagationRule()
+    return Engine([rule], root=REPO).check_source(rule, relpath, src)
+
+
+class TestTraceparentSingleSeam:
+    def test_dict_literal_construction_flagged(self):
+        diags = _trc('headers = {"traceparent": value}\n')
+        assert len(diags) == 1 and diags[0].rule == "TRC001"
+
+    def test_subscript_store_flagged(self):
+        diags = _trc('headers["traceparent"] = value\n')
+        assert len(diags) == 1
+
+    def test_setdefault_flagged(self):
+        diags = _trc('headers.setdefault("traceparent", value)\n')
+        assert len(diags) == 1
+
+    def test_read_side_clean(self):
+        # Extraction is legal everywhere — that is the app layer's job.
+        assert _trc('remote = headers.get("traceparent")\n') == []
+
+    def test_bare_constant_clean(self):
+        # obs/propagate.py owns the header NAME without writing a map.
+        assert _trc('TRACEPARENT_HEADER = "traceparent"\n') == []
+
+    def test_kwarg_forwarding_clean(self):
+        # The gateway forwards an ALREADY-EXTRACTED value as a keyword
+        # argument — not wire-header construction.
+        assert _trc("extra = dict(traceparent=traceparent)\n") == []
+
+    def test_transport_seam_is_the_one_exemption(self):
+        rule = TracePropagationRule()
+        assert not rule.wants("headlamp_tpu/transport/pool.py")
+        assert rule.wants("headlamp_tpu/server/app.py")
+        assert rule.wants("headlamp_tpu/replicate/replica.py")
+
+    def test_live_pool_constructs_header(self):
+        # The seam really does construct the header — if the injection
+        # moves, this test and the exemption list must move together.
+        with open(
+            os.path.join(REPO, "headlamp_tpu", "transport", "pool.py")
+        ) as f:
+            src = f.read()
+        assert f'send_headers[{TRACEPARENT_HEADER!r}]' in src or (
+            'send_headers[TRACEPARENT_HEADER]' in src
+        )

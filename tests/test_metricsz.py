@@ -225,6 +225,11 @@ class TestFormat:
             # quiet when the active cache belongs to a dropped app. The
             # hit/miss/eviction counters are unlabeled and always emit.
             "headlamp_tpu_render_fragment_cache_bytes",
+            # ADR-028 propagation counter: labeled, so it renders no
+            # samples until a traceparent is actually injected or
+            # extracted — the socketless fixture never drives the
+            # transport pool or an inbound header.
+            "headlamp_tpu_trace_propagation_total",
         }, f"unexpected sample-free families: {sorted(quiet)}"
 
     def test_name_grammar_and_unit_suffixes(self, exposition):

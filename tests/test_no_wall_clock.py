@@ -233,6 +233,35 @@ class TestMutations:
         assert len(diags) == 1
         assert diags[0].line == 3
 
+    def test_ledger_stage_lag_on_wall_clock_flagged(self):
+        # The ADR-028 mistake the obs scope guards in ledger.py:
+        # measuring stage-to-stage lag on the wall clock — an NTP step
+        # between two stamps would report a negative (or wildly wrong)
+        # lag, and the zero-sleep lifecycle tests could never drive it.
+        diags = self._diags(
+            "import time\n"
+            "def _stamp(self, generation, stage):\n"
+            "    now = time.time()\n"
+            "    return now - self._stages[stage]\n"
+        )
+        assert len(diags) == 1
+        assert diags[0].line == 3
+
+    def test_ledger_sanctioned_forms_allowed(self):
+        # The real GenerationLedger shape: injected monotonic for every
+        # same-process lag, the injected wall strictly through the seam
+        # default for display stamps and the one cross-process delta.
+        diags = self._diags(
+            "import time\n"
+            "def __init__(self, *, monotonic=None, wall=time.time):\n"
+            "    self._mono = monotonic or time.monotonic\n"
+            "    self._wall = wall\n"
+            "def _stamp(self, generation, stage):\n"
+            "    now_mono, now_wall = self._mono(), self._wall()\n"
+            "    return now_mono, now_wall\n"
+        )
+        assert diags == []
+
 
 def test_engine_parity_on_dirty_tree(tmp_path):
     # ADR-022 migration pin: the shim and the engine rule (WCK001)

@@ -663,6 +663,7 @@ class TestObservabilityRoutes:
             "dashboard_render",
             "forecast_fit",
             "transport_connect",
+            "data_freshness",
         }
         assert all(v in ("ok", "warn", "page") for v in slo_block.values())
 

@@ -19,6 +19,7 @@ from .raw_urlopen import RawUrlopenRule
 from .release_paths import ReleaseOnAllPathsRule
 from .slo_observation import SloObservationRule
 from .thread_spawn import ThreadSpawnRule
+from .trace_propagation import TracePropagationRule
 from .transitive_blocking import TransitiveLockBlockingRule
 from .unregistered_jit import UnregisteredJitRule
 from .viewport import ViewportIterationRule
@@ -51,6 +52,9 @@ def all_rules() -> list[Rule]:
         # ADR-026 viewport discipline: pages paint O(viewport), not
         # O(fleet); legacy full-fleet surfaces are baselined.
         ViewportIterationRule(),
+        # ADR-028 propagation discipline: the traceparent header is
+        # written at exactly one seam (transport/pool.py).
+        TracePropagationRule(),
     ]
 
 
@@ -72,4 +76,5 @@ RULE_IDS = {
     "GRD002": CheckThenActRule,
     "PUB001": PublishThenMutateRule,
     "VPT001": ViewportIterationRule,
+    "TRC001": TracePropagationRule,
 }

@@ -1,0 +1,84 @@
+"""TRC001 — the ``traceparent`` header is written at one seam only.
+
+ADR-028: cross-process trace stitching holds only if exactly ONE place
+constructs the outbound ``traceparent`` request header — the ADR-014
+transport seam (``transport/pool.py``), which injects it once per
+logical request, before the stale-retry loop. A second injection site
+would double-stamp retries and forks, or stamp a DIFFERENT trace id
+than the one the pool recorded as injected, silently unstitching the
+fleet's traces.
+
+Flagged header-construction shapes (the write side):
+
+- a dict literal with a ``"traceparent"`` key —
+  ``{"traceparent": value}``
+- a subscript store — ``headers["traceparent"] = value`` (plain or
+  augmented)
+- ``headers.setdefault("traceparent", value)``
+
+READING the inbound header stays legal everywhere —
+``headers.get("traceparent")`` is how the app layer and the bus serve
+extract the remote parent. The bare string constant is legal too
+(``obs/propagate.py`` owns the header NAME without ever writing a
+mapping).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Diagnostic, FileContext, Rule
+
+HEADER = "traceparent"
+
+MESSAGE = (
+    "traceparent header constructed outside the transport seam — the "
+    "ONE legal injection site is transport/pool.py (ADR-028); a second "
+    "writer double-stamps retries and unstitches cross-process traces"
+)
+
+
+def _is_header_const(node: ast.expr) -> bool:
+    return isinstance(node, ast.Constant) and node.value == HEADER
+
+
+class TracePropagationRule(Rule):
+    rule_id = "TRC001"
+    name = "traceparent-single-seam"
+    description = "The traceparent header is written only by transport/pool.py"
+    top_dirs = ("headlamp_tpu",)
+    exempt_files = ("headlamp_tpu/transport/pool.py",)
+
+    def check_file(self, ctx: FileContext) -> list[Diagnostic]:
+        tree, path = ctx.tree, ctx.relpath
+        out: list[Diagnostic] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if key is not None and _is_header_const(key):
+                        out.append(
+                            Diagnostic(self.rule_id, path, node.lineno, MESSAGE)
+                        )
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for target in targets:
+                    if isinstance(target, ast.Subscript) and _is_header_const(
+                        target.slice
+                    ):
+                        out.append(
+                            Diagnostic(self.rule_id, path, node.lineno, MESSAGE)
+                        )
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr == "setdefault"
+                    and node.args
+                    and _is_header_const(node.args[0])
+                ):
+                    out.append(
+                        Diagnostic(self.rule_id, path, node.lineno, MESSAGE)
+                    )
+        return out
