@@ -278,6 +278,20 @@ class MultiCallbackGauge:
             )
 
 
+class MultiCallbackCounter(MultiCallbackGauge):
+    """Labeled callback COUNTER: same scrape-time sample contract as
+    :class:`MultiCallbackGauge`, rendered with ``TYPE counter``. For
+    monotone values whose storage lives outside this process's
+    instruments — the ADR-029 worker status board, where each worker
+    process owns its counters in shared memory and every process's
+    /metricsz must render the whole fleet's. The callback is trusted to
+    be monotone per label set (the name grammar still enforces
+    ``_total``); a registry-side monotonicity check would need
+    last-value state that breaks the stateless-view design."""
+
+    kind = "counter"
+
+
 class _HistogramChild:
     __slots__ = ("counts", "sum", "count", "lock", "exemplars")
 
@@ -509,6 +523,22 @@ class MetricRegistry:
         if isinstance(gauge, MultiCallbackGauge):
             gauge.fn = fn
         return gauge
+
+    def counter_samples_fn(
+        self,
+        name: str,
+        help: str,
+        labels: tuple[str, ...],
+        fn: Callable[[], Any],
+    ) -> MultiCallbackCounter:
+        """Labeled callback counter (see MultiCallbackCounter). Same
+        latest-producer-wins re-registration semantics as gauge_fn."""
+        counter = self._get_or_create(
+            name, lambda: MultiCallbackCounter(name, help, labels, fn), "counter"
+        )
+        if isinstance(counter, MultiCallbackCounter):
+            counter.fn = fn
+        return counter
 
     def histogram(
         self,

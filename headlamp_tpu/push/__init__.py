@@ -36,6 +36,7 @@ from .conditional import (
     encode_body,
     etag_for,
     gzip_accepted,
+    gzip_cache_clear,
     if_none_match_matches,
 )
 from .differ import (
@@ -54,6 +55,8 @@ from .hub import (
     Subscription,
     format_event,
     parse_last_event_id,
+    set_worker_identity,
+    worker_identity,
 )
 
 _DIFF_SECONDS = _metrics_registry.histogram(
@@ -269,7 +272,10 @@ __all__ = [
     "etag_for",
     "format_event",
     "gzip_accepted",
+    "gzip_cache_clear",
     "if_none_match_matches",
     "parse_last_event_id",
     "set_active_push",
+    "set_worker_identity",
+    "worker_identity",
 ]

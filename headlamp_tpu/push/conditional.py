@@ -22,6 +22,9 @@ from __future__ import annotations
 
 import gzip as _gzip
 import hashlib
+import threading
+import zlib
+from collections import OrderedDict
 from urllib.parse import parse_qsl, urlparse
 
 from ..obs.metrics import registry as _metrics_registry
@@ -48,6 +51,31 @@ _NOT_MODIFIED = _metrics_registry.counter(
     "by route template.",
     labels=("route",),
 )
+
+#: Gzip output cache bound. Strong ETags change with every generation,
+#: so entries age out naturally; 64 covers the handful of routes ×
+#: window tokens a poll fleet touches within one generation while
+#: bounding worst-case retention to a few MB of compressed paints.
+GZIP_CACHE_LIMIT = 64
+
+_GZIP_CACHE_EVENTS = _metrics_registry.counter(
+    "headlamp_tpu_push_gzip_cache_total",
+    "Gzip output cache traffic for ETag-keyed full paints: hits reuse "
+    "compressed bytes, misses pay the encode, evictions are LRU drops "
+    "past the bound.",
+    labels=("outcome",),
+)
+
+#: (etag, raw length, raw crc32) → gzip bytes, or None when the body
+#: proved incompressible (ship identity — remembering that verdict is
+#: as valuable as remembering the bytes). The ETag alone is NOT a safe
+#: key: etag_for hashes only the query window, so two ROUTES at the
+#: same generation share a tag while painting different bodies. The
+#: length+crc pair pins the cached bytes to the exact body; computing
+#: the crc costs microseconds against the milliseconds a level-6 encode
+#: of a fleet paint costs.
+_GZIP_CACHE: "OrderedDict[tuple[str, int, int], bytes | None]" = OrderedDict()
+_GZIP_CACHE_LOCK = threading.Lock()
 
 
 def etag_for(generation: int, epoch: int, degraded: bool, window: str = "") -> str:
@@ -134,30 +162,74 @@ def gzip_accepted(accept_encoding: str | None) -> bool:
     return wildcard_q is not None and wildcard_q > 0.0
 
 
-def encode_body(data: bytes, accept_encoding: str | None) -> tuple[bytes, str | None]:
+def encode_body(
+    data: bytes, accept_encoding: str | None, *, etag: str | None = None
+) -> tuple[bytes, str | None]:
     """(payload, content-encoding|None) for a full-paint body. Encodes
     only when the client accepts gzip, the body clears MIN_GZIP_SIZE,
     and compression actually shrank it (incompressible bodies ship
     identity rather than paying the header tax). Byte counters record
     every encoded paint so /metricsz shows the realized savings, not
-    the configured policy."""
+    the configured policy.
+
+    ``etag`` (the strong validator the gateway stamped on the response)
+    turns on the output cache: deterministic encoding (``mtime=0``)
+    means the same validated body always compresses to the same bytes,
+    so a poll fleet hammering an unchanged route pays ONE encode per
+    generation instead of one per request. Counted hit/miss/evicted;
+    validator-less callers (SSE frames, tests) skip the cache
+    entirely."""
     if len(data) < MIN_GZIP_SIZE or not gzip_accepted(accept_encoding):
         return data, None
+    key = None
+    if etag:
+        key = (etag, len(data), zlib.crc32(data))
+        with _GZIP_CACHE_LOCK:
+            if key in _GZIP_CACHE:
+                cached = _GZIP_CACHE[key]
+                _GZIP_CACHE.move_to_end(key)
+                _GZIP_CACHE_EVENTS.inc(outcome="hit")
+                if cached is None:
+                    return data, None
+                return cached, "gzip"
+        _GZIP_CACHE_EVENTS.inc(outcome="miss")
     compressed = _gzip.compress(data, GZIP_LEVEL, mtime=0)
-    if len(compressed) >= len(data):
+    shrank = len(compressed) < len(data)
+    if key is not None:
+        with _GZIP_CACHE_LOCK:
+            _GZIP_CACHE[key] = compressed if shrank else None
+            _GZIP_CACHE.move_to_end(key)
+            while len(_GZIP_CACHE) > GZIP_CACHE_LIMIT:
+                _GZIP_CACHE.popitem(last=False)
+                _GZIP_CACHE_EVENTS.inc(outcome="evicted")
+    if not shrank:
         return data, None
     _GZIP_BYTES.inc(len(data), kind="raw")
     _GZIP_BYTES.inc(len(compressed), kind="compressed")
     return compressed, "gzip"
 
 
+def gzip_cache_clear() -> None:
+    """Test seam: empty the output cache (counters are left alone)."""
+    with _GZIP_CACHE_LOCK:
+        _GZIP_CACHE.clear()
+
+
+def gzip_cache_len() -> int:
+    with _GZIP_CACHE_LOCK:
+        return len(_GZIP_CACHE)
+
+
 __all__ = [
+    "GZIP_CACHE_LIMIT",
     "GZIP_LEVEL",
     "MIN_GZIP_SIZE",
     "count_not_modified",
     "encode_body",
     "etag_for",
     "gzip_accepted",
+    "gzip_cache_clear",
+    "gzip_cache_len",
     "if_none_match_matches",
     "window_token",
 ]

@@ -110,6 +110,25 @@ class Subscription:
         self.closed = False
 
 
+#: Which serving process this is, as a short label ("w0", "w1", …) —
+#: set once at worker entry (ADR-029), None in single-process serving.
+#: Process-global on purpose: a worker process IS one identity, and the
+#: SSE handler and push snapshot both stamp it without plumbing.
+_WORKER_IDENTITY: str | None = None
+
+
+def set_worker_identity(label: str | None) -> None:
+    """Install this process's worker label (``worker_main`` calls it
+    before the socket opens). None restores single-process behavior —
+    the test seam."""
+    global _WORKER_IDENTITY
+    _WORKER_IDENTITY = label
+
+
+def worker_identity() -> str | None:
+    return _WORKER_IDENTITY
+
+
 def parse_last_event_id(value: str | None) -> int | None:
     """``g<generation>`` → generation, else None (an unparseable id is
     ignored rather than 400d — the stream still serves live frames)."""
@@ -416,6 +435,11 @@ class BroadcastHub:
                 page: len(entries) for page, entries in self._backlog.items()
             }
             out["resume_complete_from"] = self._complete_from
+        worker = worker_identity()
+        if worker is not None:
+            # ADR-029: under multi-process serving the hub (and its SSE
+            # clients) are per-worker — say which one this block is.
+            out["worker"] = worker
         return out
 
 
@@ -427,4 +451,6 @@ __all__ = [
     "Subscription",
     "format_event",
     "parse_last_event_id",
+    "set_worker_identity",
+    "worker_identity",
 ]

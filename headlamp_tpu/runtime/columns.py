@@ -1,0 +1,136 @@
+"""Columnar fleet ⇄ flat bytes (ADR-029 column layout export).
+
+The ADR-012 :class:`~headlamp_tpu.analytics.encode.FleetArrays` columns
+are already contiguous fixed-dtype numpy arrays — the exact shape a
+shared-memory segment wants. :func:`pack_fleet` serializes one
+FleetArrays to a self-describing byte blob (magic + JSON table of
+contents + 8-aligned raw column bytes); :func:`unpack_fleet` rebuilds
+it with ``np.frombuffer`` VIEWS over the source buffer — zero copy, so
+a worker attaching a published segment pays parsing of a ~200-byte toc,
+not a per-column copy, and never re-runs ``encode_fleet``'s Python
+loop over the fleet.
+
+The blob is versioned by its magic: a reader that sees a different
+magic refuses the blob outright (the ADR-029 version gate at the
+column layer), mirroring the bus codec's ``BUS_VERSION`` stance —
+never half-decode a foreign layout.
+
+Mutability contract: ``unpack_fleet`` views are as writable as the
+buffer they wrap. Callers handing out views over shared memory MUST
+pass an immutable snapshot (``bytes``) or a read-only memoryview —
+the seqlock in ``workers/shm.py`` copies the payload out of the mmap
+before unpacking for exactly this reason.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..analytics.encode import FleetArrays
+
+#: Layout version rides in the magic itself — bump the trailing digit
+#: for incompatible changes and old readers refuse by magic mismatch.
+COLUMNS_MAGIC = b"HLTPCOL1"
+
+#: Array fields serialized, in a FIXED order (the toc repeats the
+#: names, so the order is a determinism nicety, not a decode input).
+ARRAY_FIELDS: tuple[str, ...] = (
+    "node_capacity",
+    "node_allocatable",
+    "node_ready",
+    "node_generation",
+    "node_valid",
+    "pod_request",
+    "pod_phase",
+    "pod_node_idx",
+    "pod_valid",
+)
+
+_LEN = struct.Struct("<I")
+
+
+def _pad8(n: int) -> int:
+    return (-n) % 8
+
+
+def pack_fleet(fleet: "FleetArrays") -> bytes:
+    """One FleetArrays → self-describing bytes. Deterministic for a
+    given fleet (canonical JSON toc, fixed field order, zero padding),
+    so two packs of the same arrays are byte-identical — the same
+    property the bus codec pins for NDJSON lines."""
+    parts: list[bytes] = []
+    columns: list[list[object]] = []
+    offset = 0
+    for name in ARRAY_FIELDS:
+        arr = np.ascontiguousarray(getattr(fleet, name))
+        raw = arr.tobytes()
+        columns.append([name, arr.dtype.str, int(arr.shape[0]), offset])
+        parts.append(raw)
+        pad = _pad8(len(raw))
+        if pad:
+            parts.append(b"\x00" * pad)
+        offset += len(raw) + pad
+    toc = json.dumps(
+        {
+            "n_nodes": int(fleet.n_nodes),
+            "n_pods": int(fleet.n_pods),
+            "node_names": list(fleet.node_names),
+            "columns": columns,
+        },
+        sort_keys=True,
+        separators=(",", ":"),
+    ).encode("utf-8")
+    head = COLUMNS_MAGIC + _LEN.pack(len(toc))
+    lead = len(head) + len(toc)
+    return head + toc + b"\x00" * _pad8(lead) + b"".join(parts)
+
+
+def unpack_fleet(buf: bytes | memoryview) -> "FleetArrays":
+    """Bytes → FleetArrays whose columns are ``frombuffer`` views over
+    ``buf`` (zero copy). Raises ``ValueError`` on a foreign magic or a
+    truncated blob — a corrupt segment must surface as an exception the
+    worker's fallback ladder can count, never as garbage arrays."""
+    from ..analytics.encode import FleetArrays
+
+    view = memoryview(buf)
+    if len(view) < len(COLUMNS_MAGIC) + _LEN.size:
+        raise ValueError("column blob truncated before header")
+    if bytes(view[: len(COLUMNS_MAGIC)]) != COLUMNS_MAGIC:
+        raise ValueError(
+            f"column blob magic mismatch (expected {COLUMNS_MAGIC!r})"
+        )
+    (toc_len,) = _LEN.unpack_from(view, len(COLUMNS_MAGIC))
+    toc_start = len(COLUMNS_MAGIC) + _LEN.size
+    if len(view) < toc_start + toc_len:
+        raise ValueError("column blob truncated inside toc")
+    toc = json.loads(bytes(view[toc_start : toc_start + toc_len]))
+    lead = toc_start + toc_len
+    data_start = lead + _pad8(lead)
+    arrays: dict[str, np.ndarray] = {}
+    for name, dtype, length, offset in toc["columns"]:
+        if name not in ARRAY_FIELDS:
+            continue  # forward-compat: unknown columns skipped, not fatal
+        dt = np.dtype(dtype)
+        end = data_start + offset + length * dt.itemsize
+        if end > len(view):
+            raise ValueError(f"column blob truncated inside column {name!r}")
+        arrays[name] = np.frombuffer(
+            view, dtype=dt, count=length, offset=data_start + offset
+        )
+    missing = [name for name in ARRAY_FIELDS if name not in arrays]
+    if missing:
+        raise ValueError(f"column blob missing columns: {missing}")
+    return FleetArrays(
+        n_nodes=int(toc["n_nodes"]),
+        n_pods=int(toc["n_pods"]),
+        node_names=[str(n) for n in toc["node_names"]],
+        **arrays,
+    )
+
+
+__all__ = ["ARRAY_FIELDS", "COLUMNS_MAGIC", "pack_fleet", "unpack_fleet"]

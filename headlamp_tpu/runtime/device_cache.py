@@ -152,6 +152,32 @@ class DeviceFleetCache:
         _annotate(fleet_cache="unversioned")
         return encode_fleet(view.nodes, view.pods)
 
+    def seed(
+        self,
+        provider: str,
+        version: int,
+        fleet: "FleetArrays",
+        *,
+        to_device: bool = True,
+    ) -> None:
+        """Install pre-built columns for ``(provider, version)`` without
+        running ``encode_fleet`` — the ADR-029 shared-memory fast path:
+        a worker that attached a published segment already HOLDS the
+        contiguous columns, so the first render of that generation
+        must not pay the per-node encode loop again. ``to_device``
+        uploads eagerly (same contract as ``warm``); on a jax-less
+        host the host arrays are seeded as-is — ``fleet_for`` already
+        serves host arrays on its unversioned path, so downstream
+        handles both. Same invalidation contract as every other entry:
+        the generation is the key, a newer seed replaces the entry."""
+        if to_device:
+            try:
+                fleet = _to_device(fleet)
+            except Exception:  # noqa: BLE001 — jax-less host: host columns still serve
+                pass
+        with self._lock:
+            self._entries[provider] = (int(version), fleet)
+
     def warm(self, view: "FleetView") -> bool:
         """Background-sync hook: encode + upload ``view`` now so the
         next request hits warm. Swallows nothing — but the caller (the

@@ -13,6 +13,14 @@ Read-tier roles (ADR-025):
   exercised even single-host).
 - ``--replica URL``                   — no cluster access: consume the bus of
   the leader at URL and serve paints/push/ETags from applied records.
+
+Multi-process serving (ADR-029):
+- ``--workers N``                     — N single-threaded-serving worker
+  processes accept on the port (SO_REUSEPORT or an inherited shared
+  listener); the parent becomes the supervisor: it alone talks to the
+  cluster and distributes each snapshot generation over a shared-memory
+  segment, with the NDJSON bus on an internal port as the workers'
+  counted fallback.
 """
 
 from __future__ import annotations
@@ -52,11 +60,19 @@ def main(argv: list[str] | None = None) -> None:
         help="run as a stateless read replica consuming the bus of the "
         "leader at LEADER_URL (no cluster access; ADR-025)",
     )
+    parser.add_argument(
+        "--workers", type=int, metavar="N", default=None,
+        help="serve with N worker processes over a shared-memory "
+        "snapshot plane; the parent becomes the supervisor/leader "
+        "(ADR-029)",
+    )
     args = parser.parse_args(argv)
 
     if args.replica:
         if args.demo or args.apiserver or args.in_cluster or args.replication_leader:
             parser.error("--replica excludes cluster modes and --replication-leader")
+        if args.workers:
+            parser.error("--replica excludes --workers (workers are replicas)")
         from ..replicate import BusConsumer, ReplicaApp, pool_fetch
 
         app = ReplicaApp()
@@ -89,12 +105,34 @@ def main(argv: list[str] | None = None) -> None:
 
     from ..context.sources import ACTIVE_PODS_FIELD_SELECTOR
 
-    app = DashboardApp(
-        transport,
-        pod_field_selector=(
-            ACTIVE_PODS_FIELD_SELECTOR if args.active_pods_only else None
-        ),
+    pod_field_selector = (
+        ACTIVE_PODS_FIELD_SELECTOR if args.active_pods_only else None
     )
+
+    if args.workers:
+        if args.replication_leader:
+            parser.error(
+                "--workers excludes --replication-leader: the supervisor "
+                "already publishes (its internal bus feeds the workers)"
+            )
+        from ..workers import run_supervisor
+
+        def _leader_app() -> DashboardApp:
+            return DashboardApp(transport, pod_field_selector=pod_field_selector)
+
+        kwargs = {}
+        if args.background_sync:
+            kwargs["sync_interval_s"] = args.background_sync
+        run_supervisor(
+            _leader_app,
+            host=args.host,
+            port=args.port,
+            workers=args.workers,
+            **kwargs,
+        )
+        return
+
+    app = DashboardApp(transport, pod_field_selector=pod_field_selector)
     elector = None
     if args.replication_leader:
         from ..replicate import (

@@ -52,6 +52,7 @@ from ..obs.propagate import parse_traceparent
 from ..obs.trace import annotate, current_trace_id, span, trace_request, trace_ring
 from ..push import PAGES as PUSH_PAGES
 from ..push import PushPipeline, encode_body, format_event, set_active_push
+from ..push.hub import worker_identity
 from ..runtime.refresh import Refresher
 from ..runtime.transfer import TransferBatch
 from ..pages.native import native_node_page, native_pod_page
@@ -141,6 +142,7 @@ def _runtime_health(
     push: Any = None,
     replication: Any = None,
     fragments: Any = None,
+    workers: Any = None,
 ) -> dict[str, Any]:
     """Transfer-funnel, device-cache, transport-pool, and refresher
     counters for /healthz: how many blocking device_gets the process
@@ -193,6 +195,12 @@ def _runtime_health(
             # Fragment-cache view (ADR-027): entries/bytes/hit-rate —
             # the first stop when page.component dominates --attribute.
             out["render"] = fragments.snapshot()
+        if workers is not None:
+            # Multi-process plane view (ADR-029): every worker slot's
+            # counters off the shared status board, plus which worker
+            # answered this probe — triage must not depend on which
+            # process the kernel handed the socket to.
+            out["workers"] = workers.snapshot()
         # Burn-rate states per declared SLO (ADR-016): the one-line
         # answer a probe reader wants before opening /sloz.
         out["slo"] = slo_mod.engine().health_block()
@@ -487,6 +495,11 @@ class DashboardApp:
         #: BusConsumer (set by its constructor). None (default) keeps
         #: single-process serving byte-identical to pre-replication.
         self.replication: Any = None
+        #: Multi-process plane hook (ADR-029). On a worker process: a
+        #: _BoardHealth adapter over the shared status board, so
+        #: /healthz reports runtime.workers — the whole board, stamped
+        #: with which worker answered. None everywhere else.
+        self.workers: Any = None
 
     @property
     def registry(self) -> Registry:
@@ -1200,6 +1213,7 @@ class DashboardApp:
                             push=self.push,
                             replication=self.replication,
                             fragments=self.fragments,
+                            workers=self.workers,
                         ),
                     }
                 )
@@ -1240,6 +1254,7 @@ class DashboardApp:
                         push=self.push,
                         replication=self.replication,
                         fragments=self.fragments,
+                        workers=self.workers,
                     ),
                 }
             )
@@ -1663,7 +1678,22 @@ class DashboardApp:
             pages, last_event_id=last_event_id, priority=priority
         )
 
-    def serve(self, host: str = "127.0.0.1", port: int = 8631) -> ThreadingHTTPServer:
+    def serve(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8631,
+        *,
+        reuse_port: bool = False,
+        listen_socket: Any = None,
+    ) -> ThreadingHTTPServer:
+        """Build the HTTP server (caller runs ``serve_forever``).
+
+        ADR-029 multi-process knobs: ``reuse_port`` lets N worker
+        processes bind the same address (SO_REUSEPORT — the kernel
+        load-balances accepts); ``listen_socket`` adopts a pre-bound
+        listener inherited across a fork (the fd-passing strategy on
+        hosts without SO_REUSEPORT). Default: plain single-process
+        bind, byte-identical to the pre-worker behavior."""
         app = self
         gateway = self.ensure_gateway()
         # Always-on low-rate sampler (ADR-019). Here, not in __init__:
@@ -1723,8 +1753,15 @@ class DashboardApp:
                 data = body.encode()
                 encoding = None
                 if status == 200:
+                    # The strong ETag the gateway stamped keys the gzip
+                    # output cache: same validator, same bytes, so a
+                    # repeat 200 reuses the compression (ADR-021).
+                    etag = next(
+                        (v for n, v in response.headers if n.lower() == "etag"),
+                        None,
+                    )
                     data, encoding = encode_body(
-                        data, self.headers.get("Accept-Encoding")
+                        data, self.headers.get("Accept-Encoding"), etag=etag
                     )
                 self.send_response(status)
                 self.send_header("Content-Type", f"{content_type}; charset=utf-8")
@@ -1791,6 +1828,13 @@ class DashboardApp:
                     self.send_header(
                         "X-Headlamp-Generation", str(app.snapshot_generation())
                     )
+                    # Multi-process serving (ADR-029): which worker this
+                    # stream is pinned to. Connection pinning is what
+                    # keeps SSE per-worker; the header makes the pin
+                    # observable (and testable) from the client side.
+                    worker = worker_identity()
+                    if worker is not None:
+                        self.send_header("X-Headlamp-Worker", worker)
                     self.end_headers()
                     while True:
                         event = hub.next_event(sub)
@@ -1811,7 +1855,27 @@ class DashboardApp:
             def log_message(self, *args: Any) -> None:
                 pass
 
-        server = ThreadingHTTPServer((host, port), Handler)
+        if listen_socket is not None:
+            # Adopt the supervisor's pre-bound, pre-listening socket:
+            # skip bind/activate entirely and serve its accept queue.
+            server = ThreadingHTTPServer((host, port), Handler, bind_and_activate=False)
+            server.socket.close()
+            server.socket = listen_socket
+            server.server_address = listen_socket.getsockname()[:2]
+        elif reuse_port:
+            import socket as _socket
+
+            class _ReusePortServer(ThreadingHTTPServer):
+                def server_bind(self) -> None:
+                    if hasattr(_socket, "SO_REUSEPORT"):
+                        self.socket.setsockopt(
+                            _socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1
+                        )
+                    super().server_bind()
+
+            server = _ReusePortServer((host, port), Handler)
+        else:
+            server = ThreadingHTTPServer((host, port), Handler)
         return server
 
 

@@ -1,0 +1,56 @@
+"""Multi-process serving tier (ADR-029).
+
+N single-threaded-serving worker PROCESSES accept on one port — via
+``SO_REUSEPORT`` where the kernel offers it, or a shared pre-bound
+listener (fd passing over fork) everywhere else — each running a
+:class:`~headlamp_tpu.replicate.replica.ReplicaApp` fed through the
+existing ``apply_record`` seam. Same-host snapshot distribution rides
+a shared-memory segment (generation header + seqlock ready flag +
+ADR-012 columns + the canonical NDJSON record); the NDJSON bus stays
+the cross-host wire format and the counted fallback.
+"""
+
+from .balancer import (
+    RoundRobinBalancer,
+    pick_strategy,
+    reuseport_supported,
+    shared_listener,
+)
+from .shm import (
+    SEGMENT_VERSION,
+    SegmentBusPublisher,
+    SegmentCorrupt,
+    SegmentError,
+    SegmentFrame,
+    SegmentReader,
+    SegmentUnavailable,
+    SegmentVersionGated,
+    SnapshotSegment,
+    default_segment_path,
+)
+from .status import WorkerStatusBoard, register_worker_metrics
+from .supervisor import WorkerSupervisor, run_supervisor
+from .worker import ShmConsumer, worker_main
+
+__all__ = [
+    "RoundRobinBalancer",
+    "SEGMENT_VERSION",
+    "SegmentBusPublisher",
+    "SegmentCorrupt",
+    "SegmentError",
+    "SegmentFrame",
+    "SegmentReader",
+    "SegmentUnavailable",
+    "SegmentVersionGated",
+    "ShmConsumer",
+    "SnapshotSegment",
+    "WorkerStatusBoard",
+    "WorkerSupervisor",
+    "default_segment_path",
+    "pick_strategy",
+    "register_worker_metrics",
+    "reuseport_supported",
+    "run_supervisor",
+    "shared_listener",
+    "worker_main",
+]

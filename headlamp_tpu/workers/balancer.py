@@ -1,0 +1,174 @@
+"""One port, N accepting processes (ADR-029 part 4: the front door).
+
+Strategy ladder:
+
+1. ``SO_REUSEPORT`` — each worker binds the same ``(host, port)`` and
+   the kernel load-balances accepts. Zero in-repo moving parts; Linux
+   and the BSDs offer it.
+2. fd passing — the supervisor binds ONE listening socket before
+   forking and every worker inherits the fd (:func:`shared_listener`);
+   the kernel wakes one accepter per connection. Works on any POSIX
+   host, at the price of a shared accept queue.
+3. :class:`RoundRobinBalancer` — a plain round-robin TCP proxy for
+   topologies where the workers had to bind distinct ports (no fork
+   relationship, e.g. pre-started workers in a test). In-repo so the
+   bench works everywhere; never the production default.
+
+All three present the same contract to clients: one address, and any
+accepted connection is PINNED to one worker for its lifetime — which
+is exactly what keeps SSE streams per-worker (ADR-021 resume semantics
+ride ``Last-Event-ID``, so a reconnect landing on a different worker
+replays from its hub or falls back to a full paint, unchanged).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any
+
+
+def reuseport_supported() -> bool:
+    """Does this host offer SO_REUSEPORT? Probed by actually setting
+    the option on a throwaway socket — the constant existing does not
+    mean the kernel accepts it (WSL1, some container runtimes)."""
+    if not hasattr(socket, "SO_REUSEPORT"):
+        return False
+    probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        return True
+    except OSError:
+        return False
+    finally:
+        probe.close()
+
+
+def pick_strategy() -> str:
+    """``"reuseport"`` where the kernel offers it, ``"fd-passing"``
+    otherwise — the supervisor's default choice."""
+    return "reuseport" if reuseport_supported() else "fd-passing"
+
+
+def shared_listener(host: str, port: int, *, backlog: int = 128) -> socket.socket:
+    """The fd-passing strategy's one listening socket: bound and
+    listening BEFORE workers fork, inheritable across the fork so every
+    worker accepts on the same queue."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind((host, port))
+    sock.listen(backlog)
+    sock.set_inheritable(True)
+    return sock
+
+
+class RoundRobinBalancer:
+    """Minimal round-robin TCP proxy: accept on one port, pin each
+    accepted connection to the next backend, pump bytes both ways until
+    either side closes. Thread-per-direction — acceptable for the
+    fallback tier it is (the bench and odd topologies), not a
+    production data plane."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        backends: list[tuple[str, int]],
+        *,
+        backlog: int = 128,
+    ) -> None:
+        if not backends:
+            raise ValueError("balancer needs at least one backend")
+        self.backends = list(backends)
+        self._next = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.connections = 0
+        self.failures = 0
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(backlog)
+        self.address = self._sock.getsockname()[:2]
+
+    def pick(self) -> tuple[str, int]:
+        with self._lock:
+            backend = self.backends[self._next % len(self.backends)]
+            self._next += 1
+            self.connections += 1
+        return backend
+
+    # -- serving (sanctioned THR001 seam: RoundRobinBalancer.start) -----
+
+    def start(self) -> None:
+        if self._threads:
+            return
+        self._stop.clear()
+        self._sock.settimeout(0.2)
+
+        def _pump(src: socket.socket, dst: socket.socket) -> None:
+            try:
+                while True:
+                    chunk = src.recv(65536)
+                    if not chunk:
+                        break
+                    dst.sendall(chunk)
+            except OSError:
+                pass  # either side closing ends the stream — normal
+            finally:
+                for s in (src, dst):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+        def _accept_loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    client, _addr = self._sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return  # listener closed: stop()
+                host, port = self.pick()
+                try:
+                    upstream = socket.create_connection((host, port), timeout=5.0)
+                except OSError:
+                    self.failures += 1
+                    client.close()
+                    continue
+                for pair in ((client, upstream), (upstream, client)):
+                    t = threading.Thread(target=_pump, args=pair, daemon=True)
+                    t.start()
+
+        accepter = threading.Thread(
+            target=_accept_loop, name="workers-balancer", daemon=True
+        )
+        self._threads.append(accepter)
+        accepter.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "backends": [f"{h}:{p}" for h, p in self.backends],
+            "connections": self.connections,
+            "failures": self.failures,
+        }
+
+
+__all__ = [
+    "RoundRobinBalancer",
+    "pick_strategy",
+    "reuseport_supported",
+    "shared_listener",
+]

@@ -1,0 +1,415 @@
+"""Shared-memory snapshot plane (ADR-029 part 1: the segment).
+
+One mmap'd file-backed segment per serving host, published by the
+supervisor (the leader/consumer process) and attached read-only by
+every worker. Layout::
+
+    0   magic            8s   b"HLTPSHM\\0"
+    8   version          u32  SEGMENT_VERSION (readers refuse others)
+    12  reserved         u32
+    16  seq              u64  seqlock: odd = write in progress
+    24  generation       u64  snapshot generation of the payload
+    32  fencing          u64  leadership term that published it
+    40  record_off       u64  canonical NDJSON record (bus codec line)
+    48  record_len       u64
+    56  columns_off      u64  per-provider ADR-012 packed columns
+    64  columns_len      u64
+    128 payload area
+
+Seqlock protocol (the "ready flag" of the ISSUE): the writer bumps
+``seq`` to an odd value, writes payload then header fields, then bumps
+``seq`` to the next even value. A reader snapshots ``seq`` (retrying
+while odd), COPIES the payload bytes out of the mmap, re-reads ``seq``,
+and only parses when the two reads match — so a torn write can cost a
+retry, never a half-applied snapshot. CPython's mmap stores are not
+atomic instructions, but the protocol only needs "a concurrent write
+is detectable", which the double-read gives: any interleaving either
+leaves ``seq`` odd or changes it between the reads.
+
+The NDJSON record inside the segment is the EXACT line the bus
+publisher retains (``replicate.bus.dumps_record`` bytes) — one codec,
+two transports — so a record applied from the segment is
+indistinguishable from one applied off the bus, and every byte-identity
+property of ADR-025 (ETags, 304s, push frames) carries over for free.
+
+Fallback ladder (ADR-029): segment missing → ``SegmentUnavailable``;
+foreign/future layout → ``SegmentVersionGated``; truncated header, bad
+magic, unstable seqlock, payload that fails to parse →
+``SegmentCorrupt``. Workers count each rung and drop to the NDJSON bus
+(the cross-host wire format, unchanged), never serve garbage.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import struct
+import tempfile
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any
+
+from ..replicate.bus import BusPublisher
+from ..runtime.columns import pack_fleet, unpack_fleet
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from ..analytics.encode import FleetArrays
+
+SEGMENT_MAGIC = b"HLTPSHM\x00"
+SEGMENT_VERSION = 1
+
+#: Fixed header area; payload starts here. Generous so the header can
+#: grow fields without moving the payload across versions.
+HEADER_SIZE = 128
+
+#: Default segment size. The 1024-node fixture's self-contained record
+#: is a few MB; 64 MiB of file-backed mmap is virtual until written and
+#: leaves headroom for the ROADMAP's 16k-fleet item. A payload that
+#: does not fit is refused (publish returns False, counted) — workers
+#: then ride the NDJSON bus, which has no size ceiling.
+DEFAULT_SEGMENT_SIZE = 64 * 1024 * 1024
+
+_HEADER = struct.Struct("<8sII7Q")  # magic, version, reserved, seq..columns_len
+_SEQ = struct.Struct("<Q")
+_SEQ_OFF = 16
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+class SegmentError(Exception):
+    """Base of the fallback-ladder rungs."""
+
+
+class SegmentUnavailable(SegmentError):
+    """No segment at the path (supervisor not running / not publishing)."""
+
+
+class SegmentVersionGated(SegmentError):
+    """Segment exists but speaks a different layout version."""
+
+
+class SegmentCorrupt(SegmentError):
+    """Bad magic, truncated payload, or an unstable seqlock read."""
+
+
+def default_segment_path(port: int, *, kind: str = "seg") -> str:
+    """Per-port rendezvous path: /dev/shm where the host has it (true
+    shared memory, zero disk traffic), the tempdir otherwise — both
+    sides derive the same path from the serving port alone."""
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+    return os.path.join(base, f"headlamp-tpu-{int(port)}.{kind}")
+
+
+def _pack_columns(columns: dict[str, "FleetArrays"]) -> bytes:
+    """Per-provider packed columns: u32 count, then per provider a
+    u32-length-prefixed utf-8 name and a u64-length-prefixed
+    ``pack_fleet`` blob."""
+    parts = [_U32.pack(len(columns))]
+    for name in sorted(columns):
+        blob = pack_fleet(columns[name])
+        encoded = name.encode("utf-8")
+        parts.append(_U32.pack(len(encoded)))
+        parts.append(encoded)
+        parts.append(_U64.pack(len(blob)))
+        parts.append(blob)
+    return b"".join(parts)
+
+
+def _unpack_columns(buf: bytes) -> dict[str, "FleetArrays"]:
+    out: dict[str, "FleetArrays"] = {}
+    view = memoryview(buf)
+    if len(view) < _U32.size:
+        raise ValueError("columns section truncated")
+    (count,) = _U32.unpack_from(view, 0)
+    pos = _U32.size
+    for _ in range(count):
+        (name_len,) = _U32.unpack_from(view, pos)
+        pos += _U32.size
+        name = bytes(view[pos : pos + name_len]).decode("utf-8")
+        pos += name_len
+        (blob_len,) = _U64.unpack_from(view, pos)
+        pos += _U64.size
+        if pos + blob_len > len(view):
+            raise ValueError(f"columns section truncated in {name!r}")
+        out[name] = unpack_fleet(bytes(view[pos : pos + blob_len]))
+        pos += blob_len
+    return out
+
+
+@dataclass
+class SegmentFrame:
+    """One stable read of the segment: the generation header plus the
+    payload COPIED out of the mmap (the columns view bytes are owned by
+    this frame, so a later publish can never mutate them under a
+    reader)."""
+
+    generation: int
+    fencing: int
+    record_line: str
+    columns: dict[str, "FleetArrays"]
+
+    def record(self) -> dict[str, Any]:
+        """The canonical bus record (``json.loads`` of the one line) —
+        feed it straight into ``ReplicaApp.apply_record``."""
+        return json.loads(self.record_line)
+
+
+class SnapshotSegment:
+    """Writer half: the supervisor's publish target. Creation is
+    atomic (temp file + rename), so a reader can never attach a
+    half-initialized header."""
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        size: int = DEFAULT_SEGMENT_SIZE,
+        version: int = SEGMENT_VERSION,
+    ) -> None:
+        self.path = path
+        self.size = int(size)
+        self.version = int(version)
+        self.published = 0
+        self.overflows = 0
+        directory = os.path.dirname(path) or "."
+        fd, tmp = tempfile.mkstemp(prefix=".hltp-seg-", dir=directory)
+        try:
+            os.ftruncate(fd, self.size)
+            header = bytearray(HEADER_SIZE)
+            _HEADER.pack_into(
+                header, 0, SEGMENT_MAGIC, self.version, 0, 0, 0, 0, 0, 0, 0, 0
+            )  # magic, version, reserved, seq, generation, fencing, 4 offsets/lens
+            os.pwrite(fd, bytes(header), 0)
+            self._file = os.fdopen(os.dup(fd), "r+b")
+            os.replace(tmp, path)
+        except BaseException:
+            os.close(fd)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        os.close(fd)
+        self._map = mmap.mmap(self._file.fileno(), self.size)
+        self._seq = 0
+
+    # -- publish ---------------------------------------------------------
+
+    def publish(
+        self,
+        record_line: str,
+        columns: dict[str, "FleetArrays"],
+        *,
+        generation: int,
+        fencing: int = 0,
+    ) -> bool:
+        """Seqlock-guarded overwrite with the new generation. Returns
+        False (counted) when the payload exceeds the segment — the
+        caller's bus backlog still carries the generation, so workers
+        fall back rather than stall."""
+        record = record_line.encode("utf-8")
+        cols = _pack_columns(columns)
+        record_off = HEADER_SIZE
+        columns_off = record_off + len(record) + (-len(record)) % 8
+        if columns_off + len(cols) > self.size:
+            self.overflows += 1
+            return False
+        m = self._map
+        self._seq += 1  # odd: write in progress
+        _SEQ.pack_into(m, _SEQ_OFF, self._seq)
+        m[record_off : record_off + len(record)] = record
+        m[columns_off : columns_off + len(cols)] = cols
+        struct.pack_into(
+            "<QQQQQQ",
+            m,
+            24,
+            int(generation),
+            int(fencing),
+            record_off,
+            len(record),
+            columns_off,
+            len(cols),
+        )
+        self._seq += 1  # even: stable
+        _SEQ.pack_into(m, _SEQ_OFF, self._seq)
+        self.published += 1
+        return True
+
+    def close(self) -> None:
+        try:
+            self._map.close()
+        finally:
+            self._file.close()
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class SegmentReader:
+    """Reader half: workers attach read-only and pull stable frames.
+    Construction raises the fallback-ladder rung that applies; ``read``
+    re-checks the version every call (the file under the path can be
+    replaced by a newer supervisor)."""
+
+    #: Seqlock retries before declaring the segment unstable. Each
+    #: retry is a microsecond-scale header re-read; 64 bounds a reader
+    #: spinning against a pathological writer loop.
+    MAX_RETRIES = 64
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        try:
+            self._file = open(path, "rb")
+        except FileNotFoundError as exc:
+            raise SegmentUnavailable(f"no segment at {path}") from exc
+        try:
+            size = os.fstat(self._file.fileno()).st_size
+            if size < HEADER_SIZE:
+                raise SegmentCorrupt(f"segment at {path} smaller than header")
+            self._map = mmap.mmap(
+                self._file.fileno(), size, access=mmap.ACCESS_READ
+            )
+        except SegmentError:
+            self._file.close()
+            raise
+        except (OSError, ValueError) as exc:
+            self._file.close()
+            raise SegmentCorrupt(f"segment at {path} unmappable") from exc
+        self._check_header()
+
+    def _check_header(self) -> None:
+        magic, version, _r, *_rest = _HEADER.unpack_from(self._map, 0)
+        if magic != SEGMENT_MAGIC:
+            raise SegmentCorrupt(
+                f"segment at {self.path} has foreign magic {magic!r}"
+            )
+        if version != SEGMENT_VERSION:
+            raise SegmentVersionGated(
+                f"segment at {self.path} is layout v{version}; "
+                f"this build reads v{SEGMENT_VERSION}"
+            )
+
+    def generation(self) -> int:
+        """Cheap header peek — the poll loop's "anything new?" check
+        (one u64 read, no payload copy). A mid-write peek may see the
+        incoming generation early; the full ``read`` re-validates."""
+        return _U64.unpack_from(self._map, 24)[0]
+
+    def read(self) -> SegmentFrame | None:
+        """One stable frame, or None while nothing has been published
+        (generation 0). Raises ``SegmentVersionGated``/``SegmentCorrupt``
+        per the fallback ladder."""
+        self._check_header()
+        m = self._map
+        for _ in range(self.MAX_RETRIES):
+            (seq1,) = _SEQ.unpack_from(m, _SEQ_OFF)
+            if seq1 & 1:
+                continue  # write in progress
+            generation, fencing, record_off, record_len, cols_off, cols_len = (
+                struct.unpack_from("<QQQQQQ", m, 24)
+            )
+            if generation == 0:
+                return None
+            end = max(record_off + record_len, cols_off + cols_len)
+            if end > len(m) or record_off < HEADER_SIZE:
+                raise SegmentCorrupt(
+                    f"segment at {self.path} header points outside the map"
+                )
+            # Copy BEFORE the confirming seq read: the copy is what the
+            # second read validates.
+            record = bytes(m[record_off : record_off + record_len])
+            cols = bytes(m[cols_off : cols_off + cols_len])
+            (seq2,) = _SEQ.unpack_from(m, _SEQ_OFF)
+            if seq1 != seq2:
+                continue  # torn read: retry
+            try:
+                return SegmentFrame(
+                    generation=int(generation),
+                    fencing=int(fencing),
+                    record_line=record.decode("utf-8"),
+                    columns=_unpack_columns(cols),
+                )
+            except (ValueError, UnicodeDecodeError) as exc:
+                raise SegmentCorrupt(
+                    f"segment at {self.path} payload failed to parse"
+                ) from exc
+        raise SegmentCorrupt(f"segment at {self.path} seqlock never stabilized")
+
+    def close(self) -> None:
+        try:
+            self._map.close()
+        finally:
+            self._file.close()
+
+
+class SegmentBusPublisher(BusPublisher):
+    """BusPublisher that ALSO mirrors every accepted generation into the
+    shared-memory segment — one codec (the bus record line is reused
+    verbatim), two transports. The bus backlog stays authoritative:
+    segment publish failures (overflow, closed map) are absorbed and
+    counted, because the NDJSON fallback ladder already covers them."""
+
+    def __init__(self, segment: SnapshotSegment, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.segment = segment
+        self.segment_publishes = 0
+        self.segment_failures = 0
+
+    def publish(
+        self,
+        snap: Any,
+        *,
+        generation: int,
+        metrics: Any = None,
+        forecast: Any = None,
+    ) -> bool:
+        accepted = super().publish(
+            snap, generation=generation, metrics=metrics, forecast=forecast
+        )
+        if not accepted:
+            return False
+        with self._lock:
+            line = self._backlog[-1][1]
+        try:
+            from ..analytics.encode import encode_fleet
+
+            columns = {
+                name: encode_fleet(state.view.nodes, state.view.pods)
+                for name, state in (getattr(snap, "providers", {}) or {}).items()
+            }
+            if self.segment.publish(
+                line, columns, generation=generation, fencing=self.fencing
+            ):
+                self.segment_publishes += 1
+            else:
+                self.segment_failures += 1
+        except Exception:  # noqa: BLE001 — the segment is an optimization; the bus is truth
+            self.segment_failures += 1
+        return True
+
+    def snapshot(self) -> dict[str, Any]:
+        out = super().snapshot()
+        out["segment_publishes"] = self.segment_publishes
+        out["segment_failures"] = self.segment_failures
+        out["segment_path"] = self.segment.path
+        return out
+
+
+__all__ = [
+    "DEFAULT_SEGMENT_SIZE",
+    "HEADER_SIZE",
+    "SEGMENT_MAGIC",
+    "SEGMENT_VERSION",
+    "SegmentBusPublisher",
+    "SegmentCorrupt",
+    "SegmentError",
+    "SegmentFrame",
+    "SegmentReader",
+    "SegmentUnavailable",
+    "SegmentVersionGated",
+    "SnapshotSegment",
+    "default_segment_path",
+]

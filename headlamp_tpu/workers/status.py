@@ -1,0 +1,243 @@
+"""Cross-worker status board (ADR-029 part 3: observability).
+
+Per-worker monotone counters in a second tiny mmap'd file: each worker
+owns ONE fixed slot it alone writes (no lock — single writer per
+slot), and any process on the host (another worker answering its
+/metricsz scrape, the supervisor's health poll, the bench) reads all
+slots. This is what makes "per-worker metrics aggregation on
+/metricsz" work under SO_REUSEPORT, where a scrape lands on an
+arbitrary worker: every worker renders every worker's counters from
+the shared board, so the scrape answer does not depend on which
+process accepted the socket.
+
+Layout::
+
+    0   magic    8s  b"HLTPWSB\\0"
+    8   version  u32
+    12  n_slots  u32
+    16  slots, 48 bytes each:
+        u32 worker_id   u32 pid   u64 generation
+        u64 generations_applied   u64 shm_attach_failures
+        u64 fallback_decodes
+
+Slot reads are not seqlock-guarded: every field is independently
+monotone (or a pid/id that never changes after registration), so a
+torn read can only show a value between two true values — fine for
+counters, and the price of guarding would be a lock shared across
+processes.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import tempfile
+from typing import Any
+
+from ..obs.metrics import registry as _metrics_registry
+
+BOARD_MAGIC = b"HLTPWSB\x00"
+BOARD_VERSION = 1
+_BOARD_HEADER = struct.Struct("<8sII")
+_SLOT = struct.Struct("<IIQQQQ")
+SLOT_SIZE = _SLOT.size  # 48
+
+#: The three ISSUE-named per-worker families (counters, per-worker
+#: label), rendered at scrape time from the shared board.
+WORKER_METRIC_FAMILIES = (
+    "headlamp_tpu_worker_generations_applied_total",
+    "headlamp_tpu_worker_shm_attach_failures_total",
+    "headlamp_tpu_worker_fallback_decodes_total",
+)
+
+
+class WorkerSlot:
+    """One worker's writer handle: counters live as plain ints here and
+    every mutation writes the packed slot through — the board is the
+    publication, the ints are the fast local truth."""
+
+    def __init__(self, board: "WorkerStatusBoard", worker_id: int) -> None:
+        self._board = board
+        self.worker_id = int(worker_id)
+        self.pid = os.getpid()
+        self.generation = 0
+        self.generations_applied = 0
+        self.shm_attach_failures = 0
+        self.fallback_decodes = 0
+        self._write()
+
+    def _write(self) -> None:
+        self._board._write_slot(
+            self.worker_id,
+            self.pid,
+            self.generation,
+            self.generations_applied,
+            self.shm_attach_failures,
+            self.fallback_decodes,
+        )
+
+    def applied(self, generation: int) -> None:
+        self.generation = int(generation)
+        self.generations_applied += 1
+        self._write()
+
+    def attach_failure(self) -> None:
+        self.shm_attach_failures += 1
+        self._write()
+
+    def fallback_decode(self) -> None:
+        self.fallback_decodes += 1
+        self._write()
+
+
+class WorkerStatusBoard:
+    """The mmap'd board. ``create`` (supervisor) zeroes fresh slots via
+    atomic temp-file + rename; ``attach`` (workers, scrapers) maps the
+    existing file writable so each worker can publish its own slot."""
+
+    def __init__(self, path: str, *, n_slots: int, _map: mmap.mmap, _file: Any) -> None:
+        self.path = path
+        self.n_slots = int(n_slots)
+        self._map = _map
+        self._file = _file
+
+    @classmethod
+    def create(cls, path: str, *, n_slots: int) -> "WorkerStatusBoard":
+        size = _BOARD_HEADER.size + int(n_slots) * SLOT_SIZE
+        directory = os.path.dirname(path) or "."
+        fd, tmp = tempfile.mkstemp(prefix=".hltp-wsb-", dir=directory)
+        try:
+            os.ftruncate(fd, size)
+            header = bytearray(_BOARD_HEADER.size)
+            _BOARD_HEADER.pack_into(header, 0, BOARD_MAGIC, BOARD_VERSION, n_slots)
+            os.pwrite(fd, bytes(header), 0)
+            file = os.fdopen(os.dup(fd), "r+b")
+            os.replace(tmp, path)
+        except BaseException:
+            os.close(fd)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        os.close(fd)
+        return cls(path, n_slots=n_slots, _map=mmap.mmap(file.fileno(), size), _file=file)
+
+    @classmethod
+    def attach(cls, path: str) -> "WorkerStatusBoard":
+        file = open(path, "r+b")
+        try:
+            size = os.fstat(file.fileno()).st_size
+            m = mmap.mmap(file.fileno(), size)
+            magic, version, n_slots = _BOARD_HEADER.unpack_from(m, 0)
+            if magic != BOARD_MAGIC or version != BOARD_VERSION:
+                m.close()
+                raise ValueError(f"foreign status board at {path}")
+        except BaseException:
+            file.close()
+            raise
+        return cls(path, n_slots=n_slots, _map=m, _file=file)
+
+    # -- slot I/O --------------------------------------------------------
+
+    def slot(self, worker_id: int) -> WorkerSlot:
+        if not 0 <= int(worker_id) < self.n_slots:
+            raise ValueError(f"worker id {worker_id} outside board ({self.n_slots} slots)")
+        return WorkerSlot(self, worker_id)
+
+    def _write_slot(self, worker_id: int, *values: int) -> None:
+        offset = _BOARD_HEADER.size + int(worker_id) * SLOT_SIZE
+        _SLOT.pack_into(self._map, offset, int(worker_id), *[int(v) for v in values])
+
+    def rows(self) -> list[dict[str, int]]:
+        """Every REGISTERED slot (pid != 0), in worker-id order."""
+        out: list[dict[str, int]] = []
+        for i in range(self.n_slots):
+            offset = _BOARD_HEADER.size + i * SLOT_SIZE
+            worker_id, pid, generation, applied, attach_failures, fallbacks = (
+                _SLOT.unpack_from(self._map, offset)
+            )
+            if pid == 0:
+                continue
+            out.append(
+                {
+                    "worker": worker_id,
+                    "pid": pid,
+                    "generation": generation,
+                    "generations_applied": applied,
+                    "shm_attach_failures": attach_failures,
+                    "fallback_decodes": fallbacks,
+                }
+            )
+        return out
+
+    def samples(self, field: str) -> list[tuple[tuple[str, ...], int]]:
+        """((worker,), value) pairs for one counter field — the
+        scrape-time feed of the per-worker metric families."""
+        return [((f"w{row['worker']}",), row[field]) for row in self.rows()]
+
+    def snapshot(self, *, self_id: int | None = None) -> dict[str, Any]:
+        """The /healthz ``runtime.workers`` block: which worker
+        answered, how many slots are live, and every worker's counters
+        (the whole board — triage must not depend on which worker the
+        probe landed on)."""
+        rows = self.rows()
+        return {
+            "self": f"w{self_id}" if self_id is not None else None,
+            "slots": self.n_slots,
+            "live": len(rows),
+            "workers": rows,
+        }
+
+    def close(self) -> None:
+        try:
+            self._map.close()
+        finally:
+            self._file.close()
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+def register_worker_metrics(board: WorkerStatusBoard) -> None:
+    """Wire the three per-worker counter families to ``board`` —
+    callback counters rendered from the shared slots at scrape time,
+    with latest-producer-wins re-registration (same contract as
+    ``gauge_fn``) so every process that attaches the board can call
+    this idempotently."""
+    _metrics_registry.counter_samples_fn(
+        "headlamp_tpu_worker_generations_applied_total",
+        "Snapshot generations applied by each worker process (from the "
+        "shared status board; labeled by worker slot).",
+        ("worker",),
+        lambda: board.samples("generations_applied"),
+    )
+    _metrics_registry.counter_samples_fn(
+        "headlamp_tpu_worker_shm_attach_failures_total",
+        "Shared-memory segment attach/read failures per worker (each one "
+        "is a counted drop down the ADR-029 fallback ladder).",
+        ("worker",),
+        lambda: board.samples("shm_attach_failures"),
+    )
+    _metrics_registry.counter_samples_fn(
+        "headlamp_tpu_worker_fallback_decodes_total",
+        "Generations a worker applied via the NDJSON bus fallback "
+        "instead of the shared-memory segment.",
+        ("worker",),
+        lambda: board.samples("fallback_decodes"),
+    )
+
+
+__all__ = [
+    "BOARD_MAGIC",
+    "BOARD_VERSION",
+    "SLOT_SIZE",
+    "WORKER_METRIC_FAMILIES",
+    "WorkerSlot",
+    "WorkerStatusBoard",
+    "register_worker_metrics",
+]

@@ -1,0 +1,221 @@
+"""Supervisor: one leader process, N serving workers (ADR-029 part 5).
+
+The supervisor owns the only cluster-facing ``DashboardApp``: it syncs,
+publishes every generation through a :class:`SegmentBusPublisher`
+(segment + bus backlog in one call), and serves the NDJSON bus on an
+internal loopback port — the fallback rung and the cross-host wire
+format, unchanged. The workers it forks never touch the cluster: each
+is a ``ReplicaApp`` behind :func:`~.worker.worker_main`, fed from the
+segment, accepting on the public port via SO_REUSEPORT or the shared
+pre-bound listener.
+
+Fork, not spawn, deliberately: the listener fd and the segment path
+must reach the children, and fork inherits both without pickling. The
+supervisor forks BEFORE its first sync (no jax, no device handles, no
+thread pools yet), which is what makes fork safe here — the children
+import their own runtime stacks fresh.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+import threading
+import time
+from typing import Any, Callable
+
+from .balancer import pick_strategy, shared_listener
+from .shm import SegmentBusPublisher, SnapshotSegment, default_segment_path
+from .status import WorkerStatusBoard
+from .worker import worker_main
+
+#: Default supervisor sync cadence — the background heartbeat every
+#: worker's generation feed rides on.
+DEFAULT_SYNC_INTERVAL_S = 2.0
+
+
+class WorkerSupervisor:
+    """Builds the leader app, publishes into the shared-memory plane,
+    and keeps N worker processes accepting on the public port.
+
+    ``app_factory`` returns the cluster-facing ``DashboardApp`` (demo
+    transport, kube proxy, in-cluster — the supervisor is
+    transport-agnostic). Lifecycle: ``start()`` forks workers and
+    starts the sync loop; ``poll()`` reports liveness; ``stop()``
+    terminates children and closes the plane.
+    """
+
+    def __init__(
+        self,
+        app_factory: Callable[[], Any],
+        *,
+        host: str = "127.0.0.1",
+        port: int = 8631,
+        workers: int = 2,
+        segment_path: str | None = None,
+        board_path: str | None = None,
+        sync_interval_s: float = DEFAULT_SYNC_INTERVAL_S,
+        strategy: str | None = None,
+    ) -> None:
+        if workers < 1:
+            raise ValueError("workers must be >= 1")
+        self._app_factory = app_factory
+        self.host = host
+        self.port = int(port)
+        self.workers = int(workers)
+        self.segment_path = segment_path or default_segment_path(port)
+        self.board_path = board_path or default_segment_path(port, kind="wsb")
+        self.sync_interval_s = sync_interval_s
+        self.strategy = strategy or pick_strategy()
+        self.app: Any = None
+        self.publisher: SegmentBusPublisher | None = None
+        self.segment: SnapshotSegment | None = None
+        self.board: WorkerStatusBoard | None = None
+        self.bus_url: str | None = None
+        self._bus_server: Any = None
+        self._listener: Any = None
+        self._procs: list[Any] = []
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        """Bring the plane up in dependency order: segment + board
+        first (workers attach at entry), then the fork — BEFORE the
+        leader app exists, so children inherit no jax/device state —
+        then the leader app, its internal bus endpoint, and the sync
+        heartbeat."""
+        self.segment = SnapshotSegment(self.segment_path)
+        self.board = WorkerStatusBoard.create(self.board_path, n_slots=self.workers)
+        listener = None
+        if self.strategy != "reuseport":
+            listener = shared_listener(self.host, self.port)
+            self._listener = listener
+        # The internal bus endpoint's port must be known before the
+        # fork so workers get their fallback URL; bind it now, serve
+        # after the leader app exists.
+        import socket as _socket
+
+        bus_sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        bus_sock.bind((self.host, 0))
+        bus_port = bus_sock.getsockname()[1]
+        bus_sock.close()
+        self.bus_url = f"http://{self.host}:{bus_port}"
+        ctx = multiprocessing.get_context("fork")
+        for worker_id in range(self.workers):
+            proc = ctx.Process(
+                target=worker_main,
+                args=(worker_id, self.host, self.port),
+                kwargs={
+                    "segment_path": self.segment_path,
+                    "board_path": self.board_path,
+                    "fallback_url": self.bus_url,
+                    "listen_socket": listener,
+                },
+                daemon=True,
+                name=f"headlamp-worker-{worker_id}",
+            )
+            proc.start()
+            self._procs.append(proc)
+        # Leader app + publisher, post-fork.
+        app = self._app_factory()
+        self.app = app
+        self.publisher = SegmentBusPublisher(
+            self.segment,
+            ledger=getattr(app, "ledger", None),
+            note=f"supervisor {self.host}:{self.port}",
+        )
+        app.replication = self.publisher
+        bus_server = app.serve(self.host, bus_port)
+        self._bus_server = bus_server
+        bus_thread = threading.Thread(
+            target=bus_server.serve_forever,
+            name="workers-supervisor-bus",
+            daemon=True,
+        )
+        bus_thread.start()
+        app.start_background_sync(self.sync_interval_s)
+
+    def poll(self) -> dict[str, Any]:
+        """Liveness + plane counters — the supervisor-side triage view
+        (workers expose their own /healthz on the public port)."""
+        alive = [p.pid for p in self._procs if p.is_alive()]
+        out: dict[str, Any] = {
+            "strategy": self.strategy,
+            "workers": self.workers,
+            "alive": len(alive),
+            "pids": alive,
+            "segment_path": self.segment_path,
+        }
+        if self.publisher is not None:
+            out["replication"] = self.publisher.snapshot()
+        if self.board is not None:
+            out["board"] = self.board.snapshot()
+        return out
+
+    def wait(self) -> None:
+        """Park the supervisor's main thread until interrupted —
+        ``python -m headlamp_tpu.server --workers N``'s steady state."""
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:  # analysis: disable=EXC001
+            pass  # top-of-process Ctrl-C: clean stop IS the handling
+
+    def stop(self, *, unlink: bool = True) -> None:
+        for proc in self._procs:
+            if proc.is_alive():
+                proc.terminate()
+        for proc in self._procs:
+            proc.join(timeout=5.0)
+        self._procs.clear()
+        if self._bus_server is not None:
+            try:
+                self._bus_server.shutdown()
+                self._bus_server.server_close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            self._bus_server = None
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        if self.segment is not None:
+            self.segment.close()
+            if unlink:
+                self.segment.unlink()
+        if self.board is not None:
+            self.board.close()
+            if unlink:
+                self.board.unlink()
+
+
+def run_supervisor(
+    app_factory: Callable[[], Any],
+    *,
+    host: str,
+    port: int,
+    workers: int,
+    sync_interval_s: float = DEFAULT_SYNC_INTERVAL_S,
+) -> None:
+    """CLI entry (``--workers N``): start, announce, park, clean up."""
+    sup = WorkerSupervisor(
+        app_factory,
+        host=host,
+        port=port,
+        workers=workers,
+        sync_interval_s=sync_interval_s,
+    )
+    sup.start()
+    print(
+        f"TPU dashboard SUPERVISOR: {workers} workers on "
+        f"http://{host}:{port}/tpu ({sup.strategy}; pid {os.getpid()})"
+    )
+    try:
+        sup.wait()
+    finally:
+        sup.stop()
+
+
+__all__ = ["DEFAULT_SYNC_INTERVAL_S", "WorkerSupervisor", "run_supervisor"]

@@ -1,0 +1,287 @@
+"""Worker process: ReplicaApp fed from the segment (ADR-029 part 2).
+
+A worker is the ADR-025 replica, re-hosted: the same ``ReplicaApp``,
+the same ``apply_record`` seam, the same stale-honesty wiring — only
+the FEED changes. :class:`ShmConsumer` polls the shared-memory segment
+(a header peek per tick, a full seqlock read only on generation
+change) and falls down the counted NDJSON-bus ladder when the segment
+is missing, version-gated, or corrupt. Because the segment carries the
+canonical bus record line verbatim, a segment-applied generation is
+byte-identical — pages, ETags, 304s, SSE frames — to a bus-applied
+one; the fast path changes WHERE the bytes come from, never what they
+decode to.
+
+The shm win on top of skipping the HTTP hop: the segment ships the
+ADR-012 columns pre-encoded, so after ``apply_record`` the consumer
+SEEDS the device fleet cache directly (``DeviceFleetCache.seed``) and
+the worker's first render of the generation skips ``encode_fleet``'s
+per-node Python loop entirely.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from ..replicate.bus import _BYTES, parse_payload
+from ..replicate.replica import ReplicaApp, set_active_consumer
+from .shm import SegmentError, SegmentReader, SegmentUnavailable
+from .status import WorkerSlot
+
+
+class ShmConsumer:
+    """Pulls generations off the shared-memory segment into one
+    ReplicaApp, with the NDJSON bus as the counted fallback.
+    ``poll_once`` is the whole protocol — deterministic tests call it
+    directly; production calls ``start()`` for a poll thread (a
+    sanctioned THR001 seam, mirroring ``BusConsumer``). Every failure
+    rung is absorbed and counted: a missing supervisor must degrade the
+    worker to stale-honest serving, never crash it."""
+
+    def __init__(
+        self,
+        app: ReplicaApp,
+        segment_path: str,
+        *,
+        fallback_fetch: Callable[[int], str] | None = None,
+        slot: WorkerSlot | None = None,
+        monotonic: Callable[[], float] | None = None,
+        interval_s: float = 0.25,
+    ) -> None:
+        self.app = app
+        self.segment_path = segment_path
+        self._fallback = fallback_fetch
+        self.slot = slot
+        self._mono = monotonic or time.monotonic
+        self.interval_s = interval_s
+        self._reader: SegmentReader | None = None
+        self.polls = 0
+        self.applied_shm = 0
+        self.applied_fallback = 0
+        self.attach_failures = 0
+        self.fallback_failures = 0
+        self.cursor = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # The /healthz runtime.replication block reads the consumer —
+        # same wiring as BusConsumer, role "worker".
+        app.replication = self
+        set_active_consumer(self)
+
+    # -- one tick --------------------------------------------------------
+
+    def poll_once(self) -> int:
+        """One tick of the fallback ladder: segment read → apply →
+        seed; any segment failure counts an attach failure and (when a
+        bus fetch is wired) drops to the NDJSON path. Returns the
+        number of generations applied."""
+        self.polls += 1
+        frame = None
+        segment_ok = False
+        try:
+            reader = self._reader
+            if reader is None:
+                reader = SegmentReader(self.segment_path)
+                self._reader = reader
+            if reader.generation() > self.app.snapshot_generation():
+                frame = reader.read()
+            segment_ok = True
+        except SegmentError:
+            # Missing / version-gated / corrupt: drop the attachment so
+            # the next tick re-opens (the file may be replaced by a
+            # fresh supervisor), count the rung, take the ladder.
+            self._drop_reader()
+            self.attach_failures += 1
+            if self.slot is not None:
+                self.slot.attach_failure()
+        if frame is not None:
+            try:
+                record = frame.record()
+            except ValueError:
+                # Parseable segment, unparseable record: same rung as
+                # corrupt — counted, then the bus gets a chance.
+                self._drop_reader()
+                self.attach_failures += 1
+                segment_ok = False
+                record = None
+                if self.slot is not None:
+                    self.slot.attach_failure()
+            if record is not None:
+                generation = int(record.get("generation") or 0)
+                self.cursor = max(self.cursor, generation)
+                if self.app.apply_record(record):
+                    self.applied_shm += 1
+                    if self.slot is not None:
+                        self.slot.applied(generation)
+                    self._seed_columns(frame.columns, generation)
+                    return 1
+                return 0
+        if segment_ok:
+            # Segment healthy and nothing newer than the app: done.
+            return 0
+        return self._poll_fallback()
+
+    def _drop_reader(self) -> None:
+        reader, self._reader = self._reader, None
+        if reader is not None:
+            try:
+                reader.close()
+            except Exception:  # noqa: BLE001 — teardown of a broken map must not mask the rung
+                pass
+
+    def _seed_columns(self, columns: dict[str, Any], generation: int) -> None:
+        """Install the segment's pre-encoded ADR-012 columns so the
+        first render skips encode_fleet. Absorbed: a seeding failure
+        costs the render-path encode it would have skipped, nothing
+        else."""
+        try:
+            from ..runtime.device_cache import fleet_cache
+
+            for provider, fleet in columns.items():
+                fleet_cache.seed(provider, generation, fleet)
+        except Exception:  # noqa: BLE001 — seeding is an optimization only
+            pass
+
+    def _poll_fallback(self) -> int:
+        """The NDJSON-bus rung: a BusConsumer-shaped pull through the
+        injected fetch (absent on segment-only topologies)."""
+        if self._fallback is None:
+            return 0
+        try:
+            payload = self._fallback(self.cursor)
+            _, records = parse_payload(payload, origin="<worker-fallback>")
+        except Exception:  # noqa: BLE001 — dead leader degrades, never crashes
+            self.fallback_failures += 1
+            return 0
+        _BYTES.inc(len(payload), role="applied")
+        applied = 0
+        for record in records:
+            generation = int(record.get("generation") or 0)
+            if self.app.apply_record(record):
+                applied += 1
+                self.applied_fallback += 1
+                if self.slot is not None:
+                    self.slot.applied(generation)
+                    self.slot.fallback_decode()
+            self.cursor = max(self.cursor, generation)
+        return applied
+
+    # -- poll thread (sanctioned THR001 seam) ----------------------------
+
+    def start(self, interval_s: float | None = None) -> None:
+        if self._thread is not None:
+            return
+        interval = interval_s if interval_s is not None else self.interval_s
+        self._stop.clear()
+
+        def _consume_loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001 — keep pulling
+                    pass
+                self._stop.wait(interval)
+
+        thread = threading.Thread(
+            target=_consume_loop, name="workers-shm-consumer", daemon=True
+        )
+        self._thread = thread
+        thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=2.0)
+            self._thread = None
+
+    def snapshot(self) -> dict[str, Any]:
+        """The /healthz ``runtime.replication`` block (worker role)."""
+        app = self.app
+        lag = app.lag_s()
+        return {
+            "role": "worker",
+            "segment_path": self.segment_path,
+            "segment_attached": self._reader is not None,
+            "cursor": self.cursor,
+            "last_generation": app.snapshot_generation(),
+            "applied": app.applied,
+            "applied_shm": self.applied_shm,
+            "applied_fallback": self.applied_fallback,
+            "attach_failures": self.attach_failures,
+            "fallback_failures": self.fallback_failures,
+            "rejected_stale": app.rejected_stale,
+            "polls": self.polls,
+            "stale": app.stale(),
+            "lag_s": round(lag, 3) if lag is not None else None,
+        }
+
+
+def worker_main(
+    worker_id: int,
+    host: str,
+    port: int,
+    *,
+    segment_path: str,
+    board_path: str,
+    fallback_url: str | None = None,
+    listen_socket: Any = None,
+    interval_s: float = 0.25,
+) -> None:
+    """Process entry for one serving worker: ReplicaApp + segment
+    consumer + per-worker observability, accepting on the shared port
+    (via the inherited ``listen_socket`` when the supervisor chose the
+    fd-passing strategy, via SO_REUSEPORT otherwise). Runs until the
+    process is terminated — the supervisor owns lifecycle."""
+    from ..push.hub import set_worker_identity
+    from ..replicate.replica import pool_fetch
+    from .status import WorkerStatusBoard, register_worker_metrics
+
+    app = ReplicaApp()
+    slot = None
+    try:
+        board = WorkerStatusBoard.attach(board_path)
+        slot = board.slot(worker_id)
+        register_worker_metrics(board)
+        app.workers = _BoardHealth(board, worker_id)
+    except Exception:  # noqa: BLE001 — a lost board degrades observability, not serving
+        board = None
+    set_worker_identity(f"w{worker_id}")
+    fetch = pool_fetch(fallback_url) if fallback_url else None
+    consumer = ShmConsumer(
+        app,
+        segment_path,
+        fallback_fetch=fetch,
+        slot=slot,
+        interval_s=interval_s,
+    )
+    consumer.poll_once()  # best-effort first fill before the socket opens
+    consumer.start()
+    server = app.serve(
+        host,
+        port,
+        reuse_port=listen_socket is None,
+        listen_socket=listen_socket,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:  # analysis: disable=EXC001
+        pass  # supervisor-initiated stop: clean exit IS the handling
+    finally:
+        consumer.stop()
+
+
+class _BoardHealth:
+    """Adapter giving /healthz its ``runtime.workers`` block: the whole
+    board, stamped with which worker answered."""
+
+    def __init__(self, board: Any, worker_id: int) -> None:
+        self._board = board
+        self._worker_id = worker_id
+
+    def snapshot(self) -> dict[str, Any]:
+        return self._board.snapshot(self_id=self._worker_id)
+
+
+__all__ = ["ShmConsumer", "worker_main"]

@@ -393,6 +393,49 @@ class TestThreadSpawnMutations:
         )
         assert len(self._diags(stray, "headlamp_tpu/replicate/bus.py")) == 1
 
+    def test_worker_seams_clean_same_code_elsewhere_flagged(self):
+        # ADR-029 sanctioned seams: the worker's segment poll loop and
+        # the fallback balancer's accept thread — and only their start
+        # methods; the same spawns anywhere else stay findings.
+        poller = (
+            "import threading\n"
+            "class ShmConsumer:\n"
+            "    def start(self, interval_s=None):\n"
+            "        self._t = threading.Thread(target=self._consume_loop)\n"
+        )
+        accepter = (
+            "import threading\n"
+            "class RoundRobinBalancer:\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._accept_loop).start()\n"
+        )
+        assert self._diags(poller, "headlamp_tpu/workers/worker.py") == []
+        assert self._diags(accepter, "headlamp_tpu/workers/balancer.py") == []
+        assert len(self._diags(poller, "headlamp_tpu/workers/shm.py")) == 1
+        assert len(self._diags(accepter, "headlamp_tpu/workers/worker.py")) == 1
+
+    def test_process_spawn_is_a_finding_outside_the_baselined_supervisor(self):
+        # multiprocessing.Process construction is a spawn: nobody but
+        # the supervisor (grandfathered with a reason in baseline.json,
+        # NOT allowlisted) gets to fork serving processes.
+        src = (
+            "import multiprocessing\n"
+            "def scale_out(n):\n"
+            "    ctx = multiprocessing.get_context('fork')\n"
+            "    ctx.Process(target=print, daemon=True).start()\n"
+        )
+        diags = self._diags(src, "headlamp_tpu/workers/shm.py")
+        assert len(diags) == 1 and diags[0].context == "scale_out"
+        # The live supervisor spawn is attributed to the reasoned entry.
+        entries = load_baseline(default_baseline_path())
+        assert any(
+            e["rule"] == "THR001"
+            and e["path"] == "headlamp_tpu/workers/supervisor.py"
+            and e["context"] == "WorkerSupervisor.start"
+            and e["reason"]
+            for e in entries
+        )
+
 
 class TestMetricsAllowlistMutations:
     """SYN001 — quiet-family allowlist ↔ registry-literal sync."""

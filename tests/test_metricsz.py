@@ -210,6 +210,7 @@ class TestFormat:
             "headlamp_tpu_push_evictions_total",
             "headlamp_tpu_push_not_modified_total",
             "headlamp_tpu_push_gzip_bytes_total",
+            "headlamp_tpu_push_gzip_cache_total",
             "headlamp_tpu_push_clients_count",
             # ADR-025 read tier: labeled counters render no samples
             # until a bus generation is actually published/applied or a
@@ -230,6 +231,14 @@ class TestFormat:
             # extracted — the socketless fixture never drives the
             # transport pool or an inbound header.
             "headlamp_tpu_trace_propagation_total",
+            # ADR-029 multi-process plane: the per-worker callback
+            # counters render samples only while a process has a live
+            # status board attached (register_worker_metrics); in the
+            # socketless single-process fixture — and after a workers
+            # test drops its board — the families are quiet.
+            "headlamp_tpu_worker_generations_applied_total",
+            "headlamp_tpu_worker_shm_attach_failures_total",
+            "headlamp_tpu_worker_fallback_decodes_total",
         }, f"unexpected sample-free families: {sorted(quiet)}"
 
     def test_name_grammar_and_unit_suffixes(self, exposition):

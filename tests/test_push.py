@@ -438,6 +438,82 @@ class TestConditional:
         assert payload == noise
 
 
+class TestGzipOutputCache:
+    """ETag-keyed compressed-output cache (ADR-029 satellite): a poll
+    fleet hammering an unchanged route pays ONE encode per generation,
+    and the key can never serve one route's bytes for another's."""
+
+    def setup_method(self):
+        from headlamp_tpu.push.conditional import gzip_cache_clear
+
+        gzip_cache_clear()
+
+    teardown_method = setup_method
+
+    @staticmethod
+    def _events(outcome):
+        from headlamp_tpu.push.conditional import _GZIP_CACHE_EVENTS
+
+        return _GZIP_CACHE_EVENTS.value_for(outcome=outcome)
+
+    def test_second_encode_is_a_counted_hit_with_identical_bytes(self):
+        body = b"<tr><td>gke-tpu-node</td><td>4</td></tr>" * 100
+        hits, misses = self._events("hit"), self._events("miss")
+        one, enc1 = encode_body(body, "gzip", etag='"g5-e0-d0"')
+        two, enc2 = encode_body(body, "gzip", etag='"g5-e0-d0"')
+        assert enc1 == enc2 == "gzip" and one == two
+        assert gzip.decompress(two) == body
+        assert self._events("miss") == misses + 1
+        assert self._events("hit") == hits + 1
+
+    def test_etag_alone_cannot_cross_serve_two_routes(self):
+        # etag_for hashes only the query window, so two ROUTES at the
+        # same generation share a validator while painting different
+        # bodies — the length+crc half of the key must keep them apart.
+        etag = '"g5-e0-d0"'
+        nodes = b"<h1>nodes</h1>" + b"n" * 1024
+        pods = b"<h1>pods</h1>p" + b"q" * 1024  # same length, different bytes
+        assert len(nodes) == len(pods)
+        out_nodes, _ = encode_body(nodes, "gzip", etag=etag)
+        out_pods, _ = encode_body(pods, "gzip", etag=etag)
+        assert gzip.decompress(out_nodes) == nodes
+        assert gzip.decompress(out_pods) == pods
+
+    def test_incompressible_verdict_is_cached_not_reencoded(self):
+        chunk = b"seed"
+        chunks = []
+        for _ in range(64):
+            chunk = hashlib.sha256(chunk).digest()
+            chunks.append(chunk)
+        noise = b"".join(chunks)
+        hits = self._events("hit")
+        assert encode_body(noise, "gzip", etag='"g1-e0-d0"') == (noise, None)
+        assert encode_body(noise, "gzip", etag='"g1-e0-d0"') == (noise, None)
+        # The second call hit the cached identity verdict instead of
+        # paying a doomed encode.
+        assert self._events("hit") == hits + 1
+
+    def test_cache_is_bounded_and_evictions_are_counted(self):
+        from headlamp_tpu.push.conditional import (
+            GZIP_CACHE_LIMIT,
+            gzip_cache_len,
+        )
+
+        evicted = self._events("evicted")
+        body = b"<tr><td>row</td></tr>" * 64
+        for gen in range(GZIP_CACHE_LIMIT + 5):
+            encode_body(body + str(gen).encode(), "gzip", etag=f'"g{gen}-e0-d0"')
+        assert gzip_cache_len() == GZIP_CACHE_LIMIT
+        assert self._events("evicted") == evicted + 5
+
+    def test_validator_less_callers_bypass_the_cache(self):
+        from headlamp_tpu.push.conditional import gzip_cache_len
+
+        body = b"<tr><td>row</td></tr>" * 64
+        encode_body(body, "gzip")
+        assert gzip_cache_len() == 0
+
+
 # ---------------------------------------------------------------------------
 # Gateway: pre-admission 304 and page-header stamping
 # ---------------------------------------------------------------------------

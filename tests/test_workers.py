@@ -1,0 +1,720 @@
+"""ADR-029 multi-process serving: the shared-memory snapshot plane.
+
+Everything runs the REAL protocol in-process: one leader DashboardApp
+publishing through a SegmentBusPublisher into a file-backed segment,
+and ReplicaApps ("workers") fed by ShmConsumer off the same file —
+multiple processes and one process mmap'ing one file are
+indistinguishable to the seqlock. Byte-identity assertions compare a
+segment-fed worker's paints, ETags, 304s, and push frames against
+leader-local serving for the SAME generation, because the segment
+carries the canonical bus record line verbatim: the fast path changes
+where the bytes come from, never what they decode to. The failover
+drill advances injected clocks — zero sleeps, zero 5xx.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from headlamp_tpu.analytics.encode import encode_fleet
+from headlamp_tpu.fleet import fixtures as fx
+from headlamp_tpu.push.hub import format_event, set_worker_identity, worker_identity
+from headlamp_tpu.replicate import ReplicaApp, parse_payload
+from headlamp_tpu.runtime.columns import (
+    ARRAY_FIELDS,
+    COLUMNS_MAGIC,
+    pack_fleet,
+    unpack_fleet,
+)
+from headlamp_tpu.server.app import DashboardApp, add_demo_prometheus
+from headlamp_tpu.workers import (
+    RoundRobinBalancer,
+    SegmentBusPublisher,
+    SegmentCorrupt,
+    SegmentReader,
+    SegmentUnavailable,
+    SegmentVersionGated,
+    ShmConsumer,
+    SnapshotSegment,
+    WorkerStatusBoard,
+    default_segment_path,
+    pick_strategy,
+    register_worker_metrics,
+    reuseport_supported,
+)
+from headlamp_tpu.workers.shm import HEADER_SIZE
+
+
+class FakeClock:
+    def __init__(self, now: float = 1000.0) -> None:
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def make_leader(segment_path, **kwargs):
+    """Leader DashboardApp wired to a SegmentBusPublisher: every
+    accepted generation lands on the bus backlog AND in the segment."""
+    fleet = fx.fleet_v5e4()
+    t = fx.fleet_transport(fleet)
+    add_demo_prometheus(t, fleet)
+    app = DashboardApp(t, min_sync_interval_s=30.0, **kwargs)
+    seg = SnapshotSegment(str(segment_path), size=8 * 1024 * 1024)
+    pub = SegmentBusPublisher(seg)
+    app.replication = pub
+    return app, pub, seg
+
+
+def force_new_generation(app: DashboardApp) -> None:
+    app._ctx.advance_generation_floor(app.snapshot_generation() + 1)
+    app._last_sync = float("-inf")
+    app._synced_snapshot()
+
+
+def sample_fleet(app):
+    state = next(iter(app._last_snapshot.providers.values()))
+    return encode_fleet(state.view.nodes, state.view.pods)
+
+
+# ---------------------------------------------------------------------------
+# Column layout export (runtime/columns.py)
+# ---------------------------------------------------------------------------
+
+class TestColumns:
+    def test_round_trip_every_field(self, tmp_path):
+        app, _, seg = make_leader(tmp_path / "l.seg")
+        app._synced_snapshot()
+        fleet = sample_fleet(app)
+        out = unpack_fleet(pack_fleet(fleet))
+        assert out.n_nodes == fleet.n_nodes and out.n_pods == fleet.n_pods
+        assert out.node_names == list(fleet.node_names)
+        for name in ARRAY_FIELDS:
+            assert np.array_equal(getattr(out, name), getattr(fleet, name)), name
+        seg.close()
+
+    def test_pack_is_deterministic(self, tmp_path):
+        app, _, seg = make_leader(tmp_path / "l.seg")
+        app._synced_snapshot()
+        fleet = sample_fleet(app)
+        assert pack_fleet(fleet) == pack_fleet(fleet)
+        seg.close()
+
+    def test_unpack_is_zero_copy_views(self, tmp_path):
+        app, _, seg = make_leader(tmp_path / "l.seg")
+        app._synced_snapshot()
+        blob = pack_fleet(sample_fleet(app))
+        out = unpack_fleet(blob)
+        for name in ARRAY_FIELDS:
+            arr = getattr(out, name)
+            # frombuffer views never own their data — the blob does.
+            assert not arr.flags["OWNDATA"], name
+        seg.close()
+
+    def test_foreign_magic_and_truncation_refused(self, tmp_path):
+        app, _, seg = make_leader(tmp_path / "l.seg")
+        app._synced_snapshot()
+        blob = pack_fleet(sample_fleet(app))
+        with pytest.raises(ValueError):
+            unpack_fleet(b"XXXXXXXX" + blob[len(COLUMNS_MAGIC):])
+        with pytest.raises(ValueError):
+            unpack_fleet(blob[: len(blob) // 2])
+        with pytest.raises(ValueError):
+            unpack_fleet(b"")
+        seg.close()
+
+
+# ---------------------------------------------------------------------------
+# The segment: seqlock publish/read, version gate, fallback rungs
+# ---------------------------------------------------------------------------
+
+class TestSegment:
+    def test_publish_read_round_trip_is_byte_exact(self, tmp_path):
+        app, pub, seg = make_leader(tmp_path / "l.seg")
+        app._synced_snapshot()
+        assert pub.segment_publishes == 1 and pub.segment_failures == 0
+        with pub._lock:
+            line = pub._backlog[-1][1]
+        reader = SegmentReader(seg.path)
+        frame = reader.read()
+        assert frame is not None
+        # The segment carries the EXACT bus record line — one codec,
+        # two transports; everything downstream inherits byte-identity.
+        assert frame.record_line == line
+        assert frame.generation == app.snapshot_generation()
+        assert set(frame.columns) == set(app._last_snapshot.providers)
+        reader.close()
+        seg.close()
+
+    def test_generation_peek_and_empty_segment(self, tmp_path):
+        seg = SnapshotSegment(str(tmp_path / "e.seg"), size=1024 * 1024)
+        reader = SegmentReader(seg.path)
+        assert reader.generation() == 0
+        assert reader.read() is None  # nothing published yet
+        seg.publish('{"generation":7}', {}, generation=7)
+        assert reader.generation() == 7
+        reader.close()
+        seg.close()
+
+    def test_oversize_payload_refused_and_counted(self, tmp_path):
+        seg = SnapshotSegment(str(tmp_path / "s.seg"), size=HEADER_SIZE + 64)
+        assert not seg.publish("x" * 4096, {}, generation=1)
+        assert seg.overflows == 1 and seg.published == 0
+        reader = SegmentReader(seg.path)
+        assert reader.read() is None  # header never flipped
+        reader.close()
+        seg.close()
+
+    def test_missing_segment_is_unavailable(self, tmp_path):
+        with pytest.raises(SegmentUnavailable):
+            SegmentReader(str(tmp_path / "nope.seg"))
+
+    def test_version_gate(self, tmp_path):
+        seg = SnapshotSegment(str(tmp_path / "v.seg"), size=1024 * 1024, version=99)
+        with pytest.raises(SegmentVersionGated):
+            SegmentReader(seg.path)
+        seg.close()
+
+    def test_foreign_magic_is_corrupt(self, tmp_path):
+        path = tmp_path / "junk.seg"
+        path.write_bytes(b"not a segment at all" * 100)
+        with pytest.raises(SegmentCorrupt):
+            SegmentReader(str(path))
+
+    def test_truncated_file_is_corrupt(self, tmp_path):
+        path = tmp_path / "short.seg"
+        path.write_bytes(b"HL")
+        with pytest.raises(SegmentCorrupt):
+            SegmentReader(str(path))
+
+    def test_wedged_seqlock_is_corrupt_not_a_hang(self, tmp_path):
+        # A writer that died mid-publish leaves seq odd forever; the
+        # reader's bounded retry loop must surface SegmentCorrupt, not
+        # spin or parse a torn payload.
+        seg = SnapshotSegment(str(tmp_path / "w.seg"), size=1024 * 1024)
+        seg.publish('{"generation":1}', {}, generation=1)
+        struct.pack_into("<Q", seg._map, 16, 3)  # seq: odd, never evened
+        reader = SegmentReader(seg.path)
+        with pytest.raises(SegmentCorrupt):
+            reader.read()
+        reader.close()
+        seg.close()
+
+    def test_default_segment_path_is_per_port(self):
+        a, b = default_segment_path(8631), default_segment_path(8632)
+        assert a != b and "8631" in a
+        assert default_segment_path(8631, kind="wsb") != a
+
+
+# ---------------------------------------------------------------------------
+# ShmConsumer: the fallback ladder, counted at every rung
+# ---------------------------------------------------------------------------
+
+class TestShmConsumerLadder:
+    def test_segment_feed_applies_and_is_idempotent(self, tmp_path):
+        app, _, seg = make_leader(tmp_path / "l.seg")
+        app._synced_snapshot()
+        rep = ReplicaApp()
+        consumer = ShmConsumer(rep, seg.path)
+        assert consumer.poll_once() == 1
+        assert consumer.applied_shm == 1 and consumer.applied_fallback == 0
+        assert rep.snapshot_generation() == app.snapshot_generation()
+        assert consumer.poll_once() == 0  # nothing newer: no re-apply
+        assert rep.applied == 1
+        seg.close()
+
+    def test_missing_segment_falls_back_to_bus(self, tmp_path):
+        app, pub, seg = make_leader(tmp_path / "l.seg")
+        app._synced_snapshot()
+        rep = ReplicaApp()
+        consumer = ShmConsumer(
+            rep,
+            str(tmp_path / "never-created.seg"),
+            fallback_fetch=lambda cursor: pub.payload_after(cursor),
+        )
+        assert consumer.poll_once() == 1
+        assert consumer.attach_failures == 1
+        assert consumer.applied_fallback == 1 and consumer.applied_shm == 0
+        assert rep.snapshot_generation() == app.snapshot_generation()
+        seg.close()
+
+    def test_version_gated_segment_falls_back(self, tmp_path):
+        app, pub, seg = make_leader(tmp_path / "l.seg")
+        app._synced_snapshot()
+        gated = SnapshotSegment(
+            str(tmp_path / "gated.seg"), size=1024 * 1024, version=99
+        )
+        rep = ReplicaApp()
+        consumer = ShmConsumer(
+            rep, gated.path, fallback_fetch=lambda c: pub.payload_after(c)
+        )
+        assert consumer.poll_once() == 1
+        assert consumer.attach_failures == 1 and consumer.applied_fallback == 1
+        gated.close()
+        seg.close()
+
+    def test_corrupt_record_never_half_applies(self, tmp_path):
+        # A segment whose seqlock reads cleanly but whose record fails
+        # to parse must leave the app EXACTLY as it was, count the
+        # rung, and let the bus supply the generation instead.
+        app, pub, seg = make_leader(tmp_path / "l.seg")
+        app._synced_snapshot()
+        rep = ReplicaApp()
+        consumer = ShmConsumer(
+            rep, seg.path, fallback_fetch=lambda c: pub.payload_after(c)
+        )
+        assert consumer.poll_once() == 1  # generation 1 via the segment
+        before = rep.snapshot_generation()
+        force_new_generation(app)
+        # Overwrite generation 2's record in the segment with garbage
+        # (valid seqlock, unparseable payload).
+        seg.publish("{not json", {}, generation=app.snapshot_generation() + 0)
+        applied = consumer.poll_once()
+        assert consumer.attach_failures == 1
+        # The generation arrived intact via the NDJSON rung, not half-
+        # applied from the corrupt segment.
+        assert applied == 1 and consumer.applied_fallback == 1
+        assert rep.snapshot_generation() == app.snapshot_generation() > before
+        assert rep.handle("/tpu") == app.handle("/tpu")
+        seg.close()
+
+    def test_dead_fallback_degrades_never_crashes(self, tmp_path):
+        rep = ReplicaApp()
+
+        def dead_fetch(cursor):
+            raise OSError("connection refused")
+
+        consumer = ShmConsumer(
+            rep, str(tmp_path / "missing.seg"), fallback_fetch=dead_fetch
+        )
+        assert consumer.poll_once() == 0
+        assert consumer.attach_failures == 1 and consumer.fallback_failures == 1
+        status, _, _ = rep._handle("/healthz")
+        assert status == 200
+
+    def test_snapshot_reports_worker_role_and_rungs(self, tmp_path):
+        app, _, seg = make_leader(tmp_path / "l.seg")
+        app._synced_snapshot()
+        rep = ReplicaApp()
+        consumer = ShmConsumer(rep, seg.path)
+        consumer.poll_once()
+        snap = consumer.snapshot()
+        assert snap["role"] == "worker"
+        assert snap["segment_attached"] is True
+        assert snap["applied_shm"] == 1 and snap["applied_fallback"] == 0
+        # healthz wires the consumer as the replication block.
+        status, _, body = rep._handle("/healthz")
+        assert status == 200
+        assert json.loads(body)["runtime"]["replication"]["role"] == "worker"
+        seg.close()
+
+    def test_columns_seed_skips_encode_on_first_render(self, tmp_path):
+        from headlamp_tpu.runtime.device_cache import fleet_cache
+
+        app, _, seg = make_leader(tmp_path / "l.seg")
+        app._synced_snapshot()
+        rep = ReplicaApp()
+        consumer = ShmConsumer(rep, seg.path)
+        consumer.poll_once()
+        # Every provider's columns are installed at the applied
+        # generation — fleet_for() on the first render is a cache hit.
+        for name, state in rep._last_snapshot.providers.items():
+            entry = fleet_cache._entries.get(name)
+            assert entry is not None, name
+            assert entry[0] == state.view.version
+        fleet_cache.invalidate()
+        seg.close()
+
+
+# ---------------------------------------------------------------------------
+# Worker byte-identity with leader-local serving
+# ---------------------------------------------------------------------------
+
+class TestWorkerByteIdentity:
+    def make_plane(self, tmp_path, n=2):
+        app, pub, seg = make_leader(tmp_path / "plane.seg")
+        app._synced_snapshot()
+        app.handle("/tpu/metrics")  # prime peeks so the record ships them
+        force_new_generation(app)
+        workers = []
+        for _ in range(n):
+            rep = ReplicaApp()
+            consumer = ShmConsumer(rep, seg.path)
+            assert consumer.poll_once() == 1
+            workers.append((rep, consumer))
+        return app, pub, seg, workers
+
+    def test_pages_byte_identical_across_workers_and_leader(self, tmp_path):
+        app, _, seg, workers = self.make_plane(tmp_path)
+        for path in ("/tpu", "/tpu/nodes", "/tpu/pods", "/tpu/topology",
+                     "/tpu/metrics", "/tpu/deviceplugins"):
+            oracle = app.handle(path)
+            for rep, _ in workers:
+                assert rep.handle(path) == oracle, path
+        seg.close()
+
+    def test_etag_and_304_identical_across_workers(self, tmp_path):
+        app, _, seg, workers = self.make_plane(tmp_path)
+        gateways = [app.ensure_gateway(workers=1)] + [
+            rep.ensure_gateway(workers=1) for rep, _ in workers
+        ]
+        try:
+            responses = [gw.handle("/tpu") for gw in gateways]
+            etags = {dict(r.headers)["ETag"] for r in responses}
+            assert len(etags) == 1, "workers must agree on the validator"
+            assert len({r.body for r in responses}) == 1
+            etag = etags.pop()
+            # A client can land on ANY worker with its validator and
+            # still get the 304 — SO_REUSEPORT makes no promises about
+            # which process answers a poll.
+            for gw in gateways:
+                assert gw.handle("/tpu", if_none_match=etag).status == 304
+        finally:
+            for gw in gateways:
+                gw.close()
+        seg.close()
+
+    def test_sse_frames_byte_identical_across_workers(self, tmp_path):
+        app, _, seg, workers = self.make_plane(tmp_path)
+        subs = [
+            (rep.push.hub, rep.push.hub.subscribe(("/tpu", "/tpu/nodes")))
+            for rep, _ in workers
+        ]
+        leader_sub = app.push.hub.subscribe(("/tpu", "/tpu/nodes"))
+        # Real fleet churn → real frames on the next generation.
+        pod = json.loads(json.dumps(app._last_snapshot.all_pods[0]))
+        pod["status"]["phase"] = "Failed"
+        app._transport.pod_feed.push("MODIFIED", pod)
+        force_new_generation(app)
+        for _, consumer in workers:
+            assert consumer.poll_once() == 1
+
+        def drain(hub, sub):
+            out = []
+            while True:
+                event = hub.poll(sub)
+                if event is None:
+                    return out
+                out.append(format_event(event))
+
+        leader_wire = drain(app.push.hub, leader_sub)
+        worker_wires = [drain(hub, sub) for hub, sub in subs]
+        assert leader_wire
+        for wire in worker_wires:
+            assert wire == leader_wire
+        seg.close()
+
+
+# ---------------------------------------------------------------------------
+# Leader-kill drill: N workers, zero 5xx, 100% stale-stamped
+# ---------------------------------------------------------------------------
+
+class TestLeaderKillDrill:
+    def test_workers_serve_stale_honest_after_leader_death(self, tmp_path):
+        mono = FakeClock()
+        app, pub, seg = make_leader(tmp_path / "drill.seg")
+        app._synced_snapshot()
+        workers = []
+        for _ in range(2):
+            rep = ReplicaApp(monotonic=mono, stale_after_s=30.0)
+            consumer = ShmConsumer(
+                rep, seg.path, fallback_fetch=lambda c: pub.payload_after(c)
+            )
+            assert consumer.poll_once() == 1
+            workers.append((rep, consumer))
+        gateways = [rep.ensure_gateway(workers=1) for rep, _ in workers]
+        try:
+            for gw in gateways:
+                fresh = gw.handle("/tpu?t=0")
+                assert fresh.status == 200
+                assert dict(fresh.headers)["X-Headlamp-Stale"] == "0"
+            # Leader dies: the segment stops advancing (the file stays,
+            # frozen at the last generation) and the bus stops
+            # answering. Workers keep serving; past the staleness
+            # window EVERY interactive paint is stamped stale — and not
+            # one request 5xxs.
+            for rep, consumer in workers:
+                consumer._fallback = _dead_fetch
+            mono.advance(31.0)
+            for (rep, consumer), gw in zip(workers, gateways):
+                assert consumer.poll_once() == 0  # frozen segment: no news
+                assert rep.stale()
+                gw.shed_policy.invalidate()
+                statuses = []
+                for i in range(5):
+                    resp = gw.handle(f"/tpu?loss={i}")
+                    statuses.append(resp.status)
+                    assert dict(resp.headers)["X-Headlamp-Stale"] == "1"
+                assert all(s == 200 for s in statuses)
+        finally:
+            for gw in gateways:
+                gw.close()
+        seg.close()
+
+
+def _dead_fetch(cursor):
+    raise OSError("connection refused")
+
+
+# ---------------------------------------------------------------------------
+# Status board + per-worker metric families
+# ---------------------------------------------------------------------------
+
+class TestStatusBoard:
+    def test_slots_rows_samples_snapshot(self, tmp_path):
+        path = str(tmp_path / "b.wsb")
+        board = WorkerStatusBoard.create(path, n_slots=3)
+        s0 = board.slot(0)
+        s1 = board.slot(1)
+        s0.applied(5)
+        s0.applied(6)
+        s1.attach_failure()
+        s1.fallback_decode()
+        rows = board.rows()
+        assert [r["worker"] for r in rows] == [0, 1]  # slot 2 unregistered
+        assert rows[0]["generations_applied"] == 2
+        assert rows[0]["generation"] == 6
+        assert rows[1]["shm_attach_failures"] == 1
+        assert rows[1]["fallback_decodes"] == 1
+        assert board.samples("generations_applied") == [(("w0",), 2), (("w1",), 0)]
+        assert board.samples("fallback_decodes") == [(("w0",), 0), (("w1",), 1)]
+        snap = board.snapshot(self_id=1)
+        assert snap["self"] == "w1" and snap["live"] == 2 and snap["slots"] == 3
+        board.close()
+
+    def test_attach_sees_another_writers_slots(self, tmp_path):
+        # The cross-process property, minus the processes: a second
+        # attachment of the same file reads the first one's slots.
+        path = str(tmp_path / "x.wsb")
+        board = WorkerStatusBoard.create(path, n_slots=2)
+        board.slot(0).applied(9)
+        other = WorkerStatusBoard.attach(path)
+        assert other.rows()[0]["generation"] == 9
+        other.close()
+        board.close()
+
+    def test_attach_refuses_foreign_file(self, tmp_path):
+        path = tmp_path / "junk.wsb"
+        path.write_bytes(b"x" * 256)
+        with pytest.raises(ValueError):
+            WorkerStatusBoard.attach(str(path))
+
+    def test_out_of_range_slot_refused(self, tmp_path):
+        board = WorkerStatusBoard.create(str(tmp_path / "r.wsb"), n_slots=2)
+        with pytest.raises(ValueError):
+            board.slot(2)
+        board.close()
+
+    def test_metric_families_render_every_workers_counters(self, tmp_path):
+        # Under SO_REUSEPORT a scrape lands on an arbitrary worker, so
+        # any process must render the WHOLE board — per-worker labels,
+        # proper counter TYPE.
+        board = WorkerStatusBoard.create(str(tmp_path / "m.wsb"), n_slots=2)
+        board.slot(0).applied(3)
+        slot1 = board.slot(1)
+        slot1.applied(3)
+        slot1.fallback_decode()
+        register_worker_metrics(board)
+        rep = ReplicaApp()
+        status, _, body = rep._handle("/metricsz")
+        assert status == 200
+        assert (
+            'headlamp_tpu_worker_generations_applied_total{worker="w0"} 1' in body
+        )
+        assert (
+            'headlamp_tpu_worker_generations_applied_total{worker="w1"} 1' in body
+        )
+        assert (
+            'headlamp_tpu_worker_fallback_decodes_total{worker="w1"} 1' in body
+        )
+        assert "# TYPE headlamp_tpu_worker_generations_applied_total counter" in body
+        board.close()
+
+    def test_healthz_runtime_workers_block(self, tmp_path):
+        from headlamp_tpu.workers.worker import _BoardHealth
+
+        board = WorkerStatusBoard.create(str(tmp_path / "h.wsb"), n_slots=2)
+        board.slot(0).applied(4)
+        rep = ReplicaApp()
+        rep.workers = _BoardHealth(board, 0)
+        status, _, body = rep._handle("/healthz")
+        assert status == 200
+        block = json.loads(body)["runtime"]["workers"]
+        assert block["self"] == "w0"
+        assert block["slots"] == 2 and block["live"] == 1
+        assert block["workers"][0]["generation"] == 4
+        board.close()
+
+
+# ---------------------------------------------------------------------------
+# Front door: accept strategies + fallback balancer
+# ---------------------------------------------------------------------------
+
+class TestFrontDoor:
+    def test_pick_strategy_matches_probe(self):
+        assert pick_strategy() == (
+            "reuseport" if reuseport_supported() else "fd-passing"
+        )
+
+    def test_round_robin_pick_cycles(self):
+        bal = RoundRobinBalancer(
+            "127.0.0.1", 0, [("127.0.0.1", 1001), ("127.0.0.1", 1002)]
+        )
+        picks = [bal.pick() for _ in range(4)]
+        assert picks == [
+            ("127.0.0.1", 1001),
+            ("127.0.0.1", 1002),
+            ("127.0.0.1", 1001),
+            ("127.0.0.1", 1002),
+        ]
+        assert bal.snapshot()["connections"] == 4
+        bal.stop()
+
+    def test_balancer_pins_and_pumps_a_connection(self):
+        import socket as socketlib
+
+        backend = socketlib.socket(socketlib.AF_INET, socketlib.SOCK_STREAM)
+        backend.bind(("127.0.0.1", 0))
+        backend.listen(4)
+        bport = backend.getsockname()[1]
+        bal = RoundRobinBalancer("127.0.0.1", 0, [("127.0.0.1", bport)])
+        bal.start()
+        try:
+            client = socketlib.create_connection(bal.address, timeout=5.0)
+            upstream, _ = backend.accept()
+            client.sendall(b"ping")
+            assert upstream.recv(64) == b"ping"
+            upstream.sendall(b"pong")
+            assert client.recv(64) == b"pong"
+            client.close()
+            upstream.close()
+        finally:
+            bal.stop()
+            backend.close()
+
+    def test_serve_adopts_a_shared_listener(self, tmp_path):
+        from headlamp_tpu.workers.balancer import shared_listener
+
+        listener = shared_listener("127.0.0.1", 0)
+        port = listener.getsockname()[1]
+        rep = ReplicaApp()
+        server = rep.serve("127.0.0.1", port, listen_socket=listener)
+        try:
+            assert server.socket is listener
+            assert server.server_address[1] == port
+        finally:
+            server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Worker identity (SSE pinning observability)
+# ---------------------------------------------------------------------------
+
+class TestWorkerIdentitySeam:
+    def test_identity_stamps_push_snapshot(self):
+        rep = ReplicaApp()
+        try:
+            assert worker_identity() is None
+            assert "worker" not in rep.push.hub.snapshot()
+            set_worker_identity("w3")
+            assert worker_identity() == "w3"
+            assert rep.push.hub.snapshot()["worker"] == "w3"
+        finally:
+            set_worker_identity(None)
+
+
+# ---------------------------------------------------------------------------
+# Analysis-scope sync (WCK001 covers workers/; THR001 seams hold)
+# ---------------------------------------------------------------------------
+
+class TestAnalysisScope:
+    def test_workers_dir_is_in_wall_clock_scope(self, tmp_path):
+        import sys
+
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__), "..", "tools")
+        )
+        from analysis.engine import Engine
+        from analysis.rules.wall_clock import WallClockRule
+
+        assert "headlamp_tpu/workers" in WallClockRule.scope_dirs
+        scoped = tmp_path / "headlamp_tpu" / "workers"
+        scoped.mkdir(parents=True)
+        (scoped / "mut.py").write_text("import time\nnow = time.time()\n")
+        result = Engine([WallClockRule()], root=str(tmp_path)).run()
+        assert len(result.diagnostics) == 1
+        # The monotone form stays legal.
+        (scoped / "mut.py").write_text("import time\nnow = time.monotonic()\n")
+        result = Engine([WallClockRule()], root=str(tmp_path)).run()
+        assert result.diagnostics == []
+
+
+# ---------------------------------------------------------------------------
+# Real processes (slow): the supervisor end to end
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestSupervisorProcesses:
+    def test_two_workers_serve_identical_validators(self, tmp_path):
+        import subprocess
+        import sys
+        import time as timelib
+        import urllib.request
+
+        port = _free_port()
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "headlamp_tpu.server",
+                "--demo", "v5p32", "--workers", "2",
+                "--port", str(port), "--background-sync", "0.5",
+            ],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+        try:
+            deadline = timelib.monotonic() + 60.0
+            body = None
+            while timelib.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=2.0
+                    ) as resp:
+                        body = json.loads(resp.read())
+                    if body["runtime"]["workers"]["live"] == 2:
+                        break
+                except OSError:
+                    timelib.sleep(0.5)
+            assert body is not None, "supervisor never came up"
+            assert body["runtime"]["workers"]["live"] == 2
+            assert body["runtime"]["replication"]["role"] == "worker"
+            etags = set()
+            for _ in range(6):
+                req = urllib.request.Request(f"http://127.0.0.1:{port}/tpu")
+                with urllib.request.urlopen(req, timeout=5.0) as resp:
+                    assert resp.status == 200
+                    etags.add(resp.headers["ETag"])
+            assert len(etags) == 1
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10.0)
+
+
+def _free_port() -> int:
+    import socket as socketlib
+
+    sock = socketlib.socket(socketlib.AF_INET, socketlib.SOCK_STREAM)
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port

@@ -16,7 +16,13 @@ construction (``ThreadPoolExecutor`` / ``ProcessPoolExecutor`` /
   started by serve()),
 - the read-tier seams (ADR-025): the lease-renewal ticker
   (``LeaderElector.start``) and the replica's bus poll loop
-  (``BusConsumer.start``).
+  (``BusConsumer.start``),
+- the multi-process seams (ADR-029): the worker's segment poll loop
+  (``ShmConsumer.start``) and the fallback balancer's accept/pump
+  threads (``RoundRobinBalancer.start``). ``multiprocessing.Process``
+  construction counts as a spawn too — the supervisor's fork loop
+  (``WorkerSupervisor.start``) is grandfathered with a reason rather
+  than allowlisted, so any NEW process-spawn site is a finding.
 
 Every other spawn is a finding. Deliberate ones (the ADR-015 refresher
 refit worker, the ADR-020 startup compile thread, the thread-per-call
@@ -31,8 +37,10 @@ import ast
 
 from ..engine import Diagnostic, FileContext, Rule
 
-#: Constructor terminal names that create a thread of execution.
-_SPAWN_NAMES = {"Thread", "Timer", "ThreadPoolExecutor", "ProcessPoolExecutor"}
+#: Constructor terminal names that create a thread of execution (or a
+#: whole process — ``ctx.Process``/``multiprocessing.Process`` is the
+#: ADR-029 supervisor's spawn and nobody else's).
+_SPAWN_NAMES = {"Thread", "Timer", "ThreadPoolExecutor", "ProcessPoolExecutor", "Process"}
 
 #: (relpath, qualname prefix) pairs sanctioned to spawn.
 SPAWN_ALLOWLIST = (
@@ -43,6 +51,8 @@ SPAWN_ALLOWLIST = (
     ("headlamp_tpu/obs/profiler.py", "SamplingProfiler."),
     ("headlamp_tpu/replicate/leader.py", "LeaderElector.start"),
     ("headlamp_tpu/replicate/replica.py", "BusConsumer.start"),
+    ("headlamp_tpu/workers/worker.py", "ShmConsumer.start"),
+    ("headlamp_tpu/workers/balancer.py", "RoundRobinBalancer.start"),
 )
 
 MESSAGE = (

@@ -46,6 +46,7 @@ class WallClockRule(Rule):
         "headlamp_tpu/replicate",
         "headlamp_tpu/runtime",
         "headlamp_tpu/transport",
+        "headlamp_tpu/workers",
     )
 
     def check_file(self, ctx: FileContext) -> list[Diagnostic]:
