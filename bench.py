@@ -108,6 +108,12 @@ Extras reported alongside (same JSON line, `extra` object):
   ``BENCH_r*.json`` are named here (details on stderr), direction-aware
   (rates/ratios count as higher-is-better). Reporting, not gating —
   the tunnel-variance yardstick above decides if a flag is real.
+- ``python bench.py --scenario NAME|all`` — the ADR-030 incident
+  matrix: each named chaos drill runs TWICE on scripted clocks; the
+  record carries per-scenario response metrics (windows_to_page,
+  shed_rate_debug, stale_paint_rate, recovery_windows, zero_5xx_rate)
+  through the same comparator, and the round fails when the two runs'
+  transcripts differ by a byte or any drill's checks fail.
 
 Prints ONE JSON line:
   {"metric": ..., "value": p50_ms, "unit": "ms", "vs_baseline": ..., "extra": {...}}
@@ -2855,6 +2861,84 @@ def replay_main(argv: list[str]) -> None:
         raise SystemExit(1)
 
 
+def bench_scenarios(names: list[str] | None = None) -> dict:
+    """ADR-030 incident matrix: run each named drill TWICE and report
+    its response metrics plus transcript byte-parity. Everything is
+    scripted clocks, so the whole matrix is sub-second and the two
+    rounds must agree to the byte — a mismatch means nondeterminism
+    leaked into the drill path and fails the round."""
+    from headlamp_tpu.scenarios import SCENARIO_NAMES, ScenarioRunner, get_scenario
+
+    out: dict = {}
+    run_names = list(names or SCENARIO_NAMES)
+    passed = 0
+    deterministic = 0
+    for name in run_names:
+        first = ScenarioRunner(get_scenario(name)).run()
+        second = ScenarioRunner(get_scenario(name)).run()
+        byte_identical = first.transcript == second.transcript
+        deterministic += byte_identical
+        passed += first.passed and second.passed
+        prefix = f"scenario_{name}_"
+        metrics = first.metrics
+        out[prefix + "checks_passed_rate"] = round(
+            1.0 - len(first.failures) / max(len(get_scenario(name).checks), 1), 4
+        )
+        out[prefix + "replay_identical_rate"] = 1.0 if byte_identical else 0.0
+        out[prefix + "zero_5xx_rate"] = 1.0 if metrics.get("zero_5xx") else 0.0
+        out[prefix + "shed_rate_debug"] = round(metrics.get("shed_rate_debug", 0.0), 4)
+        out[prefix + "stale_paint_rate"] = round(
+            metrics.get("stale_paint_rate", 0.0), 4
+        )
+        if metrics.get("windows_to_page") is not None:
+            out[prefix + "windows_to_page"] = metrics["windows_to_page"]
+        if metrics.get("recovery_windows") is not None:
+            out[prefix + "recovery_windows"] = metrics["recovery_windows"]
+        for failure in first.failures:
+            print(f"[bench] scenario FAILED: {failure}", file=sys.stderr)
+        if not byte_identical:
+            print(
+                f"[bench] scenario {name}: two runs' transcripts differ "
+                "— drill path is nondeterministic",
+                file=sys.stderr,
+            )
+    out["scenario_matrix_passed_rate"] = round(passed / len(run_names), 4)
+    out["scenario_matrix_replay_identical_rate"] = round(
+        deterministic / len(run_names), 4
+    )
+    return out
+
+
+def scenario_main(argv: list[str]) -> None:
+    """``python bench.py --scenario NAME|all``: run the incident matrix
+    and print one JSON record (same shape as the headline bench, so the
+    round lands in ``BENCH_r*.json`` and rides the comparator). Exits 1
+    when any drill's checks fail or its two runs disagree."""
+    from headlamp_tpu.scenarios import SCENARIO_NAMES
+
+    name = argv[argv.index("--scenario") + 1]
+    names = list(SCENARIO_NAMES) if name == "all" else [name]
+    extra = bench_scenarios(names)
+    ok = (
+        extra["scenario_matrix_passed_rate"] == 1.0
+        and extra["scenario_matrix_replay_identical_rate"] == 1.0
+    )
+    record = {
+        "metric": (
+            f"incident scenario matrix ({len(names)} drill(s), two "
+            "scripted-clock rounds each, ADR-030)"
+        ),
+        "value": round(extra["scenario_matrix_passed_rate"] * len(names), 2),
+        "unit": "scenarios passed",
+        "vs_baseline": extra["scenario_matrix_passed_rate"],
+        "extra": extra,
+    }
+    record["extra"]["prev_round_regressions"] = compare_prev_round(record)
+    print(json.dumps(record, ensure_ascii=False))
+    if not ok:
+        raise SystemExit(1)
+
+
 def main() -> None:
     fleet = build_fleet()
     # MUST be the first bench that touches a jitted program: the ledger
@@ -3015,5 +3099,7 @@ if __name__ == "__main__":
         replay_main(sys.argv)
     elif "--attribute" in sys.argv:
         attribute_main(sys.argv)
+    elif "--scenario" in sys.argv:
+        scenario_main(sys.argv)
     else:
         main()

@@ -104,6 +104,25 @@ class ShedPolicy:
         self._paging_routes: set[str] = set()
         # Monotone per-instance ints (gateway dual-accounts the registry).
         self.evaluations = 0
+        #: Shed/restore observers (ADR-030): callables invoked as
+        #: ``observer(kind, detail)`` on "shed" (a debug request 503d),
+        #: "degrade" (an interactive render admitted stale-only),
+        #: "paging" (a request-backed SLO entered page on a states
+        #: refresh), and "restore" (paging cleared). The incident
+        #: timeline consumes this seam instead of scraping counters.
+        #: Exception-absorbed and counted — a broken observer must
+        #: never fail an admission ruling.
+        self.observers: list[Callable[[str, dict[str, Any]], None]] = []
+        self.observer_events = 0
+        self.observer_errors = 0
+
+    def _notify(self, kind: str, **detail: Any) -> None:
+        for observer in list(self.observers):
+            self.observer_events += 1
+            try:
+                observer(kind, detail)
+            except Exception:  # noqa: BLE001 — observers must never fail a ruling
+                self.observer_errors += 1
 
     # -- engine state ----------------------------------------------------
 
@@ -114,6 +133,7 @@ class ShedPolicy:
         now = self._monotonic()
         if self._cached_at is not None and now - self._cached_at <= self.ttl_s:
             return self._cached_states
+        previous = set(self._paging_routes)
         try:
             eng = self._engine()
             states = dict(eng.health_block())
@@ -131,6 +151,13 @@ class ShedPolicy:
         self.evaluations += 1
         self._cached_at = now
         self._cached_states = states
+        # Shed-regime transitions (ADR-030), detected on the refresh
+        # that changed the answer — the TTL cache means at most one
+        # event per ttl_s, not one per request.
+        if previous and not self._paging_routes:
+            self._notify("restore", routes=sorted(previous))
+        elif self._paging_routes and not previous:
+            self._notify("paging", routes=sorted(self._paging_routes))
         return states
 
     # -- ruling ----------------------------------------------------------
@@ -149,6 +176,7 @@ class ShedPolicy:
                 # Replica stale-feed degrade (ADR-025): unconditional
                 # for interactive routes — the data itself is stale, not
                 # one SLO's route set.
+                self._notify("degrade", route=route, reason="stale_feed")
                 return Decision(degraded=True, burn_state=states)
         paging_routes: set[str] = getattr(self, "_paging_routes", set())
         if not paging_routes:
@@ -157,11 +185,13 @@ class ShedPolicy:
             # ANY request-backed SLO paging sheds debug traffic — the
             # overload is process-wide (shared GIL, shared pool), so the
             # cheap capacity recovered helps whichever route is burning.
+            self._notify("shed", route=route, priority="debug")
             return Decision(shed=True, burn_state=states)
         if priority == PRIORITY_INTERACTIVE and route in paging_routes:
             # Degrade only the routes the paging SLO actually governs:
             # /tpu/metrics stays full-fidelity while dashboard_render
             # pages, and vice versa.
+            self._notify("degrade", route=route, reason="burn_rate")
             return Decision(degraded=True, burn_state=states)
         return Decision(burn_state=states)
 

@@ -514,3 +514,106 @@ def generations_page(snapshot: dict[str, Any]) -> Element:
             [_transition_line(t) for t in reversed(transitions)],
         ],
     )
+
+
+_INCIDENT_SOURCE_CLASS = {
+    "scenario": "hl-status-warn",
+    "slo": "hl-status-err",
+    "gateway": "hl-status-err",
+    "push": "hl-status-warn",
+    "elector": "hl-status-ok",
+}
+
+
+def _incident_row(event: dict[str, Any], first_wall: float, span_s: float) -> Element:
+    """One timeline event as a waterfall row: label = source/kind, bar
+    positioned by the event's wall offset within the drill (display
+    only — ordering came from the timeline's injected-clock sequence,
+    ADR-013), detail summarized alongside."""
+    wall = event.get("wall") or first_wall
+    left = min(max((wall - first_wall) / span_s * 100.0, 0.0), 99.5)
+    stamp = time.strftime("%H:%M:%S", time.localtime(wall))  # display only
+    detail = event.get("detail") or {}
+    summary = " ".join(f"{k}={detail[k]}" for k in sorted(detail))[:120]
+    status_class = _INCIDENT_SOURCE_CLASS.get(event.get("source", ""), "hl-status-ok")
+    return h(
+        "div",
+        {"class_": "hl-span-row"},
+        h(
+            "span",
+            {"class_": f"hl-status {status_class}"},
+            event.get("source", "?"),
+        ),
+        h("span", {"class_": "hl-span-label"}, event.get("kind", "?")),
+        h(
+            "span",
+            {"class_": "hl-span-track"},
+            h(
+                "span",
+                {
+                    "class_": "hl-span-bar",
+                    "style": f"margin-left:{left:.2f}%;width:0.50%",
+                },
+            ),
+        ),
+        h("span", {"class_": "hl-span-ms"}, stamp),
+        summary and h("span", {"class_": "hl-span-attrs"}, summary),
+    )
+
+
+def incidents_page(snapshot: dict[str, Any]) -> Element:
+    """The incident timeline (ADR-030). ``snapshot`` is
+    ``IncidentTimeline.snapshot()`` — scenario injections, SLO state
+    flips, gateway shed/restore rulings, hub evictions, and leadership
+    transitions merged into one ordered waterfall, so "what happened,
+    in what order" is one page instead of five. Renders from the
+    timeline alone (no cluster snapshot) — mid-incident is exactly when
+    it must paint."""
+    events = snapshot.get("events", [])
+    active = snapshot.get("active")
+    walls = [e["wall"] for e in events if e.get("wall") is not None]
+    first_wall = min(walls) if walls else 0.0
+    span_s = max((max(walls) - first_wall), 1e-6) if walls else 1.0
+    hint = (
+        f"{snapshot.get('events_total', 0)} event(s) recorded · "
+        f"{snapshot.get('drills_total', 0)} drill(s) · ring capacity "
+        f"{snapshot.get('capacity', 0)}. Raw JSON: /debug/incidentz · "
+        "triage path: incidentz → /sloz/html (which objective burned) → "
+        "/debug/flightz (which requests paid) — OPERATIONS.md runbook."
+    )
+    return h(
+        "div",
+        {"class_": "hl-traces hl-incidents"},
+        h("h1", None, "Incident Timeline"),
+        h("p", {"class_": "hl-hint"}, hint),
+        active
+        and h(
+            "section",
+            {"class_": "hl-section"},
+            h(
+                "header",
+                {"class_": "hl-trace-header"},
+                h("span", {"class_": "hl-status hl-status-warn"}, "DRILL ACTIVE"),
+                h("strong", None, str(active.get("active", "?"))),
+                h(
+                    "span",
+                    {"class_": "hl-hint"},
+                    f"phase {active.get('phase') or '—'} · "
+                    f"{active.get('injections', 0)} injection(s) — faults "
+                    "on this host are currently REHEARSED",
+                ),
+            ),
+        ),
+        h(
+            "section",
+            {"class_": "hl-section hl-trace"},
+            [_incident_row(e, first_wall, span_s) for e in events]
+            if events
+            else h(
+                "div",
+                {"class_": "hl-empty-content"},
+                "No incident events recorded — run a drill "
+                "(bench.py --scenario NAME) or wait for real trouble.",
+            ),
+        ),
+    )

@@ -1,0 +1,255 @@
+"""Incident timeline (ADR-030): one ordered view of a drill.
+
+During an incident — rehearsed by ``headlamp_tpu/scenarios`` or real —
+the evidence is scattered: the scenario engine knows what it injected,
+the SLO engine knows when states flipped, the shed policy knows what it
+503d, the push hub knows who it evicted, and the generation ledger
+(ADR-028) knows when leadership moved. :class:`IncidentTimeline` merges
+all five sources into one ordered event list served at
+``/debug/incidentz`` (JSON) and ``/debug/incidentz/html`` (waterfall),
+so "what happened, in what order" is one page instead of five.
+
+Sources and how they arrive:
+
+- **scenario marks** — ``inject()`` / ``begin_drill()`` / phase
+  transitions, called by the scenario runner;
+- **SLO state transitions** — ``sample_slo()`` diffs the engine's
+  health block against the last sample and records each flip;
+- **gateway shed events** — :meth:`gateway_observer` plugs into
+  ``ShedPolicy.observers`` (the ADR-030 hook seam);
+- **hub evictions** — :meth:`eviction_observer` plugs into
+  ``BroadcastHub.eviction_observers``;
+- **elector transitions** — merged at snapshot time from the attached
+  :class:`~.ledger.GenerationLedger`'s transition deque.
+
+Ordering (ADR-013): the timeline's own events order on a sequence
+number stamped under its lock — injected-monotonic order, immune to
+wall steps. Ledger transitions carry only a wall stamp (they may come
+from another process), so the cross-source merge positions them by the
+injected wall — the same "the shared wall clock is the only common
+axis" argument ADR-028 makes for cross-process stage lags.
+
+The eviction observer runs while the hub holds a subscription's
+condition; ``mark()`` takes only the timeline's own lock and never
+calls back into the hub, so no lock cycle exists.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Mapping
+
+from .metrics import registry
+
+#: Events retained — same bounded-ring rationale as the trace ring:
+#: O(capacity) memory, always answers "what happened recently". A drill
+#: produces tens of events; 256 holds several drills of history.
+TIMELINE_CAPACITY = 256
+
+_INJECTIONS = registry.counter(
+    "headlamp_tpu_scenario_injections_total",
+    "Fault injections performed by the incident scenario engine, by "
+    "scenario and fault kind.",
+    labels=("scenario", "fault"),
+)
+_EVENTS = registry.counter(
+    "headlamp_tpu_scenario_timeline_events_total",
+    "Events recorded onto the incident timeline, by source "
+    "(scenario/slo/gateway/push).",
+    labels=("source",),
+)
+_RUNS = registry.counter(
+    "headlamp_tpu_scenario_runs_total",
+    "Incident drills completed, by scenario and outcome (passed/failed).",
+    labels=("scenario", "outcome"),
+)
+
+
+class IncidentTimeline:
+    """Per-app merged incident event log. Thread-safe: observers fire
+    from request threads, the sync loop, and the scenario runner."""
+
+    def __init__(
+        self,
+        *,
+        monotonic: Callable[[], float] | None = None,
+        wall: Callable[[], float] = time.time,
+        capacity: int = TIMELINE_CAPACITY,
+    ) -> None:
+        self._mono = monotonic or time.monotonic
+        self._wall = wall
+        self._lock = threading.Lock()
+        self._events: deque[dict[str, Any]] = deque(maxlen=capacity)
+        self._seq = 0
+        self._last_slo: dict[str, str] = {}
+        #: Active drill descriptor, or None outside one — drives the
+        #: /healthz ``runtime.scenarios`` block (present only during a
+        #: drill, absent in steady state).
+        self.active: dict[str, Any] | None = None
+        #: Optional GenerationLedger (ADR-028) whose leadership
+        #: transitions interleave into snapshots. Attached by the app.
+        self.ledger: Any = None
+        self.events_total = 0
+        self.drills_total = 0
+
+    # -- recording --------------------------------------------------------
+
+    def mark(
+        self,
+        source: str,
+        kind: str,
+        detail: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        """Append one event. ``source`` is the merge lane (scenario /
+        slo / gateway / push); ``kind`` the event name within it."""
+        with self._lock:
+            self._seq += 1
+            event: dict[str, Any] = {
+                "seq": self._seq,
+                "mono": round(self._mono(), 6),
+                "wall": round(self._wall(), 6),
+                "source": source,
+                "kind": kind,
+                "detail": dict(detail or {}),
+            }
+            if self.active is not None:
+                event["scenario"] = self.active["scenario"]
+                event["phase"] = self.active.get("phase")
+            self._events.append(event)
+            self.events_total += 1
+        _EVENTS.inc(source=source)
+        return event
+
+    def inject(
+        self,
+        scenario: str,
+        fault: str,
+        detail: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        """One fault injection mark — the /metricsz-visible count plus
+        the timeline entry every assertion anchors its 'after the
+        injection' window on."""
+        _INJECTIONS.inc(scenario=scenario, fault=fault)
+        with self._lock:
+            if self.active is not None:
+                self.active["injections"] += 1
+        merged = dict(detail or {})
+        merged["fault"] = fault
+        return self.mark("scenario", "inject", merged)
+
+    def begin_drill(self, scenario: str) -> None:
+        with self._lock:
+            self.active = {"scenario": scenario, "phase": None, "injections": 0}
+            self.drills_total += 1
+        self.mark("scenario", "drill_start", {"name": scenario})
+
+    def set_phase(self, phase: str) -> None:
+        with self._lock:
+            if self.active is not None:
+                self.active["phase"] = phase
+        self.mark("scenario", "phase", {"phase": phase})
+
+    def end_drill(self, outcome: str) -> None:
+        active = self.active
+        scenario = active["scenario"] if active else "unknown"
+        self.mark("scenario", "drill_end", {"outcome": outcome})
+        _RUNS.inc(scenario=scenario, outcome=outcome)
+        with self._lock:
+            self.active = None
+
+    def sample_slo(self, states: Mapping[str, str]) -> int:
+        """Diff the engine's health block against the last sample and
+        record each state flip. The runner calls this every scripted
+        tick; a serving host could sample from its sync loop."""
+        with self._lock:
+            previous, self._last_slo = self._last_slo, dict(states)
+        flips = 0
+        for name, state in states.items():
+            if previous.get(name, "ok") != state:
+                self.mark(
+                    "slo",
+                    "transition",
+                    {"slo": name, "from": previous.get(name, "ok"), "to": state},
+                )
+                flips += 1
+        return flips
+
+    # -- observer adapters (the ADR-030 hook seams) -----------------------
+
+    def gateway_observer(self, kind: str, detail: Mapping[str, Any]) -> None:
+        """Plug into ``ShedPolicy.observers``."""
+        self.mark("gateway", kind, detail)
+
+    def eviction_observer(self, reason: str, detail: Mapping[str, Any]) -> None:
+        """Plug into ``BroadcastHub.eviction_observers``. Runs under
+        the evicted subscription's condition — mark() takes only the
+        timeline lock, so this is cycle-free and cheap."""
+        merged = dict(detail)
+        merged["reason"] = reason
+        self.mark("push", "eviction", merged)
+
+    # -- reading ----------------------------------------------------------
+
+    def health_block(self) -> dict[str, Any] | None:
+        """The /healthz ``runtime.scenarios`` block — present only
+        while a drill is active (steady-state probes stay byte-stable
+        against pre-ADR-030 expectations)."""
+        with self._lock:
+            if self.active is None:
+                return None
+            return {
+                "active": self.active["scenario"],
+                "phase": self.active.get("phase"),
+                "injections": self.active["injections"],
+                "events": self.events_total,
+            }
+
+    def events(self) -> list[dict[str, Any]]:
+        """Own events in sequence order, elector transitions from the
+        attached ledger interleaved by injected wall (see module doc)."""
+        with self._lock:
+            merged = [dict(e) for e in self._events]
+        ledger = self.ledger
+        if ledger is not None:
+            try:
+                transitions = ledger.snapshot().get("transitions", [])
+            except Exception:  # noqa: BLE001 — a broken ledger must not 500 triage
+                transitions = []
+            walls = [e["wall"] for e in merged]
+            for t in transitions:
+                event = {
+                    "seq": None,
+                    "mono": None,
+                    "wall": t.get("wall"),
+                    "source": "elector",
+                    "kind": t.get("kind", "transition"),
+                    "detail": {"fencing": t.get("fencing", 0)},
+                }
+                # Insert before the first own event stamped later —
+                # binary-search over the (already ordered) own walls.
+                lo, hi = 0, len(walls)
+                wall = event["wall"] or 0.0
+                while lo < hi:
+                    mid = (lo + hi) // 2
+                    if walls[mid] < wall:
+                        lo = mid + 1
+                    else:
+                        hi = mid
+                merged.insert(lo, event)
+                walls.insert(lo, wall)
+        return merged
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-ready body for ``/debug/incidentz``."""
+        return {
+            "capacity": self._events.maxlen,
+            "events_total": self.events_total,
+            "drills_total": self.drills_total,
+            "active": self.health_block(),
+            "events": self.events(),
+        }
+
+
+__all__ = ["IncidentTimeline", "TIMELINE_CAPACITY"]

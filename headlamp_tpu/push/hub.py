@@ -194,6 +194,16 @@ class BroadcastHub:
         self.evictions = 0
         self.resume_fallbacks = 0
         self.subscribed_total = 0
+        #: Eviction observers (ADR-030): invoked as
+        #: ``observer(reason, detail)`` from the single eviction point,
+        #: so the incident timeline and scenario assertions see every
+        #: ``bye`` the moment it is queued instead of scraping the
+        #: counter. Called while the subscription's condition is held —
+        #: observers must be cheap, must not touch hub state, and are
+        #: exception-absorbed (counted): a broken observer must never
+        #: lose the ``bye`` frame.
+        self.eviction_observers: list[Callable[[str, dict[str, Any]], None]] = []
+        self.observer_errors = 0
 
     def set_shed_check(self, shed_check: Callable[[], bool] | None) -> None:
         """(Re)wire the paging probe — called by the gateway when it
@@ -332,6 +342,14 @@ class BroadcastHub:
         sub.cond.notify_all()
         self.evictions += 1
         _EVICTIONS.inc(reason=reason)
+        for observer in list(self.eviction_observers):
+            try:
+                observer(
+                    reason,
+                    {"priority": sub.priority, "pages": sorted(sub.pages)},
+                )
+            except Exception:  # noqa: BLE001 — observers must never lose a bye
+                self.observer_errors += 1
 
     def shed_streams(self) -> int:
         """Close DEBUG-class streams while a request-backed SLO pages

@@ -17,6 +17,7 @@ from typing import Any, Callable
 
 from .obs.debug_pages import (
     generations_page,
+    incidents_page,
     profile_page,
     slo_page,
     traces_page,
@@ -248,6 +249,16 @@ def register_plugin(registry: Registry | None = None) -> Registry:
                 "debug-generations",
                 generations_page,
                 kind="generations",
+            ),
+            # Incident timeline (ADR-030): the drill/outage waterfall —
+            # injections, SLO flips, sheds, evictions, and leadership
+            # transitions in one ordered view. JSON twin is
+            # /debug/incidentz.
+            Route(
+                "/debug/incidentz/html",
+                "debug-incidents",
+                incidents_page,
+                kind="incidents",
             ),
         ]
     )

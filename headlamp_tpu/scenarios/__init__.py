@@ -1,0 +1,40 @@
+"""Incident scenario engine (ADR-030).
+
+Deterministic chaos drills: a declarative DSL (:mod:`.dsl`) scripts
+inject/hold/recover phases on injected clocks, fault injectors
+(:mod:`.inject`) break real seams, a runner (:mod:`.runner`) drives a
+real in-process app (or an ADR-025 leader+replica pair) through the
+drill recording an ADR-018 transcript, and response assertions
+(:mod:`.assertions`) gate what the observability stack must DO about
+each fault. The named drills live in :mod:`.catalog`; the merged
+incident timeline they narrate is served at ``/debug/incidentz``
+(:mod:`..obs.timeline`).
+"""
+
+from .catalog import SCENARIO_NAMES, all_scenarios, get_scenario
+from .dsl import (
+    Phase,
+    ScenarioAssertionError,
+    ScenarioError,
+    ScenarioSpec,
+)
+from .runner import (
+    ScenarioContext,
+    ScenarioReport,
+    ScenarioRunner,
+    run_scenario,
+)
+
+__all__ = [
+    "Phase",
+    "SCENARIO_NAMES",
+    "ScenarioAssertionError",
+    "ScenarioContext",
+    "ScenarioError",
+    "ScenarioReport",
+    "ScenarioRunner",
+    "ScenarioSpec",
+    "all_scenarios",
+    "get_scenario",
+    "run_scenario",
+]

@@ -1,0 +1,467 @@
+"""Scenario runner (ADR-030): drive a real app through a drill.
+
+The runner builds the SAME objects production serves with — a
+:class:`~..server.app.DashboardApp` over the demo fixture transport
+(plus, for ``read_tier`` specs, an ADR-025 leader/replica pair with
+real electors over a shared lease), a fresh ADR-016 SLO engine, an
+ADR-017 :class:`~..gateway.shed.ShedPolicy`, and the app's live push
+hub — then walks the spec's phases on scripted clocks, firing each
+phase's actions and a fixed per-tick traffic script through the
+admission path (``policy.decide`` → ``degraded_scope`` →
+``app.handle``; shed rulings synthesize the gateway's 503 without
+paying a render, exactly as the gateway would).
+
+Admission is driven directly rather than through
+:class:`~..gateway.gateway.RenderGateway` because the gateway's render
+pool is real threads — scheduling order would leak into the transcript.
+The policy ruling, the degraded contextvar scope, and the handler are
+the production code; only the thread hop is elided.
+
+Determinism (ADR-013/018): both clocks are scripted; the drill's entire
+request/ruling sequence is recorded through an ADR-018
+:class:`~..history.record.Recorder` onto those clocks, so two runs of
+one scenario produce byte-identical transcripts — pinned by
+``tests/test_scenarios.py`` and replayed by ``bench.py --scenario``.
+
+The engine swap: the app's metrics observers feed whatever
+``slo_mod.engine()`` returns, so the runner installs its scripted-clock
+engine via ``set_engine`` for the drill and restores the previous one
+in a ``finally`` — the same discipline the SLO tests use.
+
+``sabotage`` is the counterexample seam: tests pass a callable that
+breaks one policy (shed disabled, a hub that fabricates resume history,
+a wall-clocked staleness probe) after setup, proving each scenario
+assertion actually FIRES against the misbehavior it guards (the
+fires/clean discipline, ADR-015).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any, Callable, Mapping
+
+from ..gateway.gateway import RenderGateway
+from ..gateway.pool import PRIORITY_DEBUG, PRIORITY_INTERACTIVE
+from ..gateway.shed import ShedPolicy, degraded_scope
+from ..history.record import Recorder
+from ..obs import slo as slo_mod
+from ..obs.slo import SLOT_S, SLOEngine
+from ..obs.timeline import IncidentTimeline
+from .dsl import Phase, ScenarioAssertionError, ScenarioSpec
+from .inject import FaultTransport
+
+#: Fixed per-tick request script: two interactive paints, the metrics
+#: page, one debug surface, one ops surface — every priority class
+#: exercised every tick, so shed/degrade/untouchable all have evidence.
+DEFAULT_TRAFFIC: tuple[str, ...] = (
+    "/tpu",
+    "/tpu/metrics",
+    "/tpu",
+    "/debug/traces",
+    "/metricsz",
+)
+
+#: Read-tier traffic omits /tpu/metrics: a replica serves fleet pages
+#: from applied records; the Prometheus proxy lives with the leader.
+READ_TIER_TRAFFIC: tuple[str, ...] = (
+    "/tpu",
+    "/tpu",
+    "/debug/traces",
+    "/metricsz",
+)
+
+
+class ScriptedClock:
+    """Callable fake clock; actions advance it, nothing sleeps."""
+
+    def __init__(self, start: float) -> None:
+        self.now = float(start)
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> float:
+        self.now += float(dt)
+        return self.now
+
+
+class ScenarioReport:
+    """Everything a response assertion (or bench) reads off one run."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        #: ADR-018 JSONL transcript of the full request/ruling sequence.
+        self.transcript = ""
+        #: Incident timeline events (the /debug/incidentz view).
+        self.events: list[dict[str, Any]] = []
+        #: (mono, states) per tick — the SLO trajectory.
+        self.states_history: list[tuple[float, dict[str, str]]] = []
+        self.counters: dict[str, int] = {}
+        self.metrics: dict[str, Any] = {}
+        self.extra: dict[str, Any] = {}
+        #: ScenarioAssertionErrors from the spec's checks (empty = pass).
+        self.failures: list[ScenarioAssertionError] = []
+
+    @property
+    def passed(self) -> bool:
+        return not self.failures
+
+    def first_event(
+        self, source: str, kind: str, *, after: float | None = None
+    ) -> dict[str, Any] | None:
+        """Earliest timeline event matching (source, kind), optionally
+        at-or-after a monotonic stamp. Ledger-merged events carry
+        ``mono=None`` and never match an ``after`` filter."""
+        for event in self.events:
+            if event.get("source") != source or event.get("kind") != kind:
+                continue
+            if after is not None:
+                mono = event.get("mono")
+                if mono is None or mono < after:
+                    continue
+            return event
+        return None
+
+
+class ScenarioContext:
+    """Mutable drill state handed to every phase action. Holds the real
+    objects (app, engine, policy, hub accessor) plus the scripted
+    clocks and a ``faults`` scratchpad the injectors coordinate
+    through."""
+
+    def __init__(
+        self,
+        spec: ScenarioSpec,
+        *,
+        start_mono: float = 1_000.0,
+        start_wall: float = 1_700_000_000.0,
+    ) -> None:
+        from ..server.app import DashboardApp, make_demo_transport
+
+        self.spec = spec
+        self.mono = ScriptedClock(start_mono)
+        self.wall = ScriptedClock(start_wall)
+        self.faults: dict[str, Any] = {}
+        self.transport = FaultTransport(
+            make_demo_transport(), advance=self.mono.advance
+        )
+        self.app = DashboardApp(
+            self.transport, clock=self.wall, monotonic=self.mono
+        )
+        self.push = self.app.push
+        self.engine = SLOEngine(monotonic=self.mono)
+        self.policy = ShedPolicy(monotonic=self.mono)
+        self.timeline: IncidentTimeline = self.app.incidents
+        self.policy.observers.append(self.timeline.gateway_observer)
+        self.push.hub.eviction_observers.append(self.timeline.eviction_observer)
+        self.recorder = Recorder(
+            io.StringIO(),
+            monotonic=self.mono,
+            wall=self.wall,
+            note=f"scenario:{spec.name}",
+        )
+        # Per-priority accounting the assertions read.
+        self.counts = {
+            "interactive_total": 0,
+            "interactive_degraded": 0,
+            "debug_total": 0,
+            "debug_shed": 0,
+            "ops_total": 0,
+            "shed_503": 0,
+            "non_shed_5xx": 0,
+        }
+        self.replica: Any = None
+        self.leader_elector: Any = None
+        self.standby_elector: Any = None
+        if spec.read_tier:
+            self._build_read_tier()
+
+    def _build_read_tier(self) -> None:
+        from ..replicate.leader import LeaderElector, LeaseStore
+        from ..replicate.replica import ReplicaApp
+
+        self.replica = ReplicaApp(
+            clock=self.wall, monotonic=self.mono, stale_after_s=60.0
+        )
+        # The replica's timeline/ledger is the drill's: elector
+        # transitions from BOTH electors land in the ledger the
+        # /debug/incidentz merge reads (ADR-028's wall-merge rule).
+        self.timeline = self.replica.incidents
+        self.policy.observers = [self.timeline.gateway_observer]
+        self.policy.degraded_probe = self.replica.stale
+        store = LeaseStore(monotonic=self.mono)
+        self.leader_elector = LeaderElector(
+            store, "leader-0", ttl_s=600.0,
+            monotonic=self.mono, ledger=self.replica.ledger,
+        )
+        self.standby_elector = LeaderElector(
+            store, "replica-0", ttl_s=600.0,
+            monotonic=self.mono, ledger=self.replica.ledger,
+        )
+        self.leader_elector.tick()
+        # Prime the leader's snapshot (one real sync) and the replica's
+        # feed (one accepted record) so the drill starts healthy.
+        self.app.handle("/tpu")
+        self.publish_generation()
+
+    # -- accessors actions use -------------------------------------------
+
+    def hub(self) -> Any:
+        """The app's LIVE hub — re-read per call because the
+        hub-restart injector replaces it mid-drill."""
+        return self.push.hub
+
+    def inject(self, fault: str, detail: Mapping[str, Any] | None = None) -> None:
+        self.timeline.inject(self.spec.name, fault, detail)
+
+    def install_engine(self, engine: Any) -> None:
+        """Swap the drill's engine (the clock-skew counterexample
+        installs a wall-clocked one). Re-points the global accessor so
+        the app's observers and the policy follow."""
+        self.engine = engine
+        slo_mod.set_engine(engine)
+        self.policy.invalidate()
+
+    def publish_generation(self, *, fencing: int | None = None) -> bool:
+        """Build one generation record off the leader app's snapshot
+        and offer it to the replica, fenced into ``fencing``'s
+        generation band (default: the live lease holder's)."""
+        from ..replicate.bus import build_record
+        from ..replicate.leader import generation_floor
+
+        if fencing is None:
+            for elector in (self.standby_elector, self.leader_elector):
+                if elector is not None and elector.is_leader:
+                    fencing = elector.fencing
+                    break
+        fencing = int(fencing or 1)
+        seqs: dict[int, int] = self.faults.setdefault("pub_seq", {})
+        seqs[fencing] = seqs.get(fencing, 0) + 1
+        generation = generation_floor(fencing) + seqs[fencing]
+        record = build_record(
+            self.app._last_snapshot, generation=generation, fencing=fencing
+        )
+        return bool(self.replica.apply_record(record))
+
+    # -- driving ----------------------------------------------------------
+
+    def advance(self, dt: float) -> None:
+        self.mono.advance(dt)
+        self.wall.advance(dt)
+
+    def request(self, path: str) -> int:
+        """One request through the production admission path; the
+        ruling and status land in the transcript."""
+        target = self.replica if self.spec.read_tier else self.app
+        route = target._route_label(path)
+        priority = RenderGateway.classify(route)
+        decision = self.policy.decide(route, priority)
+        if priority == PRIORITY_INTERACTIVE:
+            self.counts["interactive_total"] += 1
+        elif priority == PRIORITY_DEBUG:
+            self.counts["debug_total"] += 1
+        else:
+            self.counts["ops_total"] += 1
+        if decision.shed:
+            # The gateway's shed response, without paying the render.
+            self.counts["debug_shed"] += 1
+            self.counts["shed_503"] += 1
+            self.recorder.record_ok(
+                path, {"status": 503, "shed": True, "degraded": False}
+            )
+            return 503
+        with degraded_scope(decision.degraded):
+            status, _ctype, _body = target.handle(path)
+        if decision.degraded:
+            self.counts["interactive_degraded"] += 1
+        if status >= 500:
+            self.counts["non_shed_5xx"] += 1
+        self.recorder.record_ok(
+            path,
+            {"status": status, "shed": False, "degraded": decision.degraded},
+        )
+        return status
+
+    def traffic(self) -> None:
+        script = self.spec.extra.get(
+            "traffic",
+            READ_TIER_TRAFFIC if self.spec.read_tier else DEFAULT_TRAFFIC,
+        )
+        for path in script:
+            self.request(path)
+
+    def sample(self) -> dict[str, str]:
+        """One observability sample: refresh the policy's view of the
+        engine (firing paging/restore observers) and diff SLO states
+        onto the timeline."""
+        states = dict(self.policy.states())
+        self.timeline.sample_slo(states)
+        return states
+
+
+class ScenarioRunner:
+    """Runs one spec: phases → ticks → report → checks."""
+
+    def __init__(
+        self,
+        spec: ScenarioSpec,
+        *,
+        sabotage: Callable[[ScenarioContext], None] | None = None,
+        start_mono: float = 1_000.0,
+        start_wall: float = 1_700_000_000.0,
+    ) -> None:
+        self.spec = spec
+        self.sabotage = sabotage
+        self.start_mono = start_mono
+        self.start_wall = start_wall
+
+    def run(self) -> ScenarioReport:
+        spec = self.spec
+        previous_engine = slo_mod.engine()
+        report = ScenarioReport(spec.name)
+        try:
+            ctx = ScenarioContext(
+                spec, start_mono=self.start_mono, start_wall=self.start_wall
+            )
+            slo_mod.set_engine(ctx.engine)
+            ctx.policy.invalidate()
+            if self.sabotage is not None:
+                self.sabotage(ctx)
+            ctx.timeline.begin_drill(spec.name)
+            for phase in spec.phases:
+                ctx.timeline.set_phase(phase.kind)
+                for action in phase.enter:
+                    action(ctx)
+                for _ in range(spec.ticks_in(phase)):
+                    for action in phase.tick:
+                        action(ctx)
+                    ctx.traffic()
+                    ctx.advance(spec.tick_s)
+                    report.states_history.append((ctx.mono(), ctx.sample()))
+            self._finalize(ctx, report)
+            for check in spec.checks:
+                try:
+                    check(report)
+                except ScenarioAssertionError as failure:
+                    report.failures.append(failure)
+            ctx.timeline.end_drill("passed" if report.passed else "failed")
+            report.events = ctx.timeline.events()
+        finally:
+            slo_mod.set_engine(previous_engine)
+        return report
+
+    def _finalize(self, ctx: ScenarioContext, report: ScenarioReport) -> None:
+        report.transcript = ctx.recorder._sink.getvalue()
+        report.counters = dict(ctx.counts)
+        report.events = ctx.timeline.events()
+        self._drain_subscribers(ctx, report)
+        if ctx.replica is not None:
+            report.extra["replica"] = {
+                "rejected_stale": ctx.replica.rejected_stale,
+                "stale": bool(ctx.replica.stale()),
+                "fencings": [
+                    t.get("fencing", 0)
+                    for t in ctx.replica.ledger.snapshot().get("transitions", [])
+                ],
+            }
+        report.extra["hub"] = ctx.hub().snapshot()
+        report.metrics.update(self._derive_metrics(ctx, report))
+
+    def _drain_subscribers(self, ctx: ScenarioContext, report: ScenarioReport) -> None:
+        herd = ctx.faults.get("herd") or []
+        if herd:
+            drained = []
+            hub = ctx.hub()
+            for sub in herd:
+                kinds: list[dict[str, Any]] = []
+                while True:
+                    event = hub.poll(sub)
+                    if event is None or event["kind"] == "heartbeat":
+                        break
+                    kinds.append(
+                        {"kind": event["kind"], "data": event.get("data", {})}
+                    )
+                drained.append(kinds)
+            report.extra["herd_events"] = drained
+            report.extra["resume_fallbacks"] = ctx.hub().resume_fallbacks
+        loris = ctx.faults.get("loris") or []
+        if loris:
+            report.extra["loris"] = [
+                {
+                    "evicted_reason": sub.evicted_reason,
+                    "outbox_kinds": [e["kind"] for e in sub.outbox],
+                }
+                for sub in loris
+            ]
+
+    def _derive_metrics(
+        self, ctx: ScenarioContext, report: ScenarioReport
+    ) -> dict[str, Any]:
+        counts = report.counters
+        first_inject = report.first_event("scenario", "inject")
+        first_page = report.first_event("gateway", "paging")
+        metrics: dict[str, Any] = {
+            "shed_rate_debug": (
+                counts["debug_shed"] / counts["debug_total"]
+                if counts["debug_total"]
+                else 0.0
+            ),
+            "stale_paint_rate": (
+                counts["interactive_degraded"] / counts["interactive_total"]
+                if counts["interactive_total"]
+                else 0.0
+            ),
+            "zero_5xx": counts["non_shed_5xx"] == 0,
+            "windows_to_page": None,
+            "recovery_windows": None,
+        }
+        if first_inject and first_page:
+            metrics["windows_to_page"] = round(
+                (first_page["mono"] - first_inject["mono"]) / SLOT_S, 2
+            )
+        recover = None
+        for event in report.events:
+            if (
+                event.get("source") == "scenario"
+                and event.get("kind") == "phase"
+                and event.get("detail", {}).get("phase") == "recover"
+            ):
+                recover = event
+                break
+        if recover is not None and recover.get("mono") is not None:
+            restore = report.first_event(
+                "gateway", "restore", after=recover["mono"]
+            )
+            if restore is not None:
+                metrics["recovery_windows"] = round(
+                    (restore["mono"] - recover["mono"]) / SLOT_S, 2
+                )
+        if report.states_history:
+            metrics["final_states"] = dict(report.states_history[-1][1])
+        return metrics
+
+
+def run_scenario(
+    spec: ScenarioSpec,
+    *,
+    sabotage: Callable[[ScenarioContext], None] | None = None,
+) -> ScenarioReport:
+    """Run one drill; raise its first failed check (tests and the bench
+    call this — a failing drill should fail loudly, with the scenario
+    and check names in the message)."""
+    report = ScenarioRunner(spec, sabotage=sabotage).run()
+    if report.failures:
+        raise report.failures[0]
+    return report
+
+
+__all__ = [
+    "DEFAULT_TRAFFIC",
+    "READ_TIER_TRAFFIC",
+    "ScenarioContext",
+    "ScenarioReport",
+    "ScenarioRunner",
+    "ScriptedClock",
+    "run_scenario",
+]

@@ -46,6 +46,7 @@ from ..obs import slo as slo_mod
 from ..obs.flight import flight_recorder, wide_event
 from ..obs.jaxcost import ledger as jax_ledger
 from ..obs.ledger import GenerationLedger
+from ..obs.timeline import IncidentTimeline
 from ..obs.metrics import registry as metrics_registry
 from ..obs.profiler import attribution, profiler
 from ..obs.propagate import parse_traceparent
@@ -143,6 +144,7 @@ def _runtime_health(
     replication: Any = None,
     fragments: Any = None,
     workers: Any = None,
+    scenarios: Any = None,
 ) -> dict[str, Any]:
     """Transfer-funnel, device-cache, transport-pool, and refresher
     counters for /healthz: how many blocking device_gets the process
@@ -201,6 +203,14 @@ def _runtime_health(
             # answered this probe — triage must not depend on which
             # process the kernel handed the socket to.
             out["workers"] = workers.snapshot()
+        if scenarios is not None:
+            # Incident-drill view (ADR-030): present ONLY while a drill
+            # is active — a probe reader must know the faults it is
+            # seeing are rehearsed; steady-state probes stay
+            # byte-stable against pre-ADR-030 expectations.
+            drill = scenarios.health_block()
+            if drill is not None:
+                out["scenarios"] = drill
         # Burn-rate states per declared SLO (ADR-016): the one-line
         # answer a probe reader wants before opening /sloz.
         out["slo"] = slo_mod.engine().health_block()
@@ -485,9 +495,16 @@ class DashboardApp:
         self.ledger = GenerationLedger(
             monotonic=monotonic, wall=clock, role="leader"
         )
+        #: Incident timeline (ADR-030): scenario injections, SLO state
+        #: flips, gateway shed/restore events, hub evictions, and the
+        #: ledger's leadership transitions merged into one ordered view
+        #: at /debug/incidentz. Always present; cheap when idle.
+        self.incidents = IncidentTimeline(monotonic=monotonic, wall=clock)
+        self.incidents.ledger = self.ledger
         self.push = PushPipeline(
             monotonic=monotonic, fragments=self.fragments, ledger=self.ledger
         )
+        self.push.hub.eviction_observers.append(self.incidents.eviction_observer)
         set_active_push(self.push)
         #: Read-tier hook (ADR-025). On a leader: a BusPublisher —
         #: _record_sync hands it every published generation, and
@@ -988,6 +1005,8 @@ class DashboardApp:
             "/debug/profilez/html",
             "/debug/generationz",
             "/debug/generationz/html",
+            "/debug/incidentz",
+            "/debug/incidentz/html",
         }
     )
 
@@ -1007,6 +1026,7 @@ class DashboardApp:
             "/debug/profilez",
             "/debug/profilez/folded",
             "/debug/generationz",
+            "/debug/incidentz",
             "/events",
         ):
             return route_path
@@ -1214,6 +1234,7 @@ class DashboardApp:
                             replication=self.replication,
                             fragments=self.fragments,
                             workers=self.workers,
+                            scenarios=self.incidents,
                         ),
                     }
                 )
@@ -1255,6 +1276,7 @@ class DashboardApp:
                         replication=self.replication,
                         fragments=self.fragments,
                         workers=self.workers,
+                        scenarios=self.incidents,
                     ),
                 }
             )
@@ -1315,6 +1337,13 @@ class DashboardApp:
             # breaches pinned past rotation, leadership transitions
             # interleaved. JSON twin of /debug/generationz/html.
             return 200, "application/json", json.dumps(self.ledger.snapshot())
+
+        if route_path == "/debug/incidentz":
+            # Incident timeline (ADR-030): scenario injections, SLO
+            # flips, shed/restore events, hub evictions, and leadership
+            # transitions in one ordered list. JSON twin of
+            # /debug/incidentz/html.
+            return 200, "application/json", json.dumps(self.incidents.snapshot())
 
         if route_path == "/debug/profilez":
             # Sampling-profiler state (ADR-019): counters, per-route
@@ -1488,6 +1517,11 @@ class DashboardApp:
                 # 028) — no cluster snapshot, so it paints even when
                 # the feed being debugged is the thing that is stale.
                 el = route.component(self.ledger.snapshot())
+            elif route.kind == "incidents":
+                # Incident timeline (ADR-030) — renders the merged
+                # event log alone, no cluster snapshot: mid-incident is
+                # exactly when this page must still paint.
+                el = route.component(self.incidents.snapshot())
             elif route.kind == "trends":
                 # Pure function of the store's windowed view (ADR-018):
                 # no snapshot, no sync — trends must paint even when
@@ -1634,6 +1668,11 @@ class DashboardApp:
             # snapshot gains the SSE connection registry, and the hub
             # sheds DEBUG-class streams off the same paging policy.
             self.gateway.attach_push(self.push)
+            # ADR-030: shed/degrade/paging/restore rulings land on the
+            # incident timeline through the observer seam.
+            self.gateway.shed_policy.observers.append(
+                self.incidents.gateway_observer
+            )
         return self.gateway
 
     def open_event_stream(self, path: str, *, last_event_id: str | None = None) -> Any:

@@ -121,13 +121,13 @@ class TestRegistration:
             "/intel/metrics",
         }
         native_paths = {"/nodes"}
-        # ADR-013/016/019/028: the trace waterfall, the SLO page, the
-        # profiler flame view, and the generation provenance timeline
-        # register as routes (styling + registry dispatch) but add no
-        # sidebar entry.
+        # ADR-013/016/019/028/030: the trace waterfall, the SLO page,
+        # the profiler flame view, the generation provenance timeline,
+        # and the incident timeline register as routes (styling +
+        # registry dispatch) but add no sidebar entry.
         debug_paths = {
             "/debug/traces/html", "/sloz/html", "/debug/profilez/html",
-            "/debug/generationz/html",
+            "/debug/generationz/html", "/debug/incidentz/html",
         }
         expected = tpu_paths | intel_paths | native_paths | debug_paths
         assert {r.path for r in reg.routes} == expected

@@ -239,6 +239,12 @@ class TestFormat:
             "headlamp_tpu_worker_generations_applied_total",
             "headlamp_tpu_worker_shm_attach_failures_total",
             "headlamp_tpu_worker_fallback_decodes_total",
+            # ADR-030 incident scenario engine: labeled counters, so
+            # they render no samples until a drill actually runs in
+            # this process — the scraped_app fixture never begins one.
+            "headlamp_tpu_scenario_injections_total",
+            "headlamp_tpu_scenario_timeline_events_total",
+            "headlamp_tpu_scenario_runs_total",
         }, f"unexpected sample-free families: {sorted(quiet)}"
 
     def test_name_grammar_and_unit_suffixes(self, exposition):
@@ -447,3 +453,52 @@ class TestCoverage:
             if n == "headlamp_tpu_slo_state_info"
         ]
         assert states and all(v == 1.0 for _, _, v in states)
+
+
+class TestScenarioDrill:
+    """ADR-030: the exposition stays strictly parseable MID-drill —
+    a drill is exactly when an operator scrapes hardest — and the
+    scenario families emit once injections actually happen."""
+
+    def test_metricsz_parses_during_active_drill(self):
+        app = DashboardApp(make_demo_transport("v5p32"), min_sync_interval_s=0.0)
+        app.incidents.begin_drill("metricsz_drill")
+        app.incidents.set_phase("inject")
+        app.incidents.inject("metricsz_drill", "transport_errors", {"on": True})
+        try:
+            status, ctype, body = app.handle("/metricsz")
+            assert status == 200 and ctype == "text/plain"
+            _, types, samples, _ = parse_exposition(body)
+            for family in (
+                "headlamp_tpu_scenario_injections_total",
+                "headlamp_tpu_scenario_timeline_events_total",
+                "headlamp_tpu_scenario_runs_total",
+            ):
+                assert types.get(family) == "counter", family
+            injections = {
+                (labels["scenario"], labels["fault"])
+                for n, labels, _ in samples
+                if n == "headlamp_tpu_scenario_injections_total"
+            }
+            assert ("metricsz_drill", "transport_errors") in injections
+            event_sources = {
+                labels["source"]
+                for n, labels, _ in samples
+                if n == "headlamp_tpu_scenario_timeline_events_total"
+            }
+            assert "scenario" in event_sources
+        finally:
+            app.incidents.end_drill("passed")
+
+    def test_runs_counter_emits_after_drill_completes(self):
+        app = DashboardApp(make_demo_transport("v5p32"), min_sync_interval_s=0.0)
+        app.incidents.begin_drill("metricsz_outcome_drill")
+        app.incidents.end_drill("passed")
+        _, _, body = app.handle("/metricsz")
+        _, _, samples, _ = parse_exposition(body)
+        runs = {
+            (labels["scenario"], labels["outcome"])
+            for n, labels, _ in samples
+            if n == "headlamp_tpu_scenario_runs_total"
+        }
+        assert ("metricsz_outcome_drill", "passed") in runs

@@ -34,9 +34,19 @@ class TestRepoIsClean:
         gateway = root / "headlamp_tpu" / "gateway"
         gateway.mkdir(parents=True)
         (gateway / "gateway.py").write_text("resp = self._app.handle('/tpu')\n")
+        # ADR-030: the scenario runner is a sanctioned admission layer
+        # (it drives policy.decide → handle itself); its siblings in
+        # scenarios/ stay gated.
+        scenarios = root / "headlamp_tpu" / "scenarios"
+        scenarios.mkdir(parents=True)
+        (scenarios / "runner.py").write_text("resp = target.handle(path)\n")
+        (scenarios / "inject.py").write_text("resp = ctx.app.handle('/tpu')\n")
         diags = checker.check_tree(str(root))
-        assert len(diags) == 1
-        assert diags[0].path.endswith("other.py")
+        assert len(diags) == 2
+        assert sorted(os.path.basename(d.path) for d in diags) == [
+            "inject.py",
+            "other.py",
+        ]
 
 
 class TestMutations:

@@ -133,16 +133,22 @@ class TestMutations:
         replicate = tmp_path / "headlamp_tpu" / "replicate"
         replicate.mkdir(parents=True)
         (replicate / "bad_lease.py").write_text("import time\nnow = time.time()\n")
+        # ADR-030: the scenario engine's phase/tick scheduling too — a
+        # wall-clock read anywhere in a drill breaks two-run replay.
+        scenarios = tmp_path / "headlamp_tpu" / "scenarios"
+        scenarios.mkdir(parents=True)
+        (scenarios / "bad_runner.py").write_text("import time\nnow = time.time()\n")
         outside = tmp_path / "headlamp_tpu" / "server"
         outside.mkdir(parents=True)
         (outside / "app.py").write_text("import time\nnow = time.time()\n")
         diags = check_tree(str(tmp_path))
-        assert len(diags) == 4
+        assert len(diags) == 5
         assert {os.path.basename(d.path) for d in diags} == {
             "bad.py",
             "bad_store.py",
             "bad_hub.py",
             "bad_lease.py",
+            "bad_runner.py",
         }
 
     def test_hub_heartbeat_on_wall_clock_flagged(self):
@@ -246,6 +252,33 @@ class TestMutations:
         )
         assert len(diags) == 1
         assert diags[0].line == 3
+
+    def test_scenario_phase_scheduling_on_wall_clock_flagged(self):
+        # The ADR-030 mistake the scenarios scope guards in runner.py:
+        # timing a drill phase on the wall clock — two runs of the same
+        # scenario would record different transcripts and the byte-parity
+        # replay pin could never hold.
+        diags = self._diags(
+            "import time\n"
+            "def _phase_elapsed(self):\n"
+            "    return time.time() - self._phase_start\n"
+        )
+        assert len(diags) == 1
+        assert diags[0].line == 3
+
+    def test_scenario_sanctioned_forms_allowed(self):
+        # The real ScenarioContext shape: a scripted clock advanced by
+        # the runner, wall strictly as a seam default handed to the
+        # recorder/timeline for display stamps.
+        diags = self._diags(
+            "import time\n"
+            "def __init__(self, *, monotonic=None, wall=time.time):\n"
+            "    self._mono = monotonic or time.monotonic\n"
+            "    self._wall = wall\n"
+            "def advance(self, dt):\n"
+            "    return self._mono() + dt\n"
+        )
+        assert diags == []
 
     def test_ledger_sanctioned_forms_allowed(self):
         # The real GenerationLedger shape: injected monotonic for every

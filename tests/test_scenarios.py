@@ -1,0 +1,294 @@
+"""Incident scenario engine (ADR-030): the fires/clean matrix.
+
+Three halves, per the ADR-015 discipline every gate in this repo
+follows:
+
+1. **Clean**: every named drill in the catalog passes against the live
+   tree — the stack actually pages within budget, sheds debug first,
+   answers resumes honestly, fences zombie leaders, and absorbs wall
+   skew.
+2. **Fires**: for each drill, a deliberately broken policy double —
+   shedding disabled, an engine that swallows pages, a hub that
+   fabricates resume history, an unbounded outbox, a wall-clocked
+   staleness probe, a generation-laundering replica — makes the drill's
+   signature assertion FAIL. A scenario that cannot fail proves
+   nothing.
+3. **Determinism**: two runs of one drill produce byte-identical
+   ADR-018 transcripts (scripted clocks end to end), pinning the replay
+   guarantee ``bench.py --scenario`` builds on.
+
+The per-drill smoke here is tier-1; the full two-round bench matrix is
+``-m slow`` (it shells out to bench.py and writes a record).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from headlamp_tpu.gateway.shed import Decision
+from headlamp_tpu.push.hub import BroadcastHub
+from headlamp_tpu.scenarios import (
+    SCENARIO_NAMES,
+    ScenarioRunner,
+    get_scenario,
+    run_scenario,
+)
+from headlamp_tpu.server import DashboardApp, make_demo_transport
+
+pytestmark = pytest.mark.scenario
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestCatalog:
+    def test_the_six_named_drills(self):
+        assert SCENARIO_NAMES == (
+            "preemption_wave",
+            "prom_flapping",
+            "hub_restart_herd",
+            "slow_loris_sse",
+            "clock_skew_scrape",
+            "leader_kill_mid_churn",
+        )
+
+    def test_unknown_name_lists_the_catalog(self):
+        with pytest.raises(KeyError, match="preemption_wave"):
+            get_scenario("nope")
+
+    def test_specs_are_fresh_per_call(self):
+        # Injectors keep per-run state on the context, but the spec
+        # objects themselves must not leak between runs either.
+        assert get_scenario("preemption_wave") is not get_scenario(
+            "preemption_wave"
+        )
+
+
+class TestCleanMatrix:
+    """Every drill green on the live tree — the smoke half of tier-1."""
+
+    @pytest.mark.parametrize("name", SCENARIO_NAMES)
+    def test_scenario_passes(self, name):
+        report = run_scenario(get_scenario(name))
+        assert report.passed
+        assert report.counters["non_shed_5xx"] == 0
+        # Every drill leaves a narratable timeline: start, at least one
+        # injection-or-phase mark, end.
+        kinds = [(e["source"], e["kind"]) for e in report.events]
+        assert ("scenario", "drill_start") in kinds
+        assert ("scenario", "drill_end") in kinds
+
+    def test_read_tier_drill_merges_elector_transitions(self):
+        report = run_scenario(get_scenario("leader_kill_mid_churn"))
+        sources = {e["source"] for e in report.events}
+        assert "elector" in sources, (
+            "leadership transitions from the ADR-028 ledger must "
+            "interleave into the incident timeline"
+        )
+
+
+class TestDeterminism:
+    """Scripted clocks end to end: replay is byte-exact."""
+
+    @pytest.mark.parametrize("name", ("preemption_wave", "leader_kill_mid_churn"))
+    def test_two_runs_byte_identical(self, name):
+        first = ScenarioRunner(get_scenario(name)).run()
+        second = ScenarioRunner(get_scenario(name)).run()
+        assert first.passed and second.passed
+        assert first.transcript, "drill recorded no transcript"
+        assert first.transcript == second.transcript
+        # And the transcript is real ADR-018 JSONL, not just equal noise.
+        lines = first.transcript.splitlines()
+        assert json.loads(lines[0])["note"] == f"scenario:{name}"
+        assert all(json.loads(line) for line in lines[1:])
+
+
+# -- the broken-policy doubles -------------------------------------------
+
+
+def _shedding_disabled(ctx):
+    """ADR-017 broken: admission never sheds (503-free gateway)."""
+    original = ctx.policy.decide
+
+    def decide(route, priority):
+        ruling = original(route, priority)
+        return Decision(
+            shed=False, degraded=ruling.degraded, burn_state=ruling.burn_state
+        )
+
+    ctx.policy.decide = decide
+
+
+def _paging_swallowed(ctx):
+    """ADR-016 broken: the engine reports burn but never 'page'."""
+    original = ctx.engine.health_block
+
+    def health_block():
+        return {
+            name: ("ok" if state == "page" else state)
+            for name, state in original().items()
+        }
+
+    ctx.engine.health_block = health_block
+    ctx.policy.invalidate()
+
+
+class _DishonestHub(BroadcastHub):
+    """ADR-021 broken: answers pre-restart resumes with fabricated
+    delta frames instead of the full-paint resync fallback."""
+
+    def _resume_events(self, sub, last_gen):
+        if last_gen is None:
+            return []
+        with self._lock:
+            current = self._last_generation
+        return [
+            {
+                "kind": "delta",
+                "id": f"g{current}",
+                "data": {"page": page, "generation": current, "ops": []},
+            }
+            for page in sorted(sub.pages)
+        ]
+
+
+def _fabricated_resume(ctx):
+    ctx.faults["hub_factory"] = _DishonestHub
+
+
+def _unbounded_outbox(ctx):
+    """ADR-021 broken: no outbox bound, so stalled consumers are never
+    evicted and buffer the process instead."""
+    ctx.hub().outbox_limit = 10**9
+
+
+def _wall_clocked_probe(ctx):
+    """ADR-013 broken: a staleness probe on the WALL clock — the
+    injected NTP step fakes 'stale' and degrades healthy paints."""
+    start = ctx.wall()
+    ctx.policy.degraded_probe = lambda: ctx.wall() - start > 600.0
+
+
+def _generation_laundering(ctx):
+    """ADR-025 broken: the replica rewrites every incoming record's
+    generation to snapshot+1, so zombie-leader writes always apply."""
+    replica = ctx.replica
+    original = replica.apply_record
+
+    def apply_record(record):
+        laundered = dict(record)
+        laundered["generation"] = replica.snapshot_generation() + 1
+        return original(laundered)
+
+    replica.apply_record = apply_record
+
+
+def _probe_disabled(ctx):
+    """ADR-025 broken: the replica claims freshness during the outage
+    (no degrade while the bus feed is silent)."""
+    ctx.policy.degraded_probe = lambda: False
+
+
+class TestFires:
+    """One counterexample per drill: the signature assertion must trip
+    against the double that breaks exactly the policy it guards."""
+
+    CASES = [
+        ("preemption_wave", _shedding_disabled, "debug_sheds_first"),
+        ("prom_flapping", _paging_swallowed, "pages_within"),
+        ("hub_restart_herd", _fabricated_resume, "hub_honest"),
+        ("slow_loris_sse", _unbounded_outbox, "slow_consumers_evicted"),
+        ("clock_skew_scrape", _wall_clocked_probe, "no_stale_paints"),
+        ("leader_kill_mid_churn", _generation_laundering, "failover"),
+        ("leader_kill_mid_churn", _probe_disabled, "stale_paints_during_outage"),
+    ]
+
+    @pytest.mark.parametrize(
+        "name,sabotage,expected_check",
+        CASES,
+        ids=[f"{n}-{c}" for n, _, c in CASES],
+    )
+    def test_assertion_fires_against_broken_double(
+        self, name, sabotage, expected_check
+    ):
+        report = ScenarioRunner(get_scenario(name), sabotage=sabotage).run()
+        assert not report.passed
+        tripped = {failure.check for failure in report.failures}
+        assert expected_check in tripped, (
+            f"{name}: expected check {expected_check!r} to fire, "
+            f"tripped: {sorted(tripped)}"
+        )
+        # The drill's outcome is recorded honestly on the timeline too.
+        end = report.first_event("scenario", "drill_end")
+        assert end is not None and end["detail"]["outcome"] == "failed"
+
+
+class TestHttpSurfaces:
+    """The operator-facing halves: /healthz only during a drill, the
+    /debug/incidentz twins always."""
+
+    def _app(self):
+        return DashboardApp(make_demo_transport("v5p32"), min_sync_interval_s=0.0)
+
+    def test_healthz_scenarios_block_only_during_drill(self):
+        app = self._app()
+        before = json.loads(app.handle("/healthz")[2])
+        assert "scenarios" not in before["runtime"]
+        app.incidents.begin_drill("healthz_drill")
+        app.incidents.set_phase("inject")
+        app.incidents.inject("healthz_drill", "transport_errors", {})
+        during = json.loads(app.handle("/healthz")[2])
+        block = during["runtime"]["scenarios"]
+        assert block["active"] == "healthz_drill"
+        assert block["phase"] == "inject"
+        assert block["injections"] == 1
+        app.incidents.end_drill("passed")
+        after = json.loads(app.handle("/healthz")[2])
+        assert "scenarios" not in after["runtime"]
+
+    def test_incidentz_json_snapshot(self):
+        app = self._app()
+        app.incidents.begin_drill("incidentz_drill")
+        app.incidents.inject("incidentz_drill", "clock_skew", {"step_s": 3600.0})
+        app.incidents.end_drill("passed")
+        status, ctype, body = app.handle("/debug/incidentz")
+        assert status == 200 and ctype == "application/json"
+        snap = json.loads(body)
+        assert snap["active"] is None
+        kinds = [(e["source"], e["kind"]) for e in snap["events"]]
+        assert ("scenario", "inject") in kinds
+        assert ("scenario", "drill_end") in kinds
+
+    def test_incidentz_html_waterfall(self):
+        app = self._app()
+        app.incidents.begin_drill("waterfall_drill")
+        app.incidents.inject("waterfall_drill", "slow_loris", {})
+        status, _, body = app.handle("/debug/incidentz/html")
+        assert status == 200
+        assert "Incident Timeline" in body
+        assert "DRILL ACTIVE" in body
+        app.incidents.end_drill("passed")
+
+
+@pytest.mark.slow
+def test_full_matrix_via_bench_replays_identically(tmp_path):
+    """The acceptance gate end to end: ``bench.py --scenario all`` runs
+    every drill twice and both replay rounds must be byte-identical
+    (exit 0 only when every drill passes AND replays exactly)."""
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--scenario", "all"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    record = json.loads(proc.stdout.splitlines()[-1])
+    extra = record["extra"]
+    assert extra["scenario_matrix_passed_rate"] == 1.0
+    assert extra["scenario_matrix_replay_identical_rate"] == 1.0

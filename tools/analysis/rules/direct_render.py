@@ -38,6 +38,11 @@ class DirectRenderRule(Rule):
     )
     exempt_files = (
         "headlamp_tpu/server/app.py",
+        # The ADR-030 scenario runner drives policy.decide →
+        # degraded_scope → app.handle itself: it IS an admission layer
+        # (the gateway minus the thread pool, elided so scheduling
+        # order cannot leak into the deterministic drill transcript).
+        "headlamp_tpu/scenarios/runner.py",
         "tools/make_screenshots.py",
     )
 

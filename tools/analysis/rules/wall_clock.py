@@ -45,6 +45,7 @@ class WallClockRule(Rule):
         "headlamp_tpu/push",
         "headlamp_tpu/replicate",
         "headlamp_tpu/runtime",
+        "headlamp_tpu/scenarios",
         "headlamp_tpu/transport",
         "headlamp_tpu/workers",
     )
